@@ -1,0 +1,96 @@
+#!/usr/bin/env python
+"""Driver benchmark: SSD→TPU-HBM sustained bandwidth vs raw NVMe read bandwidth.
+
+Prints ONE JSON line:
+  {"metric": "ssd2hbm_bandwidth", "value": <GB/s delivered into device memory>,
+   "unit": "GB/s", "vs_baseline": <fraction of raw O_DIRECT read bandwidth>}
+
+"vs_baseline" is the BASELINE.json:5 north-star ratio (target >= 0.90): raw
+bandwidth is measured first with the strom-bench nvme config (O_DIRECT
+sequential, 128KiB blocks -> host RAM, = utils/nvme_test / BASELINE config #1),
+then the same bytes are delivered end-to-end into device memory through
+memcpy_ssd2tpu with async prefetch.
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--size", type=int, default=int(os.environ.get("STROM_BENCH_BYTES", 1 << 30)))
+    ap.add_argument("--chunk", type=int, default=64 * 1024 * 1024)
+    ap.add_argument("--prefetch", type=int, default=4)
+    ap.add_argument("--tmpdir", default=os.environ.get("STROM_BENCH_DIR", "/tmp"))
+    args = ap.parse_args()
+
+    import jax
+
+    from strom.cli import _drop_cache_hint, _mk_testfile
+    from strom.config import StromConfig
+    from strom.delivery.buffers import alloc_aligned
+    from strom.delivery.core import StromContext
+    from strom.engine import make_engine
+
+    path = os.path.join(args.tmpdir, "strom_bench_nvme.bin")
+    if not os.path.exists(path) or os.path.getsize(path) < args.size:
+        print(f"generating {args.size >> 20} MiB benchmark file...", file=sys.stderr)
+        _mk_testfile(path, args.size)
+    size = args.size // args.chunk * args.chunk
+
+    cfg = StromConfig(queue_depth=32, num_buffers=64)
+
+    # --- denominator: raw O_DIRECT sequential read -> host RAM (config #1) ---
+    raw_gbps = 0.0
+    for _ in range(2):
+        _drop_cache_hint(path)
+        eng = make_engine(cfg)
+        fi = eng.register_file(path, o_direct=True)
+        dest = alloc_aligned(size)
+        t0 = time.perf_counter()
+        n = eng.read_into_direct(fi, 0, size, dest)
+        dt = time.perf_counter() - t0
+        eng.close()
+        assert n == size
+        raw_gbps = max(raw_gbps, size / dt / 1e9)
+    print(f"raw O_DIRECT read: {raw_gbps:.3f} GB/s", file=sys.stderr)
+
+    # --- numerator: delivered into device memory via async memcpy_ssd2tpu ---
+    dev = jax.devices()[0]
+    print(f"device: {dev}", file=sys.stderr)
+    s2t_gbps = 0.0
+    for _ in range(2):
+        _drop_cache_hint(path)
+        ctx = StromContext(cfg)
+        ctx.memcpy_ssd2tpu(path, length=args.chunk, device=dev).block_until_ready()
+        _drop_cache_hint(path)
+        inflight, delivered = [], []
+        t0 = time.perf_counter()
+        for i in range(size // args.chunk):
+            inflight.append(ctx.memcpy_ssd2tpu(path, offset=i * args.chunk,
+                                               length=args.chunk, device=dev,
+                                               async_=True))
+            if len(inflight) > args.prefetch:
+                delivered.append(inflight.pop(0).result())
+        delivered.extend(h.result() for h in inflight)
+        for a in delivered:
+            a.block_until_ready()
+        dt = time.perf_counter() - t0
+        ctx.close()
+        s2t_gbps = max(s2t_gbps, size / dt / 1e9)
+    print(f"ssd2tpu delivered: {s2t_gbps:.3f} GB/s", file=sys.stderr)
+
+    print(json.dumps({
+        "metric": "ssd2hbm_bandwidth",
+        "value": round(s2t_gbps, 4),
+        "unit": "GB/s",
+        "vs_baseline": round(s2t_gbps / raw_gbps, 4) if raw_gbps else 0.0,
+    }))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,88 @@
+"""strom — a TPU-native storage→accelerator data-path framework.
+
+Rebuild of nvme-strom's capability surface (SSD-to-GPU Direct DMA) for
+JAX/XLA on TPU (see SURVEY.md; the reference mount was empty — SURVEY.md §0 —
+so parity is against the behavioral contract reconstructed there and in
+BASELINE.json).  API ≙ the reference's ioctl contract (SURVEY.md §7.1):
+
+=============================  ==========================================
+reference (ioctl ABI)          strom (this module)
+=============================  ==========================================
+STROM_IOCTL__CHECK_FILE        strom.check_file(path)
+STROM_IOCTL__MAP_GPU_MEMORY    strom.init(config) / engine staging pool
+STROM_IOCTL__LIST/INFO...      strom.buffer_info()
+STROM_IOCTL__MEMCPY_SSD2GPU    strom.memcpy_ssd2tpu(..., async_=False)
+  ..._ASYNC                    strom.memcpy_ssd2tpu(..., async_=True)
+STROM_IOCTL__MEMCPY_WAIT       DMAHandle.wait() / .result()
+/proc/nvme-strom               strom.stats() / strom.prometheus()
+=============================  ==========================================
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any
+
+from strom.config import DEFAULT_CONFIG, StromConfig  # noqa: F401
+from strom.delivery.core import StripedFile, StromContext  # noqa: F401
+from strom.delivery.handle import DMAHandle  # noqa: F401
+from strom.delivery.prefetch import Prefetcher  # noqa: F401
+from strom.probe.check import FileReport, PathTier, check_file  # noqa: F401
+
+__version__ = "0.1.0"
+
+_ctx: StromContext | None = None
+_ctx_lock = threading.Lock()
+
+
+def init(config: StromConfig | None = None) -> StromContext:
+    """Initialise (or re-initialise) the process-wide context: allocates and
+    registers the pinned staging pool, starts the engine.  ≙ MAP_GPU_MEMORY."""
+    global _ctx
+    with _ctx_lock:
+        if _ctx is not None:
+            _ctx.close()
+        _ctx = StromContext(config)
+        return _ctx
+
+
+def context() -> StromContext:
+    global _ctx
+    with _ctx_lock:
+        if _ctx is None:
+            _ctx = StromContext()
+        return _ctx
+
+
+def memcpy_ssd2tpu(source: str | StripedFile, **kwargs: Any):
+    """Read a byte range / array from NVMe and deliver it to TPU. See
+    StromContext.memcpy_ssd2tpu for arguments."""
+    return context().memcpy_ssd2tpu(source, **kwargs)
+
+
+def memcpy_wait(handle: DMAHandle, timeout: float | None = None):
+    """Block until an async copy retires; returns the delivered array.
+    ≙ STROM_IOCTL__MEMCPY_WAIT."""
+    return handle.result(timeout)
+
+
+def buffer_info() -> dict:
+    return context().buffer_info()
+
+
+def stats() -> dict:
+    return context().stats()
+
+
+def prometheus() -> str:
+    from strom.utils.stats import global_stats
+
+    return global_stats.prometheus()
+
+
+def close() -> None:
+    global _ctx
+    with _ctx_lock:
+        if _ctx is not None:
+            _ctx.close()
+            _ctx = None

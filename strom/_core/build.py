@@ -1,0 +1,53 @@
+"""Build helper for libstrom_core.so — compiles on first import if missing or
+stale (source newer than the .so). Kept out of setup.py so the engine works
+from a plain git checkout with no install step."""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import threading
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_DIR, "strom_core.cpp")
+_LOCK = threading.Lock()
+
+
+def lib_path(variant: str = "") -> str:
+    suffix = f"_{variant}" if variant else ""
+    return os.path.join(_DIR, f"libstrom_core{suffix}.so")
+
+
+def ensure_built(variant: str = "") -> str:
+    """Return path to the built .so, compiling if needed. Raises RuntimeError
+    with the compiler output on failure.
+
+    Cross-process safe: compiles to a tmp file and rename()s into place under
+    an flock, so a concurrent dlopen never sees a half-written object."""
+    import fcntl
+
+    so = lib_path(variant)
+    with _LOCK:
+        if os.path.exists(so) and os.path.getmtime(so) >= os.path.getmtime(_SRC):
+            return so
+        lock_file = so + ".lock"
+        with open(lock_file, "w") as lf:
+            fcntl.flock(lf, fcntl.LOCK_EX)
+            try:
+                if os.path.exists(so) and os.path.getmtime(so) >= os.path.getmtime(_SRC):
+                    return so  # another process built it while we waited
+                flags = ["-O2", "-std=c++17", "-fPIC", "-Wall", "-Wextra", "-pthread"]
+                if variant == "tsan":
+                    flags = ["-O1", "-g", "-std=c++17", "-fPIC", "-pthread", "-fsanitize=thread"]
+                elif variant == "asan":
+                    flags = ["-O1", "-g", "-std=c++17", "-fPIC", "-pthread", "-fsanitize=address"]
+                tmp = f"{so}.tmp.{os.getpid()}"
+                cmd = ["g++", *flags, "-shared", "-o", tmp, _SRC]
+                proc = subprocess.run(cmd, capture_output=True, text=True)
+                if proc.returncode != 0:
+                    raise RuntimeError(
+                        f"failed to build strom_core ({' '.join(cmd)}):\n{proc.stderr}")
+                os.rename(tmp, so)
+                return so
+            finally:
+                fcntl.flock(lf, fcntl.LOCK_UN)

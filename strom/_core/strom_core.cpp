@@ -1,0 +1,637 @@
+// strom_core — C++ io_uring read engine for strom-tpu.
+//
+// TPU-native counterpart of the reference's kernel-side DMA submit engine +
+// async completion path (SURVEY.md §2.1 "DMA submit engine", "Async
+// completion / WAIT"; §2.2 native-code obligations; reference cite UNVERIFIED
+// — the reference mount was empty, SURVEY.md §0). Where nvme_strom.ko builds
+// NVMe READ requests on blk-mq queues whose PRPs point at pinned GPU BAR1
+// pages, strom_core issues O_DIRECT reads through io_uring into a pinned,
+// buffer-registered staging pool that the Python layer hands zero-copy to the
+// XLA runtime for host->HBM DMA.
+//
+// Deliberately liburing-free: the ring ABI is set up with raw syscalls so the
+// engine builds on any box with <linux/io_uring.h> kernel headers.
+//
+// C ABI (consumed by strom/engine/uring_engine.py via ctypes):
+//   sc_create / sc_destroy               — pool + ring lifecycle (≙ MAP/UNMAP_GPU_MEMORY)
+//   sc_register_file / sc_unregister_file— dual-fd (direct+buffered) file table
+//   sc_submit_read                       — queue one read      (≙ MEMCPY_SSD2GPU_ASYNC)
+//   sc_wait                              — reap completions    (≙ MEMCPY_WAIT)
+//   sc_get_stats                         — counters + latency histogram (≙ /proc/nvme-strom)
+//   sc_set_fault_every                   — fault injection for tests
+
+#include <atomic>
+#include <cerrno>
+#include <cstdint>
+#include <cstring>
+#include <mutex>
+
+#include <fcntl.h>
+#include <linux/io_uring.h>
+#include <linux/stat.h>
+#include <linux/time_types.h>
+#include <sys/mman.h>
+#include <sys/stat.h>
+#include <sys/syscall.h>
+#include <sys/uio.h>
+#include <time.h>
+#include <unistd.h>
+
+namespace {
+
+// ---------------------------------------------------------------- syscalls
+int sys_io_uring_setup(unsigned entries, struct io_uring_params *p) {
+  return (int)syscall(__NR_io_uring_setup, entries, p);
+}
+int sys_io_uring_enter(int fd, unsigned to_submit, unsigned min_complete,
+                       unsigned flags, const void *arg, size_t argsz) {
+  return (int)syscall(__NR_io_uring_enter, fd, to_submit, min_complete, flags,
+                      arg, argsz);
+}
+int sys_io_uring_register(int fd, unsigned opcode, const void *arg,
+                          unsigned nr_args) {
+  return (int)syscall(__NR_io_uring_register, fd, opcode, arg, nr_args);
+}
+
+#ifndef STATX_DIOALIGN
+#define STATX_DIOALIGN 0x00002000U
+#endif
+
+uint64_t now_ns() {
+  struct timespec ts;
+  clock_gettime(CLOCK_MONOTONIC, &ts);
+  return (uint64_t)ts.tv_sec * 1000000000ull + (uint64_t)ts.tv_nsec;
+}
+
+constexpr uint32_t kMaxFiles = 1024;
+constexpr int kHistBuckets = 24;  // log2 us buckets: 1us .. ~8s
+
+struct FileEntry {
+  int fd = -1;           // preferred fd (O_DIRECT when available)
+  int fd_buffered = -1;  // page-cache fd for unaligned/tail fallback
+  uint32_t mem_align = 4096;
+  uint32_t offset_align = 4096;
+  bool o_direct = false;
+  bool in_use = false;
+};
+
+struct OpSlot {
+  uint64_t tag = 0;
+  uint64_t submit_ns = 0;
+  uint64_t offset = 0;
+  uint8_t *addr = nullptr;  // destination (pool slot or caller slab)
+  uint32_t length = 0;
+  int32_t file_index = -1;
+  bool in_use = false;
+};
+
+}  // namespace
+
+extern "C" {
+
+struct sc_completion {
+  uint64_t tag;
+  int64_t res;  // bytes read (>=0) or -errno
+};
+
+struct sc_stats {
+  uint64_t ops_submitted;
+  uint64_t ops_completed;
+  uint64_t ops_errored;
+  uint64_t ops_faulted;
+  uint64_t bytes_read;
+  uint64_t unaligned_fallback_reads;
+  uint64_t eof_topup_reads;
+  uint64_t lat_count;
+  uint64_t lat_total_us;
+  uint64_t lat_hist[kHistBuckets];
+  uint32_t in_flight;
+  uint8_t fixed_buffers;  // 1 if IORING_REGISTER_BUFFERS active
+  uint8_t fixed_files;    // 1 if IORING_REGISTER_FILES active
+  uint8_t mlocked;        // 1 if pool mlock succeeded
+};
+
+struct sc_engine {
+  // ring
+  int ring_fd = -1;
+  struct io_uring_params params {};
+  uint8_t *sq_ring = nullptr;
+  size_t sq_ring_sz = 0;
+  uint8_t *cq_ring = nullptr;
+  size_t cq_ring_sz = 0;
+  struct io_uring_sqe *sqes = nullptr;
+  size_t sqes_sz = 0;
+  // SQ pointers
+  std::atomic<uint32_t> *sq_head = nullptr;
+  std::atomic<uint32_t> *sq_tail = nullptr;
+  uint32_t sq_mask = 0;
+  uint32_t *sq_array = nullptr;
+  // CQ pointers
+  std::atomic<uint32_t> *cq_head = nullptr;
+  std::atomic<uint32_t> *cq_tail = nullptr;
+  uint32_t cq_mask = 0;
+  struct io_uring_cqe *cqes = nullptr;
+
+  // staging pool
+  uint8_t *pool = nullptr;
+  size_t pool_sz = 0;
+  uint32_t num_buffers = 0;
+  uint64_t buffer_size = 0;
+
+  uint32_t queue_depth = 0;
+  bool fixed_buffers = false;
+  bool fixed_files = false;
+  bool mlocked = false;
+  bool has_ext_arg = false;  // IORING_FEAT_EXT_ARG (timed waits); 5.11+
+
+  FileEntry files[kMaxFiles];
+  std::mutex files_mu;
+
+  OpSlot *slots = nullptr;  // queue_depth entries; user_data = slot index
+  uint32_t *free_slots = nullptr;
+  uint32_t n_free = 0;
+  std::mutex sq_mu;
+
+  std::mutex cq_mu;
+  // synthetic completions (fault injection) drained by sc_wait
+  sc_completion *synthetic = nullptr;
+  uint32_t n_synthetic = 0;
+
+  std::atomic<uint32_t> in_flight{0};
+  std::atomic<uint64_t> fault_every{0};
+  std::atomic<uint64_t> op_counter{0};
+
+  // stats
+  std::atomic<uint64_t> ops_submitted{0}, ops_completed{0}, ops_errored{0},
+      ops_faulted{0}, bytes_read{0}, unaligned_fallback{0}, eof_topup{0},
+      lat_count{0}, lat_total_us{0};
+  std::atomic<uint64_t> lat_hist[kHistBuckets]{};
+};
+
+static void record_latency(sc_engine *e, uint64_t us) {
+  int b = 0;
+  uint64_t v = us;
+  while (v > 1 && b < kHistBuckets - 1) {
+    v >>= 1;
+    ++b;
+  }
+  e->lat_hist[b].fetch_add(1, std::memory_order_relaxed);
+  e->lat_count.fetch_add(1, std::memory_order_relaxed);
+  e->lat_total_us.fetch_add(us, std::memory_order_relaxed);
+}
+
+// flags bit0: mlock pool; bit1: register buffers; bit2: register files
+sc_engine *sc_create(uint32_t queue_depth, uint32_t num_buffers,
+                     uint64_t buffer_size, uint32_t flags) {
+  if (queue_depth == 0 || num_buffers == 0 || buffer_size == 0) {
+    errno = EINVAL;
+    return nullptr;
+  }
+  sc_engine *e = new sc_engine();
+  e->queue_depth = queue_depth;
+  e->num_buffers = num_buffers;
+  e->buffer_size = buffer_size;
+  e->pool_sz = (size_t)num_buffers * buffer_size;
+
+  e->pool = (uint8_t *)mmap(nullptr, e->pool_sz, PROT_READ | PROT_WRITE,
+                            MAP_PRIVATE | MAP_ANONYMOUS, -1, 0);
+  if (e->pool == MAP_FAILED) {
+    e->pool = nullptr;
+    delete e;
+    return nullptr;
+  }
+  if (flags & 1u) e->mlocked = (mlock(e->pool, e->pool_sz) == 0);
+
+  memset(&e->params, 0, sizeof(e->params));
+  e->ring_fd = sys_io_uring_setup(queue_depth, &e->params);
+  if (e->ring_fd < 0) {
+    munmap(e->pool, e->pool_sz);
+    e->pool = nullptr;
+    delete e;
+    return nullptr;
+  }
+
+  // map SQ/CQ rings (+ SINGLE_MMAP handling) and the SQE array
+  e->sq_ring_sz = e->params.sq_off.array + e->params.sq_entries * sizeof(uint32_t);
+  e->cq_ring_sz =
+      e->params.cq_off.cqes + e->params.cq_entries * sizeof(struct io_uring_cqe);
+  if (e->params.features & IORING_FEAT_SINGLE_MMAP) {
+    size_t sz = e->sq_ring_sz > e->cq_ring_sz ? e->sq_ring_sz : e->cq_ring_sz;
+    e->sq_ring_sz = e->cq_ring_sz = sz;
+  }
+  e->sq_ring = (uint8_t *)mmap(nullptr, e->sq_ring_sz, PROT_READ | PROT_WRITE,
+                               MAP_SHARED | MAP_POPULATE, e->ring_fd,
+                               IORING_OFF_SQ_RING);
+  if (e->sq_ring == MAP_FAILED) goto fail;
+  if (e->params.features & IORING_FEAT_SINGLE_MMAP) {
+    e->cq_ring = e->sq_ring;
+  } else {
+    e->cq_ring = (uint8_t *)mmap(nullptr, e->cq_ring_sz, PROT_READ | PROT_WRITE,
+                                 MAP_SHARED | MAP_POPULATE, e->ring_fd,
+                                 IORING_OFF_CQ_RING);
+    if (e->cq_ring == MAP_FAILED) goto fail;
+  }
+  e->sqes_sz = e->params.sq_entries * sizeof(struct io_uring_sqe);
+  e->sqes = (struct io_uring_sqe *)mmap(nullptr, e->sqes_sz,
+                                        PROT_READ | PROT_WRITE,
+                                        MAP_SHARED | MAP_POPULATE, e->ring_fd,
+                                        IORING_OFF_SQES);
+  if (e->sqes == MAP_FAILED) goto fail;
+
+  e->sq_head = (std::atomic<uint32_t> *)(e->sq_ring + e->params.sq_off.head);
+  e->sq_tail = (std::atomic<uint32_t> *)(e->sq_ring + e->params.sq_off.tail);
+  e->sq_mask = *(uint32_t *)(e->sq_ring + e->params.sq_off.ring_mask);
+  e->sq_array = (uint32_t *)(e->sq_ring + e->params.sq_off.array);
+  e->cq_head = (std::atomic<uint32_t> *)(e->cq_ring + e->params.cq_off.head);
+  e->cq_tail = (std::atomic<uint32_t> *)(e->cq_ring + e->params.cq_off.tail);
+  e->cq_mask = *(uint32_t *)(e->cq_ring + e->params.cq_off.ring_mask);
+  e->cqes = (struct io_uring_cqe *)(e->cq_ring + e->params.cq_off.cqes);
+
+  if (flags & 2u) {
+    struct iovec *iovs = new struct iovec[num_buffers];
+    for (uint32_t i = 0; i < num_buffers; ++i) {
+      iovs[i].iov_base = e->pool + (size_t)i * buffer_size;
+      iovs[i].iov_len = buffer_size;
+    }
+    e->fixed_buffers = (sys_io_uring_register(e->ring_fd,
+                                              IORING_REGISTER_BUFFERS, iovs,
+                                              num_buffers) == 0);
+    delete[] iovs;
+  }
+  if (flags & 4u) {
+    // sparse fixed-file table; slots filled by sc_register_file
+    int *fds = new int[kMaxFiles];
+    for (uint32_t i = 0; i < kMaxFiles; ++i) fds[i] = -1;
+    e->fixed_files = (sys_io_uring_register(e->ring_fd, IORING_REGISTER_FILES,
+                                            fds, kMaxFiles) == 0);
+    delete[] fds;
+  }
+
+#ifdef IORING_FEAT_EXT_ARG
+  e->has_ext_arg = (e->params.features & IORING_FEAT_EXT_ARG) != 0;
+#endif
+  e->slots = new OpSlot[queue_depth];
+  e->free_slots = new uint32_t[queue_depth];
+  for (uint32_t i = 0; i < queue_depth; ++i) e->free_slots[i] = queue_depth - 1 - i;
+  e->n_free = queue_depth;
+  e->synthetic = new sc_completion[queue_depth];
+  e->n_synthetic = 0;
+  return e;
+
+fail : {
+  int saved = errno;
+  if (e->sqes && e->sqes != MAP_FAILED) munmap(e->sqes, e->sqes_sz);
+  if (e->cq_ring && e->cq_ring != MAP_FAILED && e->cq_ring != e->sq_ring)
+    munmap(e->cq_ring, e->cq_ring_sz);
+  if (e->sq_ring && e->sq_ring != MAP_FAILED) munmap(e->sq_ring, e->sq_ring_sz);
+  close(e->ring_fd);
+  munmap(e->pool, e->pool_sz);
+  delete e;
+  errno = saved;
+  return nullptr;
+}
+}
+
+void sc_destroy(sc_engine *e) {
+  if (!e) return;
+  for (uint32_t i = 0; i < kMaxFiles; ++i) {
+    if (e->files[i].in_use) {
+      close(e->files[i].fd);
+      close(e->files[i].fd_buffered);
+    }
+  }
+  if (e->sqes) munmap(e->sqes, e->sqes_sz);
+  if (e->cq_ring && e->cq_ring != e->sq_ring) munmap(e->cq_ring, e->cq_ring_sz);
+  if (e->sq_ring) munmap(e->sq_ring, e->sq_ring_sz);
+  if (e->ring_fd >= 0) close(e->ring_fd);
+  if (e->pool) munmap(e->pool, e->pool_sz);
+  delete[] e->slots;
+  delete[] e->free_slots;
+  delete[] e->synthetic;
+  delete e;
+}
+
+void *sc_pool_base(sc_engine *e) { return e->pool; }
+
+// o_direct: 0 = buffered, 1 = required (else fall back), 2 = auto
+int sc_register_file(sc_engine *e, const char *path, int o_direct) {
+  int fd_buf = open(path, O_RDONLY | O_CLOEXEC);
+  if (fd_buf < 0) return -errno;
+
+  uint32_t mem_align = 4096, offset_align = 4096;
+  bool dio_known = false, dio_ok = true;
+  {
+    struct statx stx;
+    memset(&stx, 0, sizeof(stx));
+    if (syscall(__NR_statx, AT_FDCWD, path, 0, STATX_DIOALIGN, &stx) == 0 &&
+        (stx.stx_mask & STATX_DIOALIGN)) {
+      dio_known = true;
+      if (stx.stx_dio_mem_align == 0 || stx.stx_dio_offset_align == 0) {
+        dio_ok = false;
+      } else {
+        mem_align = stx.stx_dio_mem_align;
+        offset_align = stx.stx_dio_offset_align;
+      }
+    }
+  }
+
+  int fd = -1;
+  bool use_direct = false;
+  if (o_direct != 0 && (!dio_known || dio_ok)) {
+    fd = open(path, O_RDONLY | O_DIRECT | O_CLOEXEC);
+    if (fd >= 0) use_direct = true;
+  }
+  if (fd < 0) {
+    fd = dup(fd_buf);
+    if (fd < 0) {
+      int err = -errno;
+      close(fd_buf);
+      return err;
+    }
+  }
+
+  std::lock_guard<std::mutex> g(e->files_mu);
+  for (uint32_t i = 0; i < kMaxFiles; ++i) {
+    if (!e->files[i].in_use) {
+      e->files[i] = FileEntry{fd, fd_buf, mem_align, offset_align, use_direct, true};
+      if (e->fixed_files) {
+        struct io_uring_files_update up;
+        memset(&up, 0, sizeof(up));
+        up.offset = i;
+        up.fds = (uint64_t)(uintptr_t)&fd;
+        if (sys_io_uring_register(e->ring_fd, IORING_REGISTER_FILES_UPDATE, &up,
+                                  1) < 0) {
+          e->fixed_files = false;  // degrade to plain fds for all ops
+        }
+      }
+      return (int)i;
+    }
+  }
+  close(fd);
+  close(fd_buf);
+  return -ENFILE;
+}
+
+int sc_unregister_file(sc_engine *e, int file_index) {
+  if (file_index < 0 || file_index >= (int)kMaxFiles) return -EINVAL;
+  std::lock_guard<std::mutex> g(e->files_mu);
+  FileEntry &f = e->files[file_index];
+  if (!f.in_use) return -EBADF;
+  if (e->fixed_files) {
+    int minus1 = -1;
+    struct io_uring_files_update up;
+    memset(&up, 0, sizeof(up));
+    up.offset = (uint32_t)file_index;
+    up.fds = (uint64_t)(uintptr_t)&minus1;
+    sys_io_uring_register(e->ring_fd, IORING_REGISTER_FILES_UPDATE, &up, 1);
+  }
+  close(f.fd);
+  close(f.fd_buffered);
+  f = FileEntry{};
+  return 0;
+}
+
+int sc_file_is_o_direct(sc_engine *e, int file_index) {
+  if (file_index < 0 || file_index >= (int)kMaxFiles) return -EINVAL;
+  std::lock_guard<std::mutex> g(e->files_mu);
+  if (!e->files[file_index].in_use) return -EBADF;
+  return e->files[file_index].o_direct ? 1 : 0;
+}
+
+uint32_t sc_in_flight(sc_engine *e) {
+  return e->in_flight.load(std::memory_order_relaxed);
+}
+
+void sc_set_fault_every(sc_engine *e, uint64_t n) {
+  e->fault_every.store(n, std::memory_order_relaxed);
+}
+
+// buf_index >= 0: read into pool slot buf_index at buf_offset (READ_FIXED
+// eligible). buf_index < 0: read into raw_addr (caller-owned slab; plain READ).
+static int submit_common(sc_engine *e, int file_index, uint64_t offset,
+                         uint32_t length, int64_t buf_index,
+                         uint32_t buf_offset, uint8_t *raw_addr, uint64_t tag) {
+  if (file_index < 0 || file_index >= (int)kMaxFiles) return -EINVAL;
+  if (buf_index >= 0) {
+    if ((uint64_t)buf_index >= e->num_buffers) return -EINVAL;
+    if ((uint64_t)buf_offset + length > e->buffer_size) return -EINVAL;
+  } else if (raw_addr == nullptr) {
+    return -EINVAL;
+  }
+
+  // fault injection: complete synthetically with -EIO
+  uint64_t fe = e->fault_every.load(std::memory_order_relaxed);
+  uint64_t opno = e->op_counter.fetch_add(1, std::memory_order_relaxed) + 1;
+  if (fe > 0 && opno % fe == 0) {
+    std::lock_guard<std::mutex> g(e->cq_mu);
+    if (e->n_synthetic >= e->queue_depth) return -EAGAIN;
+    e->ops_faulted.fetch_add(1, std::memory_order_relaxed);
+    e->ops_submitted.fetch_add(1, std::memory_order_relaxed);
+    e->in_flight.fetch_add(1, std::memory_order_relaxed);
+    e->synthetic[e->n_synthetic++] = sc_completion{tag, -EIO};
+    return 0;
+  }
+
+  FileEntry f;
+  {
+    std::lock_guard<std::mutex> g(e->files_mu);
+    if (!e->files[file_index].in_use) return -EBADF;
+    f = e->files[file_index];
+  }
+
+  uint8_t *addr = raw_addr
+                      ? raw_addr
+                      : e->pool + (size_t)buf_index * e->buffer_size + buf_offset;
+
+  std::lock_guard<std::mutex> g(e->sq_mu);
+  if (e->n_free == 0) return -EAGAIN;
+  uint32_t slot_idx = e->free_slots[--e->n_free];
+  OpSlot &slot = e->slots[slot_idx];
+  slot.tag = tag;
+  slot.submit_ns = now_ns();
+  slot.offset = offset;
+  slot.addr = addr;
+  slot.length = length;
+  slot.file_index = file_index;
+  slot.in_use = true;
+
+  bool aligned = (offset % f.offset_align == 0) &&
+                 (length % f.offset_align == 0) &&
+                 (((uintptr_t)addr) % f.mem_align == 0);
+  bool direct = f.o_direct && aligned;
+  if (f.o_direct && !aligned)
+    e->unaligned_fallback.fetch_add(1, std::memory_order_relaxed);
+
+  uint32_t tail = e->sq_tail->load(std::memory_order_relaxed);
+  uint32_t idx = tail & e->sq_mask;
+  struct io_uring_sqe *sqe = &e->sqes[idx];
+  memset(sqe, 0, sizeof(*sqe));
+  sqe->opcode = (direct && e->fixed_buffers && buf_index >= 0 && buf_offset == 0)
+                    ? IORING_OP_READ_FIXED
+                    : IORING_OP_READ;
+  sqe->addr = (uint64_t)(uintptr_t)addr;
+  sqe->len = length;
+  sqe->off = offset;
+  sqe->user_data = slot_idx;
+  if (sqe->opcode == IORING_OP_READ_FIXED) sqe->buf_index = (uint16_t)buf_index;
+  if (direct && e->fixed_files) {
+    sqe->fd = file_index;
+    sqe->flags |= IOSQE_FIXED_FILE;
+  } else {
+    sqe->fd = direct ? f.fd : f.fd_buffered;
+  }
+
+  e->sq_array[idx] = idx;
+  e->sq_tail->store(tail + 1, std::memory_order_release);
+
+  // The SQE is visible to the kernel once the tail is published, so a failed
+  // enter cannot be rolled back — retry until the kernel accepts it.
+  for (;;) {
+    int ret = sys_io_uring_enter(e->ring_fd, 1, 0, 0, nullptr, 0);
+    if (ret >= 0) break;
+    if (errno == EINTR || errno == EAGAIN || errno == EBUSY) continue;
+    // Unexpected fatal errno: the SQE may still be consumed later; account the
+    // op as in-flight so the caller can reap whatever the kernel produces.
+    break;
+  }
+  e->ops_submitted.fetch_add(1, std::memory_order_relaxed);
+  e->in_flight.fetch_add(1, std::memory_order_relaxed);
+  return 0;
+}
+
+int sc_submit_read(sc_engine *e, int file_index, uint64_t offset,
+                   uint32_t length, uint32_t buf_index, uint32_t buf_offset,
+                   uint64_t tag) {
+  return submit_common(e, file_index, offset, length, (int64_t)buf_index,
+                       buf_offset, nullptr, tag);
+}
+
+// Read straight into a caller-owned slab (e.g. the page-aligned host buffer a
+// jax.Array will be built from) — removes the pool->destination bounce copy
+// for bulk transfers (SURVEY.md §7.4 hard part #1).
+int sc_submit_read_raw(sc_engine *e, int file_index, uint64_t offset,
+                       uint32_t length, void *addr, uint64_t tag) {
+  return submit_common(e, file_index, offset, length, -1, 0, (uint8_t *)addr,
+                       tag);
+}
+
+// Drain ready CQEs + synthetic completions into out[]; returns count.
+static uint32_t reap_locked(sc_engine *e, sc_completion *out, uint32_t max) {
+  uint32_t n = 0;
+  while (n < max && e->n_synthetic > 0) {
+    out[n++] = e->synthetic[--e->n_synthetic];
+    e->in_flight.fetch_sub(1, std::memory_order_relaxed);
+  }
+  uint32_t head = e->cq_head->load(std::memory_order_relaxed);
+  uint32_t tail = e->cq_tail->load(std::memory_order_acquire);
+  while (n < max && head != tail) {
+    struct io_uring_cqe *cqe = &e->cqes[head & e->cq_mask];
+    uint32_t slot_idx = (uint32_t)cqe->user_data;
+    OpSlot &slot = e->slots[slot_idx];
+    int64_t res = cqe->res;
+    head++;
+    if (res >= 0 && (uint32_t)res < slot.length && slot.file_index >= 0) {
+      // Short read. For O_DIRECT files this is the aligned-EOF case: top up
+      // the unaligned tail through the page cache (≙ the reference's
+      // page-cache fallback arm, SURVEY.md §2.1).
+      FileEntry f;
+      bool have = false;
+      {
+        std::lock_guard<std::mutex> fg(e->files_mu);
+        if (e->files[slot.file_index].in_use) {
+          f = e->files[slot.file_index];
+          have = true;
+        }
+      }
+      if (have && f.o_direct) {
+        ssize_t extra = pread(f.fd_buffered, slot.addr + res, slot.length - res,
+                              (off_t)(slot.offset + res));
+        if (extra > 0) {
+          res += extra;
+          e->eof_topup.fetch_add(1, std::memory_order_relaxed);
+        }
+      }
+    }
+    if (res < 0)
+      e->ops_errored.fetch_add(1, std::memory_order_relaxed);
+    else {
+      e->ops_completed.fetch_add(1, std::memory_order_relaxed);
+      e->bytes_read.fetch_add((uint64_t)res, std::memory_order_relaxed);
+      record_latency(e, (now_ns() - slot.submit_ns) / 1000);
+    }
+    out[n++] = sc_completion{slot.tag, res};
+    slot.in_use = false;
+    {
+      std::lock_guard<std::mutex> sg(e->sq_mu);
+      e->free_slots[e->n_free++] = slot_idx;
+    }
+    e->in_flight.fetch_sub(1, std::memory_order_relaxed);
+  }
+  e->cq_head->store(head, std::memory_order_release);
+  return n;
+}
+
+// timeout_ms: <0 block until min_completions; 0 poll; >0 bounded wait.
+int sc_wait(sc_engine *e, sc_completion *out, uint32_t max,
+            uint32_t min_completions, int timeout_ms) {
+  if (max == 0) return 0;
+  if (min_completions > max) min_completions = max;
+  uint32_t got = 0;
+  uint64_t deadline =
+      timeout_ms > 0 ? now_ns() + (uint64_t)timeout_ms * 1000000ull : 0;
+  for (;;) {
+    {
+      std::lock_guard<std::mutex> g(e->cq_mu);
+      got += reap_locked(e, out + got, max - got);
+    }
+    if (got >= min_completions || timeout_ms == 0) return (int)got;
+    if (e->in_flight.load(std::memory_order_relaxed) == 0) return (int)got;
+    if (timeout_ms > 0 && now_ns() >= deadline) return (int)got;
+
+    unsigned want = min_completions - got;
+    if (timeout_ms < 0) {
+      int ret = sys_io_uring_enter(e->ring_fd, 0, want, IORING_ENTER_GETEVENTS,
+                                   nullptr, 0);
+      if (ret < 0 && errno != EINTR) return got > 0 ? (int)got : -errno;
+    } else if (!e->has_ext_arg) {
+      // Pre-5.11 kernels: no timed enter; poll the CQ at 500us granularity.
+      struct timespec ts = {0, 500000};
+      nanosleep(&ts, nullptr);
+    } else {
+      struct __kernel_timespec ts;
+      uint64_t left = deadline - now_ns();
+      ts.tv_sec = (int64_t)(left / 1000000000ull);
+      ts.tv_nsec = (long long)(left % 1000000000ull);
+      struct io_uring_getevents_arg arg;
+      memset(&arg, 0, sizeof(arg));
+      arg.ts = (uint64_t)(uintptr_t)&ts;
+      int ret = sys_io_uring_enter(e->ring_fd, 0, want,
+                                   IORING_ENTER_GETEVENTS | IORING_ENTER_EXT_ARG,
+                                   &arg, sizeof(arg));
+      if (ret < 0 && errno != EINTR && errno != ETIME)
+        return got > 0 ? (int)got : -errno;
+    }
+  }
+}
+
+void sc_get_stats(sc_engine *e, sc_stats *s) {
+  memset(s, 0, sizeof(*s));
+  s->ops_submitted = e->ops_submitted.load(std::memory_order_relaxed);
+  s->ops_completed = e->ops_completed.load(std::memory_order_relaxed);
+  s->ops_errored = e->ops_errored.load(std::memory_order_relaxed);
+  s->ops_faulted = e->ops_faulted.load(std::memory_order_relaxed);
+  s->bytes_read = e->bytes_read.load(std::memory_order_relaxed);
+  s->unaligned_fallback_reads =
+      e->unaligned_fallback.load(std::memory_order_relaxed);
+  s->eof_topup_reads = e->eof_topup.load(std::memory_order_relaxed);
+  s->lat_count = e->lat_count.load(std::memory_order_relaxed);
+  s->lat_total_us = e->lat_total_us.load(std::memory_order_relaxed);
+  for (int i = 0; i < kHistBuckets; ++i)
+    s->lat_hist[i] = e->lat_hist[i].load(std::memory_order_relaxed);
+  s->in_flight = e->in_flight.load(std::memory_order_relaxed);
+  s->fixed_buffers = e->fixed_buffers ? 1 : 0;
+  s->fixed_files = e->fixed_files ? 1 : 0;
+  s->mlocked = e->mlocked ? 1 : 0;
+}
+
+}  // extern "C"

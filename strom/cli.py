@@ -1,0 +1,198 @@
+"""strom-bench — benchmark CLI (``python -m strom.cli`` or the ``strom-bench``
+script).
+
+Reproduces the reference's two benchmark utilities (SURVEY.md §2.1/§3.4;
+reference cite UNVERIFIED — empty mount, SURVEY.md §0):
+
+- ``strom-bench nvme``    ≙ ``utils/nvme_test``: CPU-only O_DIRECT sequential
+  read, 128KiB blocks → host RAM. This is BASELINE config #1 (BASELINE.json:7)
+  and defines "raw NVMe read bandwidth", the ≥90% target's denominator.
+- ``strom-bench ssd2tpu`` ≙ ``utils/ssd2gpu_test``: async copy loop at queue
+  depth into device memory, reporting GB/s.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+
+def _mk_testfile(path: str, size: int) -> None:
+    """Create a benchmark file of *size* bytes (incompressible-ish pattern)."""
+    rng = np.random.default_rng(0)
+    block = 8 * 1024 * 1024
+    with open(path, "wb") as f:
+        remaining = size
+        while remaining > 0:
+            take = min(block, remaining)
+            f.write(rng.integers(0, 256, size=take, dtype=np.uint8).tobytes())
+            remaining -= take
+    os.sync()
+
+
+def _drop_cache_hint(path: str) -> None:
+    """posix_fadvise(DONTNEED) so repeat runs measure media, not page cache."""
+    fd = os.open(path, os.O_RDONLY)
+    try:
+        os.posix_fadvise(fd, 0, 0, os.POSIX_FADV_DONTNEED)
+    finally:
+        os.close(fd)
+
+
+def bench_nvme(args: argparse.Namespace) -> dict:
+    """Config #1: O_DIRECT sequential read, block-size chunks → host RAM."""
+    from strom.config import StromConfig
+    from strom.delivery.buffers import alloc_aligned
+    from strom.engine import make_engine
+
+    path = args.file
+    created = False
+    if path is None:
+        path = os.path.join(args.tmpdir, "strom_bench_nvme.bin")
+        if not os.path.exists(path) or os.path.getsize(path) < args.size:
+            _mk_testfile(path, args.size)
+        created = True
+    size = min(os.path.getsize(path), args.size) // args.block * args.block
+    cfg = StromConfig(engine=args.engine, block_size=args.block,
+                      queue_depth=args.depth, num_buffers=max(args.depth * 2, 8))
+    results = []
+    for it in range(args.iters):
+        _drop_cache_hint(path)
+        eng = make_engine(cfg)
+        fi = eng.register_file(path, o_direct=not args.buffered)
+        dest = alloc_aligned(size)
+        t0 = time.perf_counter()
+        n = eng.read_into_direct(fi, 0, size, dest)
+        dt = time.perf_counter() - t0
+        stats = eng.stats()
+        eng.close()
+        assert n == size
+        results.append(size / dt / 1e9)
+        if not args.json:
+            print(f"  iter {it}: {size / dt / 1e9:.3f} GB/s "
+                  f"({size >> 20} MiB in {dt:.3f}s, o_direct={stats.get('unaligned_fallback_reads', 0) == 0})",
+                  file=sys.stderr)
+    gbps = max(results)
+    out = {
+        "bench": "nvme", "gbps": round(gbps, 4), "block": args.block,
+        "depth": args.depth, "bytes": size, "engine": cfg.engine,
+        "o_direct": not args.buffered, "iters": args.iters,
+        "file_created": created,
+    }
+    return out
+
+
+def bench_ssd2tpu(args: argparse.Namespace) -> dict:
+    """≙ ssd2gpu_test: keep async ssd2tpu copies in flight; report delivered GB/s."""
+    import jax
+
+    from strom.config import StromConfig
+    from strom.delivery.core import StromContext
+
+    path = args.file
+    if path is None:
+        path = os.path.join(args.tmpdir, "strom_bench_nvme.bin")
+        if not os.path.exists(path) or os.path.getsize(path) < args.size:
+            _mk_testfile(path, args.size)
+    size = min(os.path.getsize(path), args.size)
+    chunk = args.chunk
+    n_chunks = size // chunk
+    size = n_chunks * chunk
+
+    cfg = StromConfig(engine=args.engine, block_size=args.block,
+                      queue_depth=args.depth, num_buffers=max(args.depth * 2, 8),
+                      prefetch_depth=args.prefetch, delivery_workers=args.prefetch)
+    results = []
+    for it in range(args.iters):
+        _drop_cache_hint(path)
+        ctx = StromContext(cfg)
+        dev = jax.devices()[0]
+        # warm up one transfer (compile/runtime init out of the timed region)
+        ctx.memcpy_ssd2tpu(path, offset=0, length=chunk, device=dev).block_until_ready()
+        _drop_cache_hint(path)
+        t0 = time.perf_counter()
+        inflight = []
+        delivered = []
+        for i in range(n_chunks):
+            h = ctx.memcpy_ssd2tpu(path, offset=i * chunk, length=chunk,
+                                   device=dev, async_=True)
+            inflight.append(h)
+            if len(inflight) > args.prefetch:
+                delivered.append(inflight.pop(0).result())
+        for h in inflight:
+            delivered.append(h.result())
+        for a in delivered:
+            a.block_until_ready()
+        dt = time.perf_counter() - t0
+        ctx.close()
+        results.append(size / dt / 1e9)
+        if not args.json:
+            print(f"  iter {it}: {size / dt / 1e9:.3f} GB/s into {dev.platform}",
+                  file=sys.stderr)
+    gbps = max(results)
+    return {
+        "bench": "ssd2tpu", "gbps": round(gbps, 4), "chunk": chunk,
+        "block": args.block, "depth": args.depth, "prefetch": args.prefetch,
+        "bytes": size, "engine": cfg.engine, "iters": args.iters,
+        "device": str(jax.devices()[0]),
+    }
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(prog="strom-bench")
+    sub = ap.add_subparsers(dest="cmd", required=True)
+
+    def common(p):
+        p.add_argument("--file", default=None, help="benchmark file (default: generated)")
+        p.add_argument("--size", type=int, default=1 << 30, help="bytes to read")
+        p.add_argument("--block", type=int, default=128 * 1024, help="I/O block size")
+        p.add_argument("--depth", type=int, default=32, help="queue depth")
+        p.add_argument("--iters", type=int, default=3)
+        p.add_argument("--engine", default="auto", choices=["auto", "uring", "python"])
+        p.add_argument("--tmpdir", default=os.environ.get("STROM_BENCH_DIR", "/tmp"))
+        p.add_argument("--json", action="store_true", help="print one JSON line only")
+
+    p_nvme = sub.add_parser("nvme", help="config #1: O_DIRECT seq read -> host RAM")
+    common(p_nvme)
+    p_nvme.add_argument("--buffered", action="store_true",
+                        help="use the page-cache path instead of O_DIRECT")
+    p_nvme.set_defaults(fn=bench_nvme)
+
+    p_s2t = sub.add_parser("ssd2tpu", help="async SSD->TPU copy loop")
+    common(p_s2t)
+    p_s2t.add_argument("--chunk", type=int, default=64 * 1024 * 1024,
+                       help="bytes per async copy")
+    p_s2t.add_argument("--prefetch", type=int, default=2, help="copies in flight")
+    p_s2t.set_defaults(fn=bench_ssd2tpu)
+
+    p_check = sub.add_parser("check", help="≙ CHECK_FILE: report a file's data-path tier")
+    p_check.add_argument("path")
+    p_check.add_argument("--json", action="store_true")
+
+    args = ap.parse_args(argv)
+    if args.cmd == "check":
+        from strom.probe import check_file
+
+        try:
+            rep = check_file(args.path)
+        except OSError as e:
+            print(f"strom-bench check: {args.path}: {e.strerror or e}", file=sys.stderr)
+            return 2
+        d = {"path": rep.path, "size": rep.size, "fs": rep.fs_type,
+             "tier": rep.tier.value, "supported": rep.supported,
+             "dio": vars(rep.dio), "extents": rep.extents,
+             "reasons": list(rep.reasons)}
+        print(json.dumps(d, indent=None if args.json else 2))
+        return 0
+    out = args.fn(args)
+    print(json.dumps(out))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

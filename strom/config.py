@@ -1,0 +1,107 @@
+"""Configuration for strom-tpu.
+
+The reference exposes its knobs as kernel-module insmod parameters plus CLI
+flags on the ``utils/`` benchmark programs (SURVEY.md §5 "Config/flag system";
+reference cite UNVERIFIED — reference mount was empty, see SURVEY.md §0).
+strom-tpu's equivalent is a frozen dataclass with ``STROM_*`` environment
+variable overrides, passed explicitly through the public API.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+from typing import Any
+
+KiB = 1024
+MiB = 1024 * KiB
+
+_ENV_PREFIX = "STROM_"
+
+
+def _env_cast(value: str, typ: Any) -> Any:
+    if typ is bool:
+        return value.strip().lower() in ("1", "true", "yes", "on")
+    if typ is int:
+        v = value.strip().lower()
+        mult = 1
+        for suffix, m in (("kib", KiB), ("mib", MiB), ("k", KiB), ("m", MiB)):
+            if v.endswith(suffix):
+                v = v[: -len(suffix)]
+                mult = m
+                break
+        return int(v) * mult
+    if typ is str:
+        return value
+    if typ == tuple[str, ...]:
+        return tuple(p for p in value.split(",") if p)
+    return value
+
+
+@dataclasses.dataclass(frozen=True)
+class StromConfig:
+    """Engine + delivery configuration.
+
+    Defaults mirror the reference benchmark shape: 128KiB transfer chunks at
+    queue depth 32 (SURVEY.md §2.1 ``utils/nvme_test``: "O_DIRECT sequential
+    read, 128KiB blocks" — BASELINE.json:7).
+    """
+
+    # I/O engine
+    block_size: int = 128 * KiB        # per-op transfer size (chunking unit)
+    queue_depth: int = 32              # max in-flight ops per engine
+    num_buffers: int = 64              # staging pool slots
+    buffer_size: int = 0               # 0 → same as block_size
+    o_direct: bool | None = None       # None → auto-probe per file
+    engine: str = "auto"               # "auto" | "uring" | "python"
+    mlock: bool = True                 # pin staging pool (best effort)
+    register_buffers: bool = True      # io_uring fixed buffers
+
+    # delivery
+    prefetch_depth: int = 2            # batches dispatched ahead of consumption
+    delivery_workers: int = 2          # threads pushing host->HBM
+
+    # RAID0 (software striped reader over N member files/devices)
+    raid_chunk: int = 512 * KiB
+
+    # fault injection (tests/hardening; 0 = off)
+    fault_every: int = 0
+
+    # observability
+    trace_annotations: bool = True     # jax.profiler traces around delivery
+
+    def __post_init__(self) -> None:
+        if self.buffer_size == 0:
+            object.__setattr__(self, "buffer_size", self.block_size)
+        if self.block_size <= 0 or self.block_size % 512:
+            raise ValueError(f"block_size must be a positive multiple of 512, got {self.block_size}")
+        if self.buffer_size < self.block_size:
+            raise ValueError("buffer_size must be >= block_size")
+        if self.queue_depth <= 0:
+            raise ValueError("queue_depth must be positive")
+        if self.num_buffers <= 0:
+            raise ValueError("num_buffers must be positive")
+        if self.engine not in ("auto", "uring", "python"):
+            raise ValueError(f"unknown engine {self.engine!r}")
+
+    @classmethod
+    def from_env(cls, **overrides: Any) -> "StromConfig":
+        """Build a config from ``STROM_*`` env vars, with explicit overrides winning."""
+        kwargs: dict[str, Any] = {}
+        for field in dataclasses.fields(cls):
+            env_key = _ENV_PREFIX + field.name.upper()
+            if env_key in os.environ:
+                typ = field.type
+                if field.name == "o_direct":
+                    kwargs[field.name] = _env_cast(os.environ[env_key], bool)
+                elif typ in ("int", int):
+                    kwargs[field.name] = _env_cast(os.environ[env_key], int)
+                elif typ in ("bool", bool):
+                    kwargs[field.name] = _env_cast(os.environ[env_key], bool)
+                elif typ in ("str", str):
+                    kwargs[field.name] = os.environ[env_key]
+        kwargs.update(overrides)
+        return cls(**kwargs)
+
+
+DEFAULT_CONFIG = StromConfig()

@@ -1,0 +1,4 @@
+from strom.delivery.buffers import alloc_aligned  # noqa: F401
+from strom.delivery.handle import DMAHandle  # noqa: F401
+from strom.delivery.prefetch import Prefetcher  # noqa: F401
+from strom.delivery.shard import contiguous_segments, plan_sharded_read  # noqa: F401

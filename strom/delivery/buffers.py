@@ -1,0 +1,39 @@
+"""Page-aligned host slab allocation.
+
+These slabs are the destination the NVMe reads land in *and* the buffer the
+XLA runtime serializes from during host→HBM transfer — one landing spot, no
+bounce copy (SURVEY.md §7.4 hard part #1).  The TPU-world analogue of the
+reference pinning GPU BAR1 pages for the SSD's DMA engine (SURVEY.md §3.2;
+reference cite UNVERIFIED — empty mount, SURVEY.md §0).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import mmap
+
+import numpy as np
+
+_libc = ctypes.CDLL(None, use_errno=True)
+
+PAGE = mmap.PAGESIZE
+
+
+def alloc_aligned(nbytes: int, *, pin: bool = False, dtype=np.uint8) -> np.ndarray:
+    """Allocate a page-aligned, optionally mlock'd uint8 slab as a numpy array.
+
+    The mmap stays alive as long as the returned array (numpy holds the buffer
+    via its .base chain). O_DIRECT reads require page alignment — a plain
+    np.empty gives 16-byte alignment only.
+    """
+    if nbytes <= 0:
+        raise ValueError("nbytes must be positive")
+    padded = (nbytes + PAGE - 1) // PAGE * PAGE
+    mm = mmap.mmap(-1, padded)
+    if pin:
+        addr = ctypes.addressof(ctypes.c_char.from_buffer(mm))
+        _libc.mlock(ctypes.c_void_p(addr), ctypes.c_size_t(padded))  # best effort
+    arr = np.frombuffer(mm, dtype=np.uint8)[:nbytes]
+    if dtype is not np.uint8:
+        arr = arr.view(dtype)
+    return arr

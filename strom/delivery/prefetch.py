@@ -1,0 +1,109 @@
+"""Prefetch queue: keep N batches in flight so compute never waits on I/O.
+
+This is the consumer-facing half of the reference's double-buffered
+I/O/compute-overlap pattern (SURVEY.md §3.5: buffer ring, async SSD2GPU into
+the next buffer while the kernel consumes the previous one; reference cite
+UNVERIFIED — empty mount, SURVEY.md §0).  The "0 data-stall steps" north-star
+counter lives here (BASELINE.json:5): a stall is recorded whenever ``next()``
+has to block because the head-of-line batch isn't ready.
+"""
+
+from __future__ import annotations
+
+import concurrent.futures
+import threading
+from collections import deque
+from typing import Callable, Generic, Iterable, Iterator, TypeVar
+
+import time
+
+from strom.utils.stats import StatsRegistry
+
+T = TypeVar("T")
+
+
+class Prefetcher(Generic[T]):
+    """Wraps an iterable of thunks (callables producing a batch) and runs up to
+    *depth* of them ahead on an executor, yielding results in order.
+
+    Thunks typically end in a `jax.device_put` dispatch, so "ready" here means
+    the host-side work is done and the HBM transfer is enqueued — the classic
+    dispatch-ahead overlap jax wants.
+    """
+
+    def __init__(self, thunks: Iterable[Callable[[], T]], *, depth: int = 2,
+                 executor: concurrent.futures.Executor | None = None,
+                 stats: StatsRegistry | None = None):
+        if depth < 1:
+            raise ValueError("depth must be >= 1")
+        self._thunks = iter(thunks)
+        self._depth = depth
+        self._own_executor = executor is None
+        self._executor = executor or concurrent.futures.ThreadPoolExecutor(
+            max_workers=depth, thread_name_prefix="strom-prefetch")
+        self._queue: deque[concurrent.futures.Future] = deque()
+        self._lock = threading.Lock()
+        self.stats = stats or StatsRegistry("prefetch")
+        self._exhausted = False
+        self._fill()
+
+    def _fill(self) -> None:
+        with self._lock:
+            while len(self._queue) < self._depth and not self._exhausted:
+                try:
+                    thunk = next(self._thunks)
+                except StopIteration:
+                    self._exhausted = True
+                    break
+                self._queue.append(self._executor.submit(thunk))
+
+    def __iter__(self) -> Iterator[T]:
+        return self
+
+    def __next__(self) -> T:
+        with self._lock:
+            if not self._queue:
+                if self._exhausted:
+                    self._shutdown()
+                    raise StopIteration
+                fut = None
+            else:
+                fut = self._queue.popleft()
+        if fut is None:
+            # nothing queued yet (depth fill raced); refill and retry
+            self._fill()
+            with self._lock:
+                if not self._queue:
+                    self._shutdown()
+                    raise StopIteration
+                fut = self._queue.popleft()
+        if not fut.done():
+            self.stats.add("data_stall_steps")
+            t0 = time.monotonic()
+            result = fut.result()
+            self.stats.observe_us("stall_wait", (time.monotonic() - t0) * 1e6)
+        else:
+            result = fut.result()
+        self.stats.add("steps")
+        self._fill()
+        return result
+
+    @property
+    def data_stall_steps(self) -> int:
+        return self.stats.counter("data_stall_steps").value
+
+    @property
+    def steps(self) -> int:
+        return self.stats.counter("steps").value
+
+    def _shutdown(self) -> None:
+        if self._own_executor:
+            self._executor.shutdown(wait=False)
+
+    def close(self) -> None:
+        with self._lock:
+            for f in self._queue:
+                f.cancel()
+            self._queue.clear()
+            self._exhausted = True
+        self._shutdown()

@@ -1,0 +1,23 @@
+"""Engine selection: C++ io_uring when available, pure-Python preadv fallback."""
+
+from __future__ import annotations
+
+from strom.config import StromConfig
+from strom.engine.base import Completion, Engine, EngineError, ReadRequest  # noqa: F401
+from strom.engine.raid0 import StripeSegment, plan_stripe_reads  # noqa: F401
+
+
+def make_engine(config: StromConfig | None = None) -> Engine:
+    config = config or StromConfig.from_env()
+    if config.engine in ("auto", "uring"):
+        try:
+            from strom.engine.uring_engine import UringEngine, uring_available
+
+            if config.engine == "uring" or uring_available():
+                return UringEngine(config)
+        except Exception:
+            if config.engine == "uring":
+                raise
+    from strom.engine.python_engine import PythonEngine
+
+    return PythonEngine(config)

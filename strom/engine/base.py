@@ -1,0 +1,224 @@
+"""Engine interface: submit/wait block reads into a registered staging pool.
+
+This is the strom-tpu analogue of the reference's kernel-side DMA submit
+engine + async completion path (SURVEY.md §2.1 "DMA submit engine",
+"Async completion / WAIT"; reference cite UNVERIFIED — empty mount,
+SURVEY.md §0).  The contract deliberately mirrors the ioctl surface:
+
+==========================  =============================================
+reference ioctl             Engine equivalent
+==========================  =============================================
+MAP_GPU_MEMORY              staging pool allocated+registered at engine init
+LIST/INFO_GPU_MEMORY        Engine.buffers() / Engine.buffer_info()
+MEMCPY_SSD2GPU(_ASYNC)      Engine.submit(ReadRequest...)
+MEMCPY_WAIT                 Engine.wait(...)
+stat ioctl / /proc node     Engine.stats()
+==========================  =============================================
+
+Two implementations share this interface: the C++ io_uring engine
+(:mod:`strom.engine.uring_engine`, the fast path) and a pure-Python
+preadv thread pool (:mod:`strom.engine.python_engine`, the portable
+fallback).
+"""
+
+from __future__ import annotations
+
+import abc
+import dataclasses
+import os
+from typing import Iterable, Sequence
+
+import numpy as np
+
+from strom.config import StromConfig
+
+
+@dataclasses.dataclass(frozen=True)
+class ReadRequest:
+    """One block read: file[offset : offset+length] → pool[buf_index][buf_offset:]."""
+
+    file_index: int    # from Engine.register_file
+    offset: int        # byte offset in file
+    length: int        # bytes to read (<= buffer_size - buf_offset)
+    buf_index: int     # staging pool slot
+    tag: int           # caller-chosen completion tag
+    buf_offset: int = 0
+
+
+@dataclasses.dataclass(frozen=True)
+class RawRead:
+    """One block read straight into caller-owned memory (no staging pool).
+
+    *dest* must be a writable C-contiguous uint8 view whose lifetime the caller
+    guarantees until the op completes; for the O_DIRECT path it must satisfy
+    the file's memory alignment (use :func:`strom.delivery.buffers.alloc_aligned`).
+    """
+
+    file_index: int
+    offset: int
+    length: int
+    dest: np.ndarray
+    tag: int
+
+
+@dataclasses.dataclass(frozen=True)
+class Completion:
+    tag: int
+    result: int        # bytes read (>=0) or negative errno
+
+
+class EngineError(OSError):
+    pass
+
+
+class Engine(abc.ABC):
+    """Owns the staging pool and the submission/completion machinery."""
+
+    name: str = "abstract"
+
+    def __init__(self, config: StromConfig):
+        self.config = config
+
+    # -- file registration (≙ CHECK_FILE handing an fd to the kmod) ---------
+    @abc.abstractmethod
+    def register_file(self, path: str, *, o_direct: bool | None = None) -> int:
+        """Open (or adopt) *path* and return a file index for ReadRequests.
+
+        o_direct=None uses the engine config / per-file auto-probe."""
+
+    @abc.abstractmethod
+    def unregister_file(self, file_index: int) -> None: ...
+
+    @abc.abstractmethod
+    def file_uses_o_direct(self, file_index: int) -> bool: ...
+
+    # -- staging pool (≙ MAP/LIST/INFO_GPU_MEMORY) --------------------------
+    @abc.abstractmethod
+    def buffer(self, buf_index: int) -> np.ndarray:
+        """Zero-copy uint8 view of one pool slot (length == buffer_size)."""
+
+    @property
+    def num_buffers(self) -> int:
+        return self.config.num_buffers
+
+    @property
+    def buffer_size(self) -> int:
+        return self.config.buffer_size
+
+    def buffer_info(self) -> dict:
+        return {
+            "num_buffers": self.num_buffers,
+            "buffer_size": self.buffer_size,
+            "total_bytes": self.num_buffers * self.buffer_size,
+            "engine": self.name,
+        }
+
+    # -- submission / completion (≙ MEMCPY_SSD2GPU_ASYNC / MEMCPY_WAIT) -----
+    @abc.abstractmethod
+    def submit(self, requests: Sequence[ReadRequest]) -> int:
+        """Queue reads; returns number submitted. Non-blocking up to queue_depth;
+        raises EngineError if more than queue_depth ops would be in flight."""
+
+    @abc.abstractmethod
+    def submit_raw(self, requests: Sequence[RawRead]) -> int:
+        """Queue reads into caller-owned memory (bypasses the staging pool)."""
+
+    @abc.abstractmethod
+    def wait(self, min_completions: int = 1, timeout_s: float | None = None) -> list[Completion]:
+        """Block until >= min_completions ops retire (or timeout); return them."""
+
+    @abc.abstractmethod
+    def in_flight(self) -> int: ...
+
+    @abc.abstractmethod
+    def stats(self) -> dict: ...
+
+    @abc.abstractmethod
+    def close(self) -> None: ...
+
+    def __enter__(self) -> "Engine":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+    # -- convenience: synchronous read of an arbitrary range ----------------
+    def read_into(self, file_index: int, offset: int, length: int,
+                  out: np.ndarray | memoryview, out_offset: int = 0) -> int:
+        """Synchronously read file[offset:offset+length] into *out* using the
+        staging pool in block_size chunks. Returns bytes read (short at EOF)."""
+        block = self.config.block_size
+        out_mv = memoryview(out).cast("B") if not isinstance(out, np.ndarray) else memoryview(out.view(np.uint8))
+        done = 0
+        pending: dict[int, tuple[int, int, int]] = {}  # tag -> (buf_index, out_pos, want)
+        free = list(range(min(self.num_buffers, self.config.queue_depth)))
+        next_tag = 0
+        pos = 0
+        short_read = False
+        while pos < length or pending:
+            while pos < length and free and not short_read:
+                want = min(block, length - pos)
+                buf = free.pop()
+                tag = next_tag
+                next_tag += 1
+                self.submit([ReadRequest(file_index, offset + pos, want, buf, tag)])
+                pending[tag] = (buf, pos, want)
+                pos += want
+            if not pending:
+                break
+            for c in self.wait(min_completions=1):
+                buf, out_pos, want = pending.pop(c.tag)
+                if c.result < 0:
+                    raise EngineError(-c.result, f"read failed: {os.strerror(-c.result)}")
+                if c.result:
+                    out_mv[out_offset + out_pos: out_offset + out_pos + c.result] = \
+                        self.buffer(buf)[:c.result]
+                done += c.result
+                if c.result < want:
+                    short_read = True  # EOF: stop submitting further chunks
+                free.append(buf)
+        return done
+
+
+    def read_into_direct(self, file_index: int, offset: int, length: int,
+                         dest: np.ndarray) -> int:
+        """Read file[offset:offset+length) straight into *dest* (uint8, len >=
+        length), chunked at block_size and pipelined at queue_depth, with no
+        staging-pool bounce. Returns bytes read (short at EOF)."""
+        block = self.config.block_size
+        pending: dict[int, int] = {}  # tag -> want
+        next_tag = 0
+        pos = 0
+        done = 0
+        short_read = False
+        d8 = dest.view(np.uint8).reshape(-1)
+        while pos < length or pending:
+            while (pos < length and len(pending) < self.config.queue_depth
+                   and not short_read):
+                want = min(block, length - pos)
+                tag = next_tag
+                next_tag += 1
+                self.submit_raw([RawRead(file_index, offset + pos, want,
+                                         d8[pos: pos + want], tag)])
+                pending[tag] = want
+                pos += want
+            if not pending:
+                break
+            for c in self.wait(min_completions=1):
+                want = pending.pop(c.tag)
+                if c.result < 0:
+                    raise EngineError(-c.result, f"read failed: {os.strerror(-c.result)}")
+                done += c.result
+                if c.result < want:
+                    short_read = True
+        return done
+
+
+def iter_chunks(offset: int, length: int, block: int) -> Iterable[tuple[int, int]]:
+    """Split [offset, offset+length) into (offset, len) chunks of *block* bytes."""
+    pos = offset
+    end = offset + length
+    while pos < end:
+        take = min(block, end - pos)
+        yield pos, take
+        pos += take

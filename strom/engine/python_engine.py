@@ -1,0 +1,241 @@
+"""Portable pure-Python engine: preadv worker pool over an mmap'd staging pool.
+
+Fallback for environments where the C++ io_uring engine can't build/run
+(SURVEY.md §7.2 step 2 prescribes both).  Same interface, same semantics,
+~10× less throughput headroom — the C++ engine is the production path.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import errno as _errno
+import mmap
+import os
+import queue
+import threading
+import time
+from typing import Sequence
+
+import numpy as np
+
+from strom.config import StromConfig
+from strom.engine.base import Completion, Engine, EngineError, RawRead, ReadRequest
+from strom.probe.odirect import probe_dio
+from strom.utils.stats import StatsRegistry
+
+_libc = ctypes.CDLL(None, use_errno=True)
+
+
+class _File:
+    __slots__ = ("fd", "fd_buffered", "o_direct", "mem_align", "offset_align", "path")
+
+    def __init__(self, path: str, fd: int, fd_buffered: int, o_direct: bool,
+                 mem_align: int, offset_align: int):
+        self.path = path
+        self.fd = fd
+        self.fd_buffered = fd_buffered
+        self.o_direct = o_direct
+        self.mem_align = mem_align
+        self.offset_align = offset_align
+
+
+class PythonEngine(Engine):
+    """Thread-pool preadv engine. Default 4 I/O threads (they block in the
+    kernel, so >1 helps even on a single-core host)."""
+
+    name = "python"
+
+    def __init__(self, config: StromConfig, *, n_workers: int = 4):
+        super().__init__(config)
+        pool_bytes = config.num_buffers * config.buffer_size
+        # Page-aligned anonymous mapping; slot alignment follows buffer_size
+        # (config enforces 512-multiple; pages give 4KiB which covers O_DIRECT
+        # mem alignment on every mainstream fs).
+        self._pool = mmap.mmap(-1, pool_bytes)
+        if config.mlock:
+            _libc.mlock(ctypes.c_void_p(ctypes.addressof(ctypes.c_char.from_buffer(self._pool))),
+                        ctypes.c_size_t(pool_bytes))  # best effort; ignore failures
+        self._np_pool = np.frombuffer(self._pool, dtype=np.uint8)
+        self._files: dict[int, _File | None] = {}
+        self._next_file = 0
+        self._submit_q: queue.SimpleQueue[ReadRequest | None] = queue.SimpleQueue()
+        self._done_q: queue.SimpleQueue[Completion] = queue.SimpleQueue()
+        self._in_flight = 0
+        self._lock = threading.Lock()
+        self._stats = StatsRegistry("engine.python")
+        self._fault_counter = 0
+        self._closed = False
+        self._workers = [
+            threading.Thread(target=self._worker, name=f"strom-io-{i}", daemon=True)
+            for i in range(n_workers)
+        ]
+        for w in self._workers:
+            w.start()
+
+    # -- files --------------------------------------------------------------
+    def register_file(self, path: str, *, o_direct: bool | None = None) -> int:
+        want_direct = self.config.o_direct if o_direct is None else o_direct
+        dio = probe_dio(path)
+        use_direct = dio.supported if want_direct is None else (want_direct and dio.supported)
+        if want_direct is True and not dio.supported:
+            use_direct = False  # observable degrade, not an error
+            self._stats.add("o_direct_denied")
+        flags = os.O_RDONLY
+        fd_buffered = os.open(path, flags)
+        if use_direct:
+            try:
+                fd = os.open(path, flags | os.O_DIRECT)
+            except OSError:
+                fd = os.dup(fd_buffered)
+                use_direct = False
+                self._stats.add("o_direct_denied")
+        else:
+            fd = os.dup(fd_buffered)
+        idx = self._next_file
+        self._next_file += 1
+        self._files[idx] = _File(path, fd, fd_buffered, use_direct,
+                                 dio.mem_align or 4096, dio.offset_align or 4096)
+        return idx
+
+    def unregister_file(self, file_index: int) -> None:
+        f = self._files.pop(file_index, None)
+        if f is not None:
+            os.close(f.fd)
+            os.close(f.fd_buffered)
+
+    def file_uses_o_direct(self, file_index: int) -> bool:
+        f = self._files[file_index]
+        assert f is not None
+        return f.o_direct
+
+    # -- pool ---------------------------------------------------------------
+    def buffer(self, buf_index: int) -> np.ndarray:
+        if not 0 <= buf_index < self.config.num_buffers:
+            raise IndexError(buf_index)
+        start = buf_index * self.config.buffer_size
+        return self._np_pool[start: start + self.config.buffer_size]
+
+    # -- submit/wait --------------------------------------------------------
+    def submit(self, requests: Sequence[ReadRequest]) -> int:
+        if self._closed:
+            raise EngineError(_errno.EBADF, "engine closed")
+        for r in requests:  # validate everything before committing any state
+            if r.buf_offset + r.length > self.config.buffer_size:
+                raise EngineError(_errno.EINVAL, "read larger than buffer slot")
+        with self._lock:
+            if self._in_flight + len(requests) > self.config.queue_depth:
+                raise EngineError(
+                    _errno.EAGAIN,
+                    f"queue depth exceeded ({self._in_flight}+{len(requests)} > {self.config.queue_depth})")
+            self._in_flight += len(requests)
+        for r in requests:
+            self._submit_q.put(r)
+        self._stats.add("ops_submitted", len(requests))
+        return len(requests)
+
+    def submit_raw(self, requests: Sequence[RawRead]) -> int:
+        if self._closed:
+            raise EngineError(_errno.EBADF, "engine closed")
+        with self._lock:
+            if self._in_flight + len(requests) > self.config.queue_depth:
+                raise EngineError(_errno.EAGAIN, "queue depth exceeded")
+            self._in_flight += len(requests)
+        for r in requests:
+            self._submit_q.put(r)
+        self._stats.add("ops_submitted", len(requests))
+        return len(requests)
+
+    def wait(self, min_completions: int = 1, timeout_s: float | None = None) -> list[Completion]:
+        out: list[Completion] = []
+        deadline = None if timeout_s is None else time.monotonic() + timeout_s
+        while len(out) < min_completions:
+            remaining = None if deadline is None else max(0.0, deadline - time.monotonic())
+            try:
+                c = self._done_q.get(timeout=remaining)
+            except queue.Empty:
+                break
+            out.append(c)
+        # opportunistically drain anything else already complete
+        while True:
+            try:
+                out.append(self._done_q.get_nowait())
+            except queue.Empty:
+                break
+        if out:
+            with self._lock:
+                self._in_flight -= len(out)
+        return out
+
+    def in_flight(self) -> int:
+        with self._lock:
+            return self._in_flight
+
+    def stats(self) -> dict:
+        snap = self._stats.snapshot()
+        snap["in_flight"] = self.in_flight()
+        snap["engine"] = self.name
+        return snap
+
+    def close(self) -> None:
+        if self._closed:
+            return
+        self._closed = True
+        for _ in self._workers:
+            self._submit_q.put(None)
+        for w in self._workers:
+            w.join(timeout=5)
+        for idx in list(self._files):
+            self.unregister_file(idx)
+        # numpy views over the mmap may be held by callers; keep the mmap object
+        # referenced by self to avoid invalidating them until GC.
+
+    # -- worker -------------------------------------------------------------
+    def _take_fault(self) -> bool:
+        n = self.config.fault_every
+        if n <= 0:
+            return False
+        with self._lock:
+            self._fault_counter += 1
+            return self._fault_counter % n == 0
+
+    def _worker(self) -> None:
+        while True:
+            req = self._submit_q.get()
+            if req is None:
+                return
+            t0 = time.monotonic()
+            if self._take_fault():
+                self._stats.add("ops_faulted")
+                self._done_q.put(Completion(req.tag, -_errno.EIO))
+                continue
+            f = self._files.get(req.file_index)
+            if f is None:
+                self._done_q.put(Completion(req.tag, -_errno.EBADF))
+                continue
+            if isinstance(req, RawRead):
+                view = memoryview(req.dest.view(np.uint8).reshape(-1))[: req.length]
+                addr = req.dest.__array_interface__["data"][0]
+            else:
+                start = req.buf_index * self.config.buffer_size + req.buf_offset
+                view = memoryview(self._pool)[start: start + req.length]
+                addr = start  # pool base is page-aligned; offset within pool suffices
+            aligned = (req.offset % f.offset_align == 0
+                       and req.length % f.offset_align == 0
+                       and addr % f.mem_align == 0)
+            fd = f.fd if (f.o_direct and aligned) else f.fd_buffered
+            if f.o_direct and not aligned:
+                self._stats.add("unaligned_fallback_reads")
+            try:
+                n = os.preadv(fd, [view], req.offset)
+                if f.o_direct and aligned and n < req.length:
+                    # O_DIRECT EOF semantics: may return short at aligned EOF;
+                    # top up the unaligned tail via the buffered fd.
+                    tail = os.preadv(f.fd_buffered, [view[n:]], req.offset + n)
+                    n += tail
+                self._stats.add("bytes_read", n)
+                self._stats.add("ops_completed")
+                self._stats.observe_us("read_latency", (time.monotonic() - t0) * 1e6)
+                self._done_q.put(Completion(req.tag, n))
+            except OSError as e:
+                self._stats.add("ops_errored")
+                self._done_q.put(Completion(req.tag, -(e.errno or _errno.EIO)))

@@ -1,0 +1,75 @@
+"""Software RAID0 stripe math.
+
+The reference decodes md-raid0 striping *in the kernel* so each NVMe READ
+lands on the right member device (SURVEY.md §2.1 "Extent resolver", §3.3;
+reference cite UNVERIFIED — empty mount, SURVEY.md §0).  strom-tpu does the
+same arithmetic in userspace: a logical byte range over an N-member stripe
+becomes per-member (offset, length) segments, which the engine reads
+concurrently — same math the kernel's raid0 map performs, applied to member
+files/devices opened directly.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+
+@dataclasses.dataclass(frozen=True)
+class StripeSegment:
+    member: int        # member index [0, n)
+    member_offset: int # byte offset within the member
+    logical_offset: int  # byte offset within the logical (striped) address space
+    length: int
+
+
+def plan_stripe_reads(offset: int, length: int, n_members: int, chunk: int) -> list[StripeSegment]:
+    """Map logical [offset, offset+length) over an n-member RAID0 with the given
+    chunk size into per-member segments, ordered by logical offset.
+
+    Layout (classic md-raid0): logical chunk k lives on member (k % n) at
+    member-chunk index (k // n).
+    """
+    if n_members <= 0:
+        raise ValueError("n_members must be positive")
+    if chunk <= 0:
+        raise ValueError("chunk must be positive")
+    if offset < 0 or length < 0:
+        raise ValueError("offset/length must be non-negative")
+    segs: list[StripeSegment] = []
+    pos = offset
+    end = offset + length
+    while pos < end:
+        chunk_idx = pos // chunk
+        within = pos % chunk
+        take = min(chunk - within, end - pos)
+        member = chunk_idx % n_members
+        member_off = (chunk_idx // n_members) * chunk + within
+        segs.append(StripeSegment(member, member_off, pos, take))
+        pos += take
+    return segs
+
+
+def coalesce(segs: list[StripeSegment]) -> list[StripeSegment]:
+    """Merge adjacent segments on the same member that are contiguous in both
+    member and logical space (happens when chunk > block size)."""
+    out: list[StripeSegment] = []
+    for s in segs:
+        if out:
+            p = out[-1]
+            if (p.member == s.member
+                    and p.member_offset + p.length == s.member_offset
+                    and p.logical_offset + p.length == s.logical_offset):
+                out[-1] = StripeSegment(p.member, p.member_offset, p.logical_offset, p.length + s.length)
+                continue
+        out.append(s)
+    return out
+
+
+def logical_size(member_sizes: list[int], chunk: int) -> int:
+    """Usable striped capacity given member sizes (md-raid0 uses min size × n for
+    equal members; we require the common prefix that stripes evenly)."""
+    if not member_sizes:
+        return 0
+    usable = min(member_sizes)
+    full_chunks = usable // chunk
+    return full_chunks * chunk * len(member_sizes)

@@ -1,0 +1,245 @@
+"""ctypes binding to the C++ io_uring engine (libstrom_core.so).
+
+The production data path (SURVEY.md §2.2: "C++ io_uring engine ... registered
+buffers + registered fds, O_DIRECT ... completion futures surfaced to Python
+... GIL-free wait").  ctypes foreign calls release the GIL, so submit/wait run
+concurrently with Python-side work; bulk bytes never transit Python — they
+land in the engine-owned pool and are exposed as zero-copy numpy views.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import errno as _errno
+import os
+import threading
+from typing import Sequence
+
+import numpy as np
+
+from strom.config import StromConfig
+from strom.engine.base import Completion, Engine, EngineError, RawRead, ReadRequest
+from strom.utils.stats import StatsRegistry
+
+_HIST_BUCKETS = 24
+
+
+class _ScCompletion(ctypes.Structure):
+    _fields_ = [("tag", ctypes.c_uint64), ("res", ctypes.c_int64)]
+
+
+class _ScStats(ctypes.Structure):
+    _fields_ = [
+        ("ops_submitted", ctypes.c_uint64),
+        ("ops_completed", ctypes.c_uint64),
+        ("ops_errored", ctypes.c_uint64),
+        ("ops_faulted", ctypes.c_uint64),
+        ("bytes_read", ctypes.c_uint64),
+        ("unaligned_fallback_reads", ctypes.c_uint64),
+        ("eof_topup_reads", ctypes.c_uint64),
+        ("lat_count", ctypes.c_uint64),
+        ("lat_total_us", ctypes.c_uint64),
+        ("lat_hist", ctypes.c_uint64 * _HIST_BUCKETS),
+        ("in_flight", ctypes.c_uint32),
+        ("fixed_buffers", ctypes.c_uint8),
+        ("fixed_files", ctypes.c_uint8),
+        ("mlocked", ctypes.c_uint8),
+    ]
+
+
+_lib = None
+_lib_lock = threading.Lock()
+
+
+def _load_lib(variant: str = ""):
+    global _lib
+    with _lib_lock:
+        if _lib is not None and not variant:
+            return _lib
+        from strom._core.build import ensure_built
+
+        lib = ctypes.CDLL(ensure_built(variant), use_errno=True)
+        lib.sc_create.restype = ctypes.c_void_p
+        lib.sc_create.argtypes = [ctypes.c_uint32, ctypes.c_uint32, ctypes.c_uint64, ctypes.c_uint32]
+        lib.sc_destroy.argtypes = [ctypes.c_void_p]
+        lib.sc_pool_base.restype = ctypes.c_void_p
+        lib.sc_pool_base.argtypes = [ctypes.c_void_p]
+        lib.sc_register_file.restype = ctypes.c_int
+        lib.sc_register_file.argtypes = [ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int]
+        lib.sc_unregister_file.restype = ctypes.c_int
+        lib.sc_unregister_file.argtypes = [ctypes.c_void_p, ctypes.c_int]
+        lib.sc_file_is_o_direct.restype = ctypes.c_int
+        lib.sc_file_is_o_direct.argtypes = [ctypes.c_void_p, ctypes.c_int]
+        lib.sc_submit_read.restype = ctypes.c_int
+        lib.sc_submit_read.argtypes = [ctypes.c_void_p, ctypes.c_int, ctypes.c_uint64,
+                                       ctypes.c_uint32, ctypes.c_uint32, ctypes.c_uint32,
+                                       ctypes.c_uint64]
+        lib.sc_submit_read_raw.restype = ctypes.c_int
+        lib.sc_submit_read_raw.argtypes = [ctypes.c_void_p, ctypes.c_int, ctypes.c_uint64,
+                                           ctypes.c_uint32, ctypes.c_void_p, ctypes.c_uint64]
+        lib.sc_wait.restype = ctypes.c_int
+        lib.sc_wait.argtypes = [ctypes.c_void_p, ctypes.POINTER(_ScCompletion),
+                                ctypes.c_uint32, ctypes.c_uint32, ctypes.c_int]
+        lib.sc_in_flight.restype = ctypes.c_uint32
+        lib.sc_in_flight.argtypes = [ctypes.c_void_p]
+        lib.sc_get_stats.argtypes = [ctypes.c_void_p, ctypes.POINTER(_ScStats)]
+        lib.sc_set_fault_every.argtypes = [ctypes.c_void_p, ctypes.c_uint64]
+        if not variant:
+            _lib = lib
+        return lib
+
+
+def uring_available() -> bool:
+    """True if the kernel accepts io_uring_setup and the .so builds."""
+    try:
+        lib = _load_lib()
+    except (RuntimeError, OSError):
+        return False
+    h = lib.sc_create(2, 1, 4096, 0)
+    if not h:
+        return False
+    lib.sc_destroy(ctypes.c_void_p(h))
+    return True
+
+
+class UringEngine(Engine):
+    name = "uring"
+
+    def __init__(self, config: StromConfig, *, variant: str = ""):
+        super().__init__(config)
+        self._lib = _load_lib(variant)
+        flags = (1 if config.mlock else 0) | (2 if config.register_buffers else 0) | 4
+        handle = self._lib.sc_create(config.queue_depth, config.num_buffers,
+                                     config.buffer_size, flags)
+        if not handle:
+            err = ctypes.get_errno()
+            raise EngineError(err or _errno.ENOSYS,
+                              f"io_uring engine init failed: {os.strerror(err or _errno.ENOSYS)}")
+        self._h = ctypes.c_void_p(handle)
+        pool_base = self._lib.sc_pool_base(self._h)
+        pool_bytes = config.num_buffers * config.buffer_size
+        # Zero-copy view over the engine-owned mmap'd pool.
+        self._np_pool = np.ctypeslib.as_array(
+            ctypes.cast(pool_base, ctypes.POINTER(ctypes.c_uint8)), shape=(pool_bytes,))
+        self._fault_every = config.fault_every
+        if config.fault_every:
+            self._lib.sc_set_fault_every(self._h, config.fault_every)
+        self._stats = StatsRegistry("engine.uring")
+        self._closed = False
+        self._comp_buf = (_ScCompletion * max(config.queue_depth, 64))()
+        self._raw_keepalive: dict[int, np.ndarray] = {}
+
+    def register_file(self, path: str, *, o_direct: bool | None = None) -> int:
+        want = self.config.o_direct if o_direct is None else o_direct
+        mode = 2 if want is None else (1 if want else 0)
+        rc = self._lib.sc_register_file(self._h, os.fsencode(path), mode)
+        if rc < 0:
+            raise EngineError(-rc, f"register_file({path}): {os.strerror(-rc)}")
+        return rc
+
+    def unregister_file(self, file_index: int) -> None:
+        self._lib.sc_unregister_file(self._h, file_index)
+
+    def file_uses_o_direct(self, file_index: int) -> bool:
+        rc = self._lib.sc_file_is_o_direct(self._h, file_index)
+        if rc < 0:
+            raise EngineError(-rc, os.strerror(-rc))
+        return bool(rc)
+
+    def buffer(self, buf_index: int) -> np.ndarray:
+        if not 0 <= buf_index < self.config.num_buffers:
+            raise IndexError(buf_index)
+        start = buf_index * self.config.buffer_size
+        return self._np_pool[start: start + self.config.buffer_size]
+
+    def submit(self, requests: Sequence[ReadRequest]) -> int:
+        for r in requests:
+            rc = self._lib.sc_submit_read(self._h, r.file_index, r.offset, r.length,
+                                          r.buf_index, r.buf_offset, r.tag)
+            if rc < 0:
+                raise EngineError(-rc, f"submit: {os.strerror(-rc)}")
+        return len(requests)
+
+    def submit_raw(self, requests: Sequence[RawRead]) -> int:
+        for r in requests:
+            if not r.dest.flags["C_CONTIGUOUS"] or not r.dest.flags["WRITEABLE"]:
+                raise EngineError(_errno.EINVAL, "RawRead.dest must be writable C-contiguous")
+            if r.dest.nbytes < r.length:
+                raise EngineError(_errno.EINVAL, "RawRead.dest smaller than length")
+            addr = r.dest.__array_interface__["data"][0]
+            # Keep the destination alive until its completion is reaped.
+            self._raw_keepalive[r.tag] = r.dest
+            rc = self._lib.sc_submit_read_raw(self._h, r.file_index, r.offset,
+                                              r.length, ctypes.c_void_p(addr), r.tag)
+            if rc < 0:
+                del self._raw_keepalive[r.tag]
+                raise EngineError(-rc, f"submit_raw: {os.strerror(-rc)}")
+        return len(requests)
+
+    def wait(self, min_completions: int = 1, timeout_s: float | None = None) -> list[Completion]:
+        timeout_ms = -1 if timeout_s is None else max(0, int(timeout_s * 1000))
+        n = self._lib.sc_wait(self._h, self._comp_buf, len(self._comp_buf),
+                              min_completions, timeout_ms)
+        if n < 0:
+            raise EngineError(-n, f"wait: {os.strerror(-n)}")
+        out = [Completion(self._comp_buf[i].tag, self._comp_buf[i].res) for i in range(n)]
+        if self._raw_keepalive:
+            for c in out:
+                self._raw_keepalive.pop(c.tag, None)
+        return out
+
+    def in_flight(self) -> int:
+        return self._lib.sc_in_flight(self._h)
+
+    def set_fault_every(self, n: int) -> None:
+        self._fault_every = n
+        self._lib.sc_set_fault_every(self._h, n)
+
+    def stats(self) -> dict:
+        s = _ScStats()
+        self._lib.sc_get_stats(self._h, ctypes.byref(s))
+        total = s.lat_count
+        out = {
+            "engine": self.name,
+            "ops_submitted": s.ops_submitted,
+            "ops_completed": s.ops_completed,
+            "ops_errored": s.ops_errored,
+            "ops_faulted": s.ops_faulted,
+            "bytes_read": s.bytes_read,
+            "unaligned_fallback_reads": s.unaligned_fallback_reads,
+            "eof_topup_reads": s.eof_topup_reads,
+            "in_flight": s.in_flight,
+            "fixed_buffers": bool(s.fixed_buffers),
+            "fixed_files": bool(s.fixed_files),
+            "mlocked": bool(s.mlocked),
+            "read_latency_mean_us": (s.lat_total_us / total) if total else 0.0,
+            "read_latency_count": total,
+        }
+        # percentiles from the log2 histogram
+        for q, name in ((0.5, "read_latency_p50_us"), (0.99, "read_latency_p99_us")):
+            acc, val = 0, 0.0
+            target = q * total
+            for i in range(_HIST_BUCKETS):
+                acc += s.lat_hist[i]
+                if total and acc >= target:
+                    val = float(2 ** i)
+                    break
+            out[name] = val
+        return out
+
+    def close(self) -> None:
+        if self._closed:
+            return
+        self._closed = True
+        # numpy views over the pool die with the engine mapping: drop our
+        # reference first so accidental use raises instead of faulting.
+        self._np_pool = None
+        self._lib.sc_destroy(self._h)
+        self._h = None
+
+    def __del__(self) -> None:
+        try:
+            if not self._closed and self._h:
+                self.close()
+        except Exception:
+            pass

@@ -1,0 +1,2 @@
+from strom.parallel.mesh import make_mesh  # noqa: F401
+from strom.parallel.sharding import batch_spec, param_specs  # noqa: F401

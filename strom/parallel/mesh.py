@@ -1,0 +1,54 @@
+"""Device-mesh construction helpers.
+
+The reference's only "fabric" is PCIe P2P between SSD and GPU BAR1; strom-tpu
+scales over the pod's ICI/DCN via `jax.sharding.Mesh` + XLA collectives
+(SURVEY.md §5 "Distributed comm backend").  Axis convention used across the
+framework: dp (data) / sp (sequence) / tp (tensor).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+from jax.sharding import Mesh
+
+
+def make_mesh(axes: dict[str, int] | None = None, *,
+              devices: list | None = None) -> Mesh:
+    """Build a Mesh from axis sizes, e.g. {"dp": 2, "tp": 4}.
+
+    Sizes must multiply to the device count; an axis of size -1 absorbs the
+    remainder (like a reshape).  With axes=None, a 1-axis "dp" mesh over all
+    devices is returned.
+    """
+    devs = devices if devices is not None else jax.devices()
+    n = len(devs)
+    if axes is None:
+        axes = {"dp": n}
+    names = tuple(axes)
+    sizes = list(axes.values())
+    if sizes.count(-1) > 1:
+        raise ValueError("at most one axis may be -1")
+    known = int(np.prod([s for s in sizes if s != -1]))
+    if -1 in sizes:
+        if n % known:
+            raise ValueError(f"{n} devices not divisible by {known}")
+        sizes[sizes.index(-1)] = n // known
+    if int(np.prod(sizes)) != n:
+        raise ValueError(f"mesh {dict(zip(names, sizes))} needs "
+                         f"{int(np.prod(sizes))} devices, have {n}")
+    arr = np.array(devs).reshape(sizes)
+    return Mesh(arr, names)
+
+
+def factor_mesh(n: int, *, want_tp: int = 0) -> dict[str, int]:
+    """Pick a sensible {dp, tp} factorisation of n devices: tp as requested if
+    it divides n, else the largest power of two <= min(n, 8)."""
+    if want_tp and n % want_tp == 0:
+        tp = want_tp
+    else:
+        tp = 1
+        while tp * 2 <= min(n, 8) and n % (tp * 2) == 0:
+            tp *= 2
+    return {"dp": n // tp, "tp": tp}

@@ -1,0 +1,55 @@
+"""Sharding rules for the flagship model: Megatron-style tensor parallelism
+expressed as PartitionSpecs; XLA inserts the ICI collectives (scaling-book
+recipe: pick a mesh, annotate shardings, let the compiler do the rest)."""
+
+from __future__ import annotations
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+# Per-leaf PartitionSpecs for strom.models.llama params (stacked layers =>
+# leading layer axis is never sharded). Column-parallel (output dim on tp)
+# feeding row-parallel (input dim on tp) pairs keep activations tp-local
+# between the two matmuls; XLA adds the reduce-scatter/all-reduce at the end.
+_LLAMA_RULES = {
+    ("embed",): P(None, "tp"),
+    ("layers", "attn_norm"): P(),
+    ("layers", "wq"): P(None, None, "tp"),
+    ("layers", "wk"): P(None, None, "tp"),
+    ("layers", "wv"): P(None, None, "tp"),
+    ("layers", "wo"): P(None, "tp", None),
+    ("layers", "mlp_norm"): P(),
+    ("layers", "w_gate"): P(None, None, "tp"),
+    ("layers", "w_up"): P(None, None, "tp"),
+    ("layers", "w_down"): P(None, "tp", None),
+    ("final_norm",): P(),
+    ("lm_head",): P(None, "tp"),
+}
+
+
+def _path_key(path) -> tuple:
+    out = []
+    for p in path:
+        if hasattr(p, "key"):
+            out.append(p.key)
+        elif hasattr(p, "idx"):
+            out.append(p.idx)
+    return tuple(out)
+
+
+def param_specs(params: dict) -> dict:
+    """PartitionSpec pytree matching the llama param pytree."""
+    return jax.tree_util.tree_map_with_path(
+        lambda path, _: _LLAMA_RULES.get(_path_key(path), P()), params)
+
+
+def param_shardings(params: dict, mesh: Mesh) -> dict:
+    return jax.tree.map(lambda spec: NamedSharding(mesh, spec),
+                        param_specs(params),
+                        is_leaf=lambda x: isinstance(x, P))
+
+
+def batch_spec(*, sp: bool = False) -> P:
+    """Token batches: batch on dp, optionally sequence on sp (long-context
+    loaders deliver sequence-sharded batches, SURVEY.md §5)."""
+    return P("dp", "sp") if sp else P("dp")

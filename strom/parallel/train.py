@@ -1,0 +1,75 @@
+"""Sharded training step for the flagship model (pjit over a dp×tp mesh).
+
+This is the consumer the data path feeds: strom loaders deliver token batches
+already sharded over ("dp", ...) and the step runs under jit with explicit
+parameter shardings — XLA places the ICI collectives (psum of dp gradients,
+tp all-reduces) itself.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from strom.models.llama import LlamaConfig, init_params, next_token_loss
+from strom.parallel.sharding import param_shardings
+
+
+@dataclasses.dataclass
+class TrainState:
+    params: Any
+    opt_state: Any
+    step: Any
+
+
+def make_optimizer(lr: float = 3e-4, weight_decay: float = 0.1,
+                   warmup: int = 100) -> optax.GradientTransformation:
+    sched = optax.warmup_cosine_decay_schedule(0.0, lr, warmup, 10_000, lr * 0.1)
+    return optax.chain(optax.clip_by_global_norm(1.0),
+                       optax.adamw(sched, weight_decay=weight_decay))
+
+
+def init_train_state(key: jax.Array, cfg: LlamaConfig, mesh: Mesh,
+                     optimizer: optax.GradientTransformation) -> TrainState:
+    """Initialise params *sharded*: jit the initializer with out_shardings so
+    big models never materialise unsharded on one device."""
+    shapes = jax.eval_shape(partial(init_params, cfg=cfg), key)
+    shardings = param_shardings(shapes, mesh)
+    p_init = jax.jit(partial(init_params, cfg=cfg), out_shardings=shardings)
+    params = p_init(key)
+    opt_state = jax.jit(optimizer.init)(params)
+    return TrainState(params=params, opt_state=opt_state,
+                      step=jnp.zeros((), dtype=jnp.int32))
+
+
+def make_train_step(cfg: LlamaConfig, mesh: Mesh,
+                    optimizer: optax.GradientTransformation, *,
+                    sp: bool = False, donate: bool = True):
+    """Compile a (state, tokens) -> (state, metrics) step.
+
+    tokens arrive sharded P("dp"[, "sp"]) — exactly the sharding
+    strom.pipelines loaders deliver — so no resharding happens on entry.
+    """
+    batch_sharding = NamedSharding(mesh, P("dp", "sp") if sp else P("dp", None))
+
+    def step(state: TrainState, tokens: jax.Array):
+        loss, grads = jax.value_and_grad(next_token_loss)(state.params, tokens, cfg)
+        updates, new_opt = optimizer.update(grads, state.opt_state, state.params)
+        new_params = optax.apply_updates(state.params, updates)
+        metrics = {"loss": loss, "grad_norm": optax.global_norm(grads)}
+        return TrainState(new_params, new_opt, state.step + 1), metrics
+
+    donate_argnums = (0,) if donate else ()
+    return jax.jit(step, in_shardings=(None, batch_sharding),
+                   donate_argnums=donate_argnums)
+
+
+jax.tree_util.register_dataclass(TrainState,
+                                 data_fields=["params", "opt_state", "step"],
+                                 meta_fields=[])

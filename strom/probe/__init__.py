@@ -1,0 +1,4 @@
+from strom.probe.check import FileReport, PathTier, check_file  # noqa: F401
+from strom.probe.fiemap import Extent, fiemap  # noqa: F401
+from strom.probe.odirect import DioAlignment, probe_dio  # noqa: F401
+from strom.probe.topology import BlockDevice, device_for_file, list_nvme_devices  # noqa: F401

@@ -1,0 +1,131 @@
+"""``strom.check_file`` — userspace equivalent of STROM_IOCTL__CHECK_FILE.
+
+The reference's CHECK_FILE ioctl *refuses* files that can't take the direct
+path (wrong fs, non-NVMe device; SURVEY.md §3.1; reference cite UNVERIFIED —
+empty mount, SURVEY.md §0).  strom-tpu instead *tiers* every file: the engine
+always works, but the report says which path the file will ride and why, so
+callers (and tests) can assert the fast path is actually in play.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+import os
+
+from strom.probe import fiemap as _fiemap
+from strom.probe.odirect import DioAlignment, probe_dio
+from strom.probe.topology import BlockDevice, device_for_file
+
+# statfs f_type magics (linux/magic.h)
+_FS_MAGICS = {
+    0xEF53: "ext4",
+    0x58465342: "xfs",
+    0x9123683E: "btrfs",
+    0x01021994: "tmpfs",
+    0x6969: "nfs",
+    0x794C7630: "overlayfs",
+    0x2FC12FC1: "zfs",
+    0xF2F52010: "f2fs",
+}
+
+
+class PathTier(enum.Enum):
+    """Which data path the file will ride (fast → slow)."""
+
+    DIRECT_NVME = "direct-nvme"    # O_DIRECT onto an NVMe (or raid0-of-NVMe) device
+    DIRECT = "direct"              # O_DIRECT but device class unknown / not NVMe
+    BUFFERED = "buffered"          # page-cache reads (≙ reference's cached-page fallback)
+
+
+@dataclasses.dataclass(frozen=True)
+class FileReport:
+    path: str
+    size: int
+    fs_type: str
+    tier: PathTier
+    dio: DioAlignment
+    device: BlockDevice | None
+    extents: int                  # number of mapped extents (0 = map unavailable)
+    extent_coverage: float        # fraction of file covered by reliable extents
+    reasons: tuple[str, ...]      # human-readable: why this tier
+
+    @property
+    def supported(self) -> bool:
+        """Parity with the reference's boolean CHECK_FILE verdict: True when the
+        direct path is available."""
+        return self.tier in (PathTier.DIRECT_NVME, PathTier.DIRECT)
+
+
+def check_file(path: str, *, want_extents: bool = True) -> FileReport:
+    st = os.stat(path)
+    fs_type = _fs_type(path)
+    reasons: list[str] = []
+
+    dio = probe_dio(path)
+    device = None
+    try:
+        device = device_for_file(path)
+    except OSError:
+        pass
+
+    extents = 0
+    cov = 0.0
+    if want_extents and st.st_size > 0:
+        try:
+            ext = _fiemap.fiemap(path)
+            extents = len(ext)
+            cov = _fiemap.coverage([e for e in ext if e.is_reliable], st.st_size)
+        except OSError:
+            reasons.append("fiemap unavailable on this filesystem")
+
+    if not dio.supported:
+        tier = PathTier.BUFFERED
+        reasons.append(f"O_DIRECT unsupported (source={dio.source}); buffered fallback")
+    else:
+        if device is not None and device.fast_class in ("nvme", "raid0-nvme"):
+            tier = PathTier.DIRECT_NVME
+            reasons.append(f"O_DIRECT on {device.fast_class} device {device.name}")
+        else:
+            tier = PathTier.DIRECT
+            dev = device.name if device else "unresolvable"
+            reasons.append(f"O_DIRECT supported; device {dev} not identified as NVMe")
+
+    return FileReport(
+        path=os.path.abspath(path),
+        size=st.st_size,
+        fs_type=fs_type,
+        tier=tier,
+        dio=dio,
+        device=device,
+        extents=extents,
+        extent_coverage=cov,
+        reasons=tuple(reasons),
+    )
+
+
+def _fs_type(path: str) -> str:
+    import ctypes
+
+    class _StatFs(ctypes.Structure):
+        _fields_ = [
+            ("f_type", ctypes.c_long),
+            ("f_bsize", ctypes.c_long),
+            ("f_blocks", ctypes.c_ulong),
+            ("f_bfree", ctypes.c_ulong),
+            ("f_bavail", ctypes.c_ulong),
+            ("f_files", ctypes.c_ulong),
+            ("f_ffree", ctypes.c_ulong),
+            ("f_fsid", ctypes.c_long * 2),
+            ("f_namelen", ctypes.c_long),
+            ("f_frsize", ctypes.c_long),
+            ("f_flags", ctypes.c_long),
+            ("f_spare", ctypes.c_long * 4),
+        ]
+
+    libc = ctypes.CDLL(None, use_errno=True)
+    buf = _StatFs()
+    rc = libc.statfs(os.fsencode(path), ctypes.byref(buf))
+    if rc != 0:
+        return "unknown"
+    return _FS_MAGICS.get(buf.f_type & 0xFFFFFFFF, f"0x{buf.f_type & 0xFFFFFFFF:X}")

@@ -1,0 +1,1 @@
+from strom.utils.stats import StatsRegistry, global_stats  # noqa: F401

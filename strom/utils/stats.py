@@ -1,0 +1,131 @@
+"""Counters / observability.
+
+Reference equivalent: per-module DMA stat counters (ops, bytes, latency
+clocks) exposed through the ``/proc/nvme-strom`` node and a stat ioctl
+(SURVEY.md §2.1 "Stats/observability"; reference cite UNVERIFIED — empty
+mount, SURVEY.md §0).  strom-tpu keeps the counters in-process: engines and
+the delivery layer feed a registry snapshot-able via :func:`strom.stats` and
+dumpable in Prometheus text format.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Iterable
+
+
+class _Counter:
+    __slots__ = ("value", "_lock")
+
+    def __init__(self) -> None:
+        self.value = 0
+        self._lock = threading.Lock()
+
+    def add(self, n: int = 1) -> None:
+        with self._lock:
+            self.value += n
+
+
+class _Histogram:
+    """Fixed-bucket latency histogram (microseconds, log2 buckets)."""
+
+    N_BUCKETS = 24  # 1us .. ~8s
+
+    __slots__ = ("buckets", "count", "total_us", "_lock")
+
+    def __init__(self) -> None:
+        self.buckets = [0] * self.N_BUCKETS
+        self.count = 0
+        self.total_us = 0.0
+        self._lock = threading.Lock()
+
+    def observe_us(self, us: float) -> None:
+        b = max(0, min(self.N_BUCKETS - 1, int(us).bit_length()))
+        with self._lock:
+            self.buckets[b] += 1
+            self.count += 1
+            self.total_us += us
+
+    def percentile(self, q: float) -> float:
+        """Approximate percentile in microseconds (upper bucket bound)."""
+        with self._lock:
+            if not self.count:
+                return 0.0
+            target = q * self.count
+            acc = 0
+            for i, n in enumerate(self.buckets):
+                acc += n
+                if acc >= target:
+                    return float(2 ** i)
+            return float(2 ** (self.N_BUCKETS - 1))
+
+    @property
+    def mean_us(self) -> float:
+        return self.total_us / self.count if self.count else 0.0
+
+
+class StatsRegistry:
+    """Named counters + histograms; one global instance + per-engine instances."""
+
+    def __init__(self, name: str = "strom") -> None:
+        self.name = name
+        self._counters: dict[str, _Counter] = {}
+        self._hists: dict[str, _Histogram] = {}
+        self._lock = threading.Lock()
+        self.created_at = time.time()
+
+    def counter(self, name: str) -> _Counter:
+        with self._lock:
+            c = self._counters.get(name)
+            if c is None:
+                c = self._counters[name] = _Counter()
+            return c
+
+    def histogram(self, name: str) -> _Histogram:
+        with self._lock:
+            h = self._hists.get(name)
+            if h is None:
+                h = self._hists[name] = _Histogram()
+            return h
+
+    def add(self, name: str, n: int = 1) -> None:
+        self.counter(name).add(n)
+
+    def observe_us(self, name: str, us: float) -> None:
+        self.histogram(name).observe_us(us)
+
+    def snapshot(self) -> dict:
+        out: dict = {}
+        with self._lock:
+            counters = dict(self._counters)
+            hists = dict(self._hists)
+        for k, c in counters.items():
+            out[k] = c.value
+        for k, h in hists.items():
+            out[k + "_p50_us"] = h.percentile(0.50)
+            out[k + "_p99_us"] = h.percentile(0.99)
+            out[k + "_mean_us"] = h.mean_us
+            out[k + "_count"] = h.count
+        return out
+
+    def merge(self, others: Iterable["StatsRegistry"]) -> dict:
+        merged = self.snapshot()
+        for o in others:
+            for k, v in o.snapshot().items():
+                key = f"{o.name}.{k}"
+                merged[key] = v
+        return merged
+
+    def prometheus(self) -> str:
+        """Prometheus text exposition of every counter/histogram summary."""
+        lines = []
+        snap = self.snapshot()
+        for k, v in sorted(snap.items()):
+            metric = f"{self.name}_{k}".replace(".", "_").replace("-", "_")
+            lines.append(f"# TYPE {metric} gauge")
+            lines.append(f"{metric} {v}")
+        return "\n".join(lines) + "\n"
+
+
+global_stats = StatsRegistry("strom")

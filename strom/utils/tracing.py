@@ -1,0 +1,20 @@
+"""Trace annotations so I/O shows up in jax profiler traces (SURVEY.md §5
+"Tracing/profiling"). No-ops when jax.profiler is unavailable or disabled."""
+
+from __future__ import annotations
+
+import contextlib
+
+
+@contextlib.contextmanager
+def trace_span(name: str, *, enabled: bool = True):
+    if not enabled:
+        yield
+        return
+    try:
+        from jax.profiler import TraceAnnotation
+    except Exception:
+        yield
+        return
+    with TraceAnnotation(name):
+        yield

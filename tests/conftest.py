@@ -1,0 +1,43 @@
+"""Test env: force the jax CPU backend with a fake 8-device mesh BEFORE any
+jax import (SURVEY.md §4.2 "Device delivery" row)."""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+flags = os.environ.get("XLA_FLAGS", "")
+if "--xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+
+# The axon sandbox pins JAX_PLATFORMS=axon at interpreter startup; the config
+# update (before any backend is touched) wins over it.
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np
+import pytest
+
+
+@pytest.fixture(scope="session")
+def rng():
+    return np.random.default_rng(1234)
+
+
+@pytest.fixture()
+def data_file(tmp_path, rng):
+    """A 4MiB+tail random file on real disk (tmp_path is on ext4 here, so
+    O_DIRECT works; SURVEY.md §4.2 'Engine integration' row)."""
+    data = rng.integers(0, 256, size=4 * 1024 * 1024 + 777, dtype=np.uint8)
+    p = tmp_path / "data.bin"
+    data.tofile(p)
+    return str(p), data
+
+
+@pytest.fixture(params=["python", "uring"])
+def engine_name(request):
+    if request.param == "uring":
+        from strom.engine.uring_engine import uring_available
+
+        if not uring_available():
+            pytest.skip("io_uring unavailable in this sandbox")
+    return request.param

@@ -1,0 +1,135 @@
+"""Engine integration on real files: integrity, alignment fallbacks, EOF,
+queue-depth pipelining, faults, stats (SURVEY.md §4.2 Engine/Integrity rows).
+Runs against both the C++ io_uring engine and the Python fallback."""
+
+import os
+
+import numpy as np
+import pytest
+
+from strom.config import StromConfig
+from strom.delivery.buffers import alloc_aligned
+from strom.engine import make_engine
+from strom.engine.base import EngineError, RawRead, ReadRequest
+
+
+@pytest.fixture()
+def engine(engine_name):
+    cfg = StromConfig(engine=engine_name, queue_depth=16, num_buffers=16)
+    eng = make_engine(cfg)
+    assert eng.name == engine_name
+    yield eng
+    eng.close()
+
+
+def test_pool_read_integrity(engine, data_file):
+    path, data = data_file
+    fi = engine.register_file(path)
+    out = np.zeros(len(data), dtype=np.uint8)
+    n = engine.read_into(fi, 0, len(data), out)
+    assert n == len(data)
+    np.testing.assert_array_equal(out, data)
+
+
+@pytest.mark.parametrize("offset,length", [
+    (0, 4096),          # aligned
+    (1234, 100_000),    # unaligned offset+length
+    (4096, 128 * 1024), # one full block
+    (0, 1),             # tiny
+])
+def test_ranged_reads(engine, data_file, offset, length):
+    path, data = data_file
+    fi = engine.register_file(path)
+    out = np.zeros(length, dtype=np.uint8)
+    n = engine.read_into(fi, offset, length, out)
+    assert n == length
+    np.testing.assert_array_equal(out, data[offset:offset + length])
+
+
+def test_eof_short_read(engine, data_file):
+    path, data = data_file
+    fi = engine.register_file(path)
+    out = np.zeros(8192, dtype=np.uint8)
+    n = engine.read_into(fi, len(data) - 1000, 8192, out)
+    assert n == 1000
+    np.testing.assert_array_equal(out[:1000], data[-1000:])
+
+
+def test_raw_slab_read(engine, data_file):
+    path, data = data_file
+    fi = engine.register_file(path)
+    dest = alloc_aligned(len(data))
+    n = engine.read_into_direct(fi, 0, len(data), dest)
+    assert n == len(data)
+    np.testing.assert_array_equal(dest, data)
+
+
+def test_queue_depth_enforced(engine, data_file):
+    path, _ = data_file
+    fi = engine.register_file(path)
+    # submit up to depth; the next one must fail with EAGAIN
+    reqs = [ReadRequest(fi, i * 4096, 4096, i % engine.num_buffers, i)
+            for i in range(engine.config.queue_depth)]
+    engine.submit(reqs)
+    with pytest.raises(EngineError):
+        engine.submit([ReadRequest(fi, 0, 4096, 0, 999)])
+    got = []
+    while len(got) < len(reqs):
+        got.extend(engine.wait(min_completions=1, timeout_s=10))
+    assert sorted(c.tag for c in got) == sorted(r.tag for r in reqs)
+
+
+def test_completion_tags_and_buffers(engine, data_file):
+    path, data = data_file
+    fi = engine.register_file(path)
+    engine.submit([ReadRequest(fi, 8192, 4096, 3, tag=42)])
+    (c,) = engine.wait(min_completions=1, timeout_s=10)
+    assert c.tag == 42 and c.result == 4096
+    np.testing.assert_array_equal(engine.buffer(3)[:4096], data[8192:8192 + 4096])
+
+
+def test_fault_injection(engine_name, data_file):
+    path, _ = data_file
+    cfg = StromConfig(engine=engine_name, queue_depth=8, num_buffers=8, fault_every=2)
+    eng = make_engine(cfg)
+    try:
+        fi = eng.register_file(path)
+        results = []
+        for i in range(8):
+            eng.submit([ReadRequest(fi, 0, 4096, i % 8, i)])
+            (c,) = eng.wait(min_completions=1, timeout_s=10)
+            results.append(c.result)
+        errors = [r for r in results if r < 0]
+        assert len(errors) == 4  # every 2nd op faults with -EIO
+        assert all(r == -5 for r in errors)
+        assert eng.stats()["ops_faulted"] == 4
+    finally:
+        eng.close()
+
+
+def test_stats_accounting(engine, data_file):
+    path, data = data_file
+    fi = engine.register_file(path)
+    out = np.zeros(len(data), dtype=np.uint8)
+    engine.read_into(fi, 0, len(data), out)
+    s = engine.stats()
+    assert s["bytes_read"] >= len(data)
+    assert s["ops_completed"] >= len(data) // engine.config.block_size
+    assert s["in_flight"] == 0
+
+
+def test_o_direct_denied_falls_back(engine, tmp_path):
+    """/proc files refuse O_DIRECT; registration must degrade, not fail."""
+    fi = engine.register_file("/proc/self/status")
+    assert engine.file_uses_o_direct(fi) is False
+    out = np.zeros(64, dtype=np.uint8)
+    n = engine.read_into(fi, 0, 64, out)
+    assert n > 0
+
+
+def test_unregister_file(engine, data_file):
+    path, _ = data_file
+    fi = engine.register_file(path)
+    engine.unregister_file(fi)
+    with pytest.raises(Exception):
+        engine.file_uses_o_direct(fi)

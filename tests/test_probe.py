@@ -1,0 +1,58 @@
+"""Probe layer: FIEMAP, O_DIRECT probing, check_file tiers (SURVEY.md §4.2)."""
+
+import os
+
+import numpy as np
+import pytest
+
+from strom.probe import check_file, fiemap, probe_dio
+from strom.probe.check import PathTier
+from strom.probe.fiemap import coverage
+
+
+def test_probe_dio_regular_file(data_file):
+    path, _ = data_file
+    dio = probe_dio(path)
+    assert dio.supported in (True, False)
+    if dio.supported:
+        assert dio.mem_align > 0 and dio.offset_align > 0
+        assert dio.mem_align % 512 == 0 or dio.mem_align in (1, 512)
+
+
+def test_fiemap_covers_file(data_file):
+    path, data = data_file
+    try:
+        ext = fiemap(path)
+    except OSError:
+        pytest.skip("fiemap unsupported on this filesystem")
+    assert ext, "expected at least one extent"
+    assert coverage([e for e in ext if e.is_reliable], len(data)) >= 0.99
+
+
+def test_fiemap_on_sparse_file(tmp_path):
+    p = tmp_path / "sparse.bin"
+    with open(p, "wb") as f:
+        f.seek(10 * 1024 * 1024 - 1)
+        f.write(b"\x01")
+    try:
+        ext = fiemap(str(p))
+    except OSError:
+        pytest.skip("fiemap unsupported")
+    total = sum(e.length for e in ext)
+    assert total < 10 * 1024 * 1024  # holes are not mapped
+
+
+def test_check_file_report(data_file):
+    path, data = data_file
+    rep = check_file(path)
+    assert rep.size == len(data)
+    assert rep.tier in (PathTier.DIRECT_NVME, PathTier.DIRECT, PathTier.BUFFERED)
+    assert rep.reasons
+    # the verdict mirror of the reference's CHECK_FILE boolean
+    assert rep.supported == (rep.tier != PathTier.BUFFERED)
+    assert rep.fs_type != ""
+
+
+def test_check_file_missing(tmp_path):
+    with pytest.raises(FileNotFoundError):
+        check_file(str(tmp_path / "nope.bin"))

@@ -1,0 +1,92 @@
+"""Shard planning: NamedSharding → byte segments (SURVEY.md §4.2 Unit row)."""
+
+import numpy as np
+import pytest
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from strom.delivery.shard import contiguous_segments, dedupe_plans, plan_sharded_read
+
+
+def segments_equal_numpy(shape, dtype, index):
+    """Golden check: reading the planned segments out of the raw bytes must
+    equal numpy's fancy-indexed sub-block."""
+    arr = np.arange(np.prod(shape), dtype=dtype).reshape(shape)
+    raw = arr.tobytes()
+    segs = list(contiguous_segments(shape, np.dtype(dtype).itemsize, index))
+    sub = arr[index]
+    out = bytearray(sub.nbytes)
+    for s in segs:
+        out[s.dest_offset:s.dest_offset + s.length] = raw[s.file_offset:s.file_offset + s.length]
+    np.testing.assert_array_equal(
+        np.frombuffer(bytes(out), dtype=dtype).reshape(sub.shape), sub)
+    return segs
+
+
+@pytest.mark.parametrize("shape,index,max_segs", [
+    ((8, 4), (slice(0, 4), slice(None)), 1),       # axis0 shard = 1 contiguous run
+    ((8, 4), (slice(2, 6), slice(None)), 1),
+    ((8, 4), (slice(None), slice(0, 2)), 8),       # axis1 shard = per-row runs
+    ((4, 4, 4), (slice(1, 3), slice(None), slice(None)), 1),
+    ((4, 4, 4), (slice(None), slice(1, 3), slice(None)), 4),
+    ((4, 4, 4), (slice(0, 2), slice(0, 2), slice(None)), 4),
+    ((16,), (slice(4, 12),), 1),
+])
+def test_contiguous_segments_golden(shape, index, max_segs):
+    segs = segments_equal_numpy(shape, np.int32, index)
+    assert len(segs) <= max_segs
+
+
+def test_plan_sharded_read_batch_axis():
+    devs = jax.devices()
+    assert len(devs) == 8, "conftest must fake 8 CPU devices"
+    mesh = Mesh(np.array(devs).reshape(8), ("dp",))
+    sharding = NamedSharding(mesh, P("dp"))
+    plans = plan_sharded_read((16, 128), np.float32, sharding)
+    assert len(plans) == 8
+    for p in plans:
+        assert p.local_shape == (2, 128)
+        assert len(p.segments) == 1  # batch-axis shard is contiguous
+        assert p.nbytes == 2 * 128 * 4
+    # all byte ranges disjoint, covering the file exactly
+    offs = sorted((p.segments[0].file_offset, p.segments[0].length) for p in plans)
+    expect = 0
+    for off, ln in offs:
+        assert off == expect
+        expect = off + ln
+    assert expect == 16 * 128 * 4
+
+
+def test_plan_sharded_read_2d():
+    devs = jax.devices()
+    mesh = Mesh(np.array(devs).reshape(4, 2), ("dp", "tp"))
+    sharding = NamedSharding(mesh, P("dp", "tp"))
+    plans = plan_sharded_read((8, 64), np.int8, sharding)
+    assert len(plans) == 8
+    for p in plans:
+        assert p.local_shape == (2, 32)
+        assert len(p.segments) == 2  # two rows, half-row each
+
+
+def test_replicated_shards_deduped():
+    devs = jax.devices()
+    mesh = Mesh(np.array(devs).reshape(8), ("dp",))
+    sharding = NamedSharding(mesh, P(None))  # fully replicated
+    plans = plan_sharded_read((4, 4), np.float32, sharding)
+    groups = dedupe_plans(plans)
+    assert len(groups) == 1  # single read, 8 device_puts
+    (segs, group), = groups.items()
+    assert len(group) == 8
+
+
+def test_sequence_dim_sharding():
+    """Llama packed-token loaders must accept sequence-axis sharding
+    (SURVEY.md §5 'Long-context' row)."""
+    devs = jax.devices()
+    mesh = Mesh(np.array(devs).reshape(2, 4), ("dp", "sp"))
+    sharding = NamedSharding(mesh, P("dp", "sp"))
+    plans = plan_sharded_read((4, 4096), np.int32, sharding)
+    for p in plans:
+        assert p.local_shape == (2, 1024)
+        assert len(p.segments) == 2
