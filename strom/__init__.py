@@ -24,7 +24,8 @@ import threading
 from typing import Any
 
 from strom.config import DEFAULT_CONFIG, StromConfig  # noqa: F401
-from strom.delivery.core import StripedFile, StromContext  # noqa: F401
+from strom.delivery.core import Source, StripedFile, StromContext  # noqa: F401
+from strom.delivery.extents import Extent, ExtentList  # noqa: F401
 from strom.delivery.handle import DMAHandle  # noqa: F401
 from strom.delivery.prefetch import Prefetcher  # noqa: F401
 from strom.probe.check import FileReport, PathTier, check_file  # noqa: F401
@@ -54,7 +55,7 @@ def context() -> StromContext:
         return _ctx
 
 
-def memcpy_ssd2tpu(source: str | StripedFile, **kwargs: Any):
+def memcpy_ssd2tpu(source: Source, **kwargs: Any):
     """Read a byte range / array from NVMe and deliver it to TPU. See
     StromContext.memcpy_ssd2tpu for arguments."""
     return context().memcpy_ssd2tpu(source, **kwargs)

@@ -24,6 +24,7 @@ import numpy as np
 
 from strom.config import StromConfig
 from strom.delivery.buffers import alloc_aligned
+from strom.delivery.extents import ExtentList
 from strom.delivery.handle import DMAHandle, deferred_handle
 from strom.delivery.shard import DevicePlan, Segment, dedupe_plans, plan_sharded_read
 from strom.engine import make_engine
@@ -49,6 +50,15 @@ class StripedFile:
         sizes = [os.stat(m).st_size for m in self.members]
         usable = min(sizes) // self.chunk * self.chunk
         return usable * len(self.members)
+
+
+# anything memcpy_ssd2tpu / pread can read from
+Source = str | StripedFile | ExtentList
+
+
+def source_size(source: Source) -> int:
+    return source.size if isinstance(source, (StripedFile, ExtentList)) \
+        else os.stat(source).st_size
 
 
 class StromContext:
@@ -84,7 +94,7 @@ class StromContext:
             return idx
 
     # -- raw range read into a fresh aligned slab ---------------------------
-    def _read_segments(self, source: str | StripedFile,
+    def _read_segments(self, source: "Source",
                        segments: Sequence[Segment], dest: np.ndarray,
                        base_offset: int = 0) -> int:
         """Read (file_offset+base_offset → dest_offset) segments, chunked at
@@ -100,6 +110,12 @@ class StromContext:
                                            len(source.members), source.chunk):
                     dest_off = seg.dest_offset + (s.logical_offset - (base_offset + seg.file_offset))
                     chunks.append((member_idx[s.member], s.member_offset, dest_off, s.length))
+        elif isinstance(source, ExtentList):
+            for seg in segments:
+                for r in source.locate(base_offset + seg.file_offset, seg.length,
+                                       seg.dest_offset):
+                    chunks.append((self.file_index(r.path), r.offset,
+                                   r.dest_offset, r.length))
         else:
             fi = self.file_index(source)
             chunks = [(fi, base_offset + s.file_offset, s.dest_offset, s.length)
@@ -156,7 +172,7 @@ class StromContext:
         return total
 
     # -- the public hot path -------------------------------------------------
-    def memcpy_ssd2tpu(self, source: str | StripedFile, *,
+    def memcpy_ssd2tpu(self, source: "Source", *,
                        offset: int = 0,
                        shape: Sequence[int] | None = None,
                        dtype: Any = np.uint8,
@@ -185,15 +201,19 @@ class StromContext:
         np_dtype = np.dtype(dtype)
         if shape is None:
             if length is None:
-                size = source.size if isinstance(source, StripedFile) else os.stat(source).st_size
-                length = size - offset
+                length = source_size(source) - offset
             if length % np_dtype.itemsize:
                 raise ValueError(f"length {length} not a multiple of dtype itemsize")
             shape = (length // np_dtype.itemsize,)
         shape = tuple(int(s) for s in shape)
         nbytes = math.prod(shape) * np_dtype.itemsize
 
-        label = f"{source if isinstance(source, str) else '+'.join(source.members)}@{offset}"
+        if isinstance(source, str):
+            label = f"{source}@{offset}"
+        elif isinstance(source, StripedFile):
+            label = f"{'+'.join(source.members)}@{offset}"
+        else:
+            label = f"{source!r}@{offset}"
 
         def run() -> Any:
             from strom.utils.tracing import trace_span
@@ -221,6 +241,22 @@ class StromContext:
         if async_:
             return deferred_handle(run, self._executor, nbytes, label)
         return run()
+
+    # -- host-side range read (format readers: indexes, footers, members) ---
+    def pread(self, source: "Source", offset: int = 0,
+              length: int | None = None) -> np.ndarray:
+        """Read bytes from *source* into a fresh aligned host slab (no device
+        transfer). The staging path format readers use for metadata and member
+        payloads before decode."""
+        if self._closed:
+            raise RuntimeError("StromContext is closed")
+        if length is None:
+            length = source_size(source) - offset
+        if length == 0:
+            return np.empty(0, dtype=np.uint8)
+        dest = alloc_aligned(length)
+        self._read_segments(source, [Segment(0, 0, length)], dest, offset)
+        return dest
 
     # -- introspection (≙ LIST/INFO_GPU_MEMORY, /proc stats) ----------------
     def buffer_info(self) -> dict:

@@ -1,0 +1,14 @@
+"""T2 format readers: record extraction over block reads (SURVEY.md §1 layer
+T2, §7.2 step 7).
+
+Each reader compiles its container format's record layout into
+:class:`strom.delivery.extents.ExtentList` byte-range plans; the delivery
+layer (T3) does the actual I/O, so every format automatically gets O_DIRECT,
+RAID0 striping, sharded reads and async handles.
+"""
+
+from strom.formats.rawbin import TokenShardSet  # noqa: F401
+from strom.formats.wds import TarIndex, TarMember, WdsSample, WdsShardSet  # noqa: F401
+from strom.formats.jpeg import (  # noqa: F401
+    DecodePool, center_crop_resize, decode_jpeg, random_resized_crop)
+from strom.formats.parquet import ParquetShard  # noqa: F401

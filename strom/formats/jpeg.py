@@ -1,0 +1,115 @@
+"""JPEG host decode + ImageNet-style transforms (SURVEY.md §7.2 step 7:
+"JPEG (host decode worker pool)").
+
+The engine lands compressed bytes in host slabs; decode runs on a thread pool
+(cv2 releases the GIL inside imdecode, so threads scale) and the decoded
+uint8 tensor is what gets `device_put`.  Keeping decode on host mirrors the
+division of labor in the reference's consumer (PG-Strom decompresses on GPU —
+strom-tpu instead keeps the TPU's MXU for the model and spends host cores on
+decode; the "0 data-stall" overlap hides both).  Consumer: the ResNet-50
+pipeline (BASELINE config #2, BASELINE.json:8).
+"""
+
+from __future__ import annotations
+
+import concurrent.futures
+from typing import Callable, Iterable, Sequence
+
+import numpy as np
+
+try:
+    import cv2
+
+    cv2.setNumThreads(0)  # we parallelise across images, not within one
+    _HAVE_CV2 = True
+except Exception:  # pragma: no cover - cv2 is present in the target image
+    _HAVE_CV2 = False
+
+try:
+    from PIL import Image
+    import io
+
+    _HAVE_PIL = True
+except Exception:  # pragma: no cover
+    _HAVE_PIL = False
+
+
+def decode_jpeg(data: bytes | np.ndarray) -> np.ndarray:
+    """Decode JPEG/PNG bytes → HWC uint8 RGB array."""
+    if _HAVE_CV2:
+        buf = np.frombuffer(data, dtype=np.uint8) if isinstance(data, (bytes, memoryview)) \
+            else data.view(np.uint8).reshape(-1)
+        img = cv2.imdecode(buf, cv2.IMREAD_COLOR)
+        if img is None:
+            raise ValueError("not a decodable image")
+        return cv2.cvtColor(img, cv2.COLOR_BGR2RGB)
+    if _HAVE_PIL:
+        raw = data.tobytes() if isinstance(data, np.ndarray) else bytes(data)
+        with Image.open(io.BytesIO(raw)) as im:
+            return np.asarray(im.convert("RGB"))
+    raise RuntimeError("no JPEG decoder available (need cv2 or PIL)")
+
+
+def _resize(img: np.ndarray, h: int, w: int) -> np.ndarray:
+    if _HAVE_CV2:
+        return cv2.resize(img, (w, h), interpolation=cv2.INTER_LINEAR)
+    return np.asarray(Image.fromarray(img).resize((w, h), Image.BILINEAR))
+
+
+def center_crop_resize(img: np.ndarray, size: int,
+                       *, resize_shorter: int | None = None) -> np.ndarray:
+    """Eval transform: resize shorter side (default size*1.15), center crop."""
+    shorter = resize_shorter or int(size * 1.15)
+    h, w = img.shape[:2]
+    scale = shorter / min(h, w)
+    img = _resize(img, max(size, round(h * scale)), max(size, round(w * scale)))
+    h, w = img.shape[:2]
+    top, left = (h - size) // 2, (w - size) // 2
+    return img[top: top + size, left: left + size]
+
+
+def random_resized_crop(img: np.ndarray, size: int, rng: np.random.Generator,
+                        *, scale: tuple[float, float] = (0.08, 1.0),
+                        ratio: tuple[float, float] = (3 / 4, 4 / 3)) -> np.ndarray:
+    """Train transform: Inception-style random area/aspect crop → size×size,
+    plus a horizontal flip coin."""
+    h, w = img.shape[:2]
+    area = h * w
+    for _ in range(10):
+        target = area * rng.uniform(*scale)
+        log_r = rng.uniform(np.log(ratio[0]), np.log(ratio[1]))
+        ar = np.exp(log_r)
+        cw = round(np.sqrt(target * ar))
+        ch = round(np.sqrt(target / ar))
+        if 0 < cw <= w and 0 < ch <= h:
+            top = int(rng.integers(0, h - ch + 1))
+            left = int(rng.integers(0, w - cw + 1))
+            img = img[top: top + ch, left: left + cw]
+            break
+    else:
+        img = center_crop_resize(img, min(h, w), resize_shorter=min(h, w))
+    out = _resize(img, size, size)
+    if rng.random() < 0.5:
+        out = out[:, ::-1]
+    return np.ascontiguousarray(out)
+
+
+class DecodePool:
+    """Thread pool mapping decode+transform over batches of member payloads."""
+
+    def __init__(self, workers: int = 8):
+        self._pool = concurrent.futures.ThreadPoolExecutor(
+            max_workers=workers, thread_name_prefix="strom-decode")
+
+    def map(self, fn: Callable[..., np.ndarray],
+            items: Iterable, *extra: Sequence) -> list[np.ndarray]:
+        return list(self._pool.map(fn, items, *extra))
+
+    def close(self) -> None:
+        self._pool.shutdown(wait=True)
+
+    def __enter__(self) -> "DecodePool":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()

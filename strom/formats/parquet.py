@@ -1,0 +1,220 @@
+"""Parquet columnar reads: column-chunk byte ranges through the engine,
+decode via pyarrow (SURVEY.md §7.2 step 7: "Parquet (column-chunk range reads
+via metadata footer)").
+
+This mirrors the reference's flagship consumer pattern — PG-Strom scans
+Parquet-ish columnar blocks straight from NVMe into the accelerator
+(SURVEY.md §0.5) — re-cut for TPU: the *selected columns'* compressed chunks
+are gather-read (O_DIRECT, RAID0, sharded fan-out all apply), decoded on
+host, and only the projected/filtered table ever reaches HBM.  Consumer: the
+Parquet scan fan-out pipeline (BASELINE config #5, BASELINE.json:11).
+"""
+
+from __future__ import annotations
+
+import bisect
+import os
+from typing import TYPE_CHECKING, Sequence
+
+import numpy as np
+
+from strom.delivery.extents import Extent, ExtentList
+
+if TYPE_CHECKING:
+    import pyarrow as pa
+
+    from strom.delivery.core import StromContext
+
+
+class _RangeCache:
+    """Sorted, non-overlapping (offset → bytes) ranges of one file."""
+
+    def __init__(self) -> None:
+        self._offsets: list[int] = []
+        self._bufs: list[np.ndarray] = []
+        self.miss_bytes = 0
+
+    def insert(self, offset: int, buf: np.ndarray) -> None:
+        i = bisect.bisect_left(self._offsets, offset)
+        self._offsets.insert(i, offset)
+        self._bufs.insert(i, buf)
+
+    def read(self, offset: int, length: int, fallback_fd: int) -> bytes:
+        """Serve [offset, +length), stitching cached ranges; gaps fall back to
+        pread on the real file (counted as miss bytes)."""
+        out = bytearray(length)
+        pos = offset
+        end = offset + length
+        while pos < end:
+            i = bisect.bisect_right(self._offsets, pos) - 1
+            hit = None
+            if i >= 0:
+                ro, rb = self._offsets[i], self._bufs[i]
+                if ro <= pos < ro + len(rb):
+                    hit = rb[pos - ro: pos - ro + (end - pos)]
+            if hit is not None and len(hit) > 0:
+                out[pos - offset: pos - offset + len(hit)] = hit.tobytes()
+                pos += len(hit)
+                continue
+            # miss: read up to the next cached range (or to end)
+            j = bisect.bisect_right(self._offsets, pos)
+            stop = min(end, self._offsets[j]) if j < len(self._offsets) else end
+            data = os.pread(fallback_fd, stop - pos, pos)
+            if not data:
+                return bytes(out[: pos - offset])  # EOF
+            out[pos - offset: pos - offset + len(data)] = data
+            self.miss_bytes += len(data)
+            pos += len(data)
+        return bytes(out)
+
+
+class RangeCachedFile:
+    """File-like object over a _RangeCache; what pyarrow decodes from.
+
+    pyarrow wraps this in a PythonFile; all reads it issues for the footer and
+    the selected column chunks are served from engine-prefetched ranges."""
+
+    def __init__(self, path: str, cache: _RangeCache):
+        self._cache = cache
+        self._fd = os.open(path, os.O_RDONLY)
+        self._size = os.fstat(self._fd).st_size
+        self._pos = 0
+        self._closed = False
+
+    def read(self, n: int = -1) -> bytes:
+        if n < 0:
+            n = self._size - self._pos
+        n = max(0, min(n, self._size - self._pos))
+        data = self._cache.read(self._pos, n, self._fd)
+        self._pos += len(data)
+        return data
+
+    def seek(self, offset: int, whence: int = os.SEEK_SET) -> int:
+        if whence == os.SEEK_SET:
+            self._pos = offset
+        elif whence == os.SEEK_CUR:
+            self._pos += offset
+        elif whence == os.SEEK_END:
+            self._pos = self._size + offset
+        return self._pos
+
+    def tell(self) -> int:
+        return self._pos
+
+    def size(self) -> int:
+        return self._size
+
+    def readable(self) -> bool:
+        return True
+
+    def seekable(self) -> bool:
+        return True
+
+    def writable(self) -> bool:
+        return False
+
+    def flush(self) -> None:
+        pass
+
+    @property
+    def closed(self) -> bool:
+        return self._closed
+
+    @property
+    def miss_bytes(self) -> int:
+        return self._cache.miss_bytes
+
+    def close(self) -> None:
+        if not self._closed:
+            self._closed = True
+            os.close(self._fd)
+
+
+class ParquetShard:
+    """One Parquet file: metadata once, column chunks as ExtentLists."""
+
+    def __init__(self, path: str):
+        import pyarrow.parquet as pq
+
+        self.path = path
+        self.metadata = pq.read_metadata(path)
+        self._footer_bytes: np.ndarray | None = None  # engine-read once, reused
+        self._col_index = {
+            self.metadata.schema.column(i).path: i
+            for i in range(self.metadata.num_columns)
+        }
+
+    @property
+    def num_row_groups(self) -> int:
+        return self.metadata.num_row_groups
+
+    @property
+    def num_rows(self) -> int:
+        return self.metadata.num_rows
+
+    @property
+    def column_names(self) -> list[str]:
+        return list(self._col_index)
+
+    def _col_indices(self, columns: Sequence[str] | None) -> list[int]:
+        if columns is None:
+            return list(range(self.metadata.num_columns))
+        out = []
+        for c in columns:
+            if c not in self._col_index:
+                raise KeyError(f"no column {c!r} in {self.path} "
+                               f"(have {self.column_names})")
+            out.append(self._col_index[c])
+        return out
+
+    def column_chunk_extents(self, row_group: int,
+                             columns: Sequence[str] | None = None) -> ExtentList:
+        """Byte ranges of the selected columns' compressed chunks in one row
+        group (dictionary page included when present)."""
+        rg = self.metadata.row_group(row_group)
+        exts = []
+        for ci in self._col_indices(columns):
+            col = rg.column(ci)
+            start = col.data_page_offset
+            if col.dictionary_page_offset is not None:
+                start = min(start, col.dictionary_page_offset)
+            exts.append(Extent(self.path, start, col.total_compressed_size))
+        return ExtentList(exts)
+
+    def footer_extent(self) -> ExtentList:
+        """The footer region. pyarrow speculatively reads the trailing 64KiB
+        to find the footer, so cover at least that (or the whole thrift
+        metadata + 4-byte length + 'PAR1' when it's bigger)."""
+        fsize = os.stat(self.path).st_size
+        flen = min(fsize, max(self.metadata.serialized_size + 8, 64 * 1024))
+        return ExtentList([Extent(self.path, fsize - flen, flen)])
+
+    def read_row_group(self, ctx: "StromContext", row_group: int,
+                       columns: Sequence[str] | None = None) -> "pa.Table":
+        """Engine-read the selected chunks + footer, decode to a pyarrow
+        Table. Everything pyarrow touches was prefetched through strom."""
+        import pyarrow.parquet as pq
+
+        chunk_ext = self.column_chunk_extents(row_group, columns)
+        footer_ext = self.footer_extent()
+        if self._footer_bytes is None:
+            self._footer_bytes = ctx.pread(footer_ext)  # immutable: read once
+        buf = ctx.pread(chunk_ext)
+        cache = _RangeCache()
+        cache.insert(footer_ext.extents[0].offset, self._footer_bytes)
+        pos = 0
+        for e in chunk_ext.extents:
+            cache.insert(e.offset, buf[pos: pos + e.length])
+            pos += e.length
+        f = RangeCachedFile(self.path, cache)
+        try:
+            pf = pq.ParquetFile(f)
+            table = pf.read_row_group(
+                row_group, columns=list(columns) if columns is not None else None)
+        finally:
+            f.close()
+        if cache.miss_bytes:
+            from strom.utils.stats import global_stats
+
+            global_stats.add("parquet_cache_miss_bytes", cache.miss_bytes)
+        return table

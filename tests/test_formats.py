@@ -1,0 +1,314 @@
+"""T2 format readers: extents, rawbin records, WebDataset tar, JPEG, Parquet
+(SURVEY.md §4.2 'Integrity' row: format reads == golden bytes/decodes)."""
+
+import io
+import json
+import os
+import tarfile
+
+import numpy as np
+import pytest
+
+from strom.config import StromConfig
+from strom.delivery.core import StromContext
+from strom.delivery.extents import Extent, ExtentList
+from strom.formats.rawbin import TokenShardSet
+from strom.formats.wds import TarIndex, WdsShardSet, split_key
+
+
+@pytest.fixture()
+def ctx(engine_name):
+    # both engines: tar/parquet extents have 512-aligned and arbitrary offsets,
+    # exactly the inputs that exercise the unaligned buffered-fd fallback
+    c = StromContext(StromConfig(engine=engine_name, queue_depth=8, num_buffers=8))
+    yield c
+    c.close()
+
+
+# ---------------------------------------------------------------- ExtentList
+class TestExtentList:
+    def test_locate_spans_extents(self, tmp_path):
+        el = ExtentList([("a", 0, 10), ("b", 100, 5), ("a", 50, 20)])
+        assert el.size == 35
+        runs = list(el.locate(8, 10))
+        assert [(r.path, r.offset, r.length, r.dest_offset) for r in runs] == [
+            ("a", 8, 2, 0), ("b", 100, 5, 2), ("a", 50, 3, 7)]
+
+    def test_locate_bounds(self):
+        el = ExtentList([("a", 0, 10)])
+        with pytest.raises(ValueError):
+            list(el.locate(5, 6))
+        assert list(el.locate(10, 0)) == []
+
+    def test_slice_and_concat(self):
+        el = ExtentList([("a", 0, 10), ("b", 0, 10)])
+        s = el.slice(5, 10)
+        assert s.size == 10
+        assert s.extents == (Extent("a", 5, 5), Extent("b", 0, 5))
+        assert ExtentList.concat([el, s]).size == 30
+
+    def test_pread_gather(self, ctx, tmp_path, rng):
+        a = rng.integers(0, 256, 1000, dtype=np.uint8)
+        b = rng.integers(0, 256, 1000, dtype=np.uint8)
+        pa_, pb = str(tmp_path / "a"), str(tmp_path / "b")
+        a.tofile(pa_)
+        b.tofile(pb)
+        el = ExtentList([(pa_, 100, 50), (pb, 0, 200), (pa_, 900, 100)])
+        got = ctx.pread(el)
+        want = np.concatenate([a[100:150], b[:200], a[900:1000]])
+        np.testing.assert_array_equal(got, want)
+
+    def test_memcpy_from_extents_sharded(self, ctx, tmp_path, rng):
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from strom.parallel.mesh import make_mesh
+
+        rows = rng.integers(0, 256, (8, 256), dtype=np.uint8)
+        paths = []
+        for i in range(4):  # two rows per file, reversed order within file
+            p = str(tmp_path / f"part{i}.bin")
+            np.concatenate([rows[2 * i + 1], rows[2 * i]]).tofile(p)
+            paths.append(p)
+        exts = []
+        for i in range(4):
+            exts.append((paths[i], 256, 256))  # row 2i
+            exts.append((paths[i], 0, 256))    # row 2i+1
+        el = ExtentList(exts)
+        mesh = make_mesh({"dp": 8}, devices=jax.devices()[:8])
+        arr = ctx.memcpy_ssd2tpu(el, shape=(8, 256), dtype=np.uint8,
+                                 sharding=NamedSharding(mesh, P("dp", None)))
+        np.testing.assert_array_equal(np.asarray(arr), rows)
+
+
+# ------------------------------------------------------------------- rawbin
+class TestTokenShardSet:
+    def make_shards(self, tmp_path, rng, n_shards=3, tokens_per_shard=100,
+                    record_tokens=9):
+        paths, all_tokens = [], []
+        for i in range(n_shards):
+            t = rng.integers(0, 50_000, tokens_per_shard + i, dtype=np.int32)
+            p = str(tmp_path / f"shard{i}.bin")
+            t.tofile(p)
+            paths.append(p)
+            # records that survive the tail drop
+            n_rec = len(t) // record_tokens
+            all_tokens.append(t[: n_rec * record_tokens].reshape(n_rec, record_tokens))
+        return TokenShardSet(tuple(paths), record_tokens=record_tokens), \
+            np.concatenate(all_tokens)
+
+    def test_record_count_drops_tails(self, tmp_path, rng):
+        ss, golden = self.make_shards(tmp_path, rng)
+        assert ss.num_records == len(golden)
+
+    def test_locate_and_extents_roundtrip(self, ctx, tmp_path, rng):
+        ss, golden = self.make_shards(tmp_path, rng)
+        idx = [0, 5, 3, ss.num_records - 1]
+        el = ss.extents(idx)
+        got = ctx.pread(el).view(np.int32).reshape(len(idx), ss.record_tokens)
+        np.testing.assert_array_equal(got, golden[idx])
+
+    def test_sequential_batch_coalesces(self, tmp_path, rng):
+        ss, _ = self.make_shards(tmp_path, rng)
+        per0 = ss.records_in_shard(0)
+        el = ss.extents(range(per0))  # whole first shard, in order
+        assert len(el) == 1
+
+    def test_out_of_range(self, tmp_path, rng):
+        ss, _ = self.make_shards(tmp_path, rng)
+        with pytest.raises(IndexError):
+            ss.locate(ss.num_records)
+
+
+# ------------------------------------------------------------------ wds/tar
+def make_wds_shard(path, samples):
+    """samples: list of (key, {ext: bytes})"""
+    with tarfile.open(path, "w") as tf:
+        for key, members in samples:
+            for ext, data in members.items():
+                info = tarfile.TarInfo(f"{key}.{ext}")
+                info.size = len(data)
+                tf.addfile(info, io.BytesIO(data))
+
+
+class TestWds:
+    def test_split_key(self):
+        assert split_key("a/b.cls.txt") == ("a/b", "cls.txt")
+        assert split_key("img001.jpg") == ("img001", "jpg")
+
+    def test_index_and_samples(self, tmp_path, rng):
+        p = str(tmp_path / "shard0.tar")
+        data = {f"s{i:03d}": {"jpg": rng.bytes(100 + i), "cls": str(i % 10).encode()}
+                for i in range(5)}
+        make_wds_shard(p, list(data.items()))
+        idx = TarIndex.build(p)
+        samples = idx.samples()
+        assert [s.key for s in samples] == sorted(data)
+        for s in samples:
+            assert set(s.members) == {"jpg", "cls"}
+
+    def test_member_bytes_roundtrip(self, ctx, tmp_path, rng):
+        p = str(tmp_path / "shard0.tar")
+        payloads = [(f"s{i}", {"jpg": rng.bytes(1000 + 17 * i)}) for i in range(4)]
+        make_wds_shard(p, payloads)
+        ss = WdsShardSet([p])
+        for (key, members), sample in zip(payloads, ss):
+            got = ctx.pread(sample.extents(["jpg"]))
+            assert got.tobytes() == members["jpg"]
+
+    def test_index_cache_roundtrip(self, tmp_path, rng):
+        p = str(tmp_path / "shard0.tar")
+        make_wds_shard(p, [("a", {"txt": b"hello"})])
+        idx1 = TarIndex.build(p)
+        assert os.path.exists(p + ".stromidx.json")
+        idx2 = TarIndex.build(p)  # served from cache
+        assert [m.__dict__ for m in idx1.members] == [m.__dict__ for m in idx2.members]
+
+    def test_stale_cache_rejected(self, tmp_path):
+        p = str(tmp_path / "shard0.tar")
+        make_wds_shard(p, [("a", {"txt": b"hello"})])
+        TarIndex.build(p)
+        with open(p + ".stromidx.json") as f:
+            blob = json.load(f)
+        blob["tar_size"] = 1  # corrupt the validation stamp
+        with open(p + ".stromidx.json", "w") as f:
+            json.dump(blob, f)
+        idx = TarIndex.build(p)  # falls back to a rescan
+        assert idx.members[0].name == "a.txt"
+
+    def test_zero_size_member_ok(self, ctx, tmp_path):
+        """Empty members (empty captions/labels exist in real datasets) must
+        yield empty reads, not crash the batch."""
+        p = str(tmp_path / "shard0.tar")
+        make_wds_shard(p, [("a", {"txt": b"", "bin": b"xy"})])
+        ss = WdsShardSet([p])
+        assert ctx.pread(ss.samples[0].extents(["txt"])).size == 0
+        assert ctx.pread(ss.samples[0].extents(["txt", "bin"])).tobytes() == b"xy"
+
+    def test_batch_extents_concat(self, ctx, tmp_path, rng):
+        p = str(tmp_path / "shard0.tar")
+        payloads = [(f"s{i}", {"bin": bytes([i]) * 64}) for i in range(3)]
+        make_wds_shard(p, payloads)
+        ss = WdsShardSet([p])
+        got = ctx.pread(ss.batch_extents([2, 0], ["bin"]))
+        assert got.tobytes() == bytes([2]) * 64 + bytes([0]) * 64
+
+
+# -------------------------------------------------------------------- jpeg
+class TestJpeg:
+    def make_jpeg(self, rng, h=48, w=64):
+        import cv2
+
+        img = rng.integers(0, 256, (h, w, 3), dtype=np.uint8)
+        ok, buf = cv2.imencode(".jpg", img, [cv2.IMWRITE_JPEG_QUALITY, 95])
+        assert ok
+        return img, buf.tobytes()
+
+    def test_decode_shape_and_closeness(self, rng):
+        from strom.formats.jpeg import decode_jpeg
+
+        img, data = self.make_jpeg(rng)
+        out = decode_jpeg(data)
+        assert out.shape == img.shape and out.dtype == np.uint8
+
+    def test_transforms_shapes(self, rng):
+        from strom.formats.jpeg import center_crop_resize, random_resized_crop
+
+        img = rng.integers(0, 256, (100, 80, 3), dtype=np.uint8)
+        assert center_crop_resize(img, 32).shape == (32, 32, 3)
+        out = random_resized_crop(img, 32, np.random.default_rng(0))
+        assert out.shape == (32, 32, 3) and out.flags.c_contiguous
+
+    def test_decode_pool(self, rng):
+        from strom.formats.jpeg import DecodePool, decode_jpeg
+
+        blobs = [self.make_jpeg(rng, 32, 32)[1] for _ in range(8)]
+        with DecodePool(4) as pool:
+            outs = pool.map(decode_jpeg, blobs)
+        assert all(o.shape == (32, 32, 3) for o in outs)
+
+    def test_garbage_raises(self):
+        from strom.formats.jpeg import decode_jpeg
+
+        with pytest.raises(ValueError):
+            decode_jpeg(b"definitely not a jpeg")
+
+
+# ----------------------------------------------------------------- parquet
+class TestParquet:
+    @pytest.fixture()
+    def pq_file(self, tmp_path, rng):
+        import pyarrow as pa
+        import pyarrow.parquet as pq
+
+        n = 10_000
+        table = pa.table({
+            "id": pa.array(np.arange(n, dtype=np.int64)),
+            "value": pa.array(rng.normal(size=n)),
+            "flag": pa.array(rng.integers(0, 2, n).astype(bool)),
+        })
+        p = str(tmp_path / "data.parquet")
+        pq.write_table(table, p, row_group_size=2500, compression="zstd")
+        return p, table
+
+    def test_metadata(self, pq_file):
+        from strom.formats.parquet import ParquetShard
+
+        p, table = pq_file
+        shard = ParquetShard(p)
+        assert shard.num_rows == table.num_rows
+        assert shard.num_row_groups == 4
+        assert shard.column_names == ["id", "value", "flag"]
+
+    def test_read_row_group_projected(self, ctx, pq_file):
+        from strom.formats.parquet import ParquetShard
+
+        p, table = pq_file
+        shard = ParquetShard(p)
+        got = shard.read_row_group(ctx, 1, columns=["id", "value"])
+        want = table.slice(2500, 2500).select(["id", "value"])
+        assert got.equals(want)
+
+    def test_no_cache_misses_on_selected_columns(self, ctx, pq_file):
+        """All bytes pyarrow touches must have come through the engine."""
+        from strom.utils.stats import global_stats
+
+        from strom.formats.parquet import ParquetShard
+
+        p, _ = pq_file
+        before = global_stats.counter("parquet_cache_miss_bytes").value
+        ParquetShard(p).read_row_group(ctx, 0, columns=["value"])
+        assert global_stats.counter("parquet_cache_miss_bytes").value == before
+
+    def test_empty_column_selection(self, ctx, pq_file):
+        """columns=[] means zero columns (rows only), never 'all columns'."""
+        from strom.formats.parquet import ParquetShard
+
+        got = ParquetShard(pq_file[0]).read_row_group(ctx, 0, columns=[])
+        assert got.num_columns == 0 and got.num_rows == 2500
+
+    def test_footer_read_once(self, ctx, pq_file):
+        from strom.formats.parquet import ParquetShard
+
+        shard = ParquetShard(pq_file[0])
+        shard.read_row_group(ctx, 0, columns=["id"])
+        footer = shard._footer_bytes
+        assert footer is not None
+        shard.read_row_group(ctx, 1, columns=["id"])
+        assert shard._footer_bytes is footer
+
+    def test_unknown_column(self, ctx, pq_file):
+        from strom.formats.parquet import ParquetShard
+
+        with pytest.raises(KeyError):
+            ParquetShard(pq_file[0]).column_chunk_extents(0, ["nope"])
+
+    def test_all_row_groups_concat(self, ctx, pq_file):
+        import pyarrow as pa
+
+        from strom.formats.parquet import ParquetShard
+
+        p, table = pq_file
+        shard = ParquetShard(p)
+        parts = [shard.read_row_group(ctx, g) for g in range(shard.num_row_groups)]
+        assert pa.concat_tables(parts).equals(table)
