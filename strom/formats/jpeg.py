@@ -20,7 +20,6 @@ import numpy as np
 try:
     import cv2
 
-    cv2.setNumThreads(0)  # we parallelise across images, not within one
     _HAVE_CV2 = True
 except Exception:  # pragma: no cover - cv2 is present in the target image
     _HAVE_CV2 = False
@@ -45,8 +44,11 @@ def decode_jpeg(data: bytes | np.ndarray) -> np.ndarray:
         return cv2.cvtColor(img, cv2.COLOR_BGR2RGB)
     if _HAVE_PIL:
         raw = data.tobytes() if isinstance(data, np.ndarray) else bytes(data)
-        with Image.open(io.BytesIO(raw)) as im:
-            return np.asarray(im.convert("RGB"))
+        try:
+            with Image.open(io.BytesIO(raw)) as im:
+                return np.asarray(im.convert("RGB"))
+        except Exception as e:  # UnidentifiedImageError etc. → one contract
+            raise ValueError("not a decodable image") from e
     raise RuntimeError("no JPEG decoder available (need cv2 or PIL)")
 
 
@@ -98,6 +100,9 @@ class DecodePool:
     """Thread pool mapping decode+transform over batches of member payloads."""
 
     def __init__(self, workers: int = 8):
+        if _HAVE_CV2:
+            # parallelism comes from this pool, not from within one image
+            cv2.setNumThreads(0)
         self._pool = concurrent.futures.ThreadPoolExecutor(
             max_workers=workers, thread_name_prefix="strom-decode")
 

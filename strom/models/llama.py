@@ -144,7 +144,8 @@ def attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
     return out.reshape(B, Sq, H, Dh)
 
 
-def block(x: jax.Array, lp: dict, cfg: LlamaConfig, positions: jax.Array) -> jax.Array:
+def block(x: jax.Array, lp: dict, cfg: LlamaConfig, positions: jax.Array,
+          attn_fn=None) -> jax.Array:
     B, S, D = x.shape
     nh, nkv, hd = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
 
@@ -154,7 +155,9 @@ def block(x: jax.Array, lp: dict, cfg: LlamaConfig, positions: jax.Array) -> jax
     v = (h @ lp["wv"]).reshape(B, S, nkv, hd)
     q = rope(q, positions, cfg.rope_theta)
     k = rope(k, positions, cfg.rope_theta)
-    attn = attention(q, k, v, causal=True)
+    # attn_fn hook: ring attention (strom.parallel.ring) substitutes here for
+    # sequence-parallel long-context runs
+    attn = (attn_fn or attention)(q, k, v)
     x = x + attn.reshape(B, S, nh * hd) @ lp["wo"]
 
     h = rmsnorm(x, lp["mlp_norm"], cfg.norm_eps)
@@ -163,7 +166,7 @@ def block(x: jax.Array, lp: dict, cfg: LlamaConfig, positions: jax.Array) -> jax
 
 
 def forward(params: dict, tokens: jax.Array, cfg: LlamaConfig,
-            positions: jax.Array | None = None) -> jax.Array:
+            positions: jax.Array | None = None, attn_fn=None) -> jax.Array:
     """tokens [B, S] int32 → logits [B, S, vocab] float32."""
     B, S = tokens.shape
     if positions is None:
@@ -171,20 +174,29 @@ def forward(params: dict, tokens: jax.Array, cfg: LlamaConfig,
     x = params["embed"][tokens].astype(cfg.jdtype)
 
     def body(carry, lp):
-        return block(carry, lp, cfg, positions), None
+        return block(carry, lp, cfg, positions, attn_fn), None
 
     x, _ = lax.scan(body, x, params["layers"])
     x = rmsnorm(x, params["final_norm"], cfg.norm_eps)
     return (x @ params["lm_head"]).astype(jnp.float32)
 
 
-def next_token_loss(params: dict, tokens: jax.Array, cfg: LlamaConfig) -> jax.Array:
-    """Mean cross-entropy of predicting tokens[:, 1:] from tokens[:, :-1]."""
-    logits = forward(params, tokens[:, :-1], cfg)
-    targets = tokens[:, 1:]
+def next_token_loss(params: dict, tokens: jax.Array, cfg: LlamaConfig,
+                    attn_fn=None) -> jax.Array:
+    """Mean cross-entropy of predicting tokens[:, 1:] from tokens[:, :-1].
+
+    Computed as a full-length forward + roll/mask rather than slicing to
+    S-1: identical values under causality, but every array keeps ONE
+    sequence length — which is what lets sequence-parallel sharding divide
+    the batch evenly (the loader's seq_len+1 record length must be divisible
+    by the sp axis size)."""
+    B, L = tokens.shape
+    logits = forward(params, tokens, cfg, attn_fn=attn_fn)
+    targets = jnp.roll(tokens, -1, axis=1)
     logz = jax.nn.logsumexp(logits, axis=-1)
     gold = jnp.take_along_axis(logits, targets[..., None], axis=-1)[..., 0]
-    return jnp.mean(logz - gold)
+    mask = (jnp.arange(L) < L - 1).astype(jnp.float32)  # last column: no target
+    return jnp.sum((logz - gold) * mask) / (B * (L - 1))
 
 
 @partial(jax.jit, static_argnames=("cfg",))

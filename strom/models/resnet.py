@@ -1,0 +1,190 @@
+"""ResNet-50 — consumer of the ImageNet raw-JPEG pipeline (BASELINE config
+#2: "ImageNet raw-JPEG shards → ResNet-50 JAX input pipeline", BASELINE.json:8).
+
+Pure-JAX functional implementation, TPU-first:
+- NHWC layout + HWIO kernels (the TPU-native conv layout XLA tiles onto the
+  MXU without transposes);
+- bfloat16 activations/convs, float32 batch-norm statistics;
+- functional batch-norm: forward returns updated running stats, so the whole
+  train step stays a pure jittable function.
+
+The reference has no models (SURVEY.md §2.3) — this is the data path's
+consumer, as PG-Strom is the reference's (SURVEY.md §3.5).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+@dataclasses.dataclass(frozen=True)
+class ResNetConfig:
+    stages: tuple[int, ...] = (3, 4, 6, 3)   # bottleneck blocks per stage (50-layer)
+    width: int = 64                          # stem channels
+    num_classes: int = 1000
+    dtype: str = "bfloat16"
+    bn_momentum: float = 0.9
+    bn_eps: float = 1e-5
+
+    @property
+    def jdtype(self):
+        return jnp.dtype(self.dtype)
+
+    @classmethod
+    def resnet50(cls) -> "ResNetConfig":
+        return cls()
+
+    @classmethod
+    def tiny(cls) -> "ResNetConfig":
+        """~100k params; unit tests and compile checks (input 32×32)."""
+        return cls(stages=(1, 1), width=8, num_classes=10)
+
+
+def _conv_init(key, kh, kw, cin, cout, dtype):
+    fan_in = kh * kw * cin
+    w = jax.random.normal(key, (kh, kw, cin, cout), dtype=jnp.float32)
+    return (w * jnp.sqrt(2.0 / fan_in)).astype(dtype)
+
+
+def _bn_init(c):
+    return {"scale": jnp.ones((c,), jnp.float32),
+            "bias": jnp.zeros((c,), jnp.float32)}
+
+
+def _bn_state_init(c):
+    return {"mean": jnp.zeros((c,), jnp.float32),
+            "var": jnp.ones((c,), jnp.float32)}
+
+
+def init_params(key: jax.Array, cfg: ResNetConfig) -> tuple[dict, dict]:
+    """Returns (params, bn_state): learnable weights and running statistics."""
+    dt = cfg.jdtype
+    keys = iter(jax.random.split(key, 4 + sum(cfg.stages) * 4))
+    params: dict = {"stem": {"conv": _conv_init(next(keys), 7, 7, 3, cfg.width, dt),
+                             "bn": _bn_init(cfg.width)}}
+    state: dict = {"stem": _bn_state_init(cfg.width)}
+    cin = cfg.width
+    for si, n_blocks in enumerate(cfg.stages):
+        mid = cfg.width * (2 ** si)
+        cout = mid * 4
+        blocks, bstate = [], []
+        for bi in range(n_blocks):
+            stride = 2 if (si > 0 and bi == 0) else 1
+            b = {
+                "conv1": _conv_init(next(keys), 1, 1, cin, mid, dt),
+                "bn1": _bn_init(mid),
+                "conv2": _conv_init(next(keys), 3, 3, mid, mid, dt),
+                "bn2": _bn_init(mid),
+                "conv3": _conv_init(next(keys), 1, 1, mid, cout, dt),
+                "bn3": _bn_init(cout),
+            }
+            s = {"bn1": _bn_state_init(mid), "bn2": _bn_state_init(mid),
+                 "bn3": _bn_state_init(cout)}
+            if cin != cout or stride != 1:
+                b["proj"] = _conv_init(next(keys), 1, 1, cin, cout, dt)
+                b["proj_bn"] = _bn_init(cout)
+                s["proj_bn"] = _bn_state_init(cout)
+            blocks.append(b)
+            bstate.append(s)
+            cin = cout
+        params[f"stage{si}"] = blocks
+        state[f"stage{si}"] = bstate
+    head_key = next(keys)
+    params["head"] = {
+        "w": (jax.random.normal(head_key, (cin, cfg.num_classes), jnp.float32)
+              / jnp.sqrt(cin)).astype(jnp.float32),
+        "b": jnp.zeros((cfg.num_classes,), jnp.float32),
+    }
+    return params, state
+
+
+def _conv(x, w, stride=1):
+    return lax.conv_general_dilated(
+        x, w, window_strides=(stride, stride), padding="SAME",
+        dimension_numbers=("NHWC", "HWIO", "NHWC"))
+
+
+def _batch_norm(x, p, s, cfg: ResNetConfig, train: bool):
+    """Returns (normalized x, updated state). Stats in float32."""
+    if train:
+        xf = x.astype(jnp.float32)
+        mean = jnp.mean(xf, axis=(0, 1, 2))
+        var = jnp.var(xf, axis=(0, 1, 2))
+        m = cfg.bn_momentum
+        new_s = {"mean": m * s["mean"] + (1 - m) * mean,
+                 "var": m * s["var"] + (1 - m) * var}
+    else:
+        mean, var = s["mean"], s["var"]
+        new_s = s
+    inv = lax.rsqrt(var + cfg.bn_eps) * p["scale"]
+    out = (x.astype(jnp.float32) - mean) * inv + p["bias"]
+    return out.astype(x.dtype), new_s
+
+
+def _bottleneck(x, b, s, cfg: ResNetConfig, stride: int, train: bool):
+    new_s = {}
+    h, new_s["bn1"] = _batch_norm(_conv(x, b["conv1"]), b["bn1"], s["bn1"], cfg, train)
+    h = jax.nn.relu(h)
+    h, new_s["bn2"] = _batch_norm(_conv(h, b["conv2"], stride), b["bn2"], s["bn2"], cfg, train)
+    h = jax.nn.relu(h)
+    h, new_s["bn3"] = _batch_norm(_conv(h, b["conv3"]), b["bn3"], s["bn3"], cfg, train)
+    if "proj" in b:
+        x, new_s["proj_bn"] = _batch_norm(_conv(x, b["proj"], stride),
+                                          b["proj_bn"], s["proj_bn"], cfg, train)
+    return jax.nn.relu(h + x), new_s
+
+
+def forward(params: dict, state: dict, images: jax.Array, cfg: ResNetConfig,
+            *, train: bool = True) -> tuple[jax.Array, dict]:
+    """images [B,H,W,3] (any float dtype, already normalized) →
+    (logits [B,classes] float32, new bn state)."""
+    x = images.astype(cfg.jdtype)
+    new_state: dict = {}
+    x = _conv(x, params["stem"]["conv"], stride=2)
+    x, new_state["stem"] = _batch_norm(x, params["stem"]["bn"], state["stem"], cfg, train)
+    x = jax.nn.relu(x)
+    x = lax.reduce_window(x, -jnp.inf, lax.max, (1, 3, 3, 1), (1, 2, 2, 1), "SAME")
+    for si in range(len(cfg.stages)):
+        blocks, bstate, outs = params[f"stage{si}"], state[f"stage{si}"], []
+        for bi, (b, s) in enumerate(zip(blocks, bstate)):
+            stride = 2 if (si > 0 and bi == 0) else 1
+            x, ns = _bottleneck(x, b, s, cfg, stride, train)
+            outs.append(ns)
+        new_state[f"stage{si}"] = outs
+    x = jnp.mean(x.astype(jnp.float32), axis=(1, 2))  # global average pool
+    logits = x @ params["head"]["w"] + params["head"]["b"]
+    return logits, new_state
+
+
+def softmax_xent(logits: jax.Array, labels: jax.Array) -> jax.Array:
+    """Mean cross-entropy; labels int32 [B]."""
+    logz = jax.nn.logsumexp(logits, axis=-1)
+    gold = jnp.take_along_axis(logits, labels[:, None], axis=-1)[:, 0]
+    return jnp.mean(logz - gold)
+
+
+def loss_fn(params: dict, state: dict, images: jax.Array, labels: jax.Array,
+            cfg: ResNetConfig) -> tuple[jax.Array, dict]:
+    logits, new_state = forward(params, state, images, cfg, train=True)
+    return softmax_xent(logits, labels), new_state
+
+
+IMAGENET_MEAN = jnp.array([0.485, 0.456, 0.406], jnp.float32)
+IMAGENET_STD = jnp.array([0.229, 0.224, 0.225], jnp.float32)
+
+
+def normalize_images(u8: jax.Array) -> jax.Array:
+    """uint8 [.. ,3] → normalized float32 (on-device, fused into the step)."""
+    return (u8.astype(jnp.float32) / 255.0 - IMAGENET_MEAN) / IMAGENET_STD
+
+
+@partial(jax.jit, static_argnames=("cfg", "train"))
+def jit_forward(params: dict, state: dict, images: jax.Array,
+                cfg: ResNetConfig, train: bool = False) -> Any:
+    return forward(params, state, images, cfg, train=train)

@@ -54,3 +54,22 @@ def run_dryrun(n_devices: int) -> None:
     assert np.isfinite(loss), f"non-finite loss {loss}"
     assert int(state.step) == 1
     print(f"dryrun ok: mesh={axes}, devices={n_devices}, loss={loss:.4f}")
+
+    # Long-context path: dp×sp mesh, sequence-sharded batch, ring attention
+    if n_devices >= 2 and n_devices % 2 == 0:
+        # keep dp ≥ 2 when possible so both axes are exercised
+        sp = 2
+        while sp * 2 <= min(max(n_devices // 2, 2), 8) and n_devices % (sp * 2) == 0:
+            sp *= 2
+        sp_axes = {"dp": n_devices // sp, "sp": sp}
+        sp_mesh = make_mesh(sp_axes, devices=devs)
+        state = init_train_state(jax.random.PRNGKey(0), cfg, sp_mesh, optimizer)
+        sp_step = make_train_step(cfg, sp_mesh, optimizer, sp=True)
+        B, L = 2 * sp_axes["dp"], 64  # record length divisible by sp
+        tokens = jnp.asarray(
+            np.random.default_rng(1).integers(0, cfg.vocab, (B, L), dtype=np.int32))
+        tokens = jax.device_put(tokens, NamedSharding(sp_mesh, P("dp", "sp")))
+        state, metrics = sp_step(state, tokens)
+        sp_loss = float(metrics["loss"])
+        assert np.isfinite(sp_loss), f"non-finite sp loss {sp_loss}"
+        print(f"dryrun ok: mesh={sp_axes} (ring attention), loss={sp_loss:.4f}")

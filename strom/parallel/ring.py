@@ -1,0 +1,109 @@
+"""Ring attention: exact causal attention over sequence-sharded activations
+(long-context / context parallelism, first-class per the build brief; the
+loader side already delivers sequence-sharded batches — SURVEY.md §5
+"Long-context" row).
+
+TPU-first mechanics: `shard_map` over the mesh's sequence axis; each step
+computes a local q-block × kv-block partial with flash-style online-softmax
+accumulation, then rotates the kv block one hop around the ring with
+`lax.ppermute` — the collective rides ICI neighbor links, and XLA overlaps
+the permute with the current block's matmuls.  Communication is O(S/n) per
+step, n steps: total bytes ≈ one all-gather, but peak memory stays at one
+kv block per device.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+_NEG_BIG = -0.7 * float(jnp.finfo(jnp.float32).max)  # finite "-inf": masked rows stay nan-free
+
+
+def _block_attn(q: jax.Array, k: jax.Array, v: jax.Array,
+                q_pos0: jax.Array, k_pos0: jax.Array, causal: bool
+                ) -> tuple[jax.Array, jax.Array, jax.Array]:
+    """One q-block × kv-block partial: returns (scores_max [B,KV,G,Sq],
+    exp-scores @ v [B,Sq,KV,G,Dh], row denominators) in float32."""
+    B, Sq, H, Dh = q.shape
+    KV = k.shape[2]
+    G = H // KV
+    qg = q.reshape(B, Sq, KV, G, Dh)
+    scores = jnp.einsum("bqkgd,bskd->bkgqs", qg, k).astype(jnp.float32)
+    scores = scores / jnp.sqrt(jnp.float32(Dh))
+    if causal:
+        qpos = q_pos0 + jnp.arange(Sq)
+        kpos = k_pos0 + jnp.arange(k.shape[1])
+        mask = qpos[:, None] >= kpos[None, :]
+        scores = jnp.where(mask[None, None, None], scores, _NEG_BIG)
+    m = jnp.max(scores, axis=-1)                         # [B,KV,G,Sq]
+    p = jnp.exp(scores - m[..., None])
+    l = jnp.sum(p, axis=-1)                              # [B,KV,G,Sq]
+    pv = jnp.einsum("bkgqs,bskd->bqkgd", p.astype(v.dtype), v).astype(jnp.float32)
+    return m, pv, l
+
+
+def ring_attention_local(q: jax.Array, k: jax.Array, v: jax.Array, *,
+                         axis_name: str, causal: bool = True) -> jax.Array:
+    """The shard_map-inner body: q,k,v are this device's sequence block
+    ([B,Sq,H,Dh] / [B,Sk,KV,Dh]); returns the exact attention output for the
+    local q block against the full (ring-assembled) sequence."""
+    n = lax.axis_size(axis_name)
+    idx = lax.axis_index(axis_name)
+    B, Sq, H, Dh = q.shape
+    Sk, KV = k.shape[1], k.shape[2]
+    G = H // KV
+
+    q_pos0 = idx * Sq
+
+    def step(carry, s):
+        m, l, acc, k_blk, v_blk = carry
+        src = (idx - s) % n            # whose kv block we hold at step s
+        bm, pv, bl = _block_attn(q, k_blk, v_blk, q_pos0, src * Sk, causal)
+        m_new = jnp.maximum(m, bm)
+        alpha = jnp.exp(m - m_new)      # m starts at finite _NEG_BIG: no nan
+        beta = jnp.exp(bm - m_new)
+        l = l * alpha + bl * beta
+        acc = acc * alpha.transpose(0, 3, 1, 2)[..., None] \
+            + pv * beta.transpose(0, 3, 1, 2)[..., None]
+        # rotate kv one hop: device i's block moves to i+1 (so next step we
+        # hold the block of (idx - s - 1) mod n)
+        perm = [(i, (i + 1) % n) for i in range(n)]
+        k_blk = lax.ppermute(k_blk, axis_name, perm)
+        v_blk = lax.ppermute(v_blk, axis_name, perm)
+        return (m_new, l, acc, k_blk, v_blk), None
+
+    m0 = jnp.full((B, KV, G, Sq), _NEG_BIG, jnp.float32)
+    l0 = jnp.zeros((B, KV, G, Sq), jnp.float32)
+    acc0 = jnp.zeros((B, Sq, KV, G, Dh), jnp.float32)
+    (m, l, acc, _, _), _ = lax.scan(step, (m0, l0, acc0, k, v), jnp.arange(n))
+    denom = l.transpose(0, 3, 1, 2)[..., None]
+    out = acc / jnp.maximum(denom, 1e-30)
+    return out.reshape(B, Sq, H, Dh).astype(q.dtype)
+
+
+def make_ring_attention(mesh: Mesh, *, axis: str = "sp",
+                        batch_axis: str = "dp", head_axis: str = "tp",
+                        causal: bool = True):
+    """A drop-in replacement for `strom.models.llama.attention` that runs the
+    ring algorithm over *axis*: q,k,v sequence-sharded on it, output likewise.
+
+    The specs also carry the mesh's batch/head axes when present, so entering
+    the shard_map reshards nothing: batch stays dp-sharded, heads stay
+    tp-sharded (n_kv_heads must divide by the tp size), and only the sequence
+    axis participates in the ring.
+    """
+    b = batch_axis if batch_axis in mesh.axis_names else None
+    h = head_axis if head_axis in mesh.axis_names else None
+    spec = P(b, axis, h, None)
+
+    @partial(jax.shard_map, mesh=mesh, in_specs=(spec, spec, spec),
+             out_specs=spec, check_vma=False)
+    def ring_attn(q, k, v):
+        return ring_attention_local(q, k, v, axis_name=axis, causal=causal)
+
+    return ring_attn

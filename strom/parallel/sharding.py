@@ -44,7 +44,13 @@ def param_specs(params: dict) -> dict:
 
 
 def param_shardings(params: dict, mesh: Mesh) -> dict:
-    return jax.tree.map(lambda spec: NamedSharding(mesh, spec),
+    """Specs restricted to the mesh's axes: a rule axis the mesh doesn't have
+    (e.g. tp on a dp×sp mesh) degrades to replicated on that dim."""
+
+    def restrict(spec: P) -> P:
+        return P(*(ax if ax in mesh.axis_names else None for ax in spec))
+
+    return jax.tree.map(lambda spec: NamedSharding(mesh, restrict(spec)),
                         param_specs(params),
                         is_leaf=lambda x: isinstance(x, P))
 

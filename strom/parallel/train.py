@@ -55,11 +55,23 @@ def make_train_step(cfg: LlamaConfig, mesh: Mesh,
 
     tokens arrive sharded P("dp"[, "sp"]) — exactly the sharding
     strom.pipelines loaders deliver — so no resharding happens on entry.
+
+    sp=True: activations stay sequence-sharded and attention runs the ring
+    algorithm (kv blocks rotate over ICI neighbor hops) instead of letting
+    XLA all-gather the whole sequence — peak memory O(S/n_sp) per device.
     """
     batch_sharding = NamedSharding(mesh, P("dp", "sp") if sp else P("dp", None))
+    attn_fn = None
+    if sp:
+        from strom.parallel.ring import make_ring_attention
+
+        attn_fn = make_ring_attention(mesh, axis="sp")
+
+    def loss_fn(params, tokens):
+        return next_token_loss(params, tokens, cfg, attn_fn=attn_fn)
 
     def step(state: TrainState, tokens: jax.Array):
-        loss, grads = jax.value_and_grad(next_token_loss)(state.params, tokens, cfg)
+        loss, grads = jax.value_and_grad(loss_fn)(state.params, tokens)
         updates, new_opt = optimizer.update(grads, state.opt_state, state.params)
         new_params = optax.apply_updates(state.params, updates)
         metrics = {"loss": loss, "grad_norm": optax.global_norm(grads)}

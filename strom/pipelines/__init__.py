@@ -1,0 +1,12 @@
+"""T5 workload pipelines (SURVEY.md §1 layer T5): `make_*_pipeline()`
+iterators yielding sharded jax.Arrays, matching BASELINE configs #2–#5."""
+
+from strom.pipelines.base import Pipeline  # noqa: F401
+from strom.pipelines.llama_pretrain import make_llama_pipeline  # noqa: F401
+from strom.pipelines.parquet_scan import (  # noqa: F401
+    parquet_count_where, parquet_scan_aggregate)
+from strom.pipelines.sampler import (  # noqa: F401
+    EpochShuffleSampler, SamplerState, load_loader_state, save_loader_state)
+from strom.pipelines.vision import (  # noqa: F401
+    make_imagenet_resnet_pipeline, make_vit_wds_pipeline,
+    make_wds_vision_pipeline)

@@ -1,0 +1,107 @@
+"""Pipeline: a prefetched, checkpointable stream of device batches.
+
+Ties together the sampler (which record indices), a batch builder (engine
+read → decode → device_put), and the Prefetcher (dispatch-ahead overlap, the
+"0 data-stall steps" counter).  Checkpointing hard case: the sampler runs
+*ahead* of consumption by the prefetch depth, so saved state is derived from
+the consumed count, never from the sampler's own cursor — a resume replays
+nothing and skips nothing.
+"""
+
+from __future__ import annotations
+
+import concurrent.futures
+from typing import Any, Callable, Iterator
+
+import numpy as np
+
+from strom.delivery.prefetch import Prefetcher
+from strom.pipelines.sampler import (EpochShuffleSampler, SamplerState,
+                                     dataset_fingerprint, load_loader_state,
+                                     save_loader_state)
+
+
+class Pipeline:
+    """Iterate device batches; `state()` is always the resume point of the
+    *next* unconsumed batch."""
+
+    def __init__(self, sampler: EpochShuffleSampler,
+                 make_batch: Callable[[np.ndarray, int], Any], *,
+                 depth: int = 2,
+                 fingerprint: dict | None = None,
+                 executor: concurrent.futures.Executor | None = None,
+                 on_close: Callable[[], None] | None = None):
+        self.sampler = sampler
+        self.fingerprint = fingerprint or {}
+        self._on_close = on_close
+        st = sampler.state
+        self._consumed = st.epoch * sampler.batches_per_epoch + st.batch_in_epoch
+        self._seed = st.seed
+
+        def thunks() -> Iterator[Callable[[], Any]]:
+            # make_batch gets (indices, serial): serial is the global batch
+            # number, stable across resume — deterministic augmentation keys
+            serial = self._consumed
+            for indices in sampler:
+                yield lambda idx=indices, s=serial: make_batch(idx, s)
+                serial += 1
+
+        self._prefetcher: Prefetcher = Prefetcher(thunks(), depth=depth,
+                                                  executor=executor)
+
+    def __iter__(self) -> "Pipeline":
+        return self
+
+    def __next__(self) -> Any:
+        batch = next(self._prefetcher)
+        self._consumed += 1
+        return batch
+
+    # -- checkpoint/resume --------------------------------------------------
+    def state(self) -> SamplerState:
+        bpe = self.sampler.batches_per_epoch
+        return SamplerState(epoch=self._consumed // bpe,
+                            batch_in_epoch=self._consumed % bpe,
+                            seed=self._seed)
+
+    def save_state(self, path: str, extra: dict | None = None) -> None:
+        save_loader_state(path, self.state(), self.fingerprint, extra)
+
+    @staticmethod
+    def load_state(path: str, fingerprint: dict | None = None
+                   ) -> tuple[SamplerState, dict]:
+        return load_loader_state(path, fingerprint)
+
+    # -- observability ------------------------------------------------------
+    @property
+    def data_stall_steps(self) -> int:
+        return self._prefetcher.data_stall_steps
+
+    @property
+    def steps_delivered(self) -> int:
+        return self._prefetcher.steps
+
+    def close(self) -> None:
+        self._prefetcher.close()
+        if self._on_close is not None:
+            self._on_close()
+
+    def __enter__(self) -> "Pipeline":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+
+def resolve_state(paths: tuple[str, ...], *, seed: int,
+                  resume_from: str | SamplerState | None
+                  ) -> tuple[SamplerState | None, dict]:
+    """Common resume plumbing: fingerprint the shard list and, when resuming
+    from a file, validate it."""
+    fp = dataset_fingerprint(paths)
+    if resume_from is None:
+        return None, fp
+    if isinstance(resume_from, SamplerState):
+        return resume_from, fp
+    state, _ = load_loader_state(resume_from, fp)
+    return state, fp

@@ -1,0 +1,53 @@
+"""Llama packed-token pretrain loader (BASELINE config #4: "Llama-3-8B
+packed-token .bin shards → JAX pretrain dataloader (v5p-8)", BASELINE.json:10).
+
+The fully-zero-copy pipeline: token records go NVMe → aligned host slab
+(io_uring O_DIRECT gather over the batch's record extents) → device_put per
+shard — no decode step, no Python touching bulk bytes (SURVEY.md §7.1).
+Accepts any `NamedSharding` over the (batch, seq) array, including
+sequence-dim sharding for consumer CP/SP meshes (SURVEY.md §5 "Long-context"
+row).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Sequence
+
+import numpy as np
+
+from strom.delivery.core import StromContext
+from strom.formats.rawbin import TokenShardSet
+from strom.pipelines.base import Pipeline, resolve_state
+from strom.pipelines.sampler import EpochShuffleSampler, SamplerState
+
+
+def make_llama_pipeline(ctx: StromContext, paths: Sequence[str], *,
+                        batch: int, seq_len: int,
+                        sharding: Any,
+                        dtype: Any = np.int32,
+                        seed: int = 0,
+                        shuffle: bool = True,
+                        prefetch_depth: int | None = None,
+                        resume_from: str | SamplerState | None = None
+                        ) -> Pipeline:
+    """Infinite stream of token batches [batch, seq_len+1] (inputs+targets
+    window), delivered as jax.Arrays with *sharding*.
+
+    Every host must construct the pipeline with the same arguments (the
+    sampler is deterministic in (seed, epoch)); the sharded read planner then
+    fetches only host-local bytes.
+    """
+    shards = TokenShardSet(tuple(paths), record_tokens=seq_len + 1,
+                           dtype=np.dtype(dtype))
+    state, fp = resolve_state(shards.paths, seed=seed, resume_from=resume_from)
+    sampler = EpochShuffleSampler(shards.num_records, batch, seed=seed,
+                                  shuffle=shuffle, state=state)
+    shape = (batch, seq_len + 1)
+
+    def make_batch(indices: np.ndarray, serial: int) -> Any:
+        el = shards.extents(indices)
+        return ctx.memcpy_ssd2tpu(el, shape=shape, dtype=shards.dtype,
+                                  sharding=sharding)
+
+    depth = prefetch_depth if prefetch_depth is not None else ctx.config.prefetch_depth
+    return Pipeline(sampler, make_batch, depth=depth, fingerprint=fp)

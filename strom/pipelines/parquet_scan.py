@@ -1,0 +1,106 @@
+"""Sharded Parquet columnar scan fan-out (BASELINE config #5: "Sharded
+Parquet columnar scan fan-out across v5p-256 (PG-Strom-style SSD2TPU scan)",
+BASELINE.json:11).
+
+The PG-Strom pattern re-cut for TPU (SURVEY.md §0.5, §3.5): row groups are
+the scan unit; each host engine-reads only its assigned groups' selected
+column chunks, the jitted map_fn (filter/project/aggregate) runs on a local
+device, and partial aggregates reduce across the pod with XLA collectives
+(psum over a scan mesh — ICI in-slice, DCN across; SURVEY.md §2.3).  I/O of
+group k+1 overlaps compute of group k via the prefetcher.
+"""
+
+from __future__ import annotations
+
+import itertools
+from functools import partial
+from typing import Any, Callable, Sequence
+
+import numpy as np
+
+from strom.delivery.core import StromContext
+from strom.delivery.prefetch import Prefetcher
+from strom.formats.parquet import ParquetShard
+
+# map_fn: dict[column -> jnp array of one row group] -> pytree of aggregates
+MapFn = Callable[[dict], Any]
+
+
+def scan_units(shards: Sequence[ParquetShard]) -> list[tuple[ParquetShard, int]]:
+    """All (shard, row_group) scan units, in deterministic order."""
+    return [(s, g) for s in shards for g in range(s.num_row_groups)]
+
+
+def parquet_scan_aggregate(ctx: StromContext, paths: Sequence[str],
+                           columns: Sequence[str], map_fn: MapFn, *,
+                           prefetch_depth: int = 2,
+                           devices: Sequence[Any] | None = None,
+                           process_index: int | None = None,
+                           process_count: int | None = None) -> Any:
+    """Scan shards' row groups, sum map_fn's partial aggregates, reduce
+    globally. Returns the aggregate pytree (host numpy leaves).
+
+    Multi-host: every process calls this with the same arguments; units are
+    assigned round-robin by process index (overridable for tests/manual
+    sharding), and the final cross-process reduction rides XLA collectives
+    via process_allgather.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    shards = [ParquetShard(p) for p in paths]
+    units = scan_units(shards)
+    if not units:
+        raise ValueError("no row groups to scan")
+    n_proc = process_count if process_count is not None else jax.process_count()
+    idx = process_index if process_index is not None else jax.process_index()
+    local_units = units[idx::n_proc]
+    devs = list(devices) if devices is not None else jax.local_devices()
+
+    def read_unit(shard: ParquetShard, rg: int) -> dict:
+        table = shard.read_row_group(ctx, rg, columns=columns)
+        return {c: np.ascontiguousarray(table[c].to_numpy(zero_copy_only=False))
+                for c in columns}
+
+    # engine read + decode of unit k+1 overlaps device compute of unit k
+    thunks = (partial(read_unit, s, g) for (s, g) in local_units)
+    jitted = jax.jit(map_fn)
+
+    acc = None
+    dev_cycle = itertools.cycle(devs)
+    for cols in Prefetcher(thunks, depth=prefetch_depth):
+        dev = next(dev_cycle)
+        cols_dev = {c: jax.device_put(v, dev) for c, v in cols.items()}
+        part = jitted(cols_dev)
+        part = jax.tree.map(lambda x: jax.device_put(x, devs[0]), part)
+        acc = part if acc is None else jax.tree.map(jnp.add, acc, part)
+    if acc is None:
+        # this process drew zero units (more processes than units): it must
+        # still contribute a zero aggregate, or peers hang in the allgather
+        schema = shards[0].metadata.schema.to_arrow_schema()
+        empty = {c: np.zeros(0, dtype=schema.field(c).type.to_pandas_dtype())
+                 for c in columns}
+        acc = jax.tree.map(jnp.zeros_like, jitted(empty))
+    acc = jax.tree.map(np.asarray, acc)
+
+    if jax.process_count() > 1:  # the real count: collectives involve everyone
+        from jax.experimental import multihost_utils
+
+        gathered = multihost_utils.process_allgather(acc)
+        acc = jax.tree.map(lambda x: np.sum(np.asarray(x), axis=0), gathered)
+    return acc
+
+
+def parquet_count_where(ctx: StromContext, paths: Sequence[str],
+                        column: str, predicate: Callable[[Any], Any],
+                        **kw: Any) -> int:
+    """Convenience: SELECT count(*) WHERE predicate(column) — the canonical
+    PG-Strom scan shape."""
+    import jax.numpy as jnp
+
+    def map_fn(cols: dict) -> Any:
+        # int32 partials: jax defaults to x64-disabled; per-row-group counts
+        # fit easily and the final sum is a python int anyway
+        return jnp.sum(predicate(cols[column]).astype(jnp.int32))
+
+    return int(parquet_scan_aggregate(ctx, paths, [column], map_fn, **kw))

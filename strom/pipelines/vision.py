@@ -1,0 +1,152 @@
+"""Vision pipelines over WebDataset shards: ImageNet→ResNet-50 (BASELINE
+config #2, BASELINE.json:8) and WebDataset→ViT-B/16 on RAID0 (config #3,
+BASELINE.json:9).
+
+Per batch: gather-read the local samples' JPEG members (engine, O_DIRECT),
+decode+augment on the host worker pool (cv2 releases the GIL), device_put
+each device's rows, assemble the global sharded array — each host only ever
+reads and decodes the rows its own devices consume (SURVEY.md §2.3
+"Mesh-sharded delivery").
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Sequence
+
+import numpy as np
+
+from strom.delivery.core import StromContext
+from strom.formats.jpeg import DecodePool, decode_jpeg, random_resized_crop
+from strom.formats.wds import WdsShardSet
+from strom.pipelines.base import Pipeline, resolve_state
+from strom.pipelines.sampler import EpochShuffleSampler, SamplerState
+
+# transform(jpeg_bytes, rng) -> HWC uint8
+Transform = Callable[[bytes, np.random.Generator], np.ndarray]
+
+
+def default_train_transform(size: int) -> Transform:
+    def tf(data: bytes, rng: np.random.Generator) -> np.ndarray:
+        return random_resized_crop(decode_jpeg(data), size, rng)
+
+    return tf
+
+
+def _local_batch_rows(sharding: Any, batch: int) -> dict:
+    """device -> (row_lo, row_hi) of the global batch this host must feed."""
+    # probe with a rank-1 view: only the batch dim's split matters
+    idx_map = sharding.addressable_devices_indices_map((batch,) + tuple(
+        1 for _ in range(_sharding_ndim(sharding) - 1)))
+    out = {}
+    for device, index in idx_map.items():
+        sl = index[0] if index else slice(None)
+        lo, hi, _ = sl.indices(batch)
+        out[device] = (lo, hi)
+    return out
+
+
+def _sharding_ndim(sharding: Any) -> int:
+    return len(sharding.spec)
+
+
+def make_wds_vision_pipeline(ctx: StromContext, paths: Sequence[str], *,
+                             batch: int,
+                             image_size: int,
+                             sharding: Any,
+                             image_ext: str = "jpg",
+                             label_ext: str = "cls",
+                             transform: Transform | None = None,
+                             decode_workers: int = 8,
+                             seed: int = 0,
+                             shuffle: bool = True,
+                             prefetch_depth: int | None = None,
+                             resume_from: str | SamplerState | None = None
+                             ) -> Pipeline:
+    """Infinite stream of (images [B,S,S,3] uint8, labels [B] int32) jax.Array
+    pairs sharded per *sharding* (a NamedSharding over a rank-4 image batch;
+    labels inherit its batch-dim spec).
+
+    Augmentation is deterministic in (seed, batch serial, row): identical
+    across hosts and across checkpoint resume.
+    """
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    if not isinstance(sharding, NamedSharding):
+        raise TypeError("vision pipelines need a NamedSharding (labels derive "
+                        "their spec from its batch axis)")
+    if len(sharding.spec) != 4:
+        raise ValueError("sharding.spec must be rank 4 (B, H, W, C)")
+    ss = WdsShardSet(paths)
+    if len(ss) < batch:
+        raise ValueError(f"dataset has {len(ss)} samples < batch {batch}")
+    state, fp = resolve_state(tuple(paths), seed=seed, resume_from=resume_from)
+    sampler = EpochShuffleSampler(len(ss), batch, seed=seed, shuffle=shuffle,
+                                  state=state)
+    tf = transform or default_train_transform(image_size)
+    pool = DecodePool(decode_workers)
+    label_sharding = NamedSharding(sharding.mesh, P(sharding.spec[0]))
+    global_shape = (batch, image_size, image_size, 3)
+    rows_by_device = _local_batch_rows(sharding, batch)
+    # the union of rows this host decodes, and each device's slice into it
+    local_rows = sorted({r for lo, hi in rows_by_device.values()
+                         for r in range(lo, hi)})
+    row_pos = {r: i for i, r in enumerate(local_rows)}
+
+    def make_batch(indices: np.ndarray, serial: int) -> tuple[Any, Any]:
+        samples = [ss.samples[int(indices[r])] for r in local_rows]
+        el = ss.batch_extents([int(indices[r]) for r in local_rows],
+                              [image_ext, label_ext])
+        buf = ctx.pread(el)
+        # split the concatenated buffer back into per-sample members
+        blobs, labels, pos = [], [], 0
+        for s in samples:
+            isz = s.members[image_ext].size
+            lsz = s.members[label_ext].size
+            blobs.append(buf[pos: pos + isz])
+            labels.append(int(buf[pos + isz: pos + isz + lsz].tobytes() or b"0"))
+            pos += isz + lsz
+        # Philox keys are two 64-bit words: (seed, serial ‖ row)
+        rngs = [np.random.Generator(np.random.Philox(
+                    key=[seed, (serial << 32) + r]))
+                for r in local_rows]
+        images = np.stack(pool.map(tf, blobs, rngs))
+        labels_np = np.asarray(labels, dtype=np.int32)
+
+        img_shards, lbl_shards = [], []
+        for device, (lo, hi) in rows_by_device.items():
+            sel = [row_pos[r] for r in range(lo, hi)]
+            img_shards.append(jax.device_put(images[sel], device))
+            lbl_shards.append(jax.device_put(labels_np[sel], device))
+        imgs = jax.make_array_from_single_device_arrays(
+            global_shape, sharding, img_shards)
+        lbls = jax.make_array_from_single_device_arrays(
+            (batch,), label_sharding, lbl_shards)
+        return imgs, lbls
+
+    depth = prefetch_depth if prefetch_depth is not None else ctx.config.prefetch_depth
+    return Pipeline(sampler, make_batch, depth=depth, fingerprint=fp,
+                    on_close=pool.close)
+
+
+def make_imagenet_resnet_pipeline(ctx: StromContext, paths: Sequence[str], *,
+                                  batch: int, sharding: Any,
+                                  image_size: int = 224,
+                                  **kw: Any) -> Pipeline:
+    """BASELINE config #2: ImageNet raw-JPEG shards → ResNet-50 input pipeline."""
+    return make_wds_vision_pipeline(ctx, paths, batch=batch,
+                                    image_size=image_size, sharding=sharding,
+                                    **kw)
+
+
+def make_vit_wds_pipeline(ctx: StromContext, paths: Sequence[str], *,
+                          batch: int, sharding: Any,
+                          image_size: int = 224,
+                          **kw: Any) -> Pipeline:
+    """BASELINE config #3: WebDataset .tar shards → ViT-B/16 training loader.
+
+    Identical mechanics; shard *paths* typically live on a RAID0 set's member
+    mounts so the gather fans out across NVMe devices."""
+    return make_wds_vision_pipeline(ctx, paths, batch=batch,
+                                    image_size=image_size, sharding=sharding,
+                                    **kw)

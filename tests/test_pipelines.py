@@ -1,0 +1,304 @@
+"""T5 pipelines: sampler checkpointing, llama/vision loaders on the fake
+8-device mesh, parquet scan fan-out (SURVEY.md §4.2 'Device delivery' and
+'Overlap/0-stall' rows)."""
+
+import numpy as np
+import pytest
+
+import jax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from strom.config import StromConfig
+from strom.delivery.core import StromContext
+from strom.parallel.mesh import make_mesh
+from strom.pipelines.sampler import (EpochShuffleSampler, SamplerState,
+                                     dataset_fingerprint, load_loader_state,
+                                     save_loader_state)
+
+
+@pytest.fixture(scope="module")
+def ctx():
+    c = StromContext(StromConfig(engine="python", queue_depth=8, num_buffers=8))
+    yield c
+    c.close()
+
+
+@pytest.fixture(scope="module")
+def mesh():
+    return make_mesh({"dp": 8}, devices=jax.devices()[:8])
+
+
+# ---------------------------------------------------------------- sampler
+class TestSampler:
+    def test_covers_epoch_exactly(self):
+        s = EpochShuffleSampler(100, 10, seed=1)
+        it = iter(s)
+        seen = np.concatenate([next(it) for _ in range(10)])
+        assert sorted(seen) == list(range(100))
+
+    def test_deterministic_and_reshuffled(self):
+        a = [next(iter(EpochShuffleSampler(50, 50, seed=7))) for _ in range(1)][0]
+        b = next(iter(EpochShuffleSampler(50, 50, seed=7)))
+        np.testing.assert_array_equal(a, b)
+        it = iter(EpochShuffleSampler(50, 50, seed=7))
+        e0, e1 = next(it), next(it)
+        assert not np.array_equal(e0, e1)  # epoch 1 reshuffles
+        np.testing.assert_array_equal(sorted(e0), sorted(e1))
+
+    def test_resume_mid_epoch(self):
+        s1 = EpochShuffleSampler(100, 10, seed=3)
+        it1 = iter(s1)
+        for _ in range(13):
+            next(it1)
+        resumed = EpochShuffleSampler(
+            100, 10, seed=3,
+            state=SamplerState(epoch=1, batch_in_epoch=3, seed=3))
+        np.testing.assert_array_equal(next(iter(resumed)), next(it1))
+
+    def test_state_file_roundtrip(self, tmp_path, data_file):
+        path, _ = data_file
+        fp = dataset_fingerprint((path,))
+        st = SamplerState(epoch=2, batch_in_epoch=5, seed=9)
+        f = str(tmp_path / "loader.json")
+        save_loader_state(f, st, fp, {"k": 1})
+        got, extra = load_loader_state(f, fp)
+        assert got == st and extra == {"k": 1}
+        with pytest.raises(ValueError, match="different dataset"):
+            load_loader_state(f, {"paths": ["other"], "sizes": [1]})
+
+    def test_ragged_rejected(self):
+        with pytest.raises(ValueError, match="drop_last"):
+            EpochShuffleSampler(10, 3, drop_last=False)
+
+
+# ---------------------------------------------------------- llama pipeline
+class TestLlamaPipeline:
+    @pytest.fixture(scope="class")
+    def token_shards(self, tmp_path_factory):
+        rng = np.random.default_rng(11)
+        td = tmp_path_factory.mktemp("tokens")
+        paths, golden = [], []
+        seq = 16  # record = 17 tokens
+        for i in range(3):
+            # ids < LlamaConfig.tiny().vocab so batches feed the train step
+            t = rng.integers(0, 500, 17 * 20 + 5, dtype=np.int32)  # 20 rec + tail
+            p = str(td / f"shard{i}.bin")
+            t.tofile(p)
+            paths.append(p)
+            golden.append(t[: 17 * 20].reshape(20, 17))
+        return paths, np.concatenate(golden), seq
+
+    def test_sequential_content_golden(self, ctx, mesh, token_shards):
+        from strom.pipelines import make_llama_pipeline
+
+        paths, golden, seq = token_shards
+        sharding = NamedSharding(mesh, P("dp", None))
+        with make_llama_pipeline(ctx, paths, batch=8, seq_len=seq,
+                                 sharding=sharding, shuffle=False) as pipe:
+            b0 = next(pipe)
+            assert b0.shape == (8, 17) and b0.sharding == sharding
+            np.testing.assert_array_equal(np.asarray(b0), golden[:8])
+            np.testing.assert_array_equal(np.asarray(next(pipe)), golden[8:16])
+
+    def test_shuffled_epoch_covers_all(self, ctx, mesh, token_shards):
+        from strom.pipelines import make_llama_pipeline
+
+        paths, golden, seq = token_shards
+        # 60 records don't split over 8 devices: replicate (also exercises the
+        # planner's read-once-put-everywhere dedupe)
+        sharding = NamedSharding(mesh, P(None, None))
+        with make_llama_pipeline(ctx, paths, batch=60, seq_len=seq,
+                                 sharding=sharding, seed=5) as pipe:
+            batch = np.asarray(next(pipe))
+        # one full epoch in one batch: same records, different order
+        assert not np.array_equal(batch, golden)
+        np.testing.assert_array_equal(
+            batch[np.lexsort(batch.T[::-1])], golden[np.lexsort(golden.T[::-1])])
+
+    def test_checkpoint_resume_replays_nothing(self, ctx, mesh, token_shards,
+                                               tmp_path):
+        from strom.pipelines import make_llama_pipeline
+
+        paths, _, seq = token_shards
+        sharding = NamedSharding(mesh, P("dp", None))
+        f = str(tmp_path / "loader.json")
+        with make_llama_pipeline(ctx, paths, batch=8, seq_len=seq,
+                                 sharding=sharding, seed=13,
+                                 prefetch_depth=3) as pipe:
+            for _ in range(3):
+                next(pipe)
+            pipe.save_state(f)  # prefetcher has run ahead; state must not
+            want_next = np.asarray(next(pipe))
+        with make_llama_pipeline(ctx, paths, batch=8, seq_len=seq,
+                                 sharding=sharding, seed=13,
+                                 resume_from=f) as pipe2:
+            np.testing.assert_array_equal(np.asarray(next(pipe2)), want_next)
+
+    def test_feeds_train_step(self, ctx, mesh, token_shards):
+        from strom.models.llama import LlamaConfig
+        from strom.parallel.train import (init_train_state, make_optimizer,
+                                          make_train_step)
+        from strom.pipelines import make_llama_pipeline
+
+        paths, _, seq = token_shards
+        tmesh = make_mesh({"dp": 2, "tp": 4}, devices=jax.devices()[:8])
+        cfg = LlamaConfig.tiny()
+        opt = make_optimizer()
+        state = init_train_state(jax.random.PRNGKey(0), cfg, tmesh, opt)
+        step = make_train_step(cfg, tmesh, opt)
+        with make_llama_pipeline(ctx, paths, batch=8, seq_len=seq,
+                                 sharding=NamedSharding(tmesh, P("dp", None))) as pipe:
+            for _ in range(2):
+                state, metrics = step(state, next(pipe))
+        assert np.isfinite(float(metrics["loss"]))
+        assert int(state.step) == 2
+
+
+# --------------------------------------------------------- vision pipeline
+class TestVisionPipeline:
+    @pytest.fixture(scope="class")
+    def wds_shards(self, tmp_path_factory):
+        import cv2
+
+        from tests.test_formats import make_wds_shard
+
+        rng = np.random.default_rng(21)
+        td = tmp_path_factory.mktemp("wds")
+        paths = []
+        labels = {}
+        k = 0
+        for s in range(2):
+            samples = []
+            for i in range(12):
+                img = rng.integers(0, 256, (40 + i, 50, 3), dtype=np.uint8)
+                ok, buf = cv2.imencode(".jpg", img)
+                assert ok
+                samples.append((f"s{k:04d}", {"jpg": buf.tobytes(),
+                                              "cls": str(k % 10).encode()}))
+                labels[f"s{k:04d}"] = k % 10
+                k += 1
+            p = str(td / f"wds{s}.tar")
+            make_wds_shard(p, samples)
+            paths.append(p)
+        return paths, labels
+
+    def test_batch_shapes_and_labels(self, ctx, mesh, wds_shards):
+        from strom.pipelines import make_imagenet_resnet_pipeline
+
+        paths, labels = wds_shards
+        sharding = NamedSharding(mesh, P("dp", None, None, None))
+        with make_imagenet_resnet_pipeline(
+                ctx, paths, batch=8, image_size=32, sharding=sharding,
+                shuffle=False, decode_workers=2) as pipe:
+            imgs, lbls = next(pipe)
+        assert imgs.shape == (8, 32, 32, 3) and imgs.dtype == np.uint8
+        assert imgs.sharding == sharding
+        assert lbls.shape == (8,)
+        np.testing.assert_array_equal(np.asarray(lbls),
+                                      [labels[f"s{i:04d}"] for i in range(8)])
+
+    def test_deterministic_augmentation(self, ctx, mesh, wds_shards):
+        from strom.pipelines import make_vit_wds_pipeline
+
+        paths, _ = wds_shards
+        sharding = NamedSharding(mesh, P("dp", None, None, None))
+        outs = []
+        for _ in range(2):
+            with make_vit_wds_pipeline(ctx, paths, batch=8, image_size=32,
+                                       sharding=sharding, seed=3,
+                                       decode_workers=2) as pipe:
+                outs.append(np.asarray(next(pipe)[0]))
+        np.testing.assert_array_equal(outs[0], outs[1])
+
+    def test_feeds_resnet_step(self, ctx, mesh, wds_shards):
+        from strom.models.resnet import ResNetConfig, init_params, loss_fn
+        from strom.pipelines import make_imagenet_resnet_pipeline
+
+        paths, _ = wds_shards
+        cfg = ResNetConfig.tiny()
+        params, state = init_params(jax.random.PRNGKey(0), cfg)
+        sharding = NamedSharding(mesh, P("dp", None, None, None))
+        with make_imagenet_resnet_pipeline(
+                ctx, paths, batch=8, image_size=32, sharding=sharding,
+                decode_workers=2) as pipe:
+            imgs, lbls = next(pipe)
+            from strom.models.resnet import normalize_images
+
+            loss, _ = jax.jit(loss_fn, static_argnames="cfg")(
+                params, state, normalize_images(imgs), lbls, cfg)
+        assert np.isfinite(float(loss))
+
+
+# ----------------------------------------------------------- parquet scan
+class TestParquetScan:
+    @pytest.fixture(scope="class")
+    def pq_shards(self, tmp_path_factory):
+        import pyarrow as pa
+        import pyarrow.parquet as pq
+
+        rng = np.random.default_rng(31)
+        td = tmp_path_factory.mktemp("pq")
+        paths, frames = [], []
+        for s in range(3):
+            n = 4000
+            vals = rng.normal(size=n)
+            ids = np.arange(n, dtype=np.int64)
+            table = pa.table({"id": pa.array(ids), "value": pa.array(vals)})
+            p = str(td / f"part{s}.parquet")
+            pq.write_table(table, p, row_group_size=1000)
+            paths.append(p)
+            frames.append(vals)
+        return paths, np.concatenate(frames)
+
+    def test_count_where_matches_numpy(self, ctx, pq_shards):
+        from strom.pipelines import parquet_count_where
+
+        paths, vals = pq_shards
+        got = parquet_count_where(ctx, paths, "value", lambda v: v > 0.5)
+        assert got == int((vals > 0.5).sum())
+
+    def test_zero_units_contributes_zero(self, ctx, pq_shards):
+        """A process with no assigned units must produce a zero aggregate of
+        the right structure, not raise (multi-host allgather safety)."""
+        import jax.numpy as jnp
+
+        from strom.pipelines import parquet_scan_aggregate
+
+        paths, _ = pq_shards  # 3 shards × 4 row groups = 12 units
+
+        def map_fn(cols):
+            v = cols["value"]
+            return {"sum": jnp.sum(v), "n": jnp.asarray(v.shape[0], jnp.int32)}
+
+        # process 12 of 13: local_units = units[12::13] = []
+        out = parquet_scan_aggregate(ctx, paths, ["value"], map_fn,
+                                     process_index=12, process_count=13)
+        assert out["sum"] == 0.0 and out["n"] == 0
+
+    def test_round_robin_partition_sums_to_whole(self, ctx, pq_shards):
+        """Simulated 3-process scan: per-process partials sum to the global."""
+        import jax.numpy as jnp
+
+        from strom.pipelines import parquet_scan_aggregate
+
+        paths, vals = pq_shards
+        parts = [parquet_scan_aggregate(
+                     ctx, paths, ["value"],
+                     lambda cols: jnp.sum(cols["value"]),
+                     process_index=i, process_count=3) for i in range(3)]
+        np.testing.assert_allclose(sum(parts), vals.sum(), rtol=1e-6)
+
+    def test_aggregate_sum_matches(self, ctx, pq_shards):
+        import jax.numpy as jnp
+
+        from strom.pipelines import parquet_scan_aggregate
+
+        paths, vals = pq_shards
+
+        def map_fn_sum(cols):
+            v = cols["value"]
+            return {"sum": jnp.sum(v), "n": jnp.asarray(v.shape[0], jnp.int32)}
+
+        out = parquet_scan_aggregate(ctx, paths, ["value"], map_fn_sum)
+        assert out["n"] == len(vals)
+        np.testing.assert_allclose(out["sum"], vals.sum(), rtol=1e-6)

@@ -1,0 +1,104 @@
+"""Ring attention / sequence parallelism: exactness vs dense attention and
+the sp train step on a dp×sp mesh (fake 8-device CPU mesh)."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from strom.models.llama import LlamaConfig, attention
+from strom.parallel.mesh import make_mesh
+from strom.parallel.ring import make_ring_attention
+
+
+@pytest.fixture(scope="module")
+def sp_mesh():
+    return make_mesh({"sp": 8}, devices=jax.devices()[:8])
+
+
+class TestRingAttention:
+    @pytest.mark.parametrize("B,S,H,KV,Dh", [(2, 64, 4, 2, 16), (1, 32, 4, 4, 8)])
+    def test_matches_dense(self, sp_mesh, B, S, H, KV, Dh):
+        rng = np.random.default_rng(0)
+        q = jnp.array(rng.normal(size=(B, S, H, Dh)), jnp.float32)
+        k = jnp.array(rng.normal(size=(B, S, KV, Dh)), jnp.float32)
+        v = jnp.array(rng.normal(size=(B, S, KV, Dh)), jnp.float32)
+        out_ring = jax.jit(make_ring_attention(sp_mesh))(q, k, v)
+        out_dense = attention(q, k, v, causal=True)
+        np.testing.assert_allclose(np.asarray(out_ring), np.asarray(out_dense),
+                                   rtol=2e-4, atol=2e-4)
+
+    def test_non_causal_matches(self, sp_mesh):
+        rng = np.random.default_rng(1)
+        q = jnp.array(rng.normal(size=(1, 32, 2, 8)), jnp.float32)
+        k = jnp.array(rng.normal(size=(1, 32, 2, 8)), jnp.float32)
+        v = jnp.array(rng.normal(size=(1, 32, 2, 8)), jnp.float32)
+        out_ring = jax.jit(make_ring_attention(sp_mesh, causal=False))(q, k, v)
+        out_dense = attention(q, k, v, causal=False)
+        np.testing.assert_allclose(np.asarray(out_ring), np.asarray(out_dense),
+                                   rtol=2e-4, atol=2e-4)
+
+    def test_sharded_io_stays_sharded(self, sp_mesh):
+        """Inputs sequence-sharded on sp → output sequence-sharded on sp."""
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        rng = np.random.default_rng(2)
+        sh = NamedSharding(sp_mesh, P(None, "sp", None, None))
+        q = jax.device_put(rng.normal(size=(1, 64, 2, 8)).astype(np.float32), sh)
+        k = jax.device_put(rng.normal(size=(1, 64, 2, 8)).astype(np.float32), sh)
+        v = jax.device_put(rng.normal(size=(1, 64, 2, 8)).astype(np.float32), sh)
+        out = jax.jit(make_ring_attention(sp_mesh))(q, k, v)
+        assert out.sharding.spec == P(None, "sp", None, None)
+
+
+class TestSequenceParallelStep:
+    def test_sp_step_matches_dense(self):
+        from strom.parallel.train import (init_train_state, make_optimizer,
+                                          make_train_step)
+
+        cfg = LlamaConfig.tiny()
+        mesh = make_mesh({"dp": 2, "sp": 4}, devices=jax.devices()[:8])
+        tokens = jnp.array(np.random.default_rng(0).integers(0, cfg.vocab, (4, 64)),
+                           jnp.int32)
+        opt = make_optimizer()
+        losses = {}
+        for sp in (True, False):
+            state = init_train_state(jax.random.PRNGKey(0), cfg, mesh, opt)
+            step = make_train_step(cfg, mesh, opt, sp=sp)
+            state, metrics = step(state, tokens)
+            losses[sp] = float(metrics["loss"])
+            assert int(state.step) == 1
+        assert abs(losses[True] - losses[False]) < 2e-3, losses
+
+    def test_sp_pipeline_feeds_sp_step(self, tmp_path):
+        """End-to-end long-context slice: seq-sharded delivery → ring step."""
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from strom.config import StromConfig
+        from strom.delivery.core import StromContext
+        from strom.parallel.train import (init_train_state, make_optimizer,
+                                          make_train_step)
+        from strom.pipelines import make_llama_pipeline
+
+        cfg = LlamaConfig.tiny()
+        mesh = make_mesh({"dp": 2, "sp": 4}, devices=jax.devices()[:8])
+        rng = np.random.default_rng(3)
+        path = str(tmp_path / "tokens.bin")
+        rng.integers(0, cfg.vocab, 64 * 50, dtype=np.int32).tofile(path)
+        ctx = StromContext(StromConfig(engine="python", queue_depth=8,
+                                       num_buffers=8))
+        try:
+            opt = make_optimizer()
+            state = init_train_state(jax.random.PRNGKey(0), cfg, mesh, opt)
+            step = make_train_step(cfg, mesh, opt, sp=True)
+            # record length 64 = seq_len+1 divisible by sp size 4
+            with make_llama_pipeline(ctx, [path], batch=4, seq_len=63,
+                                     sharding=NamedSharding(mesh, P("dp", "sp"))
+                                     ) as pipe:
+                batch = next(pipe)
+                assert batch.sharding.spec == P("dp", "sp")
+                state, metrics = step(state, batch)
+            assert np.isfinite(float(metrics["loss"]))
+        finally:
+            ctx.close()

@@ -1,0 +1,136 @@
+"""ResNet-50 and ViT-B/16 model checks (consumers of BASELINE configs #2/#3)."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+
+class TestResNet:
+    @pytest.fixture(scope="class")
+    def tiny(self):
+        from strom.models.resnet import ResNetConfig, init_params
+
+        cfg = ResNetConfig.tiny()
+        params, state = init_params(jax.random.PRNGKey(0), cfg)
+        return cfg, params, state
+
+    def test_forward_shapes_finite(self, tiny):
+        from strom.models.resnet import forward
+
+        cfg, params, state = tiny
+        x = jnp.array(np.random.default_rng(0).normal(size=(2, 32, 32, 3)),
+                      dtype=jnp.float32)
+        logits, new_state = forward(params, state, x, cfg, train=True)
+        assert logits.shape == (2, cfg.num_classes)
+        assert logits.dtype == jnp.float32
+        assert bool(jnp.isfinite(logits).all())
+        # bn state updated in train mode, untouched in eval mode
+        assert not np.allclose(np.asarray(new_state["stem"]["mean"]),
+                               np.asarray(state["stem"]["mean"]))
+        _, eval_state = forward(params, state, x, cfg, train=False)
+        np.testing.assert_array_equal(np.asarray(eval_state["stem"]["mean"]),
+                                      np.asarray(state["stem"]["mean"]))
+
+    def test_overfits_small_batch(self, tiny):
+        import optax
+
+        from strom.models.resnet import loss_fn
+
+        cfg, params, state = tiny
+        rng = np.random.default_rng(1)
+        x = jnp.array(rng.normal(size=(8, 32, 32, 3)), dtype=jnp.float32)
+        y = jnp.array(rng.integers(0, cfg.num_classes, 8), dtype=jnp.int32)
+        opt = optax.adam(1e-2)
+        opt_state = opt.init(params)
+
+        @jax.jit
+        def step(params, state, opt_state):
+            (loss, new_state), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+                params, state, x, y, cfg)
+            updates, opt_state = opt.update(grads, opt_state)
+            return optax.apply_updates(params, updates), new_state, opt_state, loss
+
+        losses = []
+        for _ in range(6):
+            params, state, opt_state, loss = step(params, state, opt_state)
+            losses.append(float(loss))
+        assert losses[-1] < losses[0], losses
+
+    def test_normalize_images(self):
+        from strom.models.resnet import normalize_images
+
+        u8 = jnp.full((1, 2, 2, 3), 128, dtype=jnp.uint8)
+        out = normalize_images(u8)
+        assert out.dtype == jnp.float32
+        assert float(jnp.abs(out).max()) < 3.0
+
+
+class TestViT:
+    @pytest.fixture(scope="class")
+    def tiny(self):
+        from strom.models.vit import ViTConfig, init_params
+
+        cfg = ViTConfig.tiny()
+        params = init_params(jax.random.PRNGKey(0), cfg)
+        return cfg, params
+
+    def test_patchify_roundtrip(self):
+        from strom.models.vit import patchify
+
+        x = jnp.arange(2 * 8 * 8 * 3, dtype=jnp.float32).reshape(2, 8, 8, 3)
+        p = patchify(x, 4)
+        assert p.shape == (2, 4, 48)
+        # first patch == top-left 4x4 block, row-major
+        np.testing.assert_array_equal(np.asarray(p[0, 0]),
+                                      np.asarray(x[0, :4, :4]).reshape(-1))
+
+    def test_forward_shapes_finite(self, tiny):
+        from strom.models.vit import forward
+
+        cfg, params = tiny
+        x = jnp.array(np.random.default_rng(0).normal(size=(2, 32, 32, 3)),
+                      dtype=jnp.float32)
+        logits = forward(params, x, cfg)
+        assert logits.shape == (2, cfg.num_classes)
+        assert bool(jnp.isfinite(logits).all())
+
+    def test_permutation_equivariance_check(self, tiny):
+        """Without pos embeddings ViT is patch-permutation invariant; with
+        them it must NOT be — catches a dropped pos_embed wiring."""
+        from strom.models.vit import forward
+
+        cfg, params = tiny
+        rng = np.random.default_rng(2)
+        x = rng.normal(size=(1, 32, 32, 3)).astype(np.float32)
+        x_shuf = x.copy()
+        # swap two 8x8 patches
+        x_shuf[0, :8, :8], x_shuf[0, :8, 8:16] = x[0, :8, 8:16], x[0, :8, :8]
+        l1 = forward(params, jnp.array(x), cfg)
+        l2 = forward(params, jnp.array(x_shuf), cfg)
+        assert not np.allclose(np.asarray(l1), np.asarray(l2), atol=1e-5)
+
+    def test_overfits_small_batch(self, tiny):
+        import optax
+
+        from strom.models.vit import loss_fn
+
+        cfg, params = tiny
+        rng = np.random.default_rng(3)
+        x = jnp.array(rng.normal(size=(8, 32, 32, 3)), dtype=jnp.float32)
+        y = jnp.array(rng.integers(0, cfg.num_classes, 8), dtype=jnp.int32)
+        opt = optax.adam(1e-3)
+        opt_state = opt.init(params)
+
+        @jax.jit
+        def step(params, opt_state):
+            loss, grads = jax.value_and_grad(loss_fn)(params, x, y, cfg)
+            updates, opt_state = opt.update(grads, opt_state)
+            return optax.apply_updates(params, updates), opt_state, loss
+
+        losses = []
+        for _ in range(6):
+            params, opt_state, loss = step(params, opt_state)
+            losses.append(float(loss))
+        assert losses[-1] < losses[0], losses
