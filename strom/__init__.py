@@ -71,6 +71,14 @@ def buffer_info() -> dict:
     return context().buffer_info()
 
 
+def map_buffers() -> list:
+    """Zero-copy numpy views of the engine's pinned, registered staging-pool
+    slots — the TPU-world analogue of MAP_GPU_MEMORY handing back the pinned
+    window (the pool is allocated+registered at engine init; this exposes it)."""
+    ctx = context()
+    return [ctx.engine.buffer(i) for i in range(ctx.engine.num_buffers)]
+
+
 def stats() -> dict:
     return context().stats()
 

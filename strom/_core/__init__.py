@@ -1,0 +1,6 @@
+"""C++ io_uring engine: sources + build helper (compiled on first use).
+
+A real package (not a namespace dir) so setuptools ships strom_core.cpp and
+the Makefile with wheels/sdists — installed users get the fast engine, not a
+silent fallback to the pure-Python one.
+"""

@@ -64,6 +64,9 @@ class StromConfig:
     # RAID0 (software striped reader over N member files/devices)
     raid_chunk: int = 512 * KiB
 
+    # failure handling: transparent per-chunk resubmits before erroring
+    io_retries: int = 1
+
     # fault injection (tests/hardening; 0 = off)
     fault_every: int = 0
 

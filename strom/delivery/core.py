@@ -127,7 +127,8 @@ class StromContext:
         eng = self.engine
         total = 0
         with self._engine_lock:
-            pending: dict[int, int] = {}  # tag -> want
+            # tag -> (file_idx, file_off, dest_off, want, attempts)
+            pending: dict[int, tuple[int, int, int, int, int]] = {}
             it = ((fi, fo + p, do + p, min(block, ln - p))
                   for (fi, fo, do, ln) in chunks
                   for p in range(0, ln, block))
@@ -143,14 +144,25 @@ class StromContext:
                         tag = self._tag_counter
                         self._tag_counter += 1
                         eng.submit_raw([RawRead(fi, fo, ln, d8[do: do + ln], tag)])
-                        pending[tag] = ln
+                        pending[tag] = (fi, fo, do, ln, 0)
                     if not pending:
                         break
                     for c in eng.wait(min_completions=1):
-                        want = pending.pop(c.tag)
+                        fi, fo, do, want, attempts = pending.pop(c.tag)
                         if c.result < 0:
+                            # transient-error policy (SURVEY.md §5 failure
+                            # detection): retry the chunk, then give up loudly
+                            if attempts < cfg.io_retries:
+                                global_stats.add("chunk_retries")
+                                tag = self._tag_counter
+                                self._tag_counter += 1
+                                eng.submit_raw([RawRead(fi, fo, want,
+                                                        d8[do: do + want], tag)])
+                                pending[tag] = (fi, fo, do, want, attempts + 1)
+                                continue
                             raise EngineError(-c.result,
-                                              f"ssd2tpu read failed: {os.strerror(-c.result)}")
+                                              f"ssd2tpu read failed after {attempts + 1} "
+                                              f"attempts: {os.strerror(-c.result)}")
                         if c.result != want:
                             raise EngineError(5, f"short read ({c.result} < {want}) — "
                                                  "file smaller than requested range?")

@@ -1,0 +1,96 @@
+"""Concurrency stress: many threads hammer one StromContext while others
+poll stats — run under the TSAN/ASAN engine builds by the sanitizer tests
+(SURVEY.md §5 'Race detection/sanitizers' row).
+
+Usage (normally via tests/test_sanitizers.py):
+    LD_PRELOAD=.../libtsan.so python -m strom.engine.stress --variant tsan
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import tempfile
+import threading
+import time
+
+import numpy as np
+
+
+def run_stress(variant: str = "", *, seconds: float = 3.0,
+               readers: int = 3, size: int = 8 * 1024 * 1024) -> int:
+    from strom.config import StromConfig
+    from strom.delivery.core import StromContext
+    from strom.engine.uring_engine import UringEngine, uring_available
+
+    cfg = StromConfig(queue_depth=16, num_buffers=32)
+    if variant:
+        if not uring_available():
+            print("io_uring unavailable; nothing to stress", file=sys.stderr)
+            return 0
+        engine = UringEngine(cfg, variant=variant)
+    else:
+        engine = None  # auto
+    ctx = StromContext(cfg, engine=engine)
+
+    with tempfile.TemporaryDirectory() as td:
+        path = os.path.join(td, "stress.bin")
+        golden = np.random.default_rng(0).integers(0, 256, size, dtype=np.uint8)
+        golden.tofile(path)
+
+        stop = threading.Event()
+        errors: list[BaseException] = []
+
+        def reader(tid: int) -> None:
+            rng = np.random.default_rng(tid)
+            try:
+                while not stop.is_set():
+                    off = int(rng.integers(0, size // 2)) & ~4095
+                    ln = int(rng.integers(1, 16)) * 128 * 1024
+                    ln = min(ln, size - off)
+                    got = ctx.pread(path, off, ln)
+                    if not np.array_equal(got, golden[off: off + ln]):
+                        raise AssertionError(f"data mismatch at {off}+{ln}")
+            except BaseException as e:  # noqa: BLE001 - surfaced to main
+                errors.append(e)
+                stop.set()
+
+        def poller() -> None:
+            try:
+                while not stop.is_set():
+                    ctx.stats()
+                    ctx.buffer_info()
+                    ctx.engine.in_flight()
+                    time.sleep(0.001)
+            except BaseException as e:  # noqa: BLE001
+                errors.append(e)
+                stop.set()
+
+        threads = [threading.Thread(target=reader, args=(i,)) for i in range(readers)]
+        threads.append(threading.Thread(target=poller))
+        for t in threads:
+            t.start()
+        time.sleep(seconds)
+        stop.set()
+        for t in threads:
+            t.join(timeout=30)
+        ctx.close()
+        if errors:
+            print(f"stress FAILED: {errors[0]!r}", file=sys.stderr)
+            return 1
+        print(f"stress ok: engine={ctx.engine.name} variant={variant or 'default'}")
+        return 0
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--variant", default="", choices=["", "tsan", "asan"])
+    ap.add_argument("--seconds", type=float, default=3.0)
+    ap.add_argument("--readers", type=int, default=3)
+    args = ap.parse_args()
+    return run_stress(args.variant, seconds=args.seconds, readers=args.readers)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

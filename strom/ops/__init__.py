@@ -1,0 +1,4 @@
+"""Pallas TPU kernels for the hot compute ops (the models consume these;
+the I/O side's hot loops live in strom/_core)."""
+
+from strom.ops.flash_attention import flash_attention, make_flash_attention  # noqa: F401

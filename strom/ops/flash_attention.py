@@ -1,0 +1,179 @@
+"""Flash attention as a Pallas TPU kernel — the flagship model's hot op.
+
+TPU-first design (per /opt/skills/guides/pallas_guide.md):
+- grid (B, H, Sq/BLK_Q, Sk/BLK_K), kv-block axis innermost so the online
+  -softmax state for one q block lives in VMEM scratch across kv steps;
+- q·kᵀ and p·v hit the MXU as [BLK, Dh]×[Dh, BLK] tiles with float32
+  accumulation (`preferred_element_type`);
+- causal masking at two granularities: whole kv blocks above the diagonal
+  are skipped with `pl.when` (no wasted MXU work), the diagonal block masks
+  elementwise with `broadcasted_iota`;
+- GQA folded into the index maps: q head h reads kv head h // group — no
+  materialized kv repeat (the dense path in strom.models.llama reshapes
+  instead).
+
+Backward runs as dense recompute under `jax.custom_vjp` (standard math, f32)
+— fine for training parity; a fused backward kernel is a later optimization.
+On non-TPU backends the kernel runs in interpreter mode so tests exercise the
+same code path the TPU compiles.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+_NEG_BIG = -0.7 * float(jnp.finfo(jnp.float32).max)
+_LANES = 128  # f32 scratch tiles are (8, 128); m/l broadcast across lanes
+
+
+def _fa_kernel(q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, acc_ref, *,
+               causal: bool, scale: float, blk_q: int, blk_k: int):
+    iq = pl.program_id(2)
+    jk = pl.program_id(3)
+    nk = pl.num_programs(3)
+
+    @pl.when(jk == 0)
+    def _init():
+        m_ref[:] = jnp.full_like(m_ref, _NEG_BIG)
+        l_ref[:] = jnp.zeros_like(l_ref)
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    # causal: kv blocks strictly above the diagonal contribute nothing
+    run = (jk * blk_k <= iq * blk_q + blk_q - 1) if causal else True
+
+    @pl.when(run)
+    def _step():
+        q = q_ref[0, 0]                       # [blk_q, Dh]
+        k = k_ref[0, 0]                       # [blk_k, Dh]
+        v = v_ref[0, 0]
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * scale
+        if causal:
+            qpos = iq * blk_q + jax.lax.broadcasted_iota(jnp.int32,
+                                                         (blk_q, blk_k), 0)
+            kpos = jk * blk_k + jax.lax.broadcasted_iota(jnp.int32,
+                                                         (blk_q, blk_k), 1)
+            s = jnp.where(qpos >= kpos, s, _NEG_BIG)
+        m_prev = m_ref[:, :1]                  # [blk_q, 1]
+        bm = jnp.max(s, axis=-1, keepdims=True)
+        m_new = jnp.maximum(m_prev, bm)
+        p = jnp.exp(s - m_new)                 # [blk_q, blk_k] f32
+        alpha = jnp.exp(m_prev - m_new)        # [blk_q, 1]
+        l_ref[:] = jnp.broadcast_to(
+            l_ref[:, :1] * alpha + jnp.sum(p, axis=-1, keepdims=True),
+            l_ref.shape)
+        m_ref[:] = jnp.broadcast_to(m_new, m_ref.shape)
+        pv = jax.lax.dot_general(p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        acc_ref[:] = acc_ref[:] * alpha + pv
+
+    @pl.when(jk == nk - 1)
+    def _finish():
+        denom = jnp.maximum(l_ref[:, :1], 1e-30)
+        o_ref[0, 0] = (acc_ref[:] / denom).astype(o_ref.dtype)
+
+
+def _flash_fwd(q: jax.Array, k: jax.Array, v: jax.Array, *, causal: bool,
+               block_q: int, block_k: int, interpret: bool) -> jax.Array:
+    """q [B,S,H,Dh]; k,v [B,S,KV,Dh] → [B,S,H,Dh]. Layout transposed to
+    head-major [B,H,S,Dh] for MXU-friendly [S,Dh] tiles."""
+    B, S, H, Dh = q.shape
+    KV = k.shape[2]
+    G = H // KV
+    blk_q = min(block_q, S)
+    blk_k = min(block_k, S)
+    if S % blk_q or S % blk_k:
+        raise ValueError(f"seq len {S} must divide by blocks ({blk_q},{blk_k})")
+    qt = q.transpose(0, 2, 1, 3)  # [B,H,S,Dh]
+    kt = k.transpose(0, 2, 1, 3)  # [B,KV,S,Dh]
+    vt = v.transpose(0, 2, 1, 3)
+    scale = 1.0 / math.sqrt(Dh)
+
+    kernel = functools.partial(_fa_kernel, causal=causal, scale=scale,
+                               blk_q=blk_q, blk_k=blk_k)
+    grid = (B, H, S // blk_q, S // blk_k)
+    out = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, 1, blk_q, Dh),
+                         lambda b, h, i, j: (b, h, i, 0)),
+            pl.BlockSpec((1, 1, blk_k, Dh),
+                         lambda b, h, i, j, G=G: (b, h // G, j, 0)),
+            pl.BlockSpec((1, 1, blk_k, Dh),
+                         lambda b, h, i, j, G=G: (b, h // G, j, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, 1, blk_q, Dh),
+                               lambda b, h, i, j: (b, h, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((B, H, S, Dh), q.dtype),
+        scratch_shapes=[
+            pltpu.VMEM((blk_q, _LANES), jnp.float32),  # m
+            pltpu.VMEM((blk_q, _LANES), jnp.float32),  # l
+            pltpu.VMEM((blk_q, Dh), jnp.float32),      # acc
+        ],
+        interpret=interpret,
+    )(qt, kt, vt)
+    return out.transpose(0, 2, 1, 3)
+
+
+def _dense_ref(q, k, v, causal):
+    """f32 dense attention — the recompute backward and the parity oracle."""
+    B, S, H, Dh = q.shape
+    KV = k.shape[2]
+    G = H // KV
+    qg = q.reshape(B, S, KV, G, Dh)
+    s = jnp.einsum("bqkgd,bskd->bkgqs", qg, k).astype(jnp.float32)
+    s = s / math.sqrt(Dh)
+    if causal:
+        pos = jnp.arange(S)
+        s = jnp.where((pos[:, None] >= pos[None, :])[None, None, None],
+                      s, _NEG_BIG)
+    p = jax.nn.softmax(s, axis=-1)
+    out = jnp.einsum("bkgqs,bskd->bqkgd", p.astype(v.dtype), v)
+    return out.reshape(B, S, H, Dh)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
+                    causal: bool = True, block_q: int = 128,
+                    block_k: int = 128,
+                    interpret: bool | None = None) -> jax.Array:
+    """Flash attention. q [B,S,H,Dh]; k,v [B,S,KV,Dh] (GQA) → [B,S,H,Dh].
+
+    interpret=None → interpreter mode automatically off on TPU, on elsewhere.
+    """
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    return _flash_fwd(q, k, v, causal=causal, block_q=block_q,
+                      block_k=block_k, interpret=interpret)
+
+
+def _vjp_fwd(q, k, v, causal, block_q, block_k, interpret):
+    out = flash_attention(q, k, v, causal, block_q, block_k, interpret)
+    return out, (q, k, v)
+
+
+def _vjp_bwd(causal, block_q, block_k, interpret, res, g):
+    q, k, v = res
+    _, pullback = jax.vjp(lambda q_, k_, v_: _dense_ref(q_, k_, v_, causal),
+                          q, k, v)
+    return pullback(g)
+
+
+flash_attention.defvjp(_vjp_fwd, _vjp_bwd)
+
+
+def make_flash_attention(*, block_q: int = 128, block_k: int = 128,
+                         causal: bool = True):
+    """An `attn_fn` for strom.models.llama.forward(..., attn_fn=...)."""
+
+    def attn(q, k, v):
+        return flash_attention(q, k, v, causal, block_q, block_k)
+
+    return attn

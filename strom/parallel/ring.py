@@ -60,9 +60,7 @@ def ring_attention_local(q: jax.Array, k: jax.Array, v: jax.Array, *,
 
     q_pos0 = idx * Sq
 
-    def step(carry, s):
-        m, l, acc, k_blk, v_blk = carry
-        src = (idx - s) % n            # whose kv block we hold at step s
+    def update(m, l, acc, k_blk, v_blk, src):
         bm, pv, bl = _block_attn(q, k_blk, v_blk, q_pos0, src * Sk, causal)
         m_new = jnp.maximum(m, bm)
         alpha = jnp.exp(m - m_new)      # m starts at finite _NEG_BIG: no nan
@@ -70,17 +68,25 @@ def ring_attention_local(q: jax.Array, k: jax.Array, v: jax.Array, *,
         l = l * alpha + bl * beta
         acc = acc * alpha.transpose(0, 3, 1, 2)[..., None] \
             + pv * beta.transpose(0, 3, 1, 2)[..., None]
-        # rotate kv one hop: device i's block moves to i+1 (so next step we
-        # hold the block of (idx - s - 1) mod n)
+        return m_new, l, acc
+
+    def step(carry, s):
+        m, l, acc, k_blk, v_blk = carry
+        # rotate one hop, THEN compute: n-1 rotations total, none wasted
         perm = [(i, (i + 1) % n) for i in range(n)]
         k_blk = lax.ppermute(k_blk, axis_name, perm)
         v_blk = lax.ppermute(v_blk, axis_name, perm)
-        return (m_new, l, acc, k_blk, v_blk), None
+        src = (idx - s) % n            # whose kv block we hold at step s
+        m, l, acc = update(m, l, acc, k_blk, v_blk, src)
+        return (m, l, acc, k_blk, v_blk), None
 
     m0 = jnp.full((B, KV, G, Sq), _NEG_BIG, jnp.float32)
     l0 = jnp.zeros((B, KV, G, Sq), jnp.float32)
     acc0 = jnp.zeros((B, Sq, KV, G, Dh), jnp.float32)
-    (m, l, acc, _, _), _ = lax.scan(step, (m0, l0, acc0, k, v), jnp.arange(n))
+    # step 0: our own kv block, no rotation needed
+    m1, l1, acc1 = update(m0, l0, acc0, k, v, idx)
+    (m, l, acc, _, _), _ = lax.scan(step, (m1, l1, acc1, k, v),
+                                    jnp.arange(1, n))
     denom = l.transpose(0, 3, 1, 2)[..., None]
     out = acc / jnp.maximum(denom, 1e-30)
     return out.reshape(B, Sq, H, Dh).astype(q.dtype)

@@ -96,12 +96,19 @@ class Pipeline:
 def resolve_state(paths: tuple[str, ...], *, seed: int,
                   resume_from: str | SamplerState | None
                   ) -> tuple[SamplerState | None, dict]:
-    """Common resume plumbing: fingerprint the shard list and, when resuming
-    from a file, validate it."""
+    """Common resume plumbing: fingerprint the shard list and, when resuming,
+    validate both the dataset identity and the shuffle seed — a checkpoint
+    saved under a different seed describes a different data order."""
     fp = dataset_fingerprint(paths)
     if resume_from is None:
         return None, fp
     if isinstance(resume_from, SamplerState):
-        return resume_from, fp
-    state, _ = load_loader_state(resume_from, fp)
+        state = resume_from
+    else:
+        state, _ = load_loader_state(resume_from, fp)
+    if state.seed != seed:
+        raise ValueError(
+            f"loader state was saved with seed {state.seed} but the pipeline "
+            f"was constructed with seed {seed}; refusing to resume a "
+            "different shuffle order")
     return state, fp

@@ -1,0 +1,71 @@
+"""Failure handling in the delivery layer: transparent chunk retry, loud
+failure past the retry budget (SURVEY.md §5 'Failure detection' row)."""
+
+import numpy as np
+import pytest
+
+from strom.config import StromConfig
+from strom.delivery.core import StromContext
+from strom.engine.base import EngineError
+from strom.utils.stats import global_stats
+
+
+class TestChunkRetry:
+    def test_faults_absorbed_by_retry(self, engine_name, data_file):
+        """fault_every=5 at qd=4: plenty of ops fault, every one retries
+        successfully, delivered bytes stay golden."""
+        path, golden = data_file
+        cfg = StromConfig(engine=engine_name, queue_depth=4, num_buffers=8,
+                          fault_every=5, io_retries=1)
+        before = global_stats.counter("chunk_retries").value
+        ctx = StromContext(cfg)
+        try:
+            got = ctx.pread(path, 0, 2 * 1024 * 1024)
+        finally:
+            ctx.close()
+        np.testing.assert_array_equal(got, golden[: 2 * 1024 * 1024])
+        assert global_stats.counter("chunk_retries").value > before
+
+    def test_retry_budget_zero_fails_loudly(self, engine_name, data_file):
+        path, _ = data_file
+        cfg = StromConfig(engine=engine_name, queue_depth=4, num_buffers=8,
+                          fault_every=2, io_retries=0)
+        ctx = StromContext(cfg)
+        try:
+            with pytest.raises(EngineError, match="after 1 attempts"):
+                ctx.pread(path, 0, 2 * 1024 * 1024)
+        finally:
+            ctx.close()
+
+    def test_persistent_fault_exhausts_retries(self, engine_name, data_file):
+        """fault_every=1 faults every op including retries: must fail, not
+        loop forever."""
+        path, _ = data_file
+        cfg = StromConfig(engine=engine_name, queue_depth=4, num_buffers=8,
+                          fault_every=1, io_retries=2)
+        ctx = StromContext(cfg)
+        try:
+            with pytest.raises(EngineError, match="after 3 attempts"):
+                ctx.pread(path, 0, 512 * 1024)
+        finally:
+            ctx.close()
+
+    def test_engine_usable_after_failed_transfer(self, engine_name, data_file):
+        """A failed transfer must not poison the shared engine for later ones."""
+        path, golden = data_file
+        cfg = StromConfig(engine=engine_name, queue_depth=4, num_buffers=8,
+                          fault_every=2, io_retries=0)
+        ctx = StromContext(cfg)
+        try:
+            with pytest.raises(EngineError):
+                ctx.pread(path, 0, 1024 * 1024)
+            # stop injecting: the next transfer must succeed cleanly
+            object.__setattr__(ctx.config, "fault_every", 0)
+            if hasattr(ctx.engine, "set_fault_every"):
+                ctx.engine.set_fault_every(0)
+            else:
+                object.__setattr__(ctx.engine.config, "fault_every", 0)
+            got = ctx.pread(path, 4096, 256 * 1024)
+            np.testing.assert_array_equal(got, golden[4096: 4096 + 256 * 1024])
+        finally:
+            ctx.close()

@@ -134,6 +134,21 @@ class TestLlamaPipeline:
                                  resume_from=f) as pipe2:
             np.testing.assert_array_equal(np.asarray(next(pipe2)), want_next)
 
+    def test_resume_with_wrong_seed_rejected(self, ctx, mesh, token_shards,
+                                             tmp_path):
+        from strom.pipelines import make_llama_pipeline
+
+        paths, _, seq = token_shards
+        sharding = NamedSharding(mesh, P("dp", None))
+        f = str(tmp_path / "loader.json")
+        with make_llama_pipeline(ctx, paths, batch=8, seq_len=seq,
+                                 sharding=sharding, seed=13) as pipe:
+            next(pipe)
+            pipe.save_state(f)
+        with pytest.raises(ValueError, match="seed 13"):
+            make_llama_pipeline(ctx, paths, batch=8, seq_len=seq,
+                                sharding=sharding, seed=7, resume_from=f)
+
     def test_feeds_train_step(self, ctx, mesh, token_shards):
         from strom.models.llama import LlamaConfig
         from strom.parallel.train import (init_train_state, make_optimizer,

@@ -1,0 +1,69 @@
+"""Sanitizer jobs: the C++ engine under TSAN/ASAN with concurrent submitters
+(SURVEY.md §5 'Race detection/sanitizers' row).
+
+The sanitized .so is loaded into a stock (non-sanitized) python, so the
+runtime must be LD_PRELOADed into a subprocess; sanitizer reports land on
+stderr and flip the exit code via TSAN_OPTIONS/ASAN_OPTIONS."""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+
+def _runtime(name: str) -> str | None:
+    try:
+        out = subprocess.run(["g++", f"-print-file-name={name}"],
+                             capture_output=True, text=True).stdout.strip()
+    except OSError:
+        return None
+    return out if out and os.path.isabs(out) and os.path.exists(out) else None
+
+
+def _run_stress(variant: str, preload: str, extra_env: dict) -> subprocess.CompletedProcess:
+    env = os.environ.copy()
+    env["LD_PRELOAD"] = preload
+    env.update(extra_env)
+    return subprocess.run(
+        [sys.executable, "-m", "strom.engine.stress", "--variant", variant,
+         "--seconds", "2"],
+        capture_output=True, text=True, timeout=600, env=env,
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+@pytest.mark.slow
+def test_tsan_stress_clean():
+    from strom.engine.uring_engine import uring_available
+
+    if not uring_available():
+        pytest.skip("io_uring unavailable")
+    rt = _runtime("libtsan.so")
+    if rt is None:
+        pytest.skip("libtsan runtime not found")
+    proc = _run_stress("tsan", rt, {
+        # history_size: keep memory modest; exitcode flips on any report
+        "TSAN_OPTIONS": "exitcode=66 report_bugs=1 history_size=2",
+    })
+    assert "ThreadSanitizer" not in proc.stderr, proc.stderr[-4000:]
+    assert proc.returncode == 0, (proc.returncode, proc.stderr[-4000:])
+    assert "stress ok" in proc.stdout
+
+
+@pytest.mark.slow
+def test_asan_stress_clean():
+    from strom.engine.uring_engine import uring_available
+
+    if not uring_available():
+        pytest.skip("io_uring unavailable")
+    rt = _runtime("libasan.so")
+    if rt is None:
+        pytest.skip("libasan runtime not found")
+    proc = _run_stress("asan", rt, {
+        # python itself "leaks" interned objects: leak detection off, the
+        # memory-error detectors (UAF/OOB) stay on
+        "ASAN_OPTIONS": "detect_leaks=0 exitcode=67",
+    })
+    assert "AddressSanitizer" not in proc.stderr, proc.stderr[-4000:]
+    assert proc.returncode == 0, (proc.returncode, proc.stderr[-4000:])
+    assert "stress ok" in proc.stdout
