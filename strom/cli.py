@@ -143,6 +143,115 @@ def bench_ssd2tpu(args: argparse.Namespace) -> dict:
     }
 
 
+def bench_llama(args: argparse.Namespace) -> dict:
+    """Config #4 loader shape: packed-token pipeline throughput (tokens/s)
+    + the 0-data-stall counter, feeding a dp mesh on the local device(s)."""
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from strom.config import StromConfig
+    from strom.delivery.core import StromContext
+    from strom.parallel.mesh import make_mesh
+    from strom.pipelines import make_llama_pipeline
+
+    record = (args.seq_len + 1) * 4
+    path = args.file
+    if path is None:
+        path = os.path.join(args.tmpdir, "strom_bench_tokens.bin")
+        want = args.steps * args.batch * record * 2
+        if not os.path.exists(path) or os.path.getsize(path) < want:
+            _mk_testfile(path, want)
+    cfg = StromConfig(engine=args.engine, block_size=args.block,
+                      queue_depth=args.depth, num_buffers=max(args.depth * 2, 8))
+    ctx = StromContext(cfg)
+    n_dev = max(d for d in range(len(jax.devices()), 0, -1) if args.batch % d == 0)
+    mesh = make_mesh({"dp": n_dev}, devices=jax.devices()[:n_dev])
+    sharding = NamedSharding(mesh, P("dp", None))
+    _drop_cache_hint(path)
+    with make_llama_pipeline(ctx, [path], batch=args.batch, seq_len=args.seq_len,
+                             sharding=sharding, prefetch_depth=args.prefetch) as pipe:
+        next(pipe).block_until_ready()  # warmup outside the timed region
+        t0 = time.perf_counter()
+        for _ in range(args.steps):
+            next(pipe).block_until_ready()
+        dt = time.perf_counter() - t0
+        stalls = pipe.data_stall_steps
+    ctx.close()
+    tokens = args.steps * args.batch * (args.seq_len + 1)
+    return {
+        "bench": "llama_loader", "tokens_per_s": round(tokens / dt, 1),
+        "gbps": round(tokens * 4 / dt / 1e9, 4), "batch": args.batch,
+        "seq_len": args.seq_len, "steps": args.steps, "devices": n_dev,
+        "data_stall_steps": stalls, "engine": cfg.engine,
+    }
+
+
+def bench_resnet(args: argparse.Namespace) -> dict:
+    """Config #2 shape: JPEG WebDataset -> decode -> device, images/s
+    (IO-bound: a throttled fake 'train step' just blocks on delivery)."""
+    import io
+    import tarfile
+
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from strom.config import StromConfig
+    from strom.delivery.core import StromContext
+    from strom.parallel.mesh import make_mesh
+    from strom.pipelines import make_imagenet_resnet_pipeline
+
+    path = args.file
+    if path is None:
+        n_samples = max(args.batch * 4, 256)
+        # fixture keyed by BOTH knobs so a bigger --batch regenerates it
+        path = os.path.join(args.tmpdir,
+                            f"strom_bench_wds_{args.image_size}_{n_samples}.tar")
+        if not os.path.exists(path):
+            import cv2
+
+            rng = np.random.default_rng(0)
+            with tarfile.open(path, "w") as tf:
+                for i in range(n_samples):
+                    img = rng.integers(0, 256, (args.image_size * 2,
+                                                args.image_size * 2, 3),
+                                       dtype=np.uint8)
+                    ok, buf = cv2.imencode(".jpg", img,
+                                           [cv2.IMWRITE_JPEG_QUALITY, 90])
+                    assert ok
+                    for name, data in ((f"s{i:06d}.jpg", buf.tobytes()),
+                                       (f"s{i:06d}.cls", str(i % 1000).encode())):
+                        info = tarfile.TarInfo(name)
+                        info.size = len(data)
+                        tf.addfile(info, io.BytesIO(data))
+            os.sync()
+    cfg = StromConfig(engine=args.engine, block_size=args.block,
+                      queue_depth=args.depth, num_buffers=max(args.depth * 2, 8))
+    ctx = StromContext(cfg)
+    n_dev = max(d for d in range(len(jax.devices()), 0, -1) if args.batch % d == 0)
+    mesh = make_mesh({"dp": n_dev}, devices=jax.devices()[:n_dev])
+    sharding = NamedSharding(mesh, P("dp", None, None, None))
+    _drop_cache_hint(path)
+    with make_imagenet_resnet_pipeline(
+            ctx, [path], batch=args.batch, image_size=args.image_size,
+            sharding=sharding, prefetch_depth=args.prefetch,
+            decode_workers=args.decode_workers) as pipe:
+        next(pipe)[0].block_until_ready()
+        t0 = time.perf_counter()
+        for _ in range(args.steps):
+            imgs, _ = next(pipe)
+            imgs.block_until_ready()
+        dt = time.perf_counter() - t0
+        stalls = pipe.data_stall_steps
+    ctx.close()
+    return {
+        "bench": "resnet_loader",
+        "images_per_s": round(args.steps * args.batch / dt, 1),
+        "batch": args.batch, "image_size": args.image_size,
+        "steps": args.steps, "devices": n_dev, "data_stall_steps": stalls,
+        "decode_workers": args.decode_workers, "engine": cfg.engine,
+    }
+
+
 def main(argv: list[str] | None = None) -> int:
     ap = argparse.ArgumentParser(prog="strom-bench")
     sub = ap.add_subparsers(dest="cmd", required=True)
@@ -169,6 +278,23 @@ def main(argv: list[str] | None = None) -> int:
                        help="bytes per async copy")
     p_s2t.add_argument("--prefetch", type=int, default=2, help="copies in flight")
     p_s2t.set_defaults(fn=bench_ssd2tpu)
+
+    p_llama = sub.add_parser("llama", help="config #4: packed-token loader tokens/s")
+    common(p_llama)
+    p_llama.add_argument("--batch", type=int, default=32)
+    p_llama.add_argument("--seq-len", type=int, default=2047, dest="seq_len")
+    p_llama.add_argument("--steps", type=int, default=50)
+    p_llama.add_argument("--prefetch", type=int, default=2)
+    p_llama.set_defaults(fn=bench_llama)
+
+    p_rn = sub.add_parser("resnet", help="config #2: JPEG loader images/s")
+    common(p_rn)
+    p_rn.add_argument("--batch", type=int, default=64)
+    p_rn.add_argument("--image-size", type=int, default=224, dest="image_size")
+    p_rn.add_argument("--steps", type=int, default=20)
+    p_rn.add_argument("--prefetch", type=int, default=2)
+    p_rn.add_argument("--decode-workers", type=int, default=8, dest="decode_workers")
+    p_rn.set_defaults(fn=bench_resnet)
 
     p_check = sub.add_parser("check", help="≙ CHECK_FILE: report a file's data-path tier")
     p_check.add_argument("path")

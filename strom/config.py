@@ -60,6 +60,9 @@ class StromConfig:
     # delivery
     prefetch_depth: int = 2            # batches dispatched ahead of consumption
     delivery_workers: int = 2          # threads pushing host->HBM
+    slab_pool_bytes: int = 512 * MiB   # recycled host slabs (0 = off); only
+                                       # used on backends where device_put
+                                       # copies (i.e. not the jax CPU backend)
 
     # RAID0 (software striped reader over N member files/devices)
     raid_chunk: int = 512 * KiB

@@ -11,25 +11,38 @@ from __future__ import annotations
 
 import ctypes
 import mmap
+import threading
 
 import numpy as np
 
 _libc = ctypes.CDLL(None, use_errno=True)
 
 PAGE = mmap.PAGESIZE
+_MAP_POPULATE = getattr(mmap, "MAP_POPULATE", 0x8000)
 
 
-def alloc_aligned(nbytes: int, *, pin: bool = False, dtype=np.uint8) -> np.ndarray:
+def alloc_aligned(nbytes: int, *, pin: bool = False, populate: bool = False,
+                  dtype=np.uint8) -> np.ndarray:
     """Allocate a page-aligned, optionally mlock'd uint8 slab as a numpy array.
 
     The mmap stays alive as long as the returned array (numpy holds the buffer
     via its .base chain). O_DIRECT reads require page alignment — a plain
     np.empty gives 16-byte alignment only.
+
+    populate=True prefaults the pages inside the mmap call — lazy faulting
+    during the read serializes against DMA submission (~0.5 ms/MiB measured),
+    which is exactly the bounce-free hot path's enemy (SURVEY.md §7.4 #1).
     """
     if nbytes <= 0:
         raise ValueError("nbytes must be positive")
     padded = (nbytes + PAGE - 1) // PAGE * PAGE
-    mm = mmap.mmap(-1, padded)
+    flags = mmap.MAP_PRIVATE | mmap.MAP_ANONYMOUS
+    if populate:
+        flags |= _MAP_POPULATE
+    try:
+        mm = mmap.mmap(-1, padded, flags=flags)
+    except (ValueError, OSError):
+        mm = mmap.mmap(-1, padded)  # kernel without MAP_POPULATE
     if pin:
         addr = ctypes.addressof(ctypes.c_char.from_buffer(mm))
         _libc.mlock(ctypes.c_void_p(addr), ctypes.c_size_t(padded))  # best effort
@@ -37,3 +50,47 @@ def alloc_aligned(nbytes: int, *, pin: bool = False, dtype=np.uint8) -> np.ndarr
     if dtype is not np.uint8:
         arr = arr.view(dtype)
     return arr
+
+
+class SlabPool:
+    """Recycles aligned slabs so steady-state transfers fault no pages.
+
+    The recycle contract is the same lifetime handshake the reference does
+    with P2P page refcounts + free callbacks (SURVEY.md §7.4 hard part #3):
+    `release()` may only be called once nothing reads the slab anymore — for
+    delivery that means after the device transfer completed
+    (`block_until_ready`), and never on backends where `device_put` aliases
+    host memory (jax CPU) instead of copying.
+    """
+
+    def __init__(self, max_bytes: int = 512 * 1024 * 1024):
+        self.max_bytes = max_bytes
+        self._free: dict[int, list[np.ndarray]] = {}
+        self._cached_bytes = 0
+        self._lock = threading.Lock()
+        self.hits = 0
+        self.misses = 0
+
+    def acquire(self, nbytes: int) -> np.ndarray:
+        with self._lock:
+            bucket = self._free.get(nbytes)
+            if bucket:
+                self.hits += 1
+                self._cached_bytes -= nbytes
+                return bucket.pop()
+            self.misses += 1
+        return alloc_aligned(nbytes, populate=True)
+
+    def release(self, arr: np.ndarray) -> None:
+        nbytes = arr.nbytes
+        with self._lock:
+            if self._cached_bytes + nbytes > self.max_bytes:
+                return  # let it drop; GC unmaps
+            self._free.setdefault(nbytes, []).append(arr)
+            self._cached_bytes += nbytes
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {"cached_bytes": self._cached_bytes, "hits": self.hits,
+                    "misses": self.misses,
+                    "buckets": {k: len(v) for k, v in self._free.items()}}

@@ -23,7 +23,7 @@ from typing import Any, Sequence
 import numpy as np
 
 from strom.config import StromConfig
-from strom.delivery.buffers import alloc_aligned
+from strom.delivery.buffers import SlabPool, alloc_aligned
 from strom.delivery.extents import ExtentList
 from strom.delivery.handle import DMAHandle, deferred_handle
 from strom.delivery.shard import DevicePlan, Segment, dedupe_plans, plan_sharded_read
@@ -82,6 +82,8 @@ class StromContext:
         # process-lifetime unique tags: stale completions from a failed
         # transfer can never alias a later transfer's ops
         self._tag_counter = 0
+        self._slab_pool = SlabPool(self.config.slab_pool_bytes) \
+            if self.config.slab_pool_bytes > 0 else None
         self._closed = False
 
     # -- file registry ------------------------------------------------------
@@ -230,25 +232,54 @@ class StromContext:
         def run() -> Any:
             from strom.utils.tracing import trace_span
 
+            # slab recycling: only when device_put COPIES host bytes (every
+            # real accelerator backend; the jax CPU backend aliases instead),
+            # and released strictly after the transfer retires. Gate on the
+            # TARGET's platform, not the default backend — a CPU destination
+            # aliases regardless of what the default device is.
+            if sharding is not None:
+                target_platform = next(iter(sharding.device_set)).platform
+            elif device is not None:
+                target_platform = device.platform
+            else:
+                target_platform = jax.default_backend()
+            pool = None if (pin or target_platform == "cpu") else self._slab_pool
+
+            def acquire(n: int) -> np.ndarray:
+                return pool.acquire(n) if pool is not None \
+                    else alloc_aligned(n, pin=pin)
+
             with trace_span("strom.memcpy_ssd2tpu", enabled=self.config.trace_annotations):
                 if sharding is None:
-                    dest = alloc_aligned(nbytes, pin=pin)
+                    dest = acquire(nbytes)
                     self._read_segments(source, [Segment(0, 0, nbytes)], dest, offset)
                     arr_host = dest.view(np_dtype).reshape(shape)
                     with trace_span("strom.device_put", enabled=self.config.trace_annotations):
-                        return jax.device_put(arr_host, device)  # device=None → default
+                        out = jax.device_put(arr_host, device)  # device=None → default
+                    if pool is not None:
+                        out.block_until_ready()
+                        pool.release(dest)
+                    return out
                 plans = plan_sharded_read(shape, np_dtype, sharding)
                 groups = dedupe_plans(plans)
                 shards = []
+                dests = []
                 for segs, group in groups.items():
-                    dest = alloc_aligned(group[0].nbytes, pin=pin)
+                    dest = acquire(group[0].nbytes)
+                    dests.append(dest)
                     self._read_segments(source, list(segs), dest, offset)
                     arr_host = dest.view(np_dtype).reshape(group[0].local_shape)
                     for p in group:
                         with trace_span("strom.device_put", enabled=self.config.trace_annotations):
                             shards.append(jax.device_put(arr_host, p.device))
-                return jax.make_array_from_single_device_arrays(
+                out = jax.make_array_from_single_device_arrays(
                     shape, sharding, shards)
+                if pool is not None:
+                    for s in shards:
+                        s.block_until_ready()
+                    for dest in dests:
+                        pool.release(dest)
+                return out
 
         if async_:
             return deferred_handle(run, self._executor, nbytes, label)
@@ -279,6 +310,8 @@ class StromContext:
             "registered_files": len(self._files),
             "ssd2tpu_bytes": global_stats.counter("ssd2tpu_bytes").value,
         }}
+        if self._slab_pool is not None:
+            out["slab_pool"] = self._slab_pool.stats()
         out["engine"] = self.engine.stats()
         return out
 

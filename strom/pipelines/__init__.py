@@ -2,6 +2,7 @@
 iterators yielding sharded jax.Arrays, matching BASELINE configs #2–#5."""
 
 from strom.pipelines.base import Pipeline  # noqa: F401
+from strom.pipelines.checkpoint import TrainCheckpointer  # noqa: F401
 from strom.pipelines.llama_pretrain import make_llama_pipeline  # noqa: F401
 from strom.pipelines.parquet_scan import (  # noqa: F401
     parquet_count_where, parquet_scan_aggregate)

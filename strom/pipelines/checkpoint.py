@@ -1,0 +1,95 @@
+"""Joint checkpointing: orbax for the train state, the loader-state blob
+next to it (SURVEY.md §5 'Checkpoint/resume' row: loader state integrates
+with orbax-style step checkpoints by the consumer).
+
+A resume restores BOTH or NEITHER — a train state without its loader cursor
+replays data (changing the training trajectory), a cursor without its train
+state skips data silently. Keeping them in one step directory makes the
+pairing atomic at the directory level.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any
+
+from strom.pipelines.base import Pipeline
+from strom.pipelines.sampler import SamplerState, load_loader_state
+
+_LOADER_FILE = "loader_state.json"
+
+
+class TrainCheckpointer:
+    """Steps' checkpoints live under root/<step>/ : orbax state + loader blob."""
+
+    def __init__(self, root: str):
+        import orbax.checkpoint as ocp
+
+        self.root = os.path.abspath(root)
+        os.makedirs(self.root, exist_ok=True)
+        self._ckptr = ocp.StandardCheckpointer()
+
+    def _step_dir(self, step: int) -> str:
+        return os.path.join(self.root, f"{step:08d}")
+
+    def save(self, step: int, train_state: Any, pipeline: Pipeline,
+             extra: dict | None = None) -> str:
+        d = self._step_dir(step)
+        self._ckptr.save(os.path.join(d, "state"), train_state)
+        self._ckptr.wait_until_finished()
+        pipeline.save_state(os.path.join(d, _LOADER_FILE), extra)
+        return d
+
+    def latest_step(self) -> int | None:
+        steps = []
+        try:
+            for name in os.listdir(self.root):
+                # only complete checkpoints: loader blob is written last
+                if name.isdigit() and os.path.exists(
+                        os.path.join(self.root, name, _LOADER_FILE)):
+                    steps.append(int(name))
+        except FileNotFoundError:
+            return None
+        return max(steps) if steps else None
+
+    def loader_state_path(self, step: int) -> str:
+        """Resume handle for make_*_pipeline(resume_from=...): the FILE path,
+        so the pipeline validates the dataset fingerprint + seed on resume
+        (a bare SamplerState would skip the fingerprint check)."""
+        return os.path.join(self._step_dir(step), _LOADER_FILE)
+
+    def restore(self, step: int, abstract_state: Any
+                ) -> tuple[Any, SamplerState, dict]:
+        """Returns (train state, loader sampler state, extra). For resuming a
+        pipeline prefer ``resume_from=self.loader_state_path(step)`` over the
+        returned SamplerState — the file path is fingerprint-validated."""
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        d = self._step_dir(step)
+        state = self._ckptr.restore(os.path.join(d, "state"), abstract_state)
+        # Restored arrays come back COMMITTED to their stored placement; a
+        # scalar opt leaf pinned to one device then clashes with mesh-sharded
+        # params inside jit. Re-place every leaf: the abstract sharding when
+        # it's a mesh sharding, replicated over the tree's mesh otherwise.
+        mesh = None
+        for leaf in jax.tree.leaves(abstract_state):
+            sh = getattr(leaf, "sharding", None)
+            if isinstance(sh, NamedSharding):
+                mesh = sh.mesh
+                break
+
+        def replace(x, a):
+            sh = getattr(a, "sharding", None)
+            if isinstance(sh, NamedSharding):
+                return jax.device_put(x, sh)
+            if mesh is not None:
+                return jax.device_put(x, NamedSharding(mesh, P()))
+            return x
+
+        state = jax.tree.map(replace, state, abstract_state)
+        sampler_state, extra = load_loader_state(os.path.join(d, _LOADER_FILE))
+        return state, sampler_state, extra
+
+    def close(self) -> None:
+        self._ckptr.close()

@@ -1,0 +1,98 @@
+"""Joint train-state + loader-state checkpointing: a restore resumes BOTH the
+model and the exact data cursor (SURVEY.md §5 'Checkpoint/resume')."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from strom.config import StromConfig
+from strom.delivery.core import StromContext
+from strom.models.llama import LlamaConfig
+from strom.parallel.mesh import make_mesh
+from strom.parallel.train import init_train_state, make_optimizer, make_train_step
+from strom.pipelines import make_llama_pipeline
+from strom.pipelines.checkpoint import TrainCheckpointer
+
+
+@pytest.fixture(scope="module")
+def token_paths(tmp_path_factory):
+    td = tmp_path_factory.mktemp("ckpt_tokens")
+    rng = np.random.default_rng(7)
+    paths = []
+    for i in range(2):
+        p = str(td / f"s{i}.bin")
+        rng.integers(0, 500, 17 * 40, dtype=np.int32).tofile(p)
+        paths.append(p)
+    return paths
+
+
+def test_save_restore_resumes_exact_trajectory(tmp_path, token_paths):
+    cfg = LlamaConfig.tiny()
+    mesh = make_mesh({"dp": 8}, devices=jax.devices()[:8])
+    sharding = NamedSharding(mesh, P("dp", None))
+    opt = make_optimizer()
+    step = make_train_step(cfg, mesh, opt, donate=False)
+    ctx = StromContext(StromConfig(engine="python", queue_depth=8, num_buffers=8))
+    ck = TrainCheckpointer(str(tmp_path / "ckpts"))
+    try:
+        # run 3 steps, checkpoint at 2, run 1 more; record the 4th batch loss
+        state = init_train_state(jax.random.PRNGKey(0), cfg, mesh, opt)
+        with make_llama_pipeline(ctx, token_paths, batch=8, seq_len=16,
+                                 sharding=sharding, seed=5) as pipe:
+            for i in range(1, 4):
+                state, metrics = step(state, next(pipe))
+                if i == 2:
+                    ck.save(2, state, pipe, {"note": "mid"})
+            loss_step3 = float(metrics["loss"])
+
+        assert ck.latest_step() == 2
+        abstract = jax.tree.map(
+            lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype, sharding=x.sharding),
+            init_train_state(jax.random.PRNGKey(0), cfg, mesh, opt))
+        restored, sampler_state, extra = ck.restore(2, abstract)
+        assert extra == {"note": "mid"}
+        assert int(restored.step) == 2
+        # resume via the file path: fingerprint + seed validated
+        with make_llama_pipeline(ctx, token_paths, batch=8, seq_len=16,
+                                 sharding=sharding, seed=5,
+                                 resume_from=ck.loader_state_path(2)) as pipe2:
+            restored, metrics2 = step(restored, next(pipe2))
+        # same params + same batch ⇒ bit-identical continuation
+        assert float(metrics2["loss"]) == loss_step3
+        assert int(restored.step) == 3
+    finally:
+        ck.close()
+        ctx.close()
+
+
+def test_resume_against_changed_dataset_rejected(tmp_path, token_paths):
+    """The checkpoint's loader blob must refuse a changed shard list."""
+    ctx = StromContext(StromConfig(engine="python", queue_depth=8, num_buffers=8))
+    mesh = make_mesh({"dp": 8}, devices=jax.devices()[:8])
+    sharding = NamedSharding(mesh, P("dp", None))
+    try:
+        with make_llama_pipeline(ctx, token_paths, batch=8, seq_len=16,
+                                 sharding=sharding, seed=5) as pipe:
+            next(pipe)
+            f = str(tmp_path / "loader.json")
+            pipe.save_state(f)
+        grown = str(tmp_path / "extra.bin")
+        np.random.default_rng(1).integers(0, 500, 17 * 10, dtype=np.int32).tofile(grown)
+        with pytest.raises(ValueError, match="different dataset"):
+            make_llama_pipeline(ctx, list(token_paths) + [grown], batch=8,
+                                seq_len=16, sharding=sharding, seed=5,
+                                resume_from=f)
+    finally:
+        ctx.close()
+
+
+def test_latest_step_ignores_incomplete(tmp_path, token_paths):
+    import os
+
+    ck = TrainCheckpointer(str(tmp_path / "ckpts"))
+    os.makedirs(str(tmp_path / "ckpts" / "00000005"))  # no loader blob: torn
+    assert ck.latest_step() is None
+    ck.close()

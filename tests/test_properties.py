@@ -1,0 +1,130 @@
+"""Property tests (hypothesis) for the pure planning math: stripe decode,
+extent location, sharded-segment decomposition, sampler coverage
+(SURVEY.md §4.2 'Unit' row: "property tests")."""
+
+import numpy as np
+from hypothesis import given, settings, strategies as st
+
+from strom.delivery.extents import ExtentList
+from strom.delivery.shard import contiguous_segments
+from strom.engine.raid0 import coalesce, plan_stripe_reads
+from strom.pipelines.sampler import EpochShuffleSampler, SamplerState
+
+
+class TestStripeProperties:
+    @given(offset=st.integers(0, 1 << 20), length=st.integers(0, 1 << 18),
+           n=st.integers(1, 8), chunk_pow=st.integers(9, 16))
+    @settings(max_examples=200, deadline=None)
+    def test_stripe_reassembles_identity(self, offset, length, n, chunk_pow):
+        """Reading the planned member segments out of a striped 'disk' model
+        must reproduce the logical range exactly."""
+        chunk = 1 << chunk_pow
+        segs = plan_stripe_reads(offset, length, n, chunk)
+        # coverage: in logical order, no gaps/overlaps
+        assert sum(s.length for s in segs) == length
+        pos = offset
+        for s in segs:
+            assert s.logical_offset == pos
+            pos += s.length
+        # correctness of the member mapping: invert it
+        for s in segs:
+            for d in (0, s.length - 1) if s.length else ():
+                logical = s.logical_offset + d
+                member_byte = s.member_offset + d
+                chunk_idx = logical // chunk
+                assert s.member == chunk_idx % n
+                assert member_byte == (chunk_idx // n) * chunk + logical % chunk
+
+    @given(offset=st.integers(0, 1 << 16), length=st.integers(0, 1 << 16),
+           n=st.integers(1, 4), chunk_pow=st.integers(9, 12))
+    @settings(max_examples=100, deadline=None)
+    def test_coalesce_preserves_bytes(self, offset, length, n, chunk_pow):
+        segs = plan_stripe_reads(offset, length, n, 1 << chunk_pow)
+        merged = coalesce(segs)
+        assert sum(s.length for s in merged) == length
+        assert len(merged) <= len(segs)
+
+
+class TestExtentProperties:
+    @given(st.data())
+    @settings(max_examples=200, deadline=None)
+    def test_locate_matches_materialized(self, data):
+        """locate() over random extents == slicing the materialized stream."""
+        n_ext = data.draw(st.integers(1, 8))
+        exts, stream = [], []
+        for i in range(n_ext):
+            ln = data.draw(st.integers(1, 256))
+            off = data.draw(st.integers(0, 1024))
+            path = f"f{data.draw(st.integers(0, 2))}"
+            exts.append((path, off, ln))
+            stream.extend((path, off + j) for j in range(ln))
+        el = ExtentList(exts)
+        assert el.size == len(stream)
+        lo = data.draw(st.integers(0, el.size))
+        ln = data.draw(st.integers(0, el.size - lo))
+        runs = list(el.locate(lo, ln, dest_offset=5))
+        flat = [(r.path, r.offset + j) for r in runs for j in range(r.length)]
+        assert flat == stream[lo: lo + ln]
+        # dest offsets are contiguous from 5
+        if runs:
+            assert runs[0].dest_offset == 5
+            for a, b in zip(runs, runs[1:]):
+                assert b.dest_offset == a.dest_offset + a.length
+
+
+class TestSegmentProperties:
+    @given(st.data())
+    @settings(max_examples=150, deadline=None)
+    def test_segments_reconstruct_subblock(self, data):
+        """contiguous_segments of a random rectangular sub-block must copy
+        exactly the bytes numpy slicing produces."""
+        ndim = data.draw(st.integers(1, 3))
+        shape = tuple(data.draw(st.integers(1, 6)) for _ in range(ndim))
+        itemsize = data.draw(st.sampled_from([1, 2, 4]))
+        index = []
+        for dim in shape:
+            lo = data.draw(st.integers(0, dim - 1))
+            hi = data.draw(st.integers(lo + 1, dim))
+            index.append(slice(lo, hi))
+        index = tuple(index)
+        total = int(np.prod(shape)) * itemsize
+        src = np.arange(total, dtype=np.uint8)
+        arr = src.view(np.uint8).reshape(tuple(shape) + (itemsize,)) \
+            if itemsize > 1 else src.reshape(shape)
+        want = (arr[index].reshape(-1).tobytes() if itemsize == 1 else
+                arr[index + (slice(None),)].reshape(-1).tobytes())
+        segs = list(contiguous_segments(shape, itemsize, index))
+        out = bytearray(len(want))
+        for s in segs:
+            out[s.dest_offset: s.dest_offset + s.length] = \
+                src[s.file_offset: s.file_offset + s.length].tobytes()
+        assert bytes(out) == want
+
+
+class TestSamplerProperties:
+    @given(num=st.integers(1, 500), batch_frac=st.integers(1, 100),
+           seed=st.integers(0, 2**31))
+    @settings(max_examples=100, deadline=None)
+    def test_epoch_partition(self, num, batch_frac, seed):
+        batch = max(1, min(num, batch_frac))
+        s = EpochShuffleSampler(num, batch, seed=seed)
+        it = iter(s)
+        seen = np.concatenate([next(it) for _ in range(s.batches_per_epoch)])
+        assert len(seen) == len(set(seen.tolist()))  # no duplicates
+        assert set(seen.tolist()) <= set(range(num))
+
+    @given(num=st.integers(2, 300), seed=st.integers(0, 2**31),
+           advance=st.integers(0, 50))
+    @settings(max_examples=100, deadline=None)
+    def test_resume_exact(self, num, seed, advance):
+        batch = max(1, num // 7)
+        s1 = EpochShuffleSampler(num, batch, seed=seed)
+        it1 = iter(s1)
+        for _ in range(advance):
+            next(it1)
+        bpe = s1.batches_per_epoch
+        s2 = EpochShuffleSampler(
+            num, batch, seed=seed,
+            state=SamplerState(epoch=advance // bpe,
+                               batch_in_epoch=advance % bpe, seed=seed))
+        np.testing.assert_array_equal(next(iter(s2)), next(it1))

@@ -1,0 +1,65 @@
+"""Slab recycling (SURVEY.md §7.4 hard parts #1/#3): page-fault amortization
+with a strict release-after-transfer lifetime contract."""
+
+import numpy as np
+
+from strom.delivery.buffers import SlabPool, alloc_aligned
+
+
+class TestSlabPool:
+    def test_acquire_release_recycles(self):
+        pool = SlabPool(max_bytes=1 << 20)
+        a = pool.acquire(4096)
+        addr = a.__array_interface__["data"][0]
+        pool.release(a)
+        b = pool.acquire(4096)
+        assert b.__array_interface__["data"][0] == addr
+        assert pool.hits == 1 and pool.misses == 1
+
+    def test_size_buckets_dont_mix(self):
+        pool = SlabPool(max_bytes=1 << 20)
+        a = pool.acquire(4096)
+        pool.release(a)
+        c = pool.acquire(8192)
+        assert c.nbytes == 8192
+        assert pool.stats()["buckets"] == {4096: 1}
+
+    def test_cap_drops_excess(self):
+        pool = SlabPool(max_bytes=8192)
+        slabs = [pool.acquire(4096) for _ in range(3)]
+        for s in slabs:
+            pool.release(s)
+        assert pool.stats()["cached_bytes"] <= 8192
+
+    def test_alignment_and_populate(self):
+        a = alloc_aligned(10_000, populate=True)
+        assert a.__array_interface__["data"][0] % 4096 == 0
+        a[:] = 3  # writable
+        p = SlabPool()
+        b = p.acquire(10_000)
+        assert b.__array_interface__["data"][0] % 4096 == 0
+
+    def test_cpu_backend_bypasses_pool(self, data_file):
+        """On the jax CPU backend device_put aliases host memory, so the
+        delivery path must NOT recycle (content would be corrupted)."""
+        import jax
+
+        from strom.config import StromConfig
+        from strom.delivery.core import StromContext
+
+        path, golden = data_file
+        ctx = StromContext(StromConfig(engine="python", queue_depth=8,
+                                       num_buffers=8))
+        try:
+            a1 = ctx.memcpy_ssd2tpu(path, length=1 << 20,
+                                    device=jax.devices()[0])
+            a2 = ctx.memcpy_ssd2tpu(path, offset=1 << 20, length=1 << 20,
+                                    device=jax.devices()[0])
+            # both must stay correct — a recycle would have overwritten a1
+            np.testing.assert_array_equal(np.asarray(a1), golden[: 1 << 20])
+            np.testing.assert_array_equal(np.asarray(a2),
+                                          golden[1 << 20: 2 << 20])
+            assert ctx._slab_pool is not None
+            assert ctx._slab_pool.hits == 0  # pool never engaged on cpu
+        finally:
+            ctx.close()
