@@ -28,7 +28,7 @@ from strom.delivery.extents import ExtentList
 from strom.delivery.handle import DMAHandle, deferred_handle
 from strom.delivery.shard import DevicePlan, Segment, dedupe_plans, plan_sharded_read
 from strom.engine import make_engine
-from strom.engine.base import Engine, EngineError, RawRead
+from strom.engine.base import Engine, EngineError
 from strom.engine.raid0 import plan_stripe_reads
 from strom.utils.stats import global_stats
 
@@ -123,65 +123,15 @@ class StromContext:
             chunks = [(fi, base_offset + s.file_offset, s.dest_offset, s.length)
                       for s in segments]
 
-        d8 = dest.view(np.uint8).reshape(-1)
-        block = cfg.block_size
-        qd = cfg.queue_depth
-        eng = self.engine
-        total = 0
+        # The engine executes the whole gather (block_size chunking, queue
+        # -depth pipelining, per-chunk retry, EOF topup): ONE boundary
+        # crossing per transfer on the C++ engine (SURVEY.md §3.3 hot loop).
         with self._engine_lock:
-            # tag -> (file_idx, file_off, dest_off, want, attempts)
-            pending: dict[int, tuple[int, int, int, int, int]] = {}
-            it = ((fi, fo + p, do + p, min(block, ln - p))
-                  for (fi, fo, do, ln) in chunks
-                  for p in range(0, ln, block))
-            exhausted = False
             try:
-                while not exhausted or pending:
-                    while not exhausted and len(pending) < qd:
-                        try:
-                            fi, fo, do, ln = next(it)
-                        except StopIteration:
-                            exhausted = True
-                            break
-                        tag = self._tag_counter
-                        self._tag_counter += 1
-                        eng.submit_raw([RawRead(fi, fo, ln, d8[do: do + ln], tag)])
-                        pending[tag] = (fi, fo, do, ln, 0)
-                    if not pending:
-                        break
-                    for c in eng.wait(min_completions=1):
-                        fi, fo, do, want, attempts = pending.pop(c.tag)
-                        if c.result < 0:
-                            # transient-error policy (SURVEY.md §5 failure
-                            # detection): retry the chunk, then give up loudly
-                            if attempts < cfg.io_retries:
-                                global_stats.add("chunk_retries")
-                                tag = self._tag_counter
-                                self._tag_counter += 1
-                                eng.submit_raw([RawRead(fi, fo, want,
-                                                        d8[do: do + want], tag)])
-                                pending[tag] = (fi, fo, do, want, attempts + 1)
-                                continue
-                            raise EngineError(-c.result,
-                                              f"ssd2tpu read failed after {attempts + 1} "
-                                              f"attempts: {os.strerror(-c.result)}")
-                        if c.result != want:
-                            raise EngineError(5, f"short read ({c.result} < {want}) — "
-                                                 "file smaller than requested range?")
-                        total += c.result
-            except BaseException:
-                # Drain our in-flight ops so the shared engine (and the uring
-                # keepalive of dest slabs) isn't poisoned for later transfers.
-                while pending:
-                    try:
-                        done = eng.wait(min_completions=1, timeout_s=30.0)
-                    except EngineError:
-                        break
-                    if not done:
-                        break
-                    for c in done:
-                        pending.pop(c.tag, None)
-                raise
+                total = self.engine.read_vectored(chunks, dest,
+                                                  retries=cfg.io_retries)
+            except EngineError as e:
+                raise EngineError(e.errno, f"ssd2tpu {e.strerror}") from None
         global_stats.add("ssd2tpu_bytes", total)
         return total
 

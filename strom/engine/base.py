@@ -25,12 +25,15 @@ from __future__ import annotations
 
 import abc
 import dataclasses
+import errno
 import os
 from typing import Iterable, Sequence
 
 import numpy as np
 
 from strom.config import StromConfig
+
+_ENODATA = errno.ENODATA
 
 
 @dataclasses.dataclass(frozen=True)
@@ -141,6 +144,91 @@ class Engine(abc.ABC):
 
     def __exit__(self, *exc) -> None:
         self.close()
+
+    # -- vectored gather: the delivery layer's hot path ---------------------
+    def read_vectored(self, chunks: Sequence[tuple[int, int, int, int]],
+                      dest: np.ndarray, *, retries: int = 1) -> int:
+        """Execute a whole gather list: chunks of (file_index, file_offset,
+        dest_offset, length) → dest, block_size-chunked and pipelined at
+        queue_depth, with per-chunk retry. Returns total bytes read.
+
+        Must not run concurrently with other submitters on this engine (the
+        delivery layer serializes transfers). Raises EngineError; ENODATA
+        means a short read (range extends past EOF).
+
+        This default uses submit_raw/wait per block; the C++ engine overrides
+        it with a single native call (one Python-boundary crossing per
+        transfer instead of per 128KiB block).
+        """
+        block = self.config.block_size
+        qd = self.config.queue_depth
+        d8 = dest.view(np.uint8).reshape(-1)
+        if not hasattr(self, "_vec_tag"):
+            self._vec_tag = 0
+        # tag -> (file_idx, file_off, dest_off, want, attempts)
+        pending: dict[int, tuple[int, int, int, int, int]] = {}
+        it = ((fi, fo + p, do + p, min(block, ln - p))
+              for (fi, fo, do, ln) in chunks
+              for p in range(0, ln, block))
+        exhausted = False
+        total = 0
+        err: EngineError | None = None
+        try:
+            while not exhausted or pending:
+                while not exhausted and len(pending) < qd and err is None:
+                    try:
+                        fi, fo, do, ln = next(it)
+                    except StopIteration:
+                        exhausted = True
+                        break
+                    tag = self._vec_tag
+                    self._vec_tag += 1
+                    self.submit_raw([RawRead(fi, fo, ln, d8[do: do + ln], tag)])
+                    pending[tag] = (fi, fo, do, ln, 0)
+                if not pending:
+                    break
+                for c in self.wait(min_completions=1):
+                    entry = pending.pop(c.tag, None)
+                    if entry is None:
+                        continue  # foreign tag: not ours to account
+                    fi, fo, do, want, attempts = entry
+                    if c.result < 0:
+                        if attempts < retries and err is None:
+                            from strom.utils.stats import global_stats
+
+                            global_stats.add("chunk_retries")
+                            tag = self._vec_tag
+                            self._vec_tag += 1
+                            self.submit_raw(
+                                [RawRead(fi, fo, want, d8[do: do + want], tag)])
+                            pending[tag] = (fi, fo, do, want, attempts + 1)
+                            continue
+                        if err is None:
+                            err = EngineError(
+                                -c.result,
+                                f"read failed after {attempts + 1} attempts: "
+                                f"{os.strerror(-c.result)}")
+                    elif c.result < want:
+                        total += c.result
+                        if err is None:
+                            err = EngineError(
+                                _ENODATA, f"short read ({c.result} < {want}) — "
+                                          "file smaller than requested range?")
+                    else:
+                        total += c.result
+                if err is not None:
+                    exhausted = True  # stop feeding; drain what's in flight
+        except BaseException:
+            while pending:
+                done = self.wait(min_completions=1, timeout_s=30.0)
+                if not done:
+                    break
+                for c in done:
+                    pending.pop(c.tag, None)
+            raise
+        if err is not None:
+            raise err
+        return total
 
     # -- convenience: synchronous read of an arbitrary range ----------------
     def read_into(self, file_index: int, offset: int, length: int,

@@ -44,6 +44,16 @@ class _ScStats(ctypes.Structure):
         ("fixed_buffers", ctypes.c_uint8),
         ("fixed_files", ctypes.c_uint8),
         ("mlocked", ctypes.c_uint8),
+        ("chunk_retries", ctypes.c_uint64),
+    ]
+
+
+class _ScVecSeg(ctypes.Structure):
+    _fields_ = [
+        ("file_index", ctypes.c_int32),
+        ("length", ctypes.c_uint32),
+        ("offset", ctypes.c_uint64),
+        ("dest_offset", ctypes.c_uint64),
     ]
 
 
@@ -84,6 +94,10 @@ def _load_lib(variant: str = ""):
         lib.sc_in_flight.argtypes = [ctypes.c_void_p]
         lib.sc_get_stats.argtypes = [ctypes.c_void_p, ctypes.POINTER(_ScStats)]
         lib.sc_set_fault_every.argtypes = [ctypes.c_void_p, ctypes.c_uint64]
+        lib.sc_read_vectored.restype = ctypes.c_int64
+        lib.sc_read_vectored.argtypes = [ctypes.c_void_p, ctypes.POINTER(_ScVecSeg),
+                                         ctypes.c_uint64, ctypes.c_void_p,
+                                         ctypes.c_uint32, ctypes.c_uint32]
         if not variant:
             _lib = lib
         return lib
@@ -188,6 +202,45 @@ class UringEngine(Engine):
                 self._raw_keepalive.pop(c.tag, None)
         return out
 
+    def read_vectored(self, chunks: Sequence[tuple[int, int, int, int]],
+                      dest: np.ndarray, *, retries: int = 1) -> int:
+        """Native override: the whole gather runs inside libstrom_core
+        (sc_read_vectored) — batched SQE fills, one io_uring_enter per batch,
+        retry + EOF topup in C++, GIL released for the entire transfer."""
+        if not chunks:
+            return 0
+        d8 = dest.view(np.uint8).reshape(-1)
+        if not d8.flags["C_CONTIGUOUS"] or not d8.flags["WRITEABLE"]:
+            raise EngineError(_errno.EINVAL, "dest must be writable C-contiguous")
+        need = max(do + ln for (_, _, do, ln) in chunks)
+        if d8.nbytes < need:
+            raise EngineError(_errno.EINVAL, "dest smaller than gather plan")
+        segs = (_ScVecSeg * len(chunks))()
+        for i, (fi, fo, do, ln) in enumerate(chunks):
+            segs[i] = _ScVecSeg(fi, ln, fo, do)
+        base = d8.__array_interface__["data"][0]
+        before = self._native_chunk_retries()
+        res = self._lib.sc_read_vectored(self._h, segs, len(chunks),
+                                         ctypes.c_void_p(base),
+                                         self.config.block_size, retries)
+        retried = self._native_chunk_retries() - before
+        if retried > 0:
+            from strom.utils.stats import global_stats
+
+            global_stats.add("chunk_retries", retried)
+        if res < 0:
+            if -res == _errno.ENODATA:
+                raise EngineError(_errno.ENODATA,
+                                  "short read — file smaller than requested range?")
+            raise EngineError(-res, f"read failed after {retries + 1} attempts: "
+                                    f"{os.strerror(-res)}")
+        return int(res)
+
+    def _native_chunk_retries(self) -> int:
+        s = _ScStats()
+        self._lib.sc_get_stats(self._h, ctypes.byref(s))
+        return int(s.chunk_retries)
+
     def in_flight(self) -> int:
         return self._lib.sc_in_flight(self._h)
 
@@ -209,6 +262,7 @@ class UringEngine(Engine):
             "unaligned_fallback_reads": s.unaligned_fallback_reads,
             "eof_topup_reads": s.eof_topup_reads,
             "in_flight": s.in_flight,
+            "chunk_retries": s.chunk_retries,
             "fixed_buffers": bool(s.fixed_buffers),
             "fixed_files": bool(s.fixed_files),
             "mlocked": bool(s.mlocked),

@@ -1,0 +1,170 @@
+"""Mixture-of-Experts Llama variant — the expert-parallel (ep) consumer.
+
+TPU-first routing: Switch-style top-1 with *capacity-based dense dispatch* —
+routing becomes two einsums against a [tokens, experts, capacity] dispatch
+tensor (the Mesh-TensorFlow/Switch-Transformer formulation), so the whole MoE
+layer is static-shaped MXU work and XLA inserts the token all-to-alls itself
+when tokens are dp-sharded and experts are ep-sharded (scaling-book recipe:
+annotate, let the compiler place collectives).
+
+The reference has no models (SURVEY.md §2.3); this consumer exists to prove
+the data path composes with every parallelism axis the mesh offers
+(dp/tp/sp/ep).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from strom.models.llama import LlamaConfig, attention, rmsnorm, rope
+
+
+@dataclasses.dataclass(frozen=True)
+class MoEConfig:
+    base: LlamaConfig = dataclasses.field(default_factory=LlamaConfig.tiny)
+    n_experts: int = 8
+    capacity_factor: float = 1.25
+    aux_loss_weight: float = 1e-2
+    router_z_weight: float = 1e-3
+
+    @classmethod
+    def tiny(cls, n_experts: int = 4) -> "MoEConfig":
+        return cls(base=LlamaConfig.tiny(), n_experts=n_experts)
+
+    def capacity(self, n_tokens: int) -> int:
+        return max(1, math.ceil(n_tokens / self.n_experts * self.capacity_factor))
+
+
+def init_params(key: jax.Array, cfg: MoEConfig) -> dict:
+    """Llama attention params + per-layer router and stacked expert FFNs
+    (leading dims: [n_layers, n_experts, ...])."""
+    b = cfg.base
+    d, f, L, E = b.d_model, b.d_ff, b.n_layers, cfg.n_experts
+    nh, nkv, hd = b.n_heads, b.n_kv_heads, b.head_dim
+    dt = b.jdtype
+    k = iter(jax.random.split(key, 12))
+
+    def dense(kk, *shape, scale_dim=None):
+        scale = 1.0 / math.sqrt(scale_dim if scale_dim is not None else shape[-2])
+        return (jax.random.normal(kk, shape, dtype=jnp.float32) * scale).astype(dt)
+
+    return {
+        "embed": dense(next(k), b.vocab, d, scale_dim=d),
+        "layers": {
+            "attn_norm": jnp.ones((L, d), jnp.float32),
+            "wq": dense(next(k), L, d, nh * hd),
+            "wk": dense(next(k), L, d, nkv * hd),
+            "wv": dense(next(k), L, d, nkv * hd),
+            "wo": dense(next(k), L, nh * hd, d),
+            "mlp_norm": jnp.ones((L, d), jnp.float32),
+            # router in float32: routing decisions are precision-sensitive
+            "router": (jax.random.normal(next(k), (L, d, E), dtype=jnp.float32)
+                       * (1.0 / math.sqrt(d))),
+            "w_gate": dense(next(k), L, E, d, f),
+            "w_up": dense(next(k), L, E, d, f),
+            "w_down": dense(next(k), L, E, f, d),
+        },
+        "final_norm": jnp.ones((d,), jnp.float32),
+        "lm_head": dense(next(k), d, b.vocab),
+    }
+
+
+def switch_route(h: jax.Array, router: jax.Array, capacity: int
+                 ) -> tuple[jax.Array, jax.Array, jax.Array]:
+    """Top-1 routing with capacity. h [N, d] → (dispatch [N, E, C] one-hot,
+    combine [N, E, C] probability-weighted, aux losses (lb, z))."""
+    logits = h.astype(jnp.float32) @ router            # [N, E]
+    probs = jax.nn.softmax(logits, axis=-1)
+    expert = jnp.argmax(probs, axis=-1)                # [N]
+    N, E = probs.shape
+    onehot = jax.nn.one_hot(expert, E, dtype=jnp.float32)   # [N, E]
+
+    # position of each token within its expert's queue (cumsum over tokens)
+    pos = jnp.cumsum(onehot, axis=0) - onehot          # [N, E], 0-based
+    keep = (pos < capacity) * onehot                   # dropped past capacity
+    pos_clipped = jnp.minimum(pos, capacity - 1).astype(jnp.int32)
+    pos_onehot = jax.nn.one_hot(pos_clipped, capacity, dtype=jnp.float32)
+    dispatch = keep[..., None] * pos_onehot            # [N, E, C]
+    gate = jnp.sum(probs * keep, axis=-1, keepdims=True)   # kept tokens' prob
+    combine = dispatch * gate[..., None]
+
+    # Switch load-balance loss: E * Σ_e fraction_tokens(e) * mean_prob(e)
+    frac = jnp.mean(onehot, axis=0)
+    mean_prob = jnp.mean(probs, axis=0)
+    lb_loss = E * jnp.sum(frac * mean_prob)
+    z_loss = jnp.mean(jax.nn.logsumexp(logits, axis=-1) ** 2)
+    return dispatch, combine, jnp.stack([lb_loss, z_loss])
+
+
+def moe_ffn(h: jax.Array, lp: dict, cfg: MoEConfig) -> tuple[jax.Array, jax.Array]:
+    """h [B, S, d] → (out [B, S, d], aux [2]). Dense-dispatch SwiGLU experts."""
+    B, S, D = h.shape
+    N = B * S
+    C = cfg.capacity(N)
+    hf = h.reshape(N, D)
+    dispatch, combine, aux = switch_route(hf, lp["router"], C)
+    dd = dispatch.astype(h.dtype)
+    # gather tokens per expert: [E, C, d] — XLA turns this into the a2a when
+    # tokens and experts live on different mesh axes
+    expert_in = jnp.einsum("nec,nd->ecd", dd, hf.astype(h.dtype))
+    gated = jax.nn.silu(jnp.einsum("ecd,edf->ecf", expert_in, lp["w_gate"]))
+    up = jnp.einsum("ecd,edf->ecf", expert_in, lp["w_up"])
+    expert_out = jnp.einsum("ecf,efd->ecd", gated * up, lp["w_down"])
+    out = jnp.einsum("nec,ecd->nd", combine.astype(h.dtype), expert_out)
+    return out.reshape(B, S, D), aux
+
+
+def block(x: jax.Array, lp: dict, cfg: MoEConfig, positions: jax.Array,
+          attn_fn=None) -> tuple[jax.Array, jax.Array]:
+    b = cfg.base
+    B, S, D = x.shape
+    nh, nkv, hd = b.n_heads, b.n_kv_heads, b.head_dim
+    h = rmsnorm(x, lp["attn_norm"], b.norm_eps)
+    q = (h @ lp["wq"]).reshape(B, S, nh, hd)
+    k = (h @ lp["wk"]).reshape(B, S, nkv, hd)
+    v = (h @ lp["wv"]).reshape(B, S, nkv, hd)
+    q = rope(q, positions, b.rope_theta)
+    k = rope(k, positions, b.rope_theta)
+    attn = (attn_fn or attention)(q, k, v)
+    x = x + attn.reshape(B, S, nh * hd) @ lp["wo"]
+
+    h = rmsnorm(x, lp["mlp_norm"], b.norm_eps)
+    ffn, aux = moe_ffn(h, lp, cfg)
+    return x + ffn, aux
+
+
+def forward(params: dict, tokens: jax.Array, cfg: MoEConfig,
+            attn_fn=None) -> tuple[jax.Array, jax.Array]:
+    """tokens [B, S] → (logits [B, S, vocab] f32, aux losses [2] summed)."""
+    b = cfg.base
+    B, S = tokens.shape
+    positions = jnp.broadcast_to(jnp.arange(S, dtype=jnp.int32), (B, S))
+    x = params["embed"][tokens].astype(b.jdtype)
+
+    def body(carry, lp):
+        y, aux = block(carry, lp, cfg, positions, attn_fn)
+        return y, aux
+
+    x, auxes = lax.scan(body, x, params["layers"])
+    x = rmsnorm(x, params["final_norm"], b.norm_eps)
+    logits = (x @ params["lm_head"]).astype(jnp.float32)
+    return logits, jnp.sum(auxes, axis=0)
+
+
+def next_token_loss(params: dict, tokens: jax.Array, cfg: MoEConfig,
+                    attn_fn=None) -> jax.Array:
+    """Full-length roll/mask LM loss (same shape contract as the dense model)
+    + weighted router aux losses."""
+    B, L = tokens.shape
+    logits, aux = forward(params, tokens, cfg, attn_fn)
+    targets = jnp.roll(tokens, -1, axis=1)
+    logz = jax.nn.logsumexp(logits, axis=-1)
+    gold = jnp.take_along_axis(logits, targets[..., None], axis=-1)[..., 0]
+    mask = (jnp.arange(L) < L - 1).astype(jnp.float32)
+    lm = jnp.sum((logz - gold) * mask) / (B * (L - 1))
+    return lm + cfg.aux_loss_weight * aux[0] + cfg.router_z_weight * aux[1]

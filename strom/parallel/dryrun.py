@@ -73,3 +73,24 @@ def run_dryrun(n_devices: int) -> None:
         sp_loss = float(metrics["loss"])
         assert np.isfinite(sp_loss), f"non-finite sp loss {sp_loss}"
         print(f"dryrun ok: mesh={sp_axes} (ring attention), loss={sp_loss:.4f}")
+
+    # Expert-parallel path: dp×ep mesh, MoE model, ep-sharded expert stacks
+    if n_devices >= 2 and n_devices % 2 == 0:
+        from strom.models.moe import MoEConfig
+        from strom.parallel.train import init_moe_train_state, make_moe_train_step
+
+        ep = 2
+        while ep * 2 <= min(max(n_devices // 2, 2), 8) and n_devices % (ep * 2) == 0:
+            ep *= 2
+        ep_axes = {"dp": n_devices // ep, "ep": ep}
+        ep_mesh = make_mesh(ep_axes, devices=devs)
+        mcfg = MoEConfig.tiny(n_experts=max(ep, 4))
+        state = init_moe_train_state(jax.random.PRNGKey(0), mcfg, ep_mesh, optimizer)
+        ep_step = make_moe_train_step(mcfg, ep_mesh, optimizer)
+        B = 2 * ep_axes["dp"]
+        tokens = jnp.asarray(np.random.default_rng(2).integers(
+            0, mcfg.base.vocab, (B, 64), dtype=np.int32))
+        state, metrics = ep_step(state, tokens)
+        ep_loss = float(metrics["loss"])
+        assert np.isfinite(ep_loss), f"non-finite ep loss {ep_loss}"
+        print(f"dryrun ok: mesh={ep_axes} (MoE expert parallel), loss={ep_loss:.4f}")

@@ -55,6 +55,32 @@ def param_shardings(params: dict, mesh: Mesh) -> dict:
                         is_leaf=lambda x: isinstance(x, P))
 
 
+# MoE variant: attention rules shared with llama; expert FFN stacks carry the
+# ep axis on the expert dim (tokens all-to-all into expert shards is XLA's to
+# place), tp on the hidden dim within each expert.
+_MOE_RULES = {
+    **_LLAMA_RULES,
+    ("layers", "router"): P(),
+    ("layers", "w_gate"): P(None, "ep", None, "tp"),
+    ("layers", "w_up"): P(None, "ep", None, "tp"),
+    ("layers", "w_down"): P(None, "ep", "tp", None),
+}
+
+
+def moe_param_specs(params: dict) -> dict:
+    return jax.tree_util.tree_map_with_path(
+        lambda path, _: _MOE_RULES.get(_path_key(path), P()), params)
+
+
+def moe_param_shardings(params: dict, mesh: Mesh) -> dict:
+    def restrict(spec: P) -> P:
+        return P(*(ax if ax in mesh.axis_names else None for ax in spec))
+
+    return jax.tree.map(lambda spec: NamedSharding(mesh, restrict(spec)),
+                        moe_param_specs(params),
+                        is_leaf=lambda x: isinstance(x, P))
+
+
 def batch_spec(*, sp: bool = False) -> P:
     """Token batches: batch on dp, optionally sequence on sp (long-context
     loaders deliver sequence-sharded batches, SURVEY.md §5)."""

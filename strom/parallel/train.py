@@ -35,17 +35,22 @@ def make_optimizer(lr: float = 3e-4, weight_decay: float = 0.1,
                        optax.adamw(sched, weight_decay=weight_decay))
 
 
-def init_train_state(key: jax.Array, cfg: LlamaConfig, mesh: Mesh,
-                     optimizer: optax.GradientTransformation) -> TrainState:
-    """Initialise params *sharded*: jit the initializer with out_shardings so
-    big models never materialise unsharded on one device."""
-    shapes = jax.eval_shape(partial(init_params, cfg=cfg), key)
-    shardings = param_shardings(shapes, mesh)
-    p_init = jax.jit(partial(init_params, cfg=cfg), out_shardings=shardings)
-    params = p_init(key)
+def _init_state(key: jax.Array, init_fn, shardings_fn, mesh: Mesh,
+                optimizer: optax.GradientTransformation) -> TrainState:
+    """Jit the initializer with out_shardings so big models never
+    materialise unsharded on one device."""
+    shapes = jax.eval_shape(init_fn, key)
+    shardings = shardings_fn(shapes, mesh)
+    params = jax.jit(init_fn, out_shardings=shardings)(key)
     opt_state = jax.jit(optimizer.init)(params)
     return TrainState(params=params, opt_state=opt_state,
                       step=jnp.zeros((), dtype=jnp.int32))
+
+
+def init_train_state(key: jax.Array, cfg: LlamaConfig, mesh: Mesh,
+                     optimizer: optax.GradientTransformation) -> TrainState:
+    return _init_state(key, partial(init_params, cfg=cfg), param_shardings,
+                       mesh, optimizer)
 
 
 def make_train_step(cfg: LlamaConfig, mesh: Mesh,
@@ -69,6 +74,46 @@ def make_train_step(cfg: LlamaConfig, mesh: Mesh,
 
     def loss_fn(params, tokens):
         return next_token_loss(params, tokens, cfg, attn_fn=attn_fn)
+
+    def step(state: TrainState, tokens: jax.Array):
+        loss, grads = jax.value_and_grad(loss_fn)(state.params, tokens)
+        updates, new_opt = optimizer.update(grads, state.opt_state, state.params)
+        new_params = optax.apply_updates(state.params, updates)
+        metrics = {"loss": loss, "grad_norm": optax.global_norm(grads)}
+        return TrainState(new_params, new_opt, state.step + 1), metrics
+
+    donate_argnums = (0,) if donate else ()
+    return jax.jit(step, in_shardings=(None, batch_sharding),
+                   donate_argnums=donate_argnums)
+
+
+def init_moe_train_state(key: jax.Array, cfg, mesh: Mesh,
+                         optimizer: optax.GradientTransformation) -> TrainState:
+    """MoE variant: expert stacks sharded over the mesh's ep axis."""
+    from strom.models import moe
+    from strom.parallel.sharding import moe_param_shardings
+
+    return _init_state(key, partial(moe.init_params, cfg=cfg),
+                       moe_param_shardings, mesh, optimizer)
+
+
+def make_moe_train_step(cfg, mesh: Mesh,
+                        optimizer: optax.GradientTransformation, *,
+                        sp: bool = False, donate: bool = True):
+    """(state, tokens) -> (state, metrics) for the MoE model: tokens arrive
+    P("dp"[, "sp"]); expert weights stay ep-sharded and XLA places the token
+    all-to-alls the dispatch einsums imply."""
+    from strom.models import moe
+
+    batch_sharding = NamedSharding(mesh, P("dp", "sp") if sp else P("dp", None))
+    attn_fn = None
+    if sp:
+        from strom.parallel.ring import make_ring_attention
+
+        attn_fn = make_ring_attention(mesh, axis="sp")
+
+    def loss_fn(params, tokens):
+        return moe.next_token_loss(params, tokens, cfg, attn_fn=attn_fn)
 
     def step(state: TrainState, tokens: jax.Array):
         loss, grads = jax.value_and_grad(loss_fn)(state.params, tokens)

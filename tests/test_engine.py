@@ -133,3 +133,52 @@ def test_unregister_file(engine, data_file):
     engine.unregister_file(fi)
     with pytest.raises(Exception):
         engine.file_uses_o_direct(fi)
+
+
+class TestReadVectored:
+    """Engine-level gather API (native in C++ engine, generic fallback)."""
+
+    def test_gather_integrity(self, engine, data_file):
+        path, data = data_file
+        fi = engine.register_file(path)
+        chunks = [(fi, 100_000, 0, 300_000),   # spans blocks, unaligned
+                  (fi, 0, 300_000, 4096),
+                  (fi, 2_000_000, 304_096, 1_000_001)]
+        dest = alloc_aligned(304_096 + 1_000_001)
+        n = engine.read_vectored(chunks, dest)
+        assert n == dest.nbytes
+        want = np.concatenate([data[100_000:400_000], data[:4096],
+                               data[2_000_000:3_000_001]])
+        np.testing.assert_array_equal(dest, want)
+
+    def test_empty_chunks(self, engine):
+        assert engine.read_vectored([], alloc_aligned(16)) == 0
+
+    def test_short_read_is_enodata(self, engine, data_file):
+        import errno
+
+        path, data = data_file
+        fi = engine.register_file(path)
+        dest = alloc_aligned(1 << 20)
+        with pytest.raises(EngineError) as ei:
+            engine.read_vectored([(fi, len(data) - 100, 0, 1 << 20)], dest)
+        assert ei.value.errno == errno.ENODATA
+
+    def test_dest_too_small_rejected(self, engine, data_file):
+        path, _ = data_file
+        fi = engine.register_file(path)
+        dest = alloc_aligned(1024)
+        with pytest.raises(EngineError):
+            engine.read_vectored([(fi, 0, 0, 1 << 20)], dest)
+
+    def test_retry_budget_respected(self, engine, data_file):
+        if not hasattr(engine, "set_fault_every"):
+            import dataclasses
+            object.__setattr__(engine.config, "fault_every", 1)
+        else:
+            engine.set_fault_every(1)
+        path, _ = data_file
+        fi = engine.register_file(path)
+        dest = alloc_aligned(512 * 1024)
+        with pytest.raises(EngineError, match="after 3 attempts"):
+            engine.read_vectored([(fi, 0, 0, 512 * 1024)], dest, retries=2)

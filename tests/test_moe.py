@@ -1,0 +1,113 @@
+"""MoE model + expert parallelism: routing invariants, capacity behavior,
+ep-sharded training on a dp×ep mesh."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from strom.models.moe import MoEConfig, forward, init_params, switch_route
+from strom.parallel.mesh import make_mesh
+
+
+class TestRouting:
+    def test_dispatch_combine_shapes_and_mass(self):
+        rng = np.random.default_rng(0)
+        h = jnp.array(rng.normal(size=(64, 16)), jnp.float32)
+        router = jnp.array(rng.normal(size=(16, 4)), jnp.float32)
+        dispatch, combine, aux = switch_route(h, router, capacity=32)
+        assert dispatch.shape == (64, 4, 32)
+        # each token lands in at most one (expert, slot)
+        per_token = np.asarray(jnp.sum(dispatch, axis=(1, 2)))
+        assert set(per_token.tolist()) <= {0.0, 1.0}
+        # each (expert, slot) holds at most one token
+        per_slot = np.asarray(jnp.sum(dispatch, axis=0))
+        assert per_slot.max() <= 1.0
+        # combine mass = gate prob of kept tokens, <= 1
+        assert float(jnp.sum(combine)) <= 64.0
+        assert np.isfinite(np.asarray(aux)).all()
+
+    def test_capacity_drops_overflow(self):
+        # all tokens prefer expert 0 → only `capacity` survive
+        h = jnp.ones((16, 4), jnp.float32)
+        router = jnp.zeros((4, 2), jnp.float32).at[:, 0].set(10.0)
+        dispatch, _, _ = switch_route(h, router, capacity=5)
+        assert float(jnp.sum(dispatch)) == 5.0
+
+    def test_balanced_router_keeps_everything(self):
+        rng = np.random.default_rng(1)
+        h = jnp.array(rng.normal(size=(64, 16)), jnp.float32)
+        router = jnp.array(rng.normal(size=(16, 8)), jnp.float32)
+        # capacity >= N: nothing can drop
+        dispatch, _, _ = switch_route(h, router, capacity=64)
+        np.testing.assert_allclose(np.asarray(jnp.sum(dispatch)), 64.0)
+
+
+class TestMoEModel:
+    @pytest.fixture(scope="class")
+    def tiny(self):
+        cfg = MoEConfig.tiny(n_experts=4)
+        params = init_params(jax.random.PRNGKey(0), cfg)
+        return cfg, params
+
+    def test_forward_shapes_finite(self, tiny):
+        cfg, params = tiny
+        tokens = jnp.array(np.random.default_rng(0).integers(
+            0, cfg.base.vocab, (2, 32)), jnp.int32)
+        logits, aux = forward(params, tokens, cfg)
+        assert logits.shape == (2, 32, cfg.base.vocab)
+        assert bool(jnp.isfinite(logits).all())
+        assert aux.shape == (2,) and bool(jnp.isfinite(aux).all())
+
+    def test_causality(self, tiny):
+        cfg, params = tiny
+        rng = np.random.default_rng(1)
+        t1 = rng.integers(0, cfg.base.vocab, (1, 24)).astype(np.int32)
+        t2 = t1.copy()
+        t2[0, 16:] = (t2[0, 16:] + 7) % cfg.base.vocab
+        l1, _ = forward(params, jnp.array(t1), cfg)
+        l2, _ = forward(params, jnp.array(t2), cfg)
+        # NOTE: routing capacity couples tokens globally; use generous
+        # capacity so early tokens' expert slots can't be stolen by changed
+        # future tokens
+        np.testing.assert_allclose(np.asarray(l1[0, :16]),
+                                   np.asarray(l2[0, :16]), rtol=1e-3, atol=1e-3)
+
+    def test_ep_sharded_training_decreases_loss(self):
+        from strom.parallel.train import (init_moe_train_state,
+                                          make_moe_train_step, make_optimizer)
+
+        cfg = MoEConfig.tiny(n_experts=4)
+        mesh = make_mesh({"dp": 2, "ep": 4}, devices=jax.devices()[:8])
+        opt = make_optimizer(lr=1e-2, warmup=1)
+        state = init_moe_train_state(jax.random.PRNGKey(0), cfg, mesh, opt)
+        # expert stacks really live on the ep axis
+        spec = state.params["layers"]["w_gate"].sharding.spec
+        assert "ep" in spec
+        step = make_moe_train_step(cfg, mesh, opt)
+        tokens = jnp.array(np.random.default_rng(2).integers(
+            0, cfg.base.vocab, (4, 33)), jnp.int32)
+        losses = []
+        for _ in range(5):
+            state, metrics = step(state, tokens)
+            losses.append(float(metrics["loss"]))
+        assert losses[-1] < losses[0], losses
+
+    def test_ep_with_sp_compose(self):
+        """dp×sp×ep mesh: sequence-sharded batch + ep-sharded experts."""
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from strom.parallel.train import (init_moe_train_state,
+                                          make_moe_train_step, make_optimizer)
+
+        cfg = MoEConfig.tiny(n_experts=4)
+        mesh = make_mesh({"dp": 2, "sp": 2, "ep": 2}, devices=jax.devices()[:8])
+        opt = make_optimizer()
+        state = init_moe_train_state(jax.random.PRNGKey(0), cfg, mesh, opt)
+        step = make_moe_train_step(cfg, mesh, opt, sp=True)
+        tokens = jnp.array(np.random.default_rng(3).integers(
+            0, cfg.base.vocab, (4, 64)), jnp.int32)
+        tokens = jax.device_put(tokens, NamedSharding(mesh, P("dp", "sp")))
+        state, metrics = step(state, tokens)
+        assert np.isfinite(float(metrics["loss"]))
