@@ -25,6 +25,7 @@
 #include <cstdint>
 #include <cstring>
 #include <mutex>
+#include <vector>
 
 #include <fcntl.h>
 #include <linux/io_uring.h>
@@ -154,13 +155,24 @@ struct sc_engine {
   std::mutex sq_mu;
 
   std::mutex cq_mu;
-  // synthetic completions (fault injection) drained by sc_wait
-  sc_completion *synthetic = nullptr;
-  uint32_t n_synthetic = 0;
+  // Synthetic completions (fault injection + rolled-back submissions) drained
+  // by sc_wait. Guarded by cq_mu; grows on demand so a rollback can never be
+  // dropped for lack of space (a dropped completion = a caller waiting
+  // forever). Lock order rule: cq_mu is NEVER acquired while sq_mu is held —
+  // submit paths stage completions locally and append after releasing sq_mu;
+  // reap_locked (under cq_mu) returns slots under sq_mu only after the CQ
+  // head is published.
+  std::vector<sc_completion> synthetic;
+  // mirrors synthetic.size(); readable without cq_mu (backpressure guards)
+  std::atomic<uint32_t> synthetic_count{0};
 
   std::atomic<uint32_t> in_flight{0};
   std::atomic<uint64_t> fault_every{0};
   std::atomic<uint64_t> op_counter{0};
+  // test hook: next ring_enter_submit call fails the whole batch with this
+  // errno instead of entering the kernel (≙ sc_set_fault_every for the
+  // submission boundary itself)
+  std::atomic<int> enter_fail_once{0};
 
   // stats
   std::atomic<uint64_t> ops_submitted{0}, ops_completed{0}, ops_errored{0},
@@ -275,8 +287,7 @@ sc_engine *sc_create(uint32_t queue_depth, uint32_t num_buffers,
   e->free_slots = new uint32_t[queue_depth];
   for (uint32_t i = 0; i < queue_depth; ++i) e->free_slots[i] = queue_depth - 1 - i;
   e->n_free = queue_depth;
-  e->synthetic = new sc_completion[queue_depth];
-  e->n_synthetic = 0;
+  e->synthetic.reserve(queue_depth);
   return e;
 
 fail : {
@@ -308,7 +319,6 @@ void sc_destroy(sc_engine *e) {
   if (e->pool) munmap(e->pool, e->pool_sz);
   delete[] e->slots;
   delete[] e->free_slots;
-  delete[] e->synthetic;
   delete e;
 }
 
@@ -407,6 +417,13 @@ void sc_set_fault_every(sc_engine *e, uint64_t n) {
   e->fault_every.store(n, std::memory_order_relaxed);
 }
 
+// Test hook: make the next kernel submission fail the whole batch with -err
+// (exercises the rollback arm of ring_enter_submit without needing a broken
+// ring fd).
+void sc_set_enter_fail_once(sc_engine *e, int err) {
+  e->enter_fail_once.store(err, std::memory_order_relaxed);
+}
+
 // Fill one SQE + OpSlot. Caller holds sq_mu and guarantees n_free > 0.
 static void fill_sqe_locked(sc_engine *e, const FileEntry &f, int file_index,
                             uint64_t offset, uint32_t length,
@@ -452,23 +469,57 @@ static void fill_sqe_locked(sc_engine *e, const FileEntry &f, int file_index,
   e->sq_tail->store(tail + 1, std::memory_order_release);
 }
 
-// Hand k published SQEs to the kernel. Caller holds sq_mu. Published SQEs
-// cannot be rolled back, so retry transient errnos until accepted.
-static void ring_enter_submit(sc_engine *e, unsigned k) {
+// Hand k published SQEs to the kernel. Caller holds sq_mu and must append
+// staged[0..EnterResult.failed) to e->synthetic under cq_mu AFTER releasing
+// sq_mu (lock-order rule: never cq_mu under sq_mu).
+//
+// Transient errnos (EINTR/EAGAIN/EBUSY) are retried. On an unexpected fatal
+// errno the kernel consumed none of the remaining SQEs, so they are rolled
+// back — sq_tail is rewound, their slots freed, and each op is failed with a
+// staged synthetic completion. The caller of sc_wait therefore sees the
+// failure within one wait cycle instead of blocking forever on ops the
+// kernel never saw.
+struct EnterResult {
+  uint32_t submitted;  // ops the kernel accepted
+  uint32_t failed;     // ops rolled back; completions staged by the caller
+};
+
+static EnterResult ring_enter_submit(sc_engine *e, unsigned k,
+                                     sc_completion *staged) {
   unsigned remaining = k;
-  while (remaining > 0) {
+  int fatal = e->enter_fail_once.exchange(0, std::memory_order_relaxed);
+  while (fatal == 0 && remaining > 0) {
     int ret = sys_io_uring_enter(e->ring_fd, remaining, 0, 0, nullptr, 0);
     if (ret >= 0) {
       remaining -= (unsigned)ret < remaining ? (unsigned)ret : remaining;
       continue;  // ret==0 is transient in non-SQPOLL mode; keep pushing
     }
     if (errno == EINTR || errno == EAGAIN || errno == EBUSY) continue;
-    // Unexpected fatal errno: the SQEs may still be consumed later; account
-    // the ops as in-flight so the caller can reap whatever appears.
-    break;
+    fatal = errno;
+  }
+  uint32_t failed = 0;
+  if (remaining > 0) {
+    // The failing io_uring_enter consumed nothing, so the last `remaining`
+    // published SQEs are untouched by the kernel: rewind sq_tail over them
+    // (we hold sq_mu; nobody else can have appended after us) and fail their
+    // ops loudly.
+    uint32_t tail = e->sq_tail->load(std::memory_order_relaxed);
+    for (unsigned j = 0; j < remaining; ++j) {
+      uint32_t idx = (tail - 1 - j) & e->sq_mask;
+      uint32_t slot_idx = (uint32_t)e->sqes[idx].user_data;
+      OpSlot &slot = e->slots[slot_idx];
+      staged[failed++] = sc_completion{slot.tag, -(int64_t)fatal};
+      slot.in_use = false;
+      e->free_slots[e->n_free++] = slot_idx;
+    }
+    e->sq_tail->store(tail - remaining, std::memory_order_release);
+    e->ops_errored.fetch_add(failed, std::memory_order_relaxed);
   }
   e->ops_submitted.fetch_add(k, std::memory_order_relaxed);
+  // failed ops stay "in flight" until their synthetic completion is reaped —
+  // same accounting as fault injection.
   e->in_flight.fetch_add(k, std::memory_order_relaxed);
+  return EnterResult{k - failed, failed};
 }
 
 // buf_index >= 0: read into pool slot buf_index at buf_offset (READ_FIXED
@@ -489,11 +540,13 @@ static int submit_common(sc_engine *e, int file_index, uint64_t offset,
   uint64_t opno = e->op_counter.fetch_add(1, std::memory_order_relaxed) + 1;
   if (fe > 0 && opno % fe == 0) {
     std::lock_guard<std::mutex> g(e->cq_mu);
-    if (e->n_synthetic >= e->queue_depth) return -EAGAIN;
+    if (e->synthetic.size() >= e->queue_depth) return -EAGAIN;
     e->ops_faulted.fetch_add(1, std::memory_order_relaxed);
     e->ops_submitted.fetch_add(1, std::memory_order_relaxed);
     e->in_flight.fetch_add(1, std::memory_order_relaxed);
-    e->synthetic[e->n_synthetic++] = sc_completion{tag, -EIO};
+    e->synthetic.push_back(sc_completion{tag, -EIO});
+    e->synthetic_count.store((uint32_t)e->synthetic.size(),
+                             std::memory_order_relaxed);
     return 0;
   }
 
@@ -508,11 +561,21 @@ static int submit_common(sc_engine *e, int file_index, uint64_t offset,
                       ? raw_addr
                       : e->pool + (size_t)buf_index * e->buffer_size + buf_offset;
 
-  std::lock_guard<std::mutex> g(e->sq_mu);
-  if (e->n_free == 0) return -EAGAIN;
-  fill_sqe_locked(e, f, file_index, offset, length, buf_index, buf_offset,
-                  addr, tag);
-  ring_enter_submit(e, 1);
+  sc_completion staged[1];
+  EnterResult r;
+  {
+    std::lock_guard<std::mutex> g(e->sq_mu);
+    if (e->n_free == 0) return -EAGAIN;
+    fill_sqe_locked(e, f, file_index, offset, length, buf_index, buf_offset,
+                    addr, tag);
+    r = ring_enter_submit(e, 1, staged);
+  }
+  if (r.failed) {
+    std::lock_guard<std::mutex> cg(e->cq_mu);
+    e->synthetic.push_back(staged[0]);
+    e->synthetic_count.store((uint32_t)e->synthetic.size(),
+                             std::memory_order_relaxed);
+  }
   return 0;
 }
 
@@ -533,14 +596,24 @@ int sc_submit_read_raw(sc_engine *e, int file_index, uint64_t offset,
 }
 
 // Drain ready CQEs + synthetic completions into out[]; returns count.
+// Caller holds cq_mu. Freed slots are returned to the SQ free list in ONE
+// sq_mu acquisition, strictly AFTER the CQ head is published — so a
+// submitter briefly holding sq_mu can never stall CQ-space publication
+// (livelock under CQ-full), and the cq_mu→sq_mu nesting here is deadlock-free
+// because no submit path acquires cq_mu while holding sq_mu.
 static uint32_t reap_locked(sc_engine *e, sc_completion *out, uint32_t max) {
   uint32_t n = 0;
-  while (n < max && e->n_synthetic > 0) {
-    out[n++] = e->synthetic[--e->n_synthetic];
+  while (n < max && !e->synthetic.empty()) {
+    out[n++] = e->synthetic.back();
+    e->synthetic.pop_back();
     e->in_flight.fetch_sub(1, std::memory_order_relaxed);
   }
+  e->synthetic_count.store((uint32_t)e->synthetic.size(),
+                           std::memory_order_relaxed);
   uint32_t head = e->cq_head->load(std::memory_order_relaxed);
   uint32_t tail = e->cq_tail->load(std::memory_order_acquire);
+  uint32_t *freed = (uint32_t *)alloca(sizeof(uint32_t) * max);
+  uint32_t n_freed = 0;
   while (n < max && head != tail) {
     struct io_uring_cqe *cqe = &e->cqes[head & e->cq_mask];
     uint32_t slot_idx = (uint32_t)cqe->user_data;
@@ -578,13 +651,15 @@ static uint32_t reap_locked(sc_engine *e, sc_completion *out, uint32_t max) {
     }
     out[n++] = sc_completion{slot.tag, res};
     slot.in_use = false;
-    {
-      std::lock_guard<std::mutex> sg(e->sq_mu);
-      e->free_slots[e->n_free++] = slot_idx;
-    }
+    freed[n_freed++] = slot_idx;
     e->in_flight.fetch_sub(1, std::memory_order_relaxed);
   }
   e->cq_head->store(head, std::memory_order_release);
+  if (n_freed > 0) {
+    std::lock_guard<std::mutex> sg(e->sq_mu);
+    for (uint32_t i = 0; i < n_freed; ++i)
+      e->free_slots[e->n_free++] = freed[i];
+  }
   return n;
 }
 
@@ -607,9 +682,24 @@ int sc_wait(sc_engine *e, sc_completion *out, uint32_t max,
 
     unsigned want = min_completions - got;
     if (timeout_ms < 0) {
-      int ret = sys_io_uring_enter(e->ring_fd, 0, want, IORING_ENTER_GETEVENTS,
-                                   nullptr, 0);
-      if (ret < 0 && errno != EINTR) return got > 0 ? (int)got : -errno;
+      // Bounded 100ms waits even for "block forever": synthetic completions
+      // (fault injection, submission rollback) produce no kernel CQE, so an
+      // unbounded GETEVENTS would never observe them — the reap at the top
+      // of the loop must get a periodic chance to drain e->synthetic.
+      if (e->has_ext_arg) {
+        struct __kernel_timespec ts = {0, 100000000};  // 100ms
+        struct io_uring_getevents_arg arg;
+        memset(&arg, 0, sizeof(arg));
+        arg.ts = (uint64_t)(uintptr_t)&ts;
+        int ret = sys_io_uring_enter(e->ring_fd, 0, want,
+                                     IORING_ENTER_GETEVENTS | IORING_ENTER_EXT_ARG,
+                                     &arg, sizeof(arg));
+        if (ret < 0 && errno != EINTR && errno != ETIME)
+          return got > 0 ? (int)got : -errno;
+      } else {
+        struct timespec ts = {0, 500000};
+        nanosleep(&ts, nullptr);
+      }
     } else if (!e->has_ext_arg) {
       // Pre-5.11 kernels: no timed enter; poll the CQ at 500us granularity.
       struct timespec ts = {0, 500000};
@@ -642,48 +732,84 @@ struct sc_raw_op {
 // Batch submit into caller-owned memory: one lock, one io_uring_enter for the
 // whole vector (the per-op path costs one syscall per 128KiB block — at NVMe
 // rates that is tens of thousands of syscalls/s this removes).
-// Returns ops accepted (< n only on -EAGAIN backpressure), or -errno.
-int sc_submit_raw_batch(sc_engine *e, const sc_raw_op *ops, uint32_t n) {
+//
+// Returns ops accepted, or -errno if the FIRST op is unacceptable. On a
+// partial accept (< n), *stop_errno (if non-null) says why: 0 for
+// backpressure (queue/synthetic budget — reap and resubmit the rest) vs the
+// positive errno of the eligible-but-broken op (EINVAL/EBADF — resubmitting
+// that op can never succeed).
+//
+// "Accepted" includes ops that will FAIL via a synthetic completion (fault
+// injection, submission rollback) — the caller sees those failures in
+// sc_wait, never as silently-missing ops.
+int sc_submit_raw_batch(sc_engine *e, const sc_raw_op *ops, uint32_t n,
+                        int32_t *stop_errno) {
   uint32_t accepted = 0;
   uint32_t filled = 0;
-  std::lock_guard<std::mutex> g(e->sq_mu);
-  for (uint32_t i = 0; i < n; ++i) {
-    const sc_raw_op &op = ops[i];
-    if (op.file_index < 0 || op.file_index >= (int)kMaxFiles ||
-        op.addr == nullptr) {
-      if (filled) ring_enter_submit(e, filled);
-      return accepted ? (int)accepted : -EINVAL;
-    }
-    // fault injection parity with the per-op path
-    uint64_t fe = e->fault_every.load(std::memory_order_relaxed);
-    uint64_t opno = e->op_counter.fetch_add(1, std::memory_order_relaxed) + 1;
-    if (fe > 0 && opno % fe == 0) {
-      std::lock_guard<std::mutex> cg(e->cq_mu);
-      if (e->n_synthetic >= e->queue_depth) break;
-      e->ops_faulted.fetch_add(1, std::memory_order_relaxed);
-      e->ops_submitted.fetch_add(1, std::memory_order_relaxed);
-      e->in_flight.fetch_add(1, std::memory_order_relaxed);
-      e->synthetic[e->n_synthetic++] = sc_completion{op.tag, -EIO};
-      ++accepted;
-      continue;
-    }
-    FileEntry f;
-    {
-      std::lock_guard<std::mutex> fg(e->files_mu);
-      if (!e->files[op.file_index].in_use) {
-        if (filled) ring_enter_submit(e, filled);
-        return accepted ? (int)accepted : -EBADF;
+  int rc = 0;
+  int32_t stop = 0;
+  // Completions staged under sq_mu, appended to e->synthetic under cq_mu only
+  // after sq_mu is released: reap_locked nests sq_mu inside cq_mu, so taking
+  // cq_mu here while holding sq_mu would be a classic ABBA deadlock.
+  std::vector<sc_completion> staged;
+  {
+    std::lock_guard<std::mutex> g(e->sq_mu);
+    for (uint32_t i = 0; i < n; ++i) {
+      const sc_raw_op &op = ops[i];
+      if (op.file_index < 0 || op.file_index >= (int)kMaxFiles ||
+          op.addr == nullptr) {
+        rc = accepted ? (int)accepted : -EINVAL;
+        stop = EINVAL;
+        break;
       }
-      f = e->files[op.file_index];
+      // fault injection parity with the per-op path
+      uint64_t fe = e->fault_every.load(std::memory_order_relaxed);
+      uint64_t opno = e->op_counter.fetch_add(1, std::memory_order_relaxed) + 1;
+      if (fe > 0 && opno % fe == 0) {
+        // guard the SHARED backlog (synthetic_count), not just this call's
+        // staging — parity with the per-op path's queue_depth cap
+        if (staged.size() +
+                e->synthetic_count.load(std::memory_order_relaxed) >=
+            e->queue_depth)
+          break;
+        e->ops_faulted.fetch_add(1, std::memory_order_relaxed);
+        e->ops_submitted.fetch_add(1, std::memory_order_relaxed);
+        e->in_flight.fetch_add(1, std::memory_order_relaxed);
+        staged.push_back(sc_completion{op.tag, -EIO});
+        ++accepted;
+        continue;
+      }
+      FileEntry f;
+      {
+        std::lock_guard<std::mutex> fg(e->files_mu);
+        if (!e->files[op.file_index].in_use) {
+          rc = accepted ? (int)accepted : -EBADF;
+          stop = EBADF;
+          break;
+        }
+        f = e->files[op.file_index];
+      }
+      if (e->n_free == 0) break;  // queue depth reached: caller reaps + resumes
+      fill_sqe_locked(e, f, op.file_index, op.offset, op.length, -1, 0,
+                      (uint8_t *)op.addr, op.tag);
+      ++filled;
+      ++accepted;
     }
-    if (e->n_free == 0) break;  // queue depth reached: caller reaps + resumes
-    fill_sqe_locked(e, f, op.file_index, op.offset, op.length, -1, 0,
-                    (uint8_t *)op.addr, op.tag);
-    ++filled;
-    ++accepted;
+    if (filled) {
+      size_t base = staged.size();
+      staged.resize(base + filled);
+      EnterResult r = ring_enter_submit(e, filled, staged.data() + base);
+      staged.resize(base + r.failed);
+    }
   }
-  if (filled) ring_enter_submit(e, filled);
-  return (int)accepted;
+  if (!staged.empty()) {
+    std::lock_guard<std::mutex> cg(e->cq_mu);
+    e->synthetic.insert(e->synthetic.end(), staged.begin(), staged.end());
+    e->synthetic_count.store((uint32_t)e->synthetic.size(),
+                             std::memory_order_relaxed);
+  }
+  if (stop_errno) *stop_errno = stop;
+  return rc != 0 ? rc : (int)accepted;
 }
 
 struct sc_vec_seg {
@@ -707,7 +833,8 @@ int64_t sc_read_vectored(sc_engine *e, const sc_vec_seg *segs, uint64_t n_segs,
     uint64_t offset, dest_off;
     uint32_t want, attempts;
     int32_t file_index;
-    bool live;
+    bool live;       // byte range claimed from the cursor, not yet retired
+    bool submitted;  // currently in flight inside the engine
   };
   uint32_t qd = e->queue_depth;
   Chunk *pend = new Chunk[qd];
@@ -715,7 +842,8 @@ int64_t sc_read_vectored(sc_engine *e, const sc_vec_seg *segs, uint64_t n_segs,
   sc_raw_op *batch = new sc_raw_op[qd];
   sc_completion *comps = new sc_completion[qd > 64 ? qd : 64];
   uint64_t si = 0, within = 0;  // cursor into segs
-  uint32_t n_pend = 0;
+  uint32_t n_live = 0;          // claimed chunks not yet retired
+  uint32_t n_inflight = 0;      // subset of live actually submitted
   uint64_t total = 0;
   int64_t err = 0;
 
@@ -735,23 +863,37 @@ int64_t sc_read_vectored(sc_engine *e, const sc_vec_seg *segs, uint64_t n_segs,
     c.attempts = 0;
     c.file_index = s.file_index;
     c.live = true;
+    c.submitted = false;
     within += take;
     return true;
   };
 
   bool exhausted = false;
-  while (!exhausted || n_pend > 0) {
-    // fill: claim free local slots, batch-submit
+  while (!exhausted || n_live > 0) {
+    // fill: requeue any live-but-unsubmitted chunks first (a previous batch
+    // the engine only partially accepted — shared-ring backpressure), then
+    // claim new chunks from the cursor. A partially-accepted batch must NOT
+    // drop its tail: those byte ranges would silently never be read.
     uint32_t k = 0;
-    while (!exhausted && n_pend + k < qd) {
-      uint32_t slot = 0;
+    for (uint32_t slot = 0; slot < qd; ++slot) {
+      if (pend[slot].live && !pend[slot].submitted) {
+        batch[k].file_index = pend[slot].file_index;
+        batch[k].length = pend[slot].want;
+        batch[k].offset = pend[slot].offset;
+        batch[k].tag = slot;
+        batch[k].addr = (uint8_t *)dest_base + pend[slot].dest_off;
+        ++k;
+      }
+    }
+    while (!exhausted) {
+      uint32_t slot = 0;  // each batch entry owns a distinct slot, so k <= qd
       while (slot < qd && pend[slot].live) ++slot;
-      // reserve by marking live in next_chunk
       if (slot >= qd) break;
       if (!next_chunk(pend[slot])) {
         exhausted = true;
         break;
       }
+      ++n_live;
       batch[k].file_index = pend[slot].file_index;
       batch[k].length = pend[slot].want;
       batch[k].offset = pend[slot].offset;
@@ -760,30 +902,38 @@ int64_t sc_read_vectored(sc_engine *e, const sc_vec_seg *segs, uint64_t n_segs,
       ++k;
     }
     if (k > 0) {
-      int acc = sc_submit_raw_batch(e, batch, k);
+      int acc = sc_submit_raw_batch(e, batch, k, nullptr);
       if (acc < 0) {
         err = acc;
-        // un-claim everything that never got submitted
-        for (uint32_t i = 0; i < k; ++i) pend[batch[i].tag].live = false;
+        // un-claim everything in this batch; nothing of it was accepted
+        for (uint32_t i = 0; i < k; ++i) {
+          pend[batch[i].tag].live = false;
+          --n_live;
+        }
         break;
       }
-      for (int i = acc; i < (int)k; ++i) pend[batch[i].tag].live = false;
-      n_pend += (uint32_t)acc;
-      // backpressure (shared ring): if nothing was accepted and nothing is
-      // pending here, another submitter owns the depth — reap below anyway
+      // first `acc` ops are in flight; the tail stays live+unsubmitted and
+      // is resubmitted on the next loop iteration
+      for (int i = 0; i < acc; ++i) pend[batch[i].tag].submitted = true;
+      for (int i = acc; i < (int)k; ++i) pend[batch[i].tag].submitted = false;
+      n_inflight += (uint32_t)acc;
     }
-    if (n_pend == 0) {
+    if (n_live == 0) {
       if (exhausted) break;
       continue;
     }
-    int got = sc_wait(e, comps, qd > 64 ? qd : 64, 1, -1);
+    // If nothing of ours is in flight (another submitter owns the whole
+    // queue depth), poll with a bounded wait so we retry submission instead
+    // of blocking forever on completions that may all be foreign.
+    int got = sc_wait(e, comps, qd > 64 ? qd : 64, 1, n_inflight > 0 ? -1 : 10);
     if (got < 0) {
       err = got;
       break;
     }
     for (int i = 0; i < got; ++i) {
       uint64_t slot = comps[i].tag;
-      if (slot >= qd || !pend[slot].live) continue;  // foreign tag: dropped
+      if (slot >= qd || !pend[slot].live || !pend[slot].submitted)
+        continue;  // foreign tag: dropped
       Chunk &c = pend[slot];
       if (comps[i].res < 0) {
         if (c.attempts < retries) {
@@ -791,36 +941,48 @@ int64_t sc_read_vectored(sc_engine *e, const sc_vec_seg *segs, uint64_t n_segs,
           e->chunk_retries.fetch_add(1, std::memory_order_relaxed);
           sc_raw_op rop{c.file_index, c.want, c.offset, slot,
                         (uint8_t *)dest_base + c.dest_off};
-          int acc = sc_submit_raw_batch(e, &rop, 1);
-          if (acc == 1) continue;  // still pending
-          err = acc < 0 ? acc : -EAGAIN;
-        } else if (err == 0) {
-          err = comps[i].res;
+          int acc = sc_submit_raw_batch(e, &rop, 1, nullptr);
+          if (acc == 1) continue;  // still in flight
+          if (acc < 0) {
+            err = acc;
+            c.live = false;
+            --n_live;
+            --n_inflight;
+          } else {
+            // backpressure: requeue through the fill phase
+            c.submitted = false;
+            --n_inflight;
+          }
+        } else {
+          if (err == 0) err = comps[i].res;
+          c.live = false;
+          --n_live;
+          --n_inflight;
         }
-        c.live = false;
-        --n_pend;
       } else if ((uint32_t)comps[i].res < c.want) {
         if (err == 0) err = -ENODATA;  // short read: past EOF
         total += (uint64_t)comps[i].res;
         c.live = false;
-        --n_pend;
+        --n_live;
+        --n_inflight;
       } else {
         total += (uint64_t)comps[i].res;
         c.live = false;
-        --n_pend;
+        --n_live;
+        --n_inflight;
       }
     }
     if (err != 0) break;
   }
   // drain whatever is still in flight so the shared engine stays clean
-  while (n_pend > 0) {
+  while (n_inflight > 0) {
     int got = sc_wait(e, comps, qd > 64 ? qd : 64, 1, 30000);
     if (got <= 0) break;
     for (int i = 0; i < got; ++i) {
       uint64_t slot = comps[i].tag;
-      if (slot < qd && pend[slot].live) {
+      if (slot < qd && pend[slot].live && pend[slot].submitted) {
         pend[slot].live = false;
-        --n_pend;
+        --n_inflight;
       }
     }
   }

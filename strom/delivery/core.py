@@ -15,6 +15,7 @@ from __future__ import annotations
 
 import concurrent.futures
 import dataclasses
+import errno
 import math
 import os
 import threading
@@ -132,6 +133,13 @@ class StromContext:
                                                   retries=cfg.io_retries)
             except EngineError as e:
                 raise EngineError(e.errno, f"ssd2tpu {e.strerror}") from None
+        planned = sum(ln for (_, _, _, ln) in chunks)
+        if total != planned:
+            # cheap insurance: any engine accounting bug (short read the
+            # engine failed to flag) surfaces loudly instead of as a
+            # zero-tailed jax array
+            raise EngineError(errno.EIO,
+                              f"ssd2tpu read {total} bytes, planned {planned}")
         global_stats.add("ssd2tpu_bytes", total)
         return total
 

@@ -1,0 +1,170 @@
+"""Submission-boundary failure handling in the C++ engine.
+
+Round-1 review findings (VERDICT.md weak #2, ADVICE.md): a fatal errno from
+io_uring_enter used to leave published-but-never-submitted ops accounted as
+in-flight, so sc_wait(timeout=-1) would block forever on completions the
+kernel would never produce. The fix rolls the SQEs back and fails the ops via
+synthetic completions; these tests force that path with the
+sc_set_enter_fail_once hook (≙ sc_set_fault_every for the submit boundary).
+Also covers the uint32 chunk-length splitting that prevents silent ctypes
+truncation of >=4GiB gather chunks (ADVICE.md high).
+"""
+
+import errno
+
+import numpy as np
+import pytest
+
+from strom.config import StromConfig
+from strom.delivery.buffers import alloc_aligned
+from strom.engine import make_engine
+from strom.engine.base import EngineError, RawRead
+from strom.engine.uring_engine import _MAX_SEG, _split_chunks, uring_available
+
+pytestmark = pytest.mark.skipif(not uring_available(),
+                                reason="io_uring unavailable in this sandbox")
+
+
+@pytest.fixture()
+def engine():
+    cfg = StromConfig(engine="uring", queue_depth=8, num_buffers=8)
+    eng = make_engine(cfg)
+    yield eng
+    eng.close()
+
+
+class TestEnterFailure:
+    def test_failure_surfaces_within_one_wait(self, engine, data_file):
+        """A fatal submit errno must complete the op with that errno (via a
+        synthetic completion) — not strand it in in_flight forever."""
+        path, _ = data_file
+        fi = engine.register_file(path)
+        dest = alloc_aligned(128 * 1024)
+        engine.set_enter_fail_once(errno.EIO)
+        engine.submit_raw([RawRead(fi, 0, 128 * 1024, dest, tag=7)])
+        # timeout bounds the test: pre-fix this wait hung forever
+        comps = engine.wait(min_completions=1, timeout_s=5.0)
+        assert len(comps) == 1
+        assert comps[0].tag == 7
+        assert comps[0].result == -errno.EIO
+        assert engine.in_flight() == 0
+
+    def test_batch_rollback_fails_all_ops(self, engine, data_file):
+        """Every op of a batch the kernel never saw gets a failure completion."""
+        path, _ = data_file
+        fi = engine.register_file(path)
+        dests = [alloc_aligned(64 * 1024) for _ in range(4)]
+        engine.set_enter_fail_once(errno.ENOMEM)
+        engine.submit_raw([RawRead(fi, i * 65536, 65536, d, tag=100 + i)
+                           for i, d in enumerate(dests)])
+        comps = engine.wait(min_completions=4, timeout_s=5.0)
+        assert sorted(c.tag for c in comps) == [100, 101, 102, 103]
+        assert all(c.result == -errno.ENOMEM for c in comps)
+        assert engine.in_flight() == 0
+
+    def test_vectored_retry_recovers(self, engine, data_file):
+        """read_vectored's per-chunk retry absorbs a one-shot submit failure
+        transparently: data stays golden."""
+        path, golden = data_file
+        fi = engine.register_file(path)
+        dest = alloc_aligned(1024 * 1024)
+        engine.set_enter_fail_once(errno.EIO)
+        n = engine.read_vectored([(fi, 0, 0, 1024 * 1024)], dest, retries=1)
+        assert n == 1024 * 1024
+        np.testing.assert_array_equal(dest, golden[: 1024 * 1024])
+
+    def test_vectored_no_retry_fails_loudly(self, engine, data_file):
+        path, golden = data_file
+        fi = engine.register_file(path)
+        dest = alloc_aligned(1024 * 1024)
+        engine.set_enter_fail_once(errno.EIO)
+        with pytest.raises(EngineError):
+            engine.read_vectored([(fi, 0, 0, 1024 * 1024)], dest, retries=0)
+        # engine must stay usable: the rollback freed every slot
+        assert engine.in_flight() == 0
+        n = engine.read_vectored([(fi, 0, 0, 1024 * 1024)], dest, retries=0)
+        assert n == 1024 * 1024
+        np.testing.assert_array_equal(dest, golden[: 1024 * 1024])
+
+
+class TestCrossThreadWake:
+    def test_waiter_thread_sees_synthetic_completion(self, engine, data_file):
+        """A dedicated waiter thread must observe a synthetic (rollback)
+        completion submitted by another thread even though it produces no
+        kernel CQE: the infinite-wait arm polls the synthetic queue on a
+        bounded cadence instead of parking forever in IORING_ENTER_GETEVENTS."""
+        import threading
+        import time
+
+        path, _ = data_file
+        fi = engine.register_file(path)
+        got: list = []
+
+        def waiter():
+            deadline = time.monotonic() + 5.0
+            while time.monotonic() < deadline:
+                comps = engine.wait(min_completions=1, timeout_s=None)
+                if comps:  # wait() returns [] fast while nothing is in flight
+                    got.extend(comps)
+                    return
+                time.sleep(0.001)
+
+        t = threading.Thread(target=waiter)
+        t.start()
+        time.sleep(0.1)  # let the waiter reach its wait loop first
+        dest = alloc_aligned(64 * 1024)
+        engine.set_enter_fail_once(errno.EIO)
+        engine.submit_raw([RawRead(fi, 0, 65536, dest, tag=42)])
+        t.join(timeout=5.0)
+        assert not t.is_alive(), "waiter stranded on synthetic completion"
+        assert [c.tag for c in got] == [42]
+        assert got[0].result == -errno.EIO
+
+
+class TestChunkSplitting:
+    def test_small_chunks_pass_through(self):
+        chunks = [(0, 0, 0, 4096), (1, 8192, 4096, 128 * 1024)]
+        assert _split_chunks(chunks) == chunks
+
+    def test_oversized_chunk_split(self):
+        ln = 5 * (1 << 30)  # 5 GiB: ctypes would mask this to 1 GiB
+        out = _split_chunks([(0, 0, 0, ln)])
+        assert sum(c[3] for c in out) == ln
+        assert all(c[3] <= _MAX_SEG for c in out)
+        # pieces must tile the original range contiguously in file AND dest
+        pos = 0
+        for fi, fo, do, l in out:
+            assert fi == 0 and fo == pos and do == pos
+            pos += l
+        assert pos == ln
+
+    def test_exact_limit_not_split(self):
+        assert _split_chunks([(0, 0, 0, _MAX_SEG)]) == [(0, 0, 0, _MAX_SEG)]
+
+    def test_negative_length_rejected(self):
+        with pytest.raises(ValueError):
+            _split_chunks([(0, 0, 0, -1)])
+
+    def test_raw_read_overflow_rejected(self, engine, data_file):
+        path, _ = data_file
+        fi = engine.register_file(path)
+        dest = np.zeros(8, dtype=np.uint8)  # size check is on length field
+        with pytest.raises(EngineError, match="uint32"):
+            engine.submit_raw([RawRead(fi, 0, 1 << 33, dest, tag=1)])
+
+
+class TestBatchSubmit:
+    def test_multi_request_batch(self, engine, data_file):
+        """submit_raw of N requests lands them all (one enter per batch)."""
+        path, golden = data_file
+        fi = engine.register_file(path)
+        dests = [alloc_aligned(64 * 1024) for _ in range(6)]
+        engine.submit_raw([RawRead(fi, i * 65536, 65536, d, tag=i)
+                           for i, d in enumerate(dests)])
+        got = {}
+        while len(got) < 6:
+            for c in engine.wait(min_completions=1, timeout_s=5.0):
+                got[c.tag] = c.result
+        assert all(v == 65536 for v in got.values())
+        for i, d in enumerate(dests):
+            np.testing.assert_array_equal(d, golden[i * 65536:(i + 1) * 65536])
