@@ -67,7 +67,13 @@ def bench_nvme(args: argparse.Namespace) -> dict:
         fi = eng.register_file(path, o_direct=not args.buffered)
         dest = alloc_aligned(size)
         t0 = time.perf_counter()
-        n = eng.read_into_direct(fi, 0, size, dest)
+        if getattr(args, "per_op", False):
+            # legacy shape: one submit+wait ctypes round trip per block
+            n = eng.read_into_direct(fi, 0, size, dest)
+        else:
+            # native vectored gather: batched SQE fills, one io_uring_enter
+            # per batch — the honest "raw bandwidth" this hardware can do
+            n = eng.read_vectored([(fi, 0, 0, size)], dest)
         dt = time.perf_counter() - t0
         stats = eng.stats()
         eng.close()
@@ -82,6 +88,7 @@ def bench_nvme(args: argparse.Namespace) -> dict:
         "bench": "nvme", "gbps": round(gbps, 4), "block": args.block,
         "depth": args.depth, "bytes": size, "engine": cfg.engine,
         "o_direct": not args.buffered, "iters": args.iters,
+        "per_op": bool(getattr(args, "per_op", False)),
         "file_created": created,
     }
     return out
@@ -145,7 +152,15 @@ def bench_ssd2tpu(args: argparse.Namespace) -> dict:
 
 def bench_llama(args: argparse.Namespace) -> dict:
     """Config #4 loader shape: packed-token pipeline throughput (tokens/s)
-    + the 0-data-stall counter, feeding a dp mesh on the local device(s)."""
+    + the 0-data-stall counter, feeding a dp mesh on the local device(s).
+
+    Two phases:
+    1. loader flat-out — no compute, every next() is consumed instantly, so
+       the stall counter here measures nothing but raw loader rate;
+    2. (--train-step) a REAL jitted llama train step consumes the batches —
+       this is the north-star measurement (BASELINE.json:5 "0 data-stall
+       steps"): with prefetch >= 2, the loader must fully hide I/O behind
+       the step's device time."""
     import jax
     from jax.sharding import NamedSharding, PartitionSpec as P
 
@@ -176,14 +191,47 @@ def bench_llama(args: argparse.Namespace) -> dict:
             next(pipe).block_until_ready()
         dt = time.perf_counter() - t0
         stalls = pipe.data_stall_steps
-    ctx.close()
     tokens = args.steps * args.batch * (args.seq_len + 1)
-    return {
+    out = {
         "bench": "llama_loader", "tokens_per_s": round(tokens / dt, 1),
         "gbps": round(tokens * 4 / dt / 1e9, 4), "batch": args.batch,
         "seq_len": args.seq_len, "steps": args.steps, "devices": n_dev,
         "data_stall_steps": stalls, "engine": cfg.engine,
     }
+
+    if getattr(args, "train_step", False):
+        from strom.models.llama import LlamaConfig
+        from strom.parallel.train import (init_train_state, make_optimizer,
+                                          make_train_step)
+
+        mcfg = getattr(LlamaConfig, args.model)()
+        opt = make_optimizer()
+        with mesh:
+            state = init_train_state(jax.random.key(0), mcfg, mesh, opt)
+            step_fn = make_train_step(mcfg, mesh, opt, attn=args.attn)
+
+            def run_step(st, toks):
+                # bench tokens are random bytes; clamp into vocab on device
+                return step_fn(st, toks % mcfg.vocab)
+
+            with make_llama_pipeline(ctx, [path], batch=args.batch,
+                                     seq_len=args.seq_len, sharding=sharding,
+                                     prefetch_depth=args.prefetch) as pipe:
+                state, m = run_step(state, next(pipe))  # compile outside timing
+                jax.block_until_ready(m)
+                base_stalls = pipe.data_stall_steps
+                t0 = time.perf_counter()
+                for _ in range(args.steps):
+                    state, m = run_step(state, next(pipe))
+                jax.block_until_ready(m)
+                dt = time.perf_counter() - t0
+                out["train_tokens_per_s"] = round(tokens / dt, 1)
+                out["train_data_stalls"] = pipe.data_stall_steps - base_stalls
+                out["train_model"] = args.model
+                out["train_attn"] = args.attn
+                out["train_loss"] = round(float(m["loss"]), 4)
+    ctx.close()
+    return out
 
 
 def bench_resnet(args: argparse.Namespace) -> dict:
@@ -270,6 +318,9 @@ def main(argv: list[str] | None = None) -> int:
     common(p_nvme)
     p_nvme.add_argument("--buffered", action="store_true",
                         help="use the page-cache path instead of O_DIRECT")
+    p_nvme.add_argument("--per-op", action="store_true", dest="per_op",
+                        help="legacy per-block submit/wait loop instead of the "
+                             "native vectored gather")
     p_nvme.set_defaults(fn=bench_nvme)
 
     p_s2t = sub.add_parser("ssd2tpu", help="async SSD->TPU copy loop")
@@ -285,6 +336,13 @@ def main(argv: list[str] | None = None) -> int:
     p_llama.add_argument("--seq-len", type=int, default=2047, dest="seq_len")
     p_llama.add_argument("--steps", type=int, default=50)
     p_llama.add_argument("--prefetch", type=int, default=2)
+    p_llama.add_argument("--train-step", action="store_true", dest="train_step",
+                         help="phase 2: a real jitted train step consumes the "
+                              "batches (the 0-data-stall measurement)")
+    p_llama.add_argument("--model", default="small", choices=["tiny", "small"],
+                         help="LlamaConfig preset for --train-step")
+    p_llama.add_argument("--attn", default="flash", choices=["dense", "flash"],
+                         help="attention path for --train-step")
     p_llama.set_defaults(fn=bench_llama)
 
     p_rn = sub.add_parser("resnet", help="config #2: JPEG loader images/s")

@@ -63,6 +63,16 @@ class StromConfig:
     slab_pool_bytes: int = 512 * MiB   # recycled host slabs (0 = off); only
                                        # used on backends where device_put
                                        # copies (i.e. not the jax CPU backend)
+    # intra-transfer streaming: overlap disk reads of chunk k+1 with the
+    # host->HBM transfer of chunk k (double-buffered slab ring) for transfers
+    # >= overlap_min_bytes. 0 disables streaming.
+    overlap_chunk_bytes: int = 128 * MiB
+    overlap_min_bytes: int = 256 * MiB
+    # one host->HBM transfer at a time: concurrent device_puts share the same
+    # host link and interleave poorly (measured: concurrency collapses
+    # throughput through the transfer relay; on a directly-attached host the
+    # serialized stream still saturates the DMA engine)
+    serialize_device_put: bool = True
 
     # RAID0 (software striped reader over N member files/devices)
     raid_chunk: int = 512 * KiB
@@ -89,6 +99,9 @@ class StromConfig:
             raise ValueError("num_buffers must be positive")
         if self.engine not in ("auto", "uring", "python"):
             raise ValueError(f"unknown engine {self.engine!r}")
+        if self.overlap_chunk_bytes and self.overlap_chunk_bytes % 4096:
+            raise ValueError("overlap_chunk_bytes must be a multiple of 4096 "
+                             "(O_DIRECT alignment and dtype itemsize)")
 
     @classmethod
     def from_env(cls, **overrides: Any) -> "StromConfig":

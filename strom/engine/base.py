@@ -124,7 +124,12 @@ class Engine(abc.ABC):
 
     @abc.abstractmethod
     def submit_raw(self, requests: Sequence[RawRead]) -> int:
-        """Queue reads into caller-owned memory (bypasses the staging pool)."""
+        """Queue reads into caller-owned memory (bypasses the staging pool).
+
+        All-or-nothing: a batch exceeding the free queue depth raises
+        EngineError(EAGAIN) with nothing submitted. (The uring engine can be
+        raced past its pre-check by a concurrent submitter; its EngineError
+        then carries ``.accepted`` — see UringEngine.submit_raw.)"""
 
     @abc.abstractmethod
     def wait(self, min_completions: int = 1, timeout_s: float | None = None) -> list[Completion]:

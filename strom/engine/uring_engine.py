@@ -215,9 +215,23 @@ class UringEngine(Engine):
     def submit_raw(self, requests: Sequence[RawRead]) -> int:
         """Batch submit through sc_submit_raw_batch: one ctypes call and one
         io_uring_enter for the whole sequence (the round-1 implementation
-        looped one syscall per request — VERDICT.md weak #8)."""
+        looped one syscall per request — VERDICT.md weak #8).
+
+        Contract (matches PythonEngine): all-or-nothing in the common case —
+        a batch that cannot fit the queue depth raises EAGAIN with nothing
+        submitted. If a concurrent submitter races us past the pre-check and
+        the engine accepts only part of the batch, the raised EngineError
+        carries ``.accepted`` = number of ops ALREADY IN FLIGHT: reap their
+        completions and resubmit only ``requests[accepted:]`` — never the
+        whole batch (tag reuse while the kernel still owns the first ops'
+        buffers would corrupt memory)."""
         if not requests:
             return 0
+        if self.in_flight() + len(requests) > self.config.queue_depth:
+            raise EngineError(
+                _errno.EAGAIN,
+                f"queue depth exceeded ({self.in_flight()}+{len(requests)} > "
+                f"{self.config.queue_depth})")
         ops = (_ScRawOp * len(requests))()
         for i, r in enumerate(requests):
             if not r.dest.flags["C_CONTIGUOUS"] or not r.dest.flags["WRITEABLE"]:
@@ -249,12 +263,16 @@ class UringEngine(Engine):
             if stop.value:
                 # an op the engine can never accept (bad file index/addr):
                 # retrying it is futile — surface its true errno
-                raise EngineError(stop.value,
+                err = EngineError(stop.value,
                                   f"submit_raw: op {rc} rejected: "
                                   f"{os.strerror(stop.value)}")
-            raise EngineError(_errno.EAGAIN,
-                              f"submit_raw: queue full after {rc}/{len(requests)} "
-                              "ops (reap completions and resubmit the rest)")
+            else:
+                err = EngineError(
+                    _errno.EAGAIN,
+                    f"submit_raw: queue full after {rc}/{len(requests)} ops "
+                    "(reap completions, then resubmit requests[accepted:])")
+            err.accepted = rc
+            raise err
         return rc
 
     def wait(self, min_completions: int = 1, timeout_s: float | None = None) -> list[Completion]:

@@ -166,15 +166,24 @@ def block(x: jax.Array, lp: dict, cfg: LlamaConfig, positions: jax.Array,
 
 
 def forward(params: dict, tokens: jax.Array, cfg: LlamaConfig,
-            positions: jax.Array | None = None, attn_fn=None) -> jax.Array:
-    """tokens [B, S] int32 → logits [B, S, vocab] float32."""
+            positions: jax.Array | None = None, attn_fn=None,
+            remat: bool = False) -> jax.Array:
+    """tokens [B, S] int32 → logits [B, S, vocab] float32.
+
+    remat=True wraps each layer in `jax.checkpoint`: the backward recomputes
+    block activations instead of the scan stacking every intermediate over
+    layers — without it a 12-layer step at B16×S2048 wants ~22G of HLO temps
+    and OOMs a 16G chip. FLOPs-for-HBM is the standard TPU trade (the brief's
+    "use jax.checkpoint / rematerialisation")."""
     B, S = tokens.shape
     if positions is None:
         positions = jnp.broadcast_to(jnp.arange(S, dtype=jnp.int32), (B, S))
     x = params["embed"][tokens].astype(cfg.jdtype)
 
+    blk = block if not remat else jax.checkpoint(block, static_argnums=(2, 4))
+
     def body(carry, lp):
-        return block(carry, lp, cfg, positions, attn_fn), None
+        return blk(carry, lp, cfg, positions, attn_fn), None
 
     x, _ = lax.scan(body, x, params["layers"])
     x = rmsnorm(x, params["final_norm"], cfg.norm_eps)
@@ -182,7 +191,7 @@ def forward(params: dict, tokens: jax.Array, cfg: LlamaConfig,
 
 
 def next_token_loss(params: dict, tokens: jax.Array, cfg: LlamaConfig,
-                    attn_fn=None) -> jax.Array:
+                    attn_fn=None, remat: bool = False) -> jax.Array:
     """Mean cross-entropy of predicting tokens[:, 1:] from tokens[:, :-1].
 
     Computed as a full-length forward + roll/mask rather than slicing to
@@ -191,7 +200,7 @@ def next_token_loss(params: dict, tokens: jax.Array, cfg: LlamaConfig,
     the batch evenly (the loader's seq_len+1 record length must be divisible
     by the sp axis size)."""
     B, L = tokens.shape
-    logits = forward(params, tokens, cfg, attn_fn=attn_fn)
+    logits = forward(params, tokens, cfg, attn_fn=attn_fn, remat=remat)
     targets = jnp.roll(tokens, -1, axis=1)
     logz = jax.nn.logsumexp(logits, axis=-1)
     gold = jnp.take_along_axis(logits, targets[..., None], axis=-1)[..., 0]

@@ -139,15 +139,20 @@ def block(x: jax.Array, lp: dict, cfg: MoEConfig, positions: jax.Array,
 
 
 def forward(params: dict, tokens: jax.Array, cfg: MoEConfig,
-            attn_fn=None) -> tuple[jax.Array, jax.Array]:
-    """tokens [B, S] → (logits [B, S, vocab] f32, aux losses [2] summed)."""
+            attn_fn=None, remat: bool = False) -> tuple[jax.Array, jax.Array]:
+    """tokens [B, S] → (logits [B, S, vocab] f32, aux losses [2] summed).
+
+    remat=True: per-layer jax.checkpoint, same trade as the dense model
+    (strom.models.llama.forward) — mandatory for real batch×seq on one chip."""
     b = cfg.base
     B, S = tokens.shape
     positions = jnp.broadcast_to(jnp.arange(S, dtype=jnp.int32), (B, S))
     x = params["embed"][tokens].astype(b.jdtype)
 
+    blk = block if not remat else jax.checkpoint(block, static_argnums=(2, 4))
+
     def body(carry, lp):
-        y, aux = block(carry, lp, cfg, positions, attn_fn)
+        y, aux = blk(carry, lp, cfg, positions, attn_fn)
         return y, aux
 
     x, auxes = lax.scan(body, x, params["layers"])
@@ -157,11 +162,11 @@ def forward(params: dict, tokens: jax.Array, cfg: MoEConfig,
 
 
 def next_token_loss(params: dict, tokens: jax.Array, cfg: MoEConfig,
-                    attn_fn=None) -> jax.Array:
+                    attn_fn=None, remat: bool = False) -> jax.Array:
     """Full-length roll/mask LM loss (same shape contract as the dense model)
     + weighted router aux losses."""
     B, L = tokens.shape
-    logits, aux = forward(params, tokens, cfg, attn_fn)
+    logits, aux = forward(params, tokens, cfg, attn_fn, remat=remat)
     targets = jnp.roll(tokens, -1, axis=1)
     logz = jax.nn.logsumexp(logits, axis=-1)
     gold = jnp.take_along_axis(logits, targets[..., None], axis=-1)[..., 0]

@@ -32,7 +32,9 @@ def run_dryrun(n_devices: int) -> None:
     cfg = LlamaConfig.tiny()
     optimizer = make_optimizer()
     state = init_train_state(jax.random.PRNGKey(0), cfg, mesh, optimizer)
-    step = make_train_step(cfg, mesh, optimizer)
+    # flash: the default TPU training path — Pallas kernels run in interpret
+    # mode on the virtual CPU mesh, so the dryrun compiles the same graph
+    step = make_train_step(cfg, mesh, optimizer, attn="flash")
 
     # Deliver the token batch through the real data path: packed-token .bin on
     # disk -> memcpy_ssd2tpu -> jax.Array sharded P("dp") over the mesh.

@@ -55,25 +55,40 @@ def init_train_state(key: jax.Array, cfg: LlamaConfig, mesh: Mesh,
 
 def make_train_step(cfg: LlamaConfig, mesh: Mesh,
                     optimizer: optax.GradientTransformation, *,
-                    sp: bool = False, donate: bool = True):
+                    attn: str = "dense", sp: bool = False, donate: bool = True):
     """Compile a (state, tokens) -> (state, metrics) step.
 
     tokens arrive sharded P("dp"[, "sp"]) — exactly the sharding
     strom.pipelines loaders deliver — so no resharding happens on entry.
 
+    attn="flash": the Pallas flash-attention kernel (blockwise forward AND
+    backward, O(S) memory — strom.ops.flash_attention) replaces the dense op
+    in every layer. This is the default TPU training path for long sequences;
+    "dense" remains for short-sequence parity and debugging.
+
     sp=True: activations stay sequence-sharded and attention runs the ring
     algorithm (kv blocks rotate over ICI neighbor hops) instead of letting
     XLA all-gather the whole sequence — peak memory O(S/n_sp) per device.
     """
+    if attn not in ("dense", "flash"):
+        raise ValueError(f"attn must be 'dense' or 'flash', got {attn!r}")
     batch_sharding = NamedSharding(mesh, P("dp", "sp") if sp else P("dp", None))
     attn_fn = None
     if sp:
         from strom.parallel.ring import make_ring_attention
 
+        if attn == "flash":
+            raise NotImplementedError(
+                "flash attention inside the ring (sp) path is not wired yet; "
+                "use attn='dense' with sp=True")
         attn_fn = make_ring_attention(mesh, axis="sp")
+    elif attn == "flash":
+        from strom.ops.flash_attention import make_flash_attention
+
+        attn_fn = make_flash_attention()
 
     def loss_fn(params, tokens):
-        return next_token_loss(params, tokens, cfg, attn_fn=attn_fn)
+        return next_token_loss(params, tokens, cfg, attn_fn=attn_fn, remat=True)
 
     def step(state: TrainState, tokens: jax.Array):
         loss, grads = jax.value_and_grad(loss_fn)(state.params, tokens)
@@ -99,21 +114,33 @@ def init_moe_train_state(key: jax.Array, cfg, mesh: Mesh,
 
 def make_moe_train_step(cfg, mesh: Mesh,
                         optimizer: optax.GradientTransformation, *,
-                        sp: bool = False, donate: bool = True):
+                        attn: str = "dense", sp: bool = False,
+                        donate: bool = True):
     """(state, tokens) -> (state, metrics) for the MoE model: tokens arrive
     P("dp"[, "sp"]); expert weights stay ep-sharded and XLA places the token
     all-to-alls the dispatch einsums imply."""
     from strom.models import moe
 
+    if attn not in ("dense", "flash"):
+        raise ValueError(f"attn must be 'dense' or 'flash', got {attn!r}")
     batch_sharding = NamedSharding(mesh, P("dp", "sp") if sp else P("dp", None))
     attn_fn = None
     if sp:
         from strom.parallel.ring import make_ring_attention
 
+        if attn == "flash":
+            raise NotImplementedError(
+                "flash attention inside the ring (sp) path is not wired yet; "
+                "use attn='dense' with sp=True")
         attn_fn = make_ring_attention(mesh, axis="sp")
+    elif attn == "flash":
+        from strom.ops.flash_attention import make_flash_attention
+
+        attn_fn = make_flash_attention()
 
     def loss_fn(params, tokens):
-        return moe.next_token_loss(params, tokens, cfg, attn_fn=attn_fn)
+        return moe.next_token_loss(params, tokens, cfg, attn_fn=attn_fn,
+                                   remat=True)
 
     def step(state: TrainState, tokens: jax.Array):
         loss, grads = jax.value_and_grad(loss_fn)(state.params, tokens)

@@ -1,0 +1,127 @@
+"""Intra-transfer streaming: read/transfer overlap inside one memcpy_ssd2tpu
+(VERDICT.md missing #1: round 1 read the whole slab, then dispatched
+device_put — no overlap within a transfer). ≙ the reference consumer's
+double-buffered DMA/compute recycle loop (SURVEY.md §3.5)."""
+
+import numpy as np
+import pytest
+
+from strom.config import StromConfig
+from strom.delivery.core import StromContext, split_segments
+from strom.delivery.shard import Segment
+
+MiB = 1024 * 1024
+
+
+class TestSplitSegments:
+    def test_single_segment_split(self):
+        pieces = split_segments([Segment(0, 0, 10 * MiB)], 4 * MiB)
+        assert [(b, n) for b, n, _ in pieces] == [
+            (0, 4 * MiB), (4 * MiB, 4 * MiB), (8 * MiB, 2 * MiB)]
+        for base, n, segs in pieces:
+            assert sum(s.length for s in segs) == n
+            assert segs[0].file_offset == base  # contiguous source here
+            assert segs[0].dest_offset == 0     # dest rebased per piece
+
+    def test_multi_segment_tiling(self):
+        # 3 source segments tiling dest [0, 6MiB) out of order
+        segs = [Segment(50 * MiB, 2 * MiB, 2 * MiB),
+                Segment(10 * MiB, 0, 2 * MiB),
+                Segment(30 * MiB, 4 * MiB, 2 * MiB)]
+        pieces = split_segments(segs, 3 * MiB)
+        assert [(b, n) for b, n, _ in pieces] == [(0, 3 * MiB), (3 * MiB, 3 * MiB)]
+        # piece 0 covers dest [0,3MiB): all of seg@10M, first half of seg@50M
+        p0 = pieces[0][2]
+        assert p0 == [Segment(10 * MiB, 0, 2 * MiB),
+                      Segment(50 * MiB, 2 * MiB, 1 * MiB)]
+        # piece 1 covers dest [3,6MiB): second half of seg@50M, all of seg@30M
+        p1 = pieces[1][2]
+        assert p1 == [Segment(50 * MiB + 1 * MiB, 0, 1 * MiB),
+                      Segment(30 * MiB, 1 * MiB, 2 * MiB)]
+
+    def test_chunk_larger_than_total(self):
+        pieces = split_segments([Segment(0, 0, MiB)], 16 * MiB)
+        assert len(pieces) == 1 and pieces[0][1] == MiB
+
+
+@pytest.fixture()
+def big_file(tmp_path, rng):
+    data = rng.integers(0, 256, size=6 * MiB + 4096, dtype=np.uint8)
+    p = tmp_path / "big.bin"
+    data.tofile(p)
+    return str(p), data
+
+
+class TestStreamedDelivery:
+    def _cfg(self, engine_name):
+        # tiny thresholds so the CI-sized file exercises the streamed path
+        return StromConfig(engine=engine_name, queue_depth=8, num_buffers=16,
+                           overlap_chunk_bytes=1 * MiB, overlap_min_bytes=2 * MiB)
+
+    def test_streamed_integrity_single_device(self, engine_name, big_file):
+        import jax
+
+        path, golden = big_file
+        ctx = StromContext(self._cfg(engine_name))
+        try:
+            arr = ctx.memcpy_ssd2tpu(path, length=6 * MiB,
+                                     device=jax.devices()[0])
+            np.testing.assert_array_equal(np.asarray(arr), golden[: 6 * MiB])
+        finally:
+            ctx.close()
+
+    def test_streamed_integrity_with_shape_dtype(self, engine_name, big_file):
+        path, golden = big_file
+        ctx = StromContext(self._cfg(engine_name))
+        try:
+            arr = ctx.memcpy_ssd2tpu(path, shape=(3 * MiB // 4, 2),
+                                     dtype=np.uint32)
+            np.testing.assert_array_equal(
+                np.asarray(arr),
+                golden[: 6 * MiB].view(np.uint32).reshape(3 * MiB // 4, 2))
+        finally:
+            ctx.close()
+
+    def test_streamed_sharded(self, engine_name, big_file):
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from strom.parallel.mesh import make_mesh
+
+        path, golden = big_file
+        mesh = make_mesh({"dp": 2}, devices=jax.devices()[:2])
+        sharding = NamedSharding(mesh, P("dp"))
+        ctx = StromContext(self._cfg(engine_name))
+        try:
+            arr = ctx.memcpy_ssd2tpu(path, shape=(6 * MiB,), dtype=np.uint8,
+                                     sharding=sharding)
+            np.testing.assert_array_equal(np.asarray(arr), golden[: 6 * MiB])
+            # each device shard (3MiB) exceeded overlap_min -> streamed
+            for s in arr.addressable_shards:
+                assert s.data.shape == (3 * MiB,)
+        finally:
+            ctx.close()
+
+    def test_streamed_offset_and_eof_error(self, engine_name, big_file):
+        from strom.engine.base import EngineError
+
+        path, golden = big_file
+        ctx = StromContext(self._cfg(engine_name))
+        try:
+            arr = ctx.memcpy_ssd2tpu(path, offset=4096, length=4 * MiB)
+            np.testing.assert_array_equal(np.asarray(arr),
+                                          golden[4096: 4096 + 4 * MiB])
+            with pytest.raises(EngineError):
+                ctx.memcpy_ssd2tpu(path, offset=4 * MiB, length=4 * MiB)
+        finally:
+            ctx.close()
+
+    def test_async_streamed(self, engine_name, big_file):
+        path, golden = big_file
+        ctx = StromContext(self._cfg(engine_name))
+        try:
+            h = ctx.memcpy_ssd2tpu(path, length=4 * MiB, async_=True)
+            arr = h.block_until_ready()
+            np.testing.assert_array_equal(np.asarray(arr), golden[: 4 * MiB])
+        finally:
+            ctx.close()
