@@ -1,0 +1,145 @@
+"""Multi-host coordination: epoch barriers, straggler accounting, balanced
+work assignment (SURVEY.md §2.3 "Multi-host coordination (DCN)": "shard→host
+assignment; barrier at epoch boundaries; straggler accounting"; reference
+cite UNVERIFIED — empty mount, SURVEY.md §0. The reference is single-host;
+these duties exist because the TPU rebuild fans out across a pod).
+
+All cross-process communication rides jax's distributed runtime
+(`multihost_utils` over DCN) — no side channel, per the design stance that
+jax's runtime IS the comm backend (SURVEY.md §5 "Distributed comm backend").
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from collections import deque
+from typing import Sequence
+
+
+def process_count() -> int:
+    import jax
+
+    return jax.process_count()
+
+
+def epoch_barrier(name: str) -> None:
+    """Block until every process reaches this point (≙ the epoch-boundary
+    barrier of SURVEY.md §2.3). No-op in single-process runs; the *name*
+    disambiguates concurrent barriers (use e.g. f"epoch-{n}")."""
+    import jax
+
+    if jax.process_count() <= 1:
+        return
+    from jax.experimental import multihost_utils
+
+    multihost_utils.sync_global_devices(name)
+
+
+def assign_balanced(sizes: Sequence[int], n_bins: int) -> list[list[int]]:
+    """Greedy LPT (longest-processing-time-first) assignment of work units to
+    bins: sort by size descending, place each in the currently-lightest bin.
+
+    Deterministic in (sizes, n_bins) — every process computes the same
+    assignment with no coordination, same as the samplers. Replaces
+    round-robin for the Parquet fan-out, where skewed row-group sizes make
+    the heaviest host the critical path (VERDICT.md missing #4); LPT is
+    within 4/3 of optimal makespan.
+
+    Returns n_bins lists of unit indices; each list preserves ascending index
+    order (deterministic iteration within a host).
+    """
+    if n_bins <= 0:
+        raise ValueError("n_bins must be positive")
+    order = sorted(range(len(sizes)), key=lambda i: (-sizes[i], i))
+    loads = [0] * n_bins
+    bins: list[list[int]] = [[] for _ in range(n_bins)]
+    for i in order:
+        b = min(range(n_bins), key=lambda j: (loads[j], j))
+        bins[b].append(i)
+        loads[b] += sizes[i]
+    for b in bins:
+        b.sort()
+    return bins
+
+
+@dataclasses.dataclass(frozen=True)
+class HostStepStats:
+    process_index: int
+    steps: int
+    mean_s: float
+    p99_s: float
+
+
+@dataclasses.dataclass(frozen=True)
+class StragglerReport:
+    hosts: tuple[HostStepStats, ...]
+    median_mean_s: float
+    stragglers: tuple[int, ...]  # process indices slower than threshold×median
+
+    def __str__(self) -> str:
+        rows = ", ".join(f"p{h.process_index}: {h.mean_s * 1e3:.1f}ms"
+                         f"(p99 {h.p99_s * 1e3:.1f})" for h in self.hosts)
+        tail = f"; stragglers: {list(self.stragglers)}" if self.stragglers else ""
+        return f"steps [{rows}]{tail}"
+
+
+class StragglerMonitor:
+    """Per-host step-time skew accounting.
+
+    Each host records its own step durations (`record`, or wrap the loop
+    body with `step()`); `report()` allgathers (mean, p99) across processes
+    and flags hosts whose mean exceeds threshold× the median — the signal
+    that one host's I/O (or its data shard) is the pod's critical path.
+    """
+
+    def __init__(self, window: int = 256):
+        self._times: deque[float] = deque(maxlen=window)
+        self._t0: float | None = None
+
+    # -- recording ----------------------------------------------------------
+    def record(self, seconds: float) -> None:
+        self._times.append(seconds)
+
+    def step(self) -> "StragglerMonitor":
+        return self  # context-manager form: with monitor.step(): <step body>
+
+    def __enter__(self) -> "StragglerMonitor":
+        self._t0 = time.monotonic()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        if self._t0 is not None:
+            self.record(time.monotonic() - self._t0)
+            self._t0 = None
+
+    # -- local stats --------------------------------------------------------
+    def local_stats(self) -> tuple[int, float, float]:
+        """(steps, mean_s, p99_s) of the recorded window."""
+        if not self._times:
+            return 0, 0.0, 0.0
+        ts = sorted(self._times)
+        mean = sum(ts) / len(ts)
+        p99 = ts[min(len(ts) - 1, int(0.99 * len(ts)))]
+        return len(ts), mean, p99
+
+    # -- cross-host report --------------------------------------------------
+    def report(self, threshold: float = 1.25) -> StragglerReport:
+        import jax
+        import numpy as np
+
+        steps, mean, p99 = self.local_stats()
+        local = np.array([float(steps), mean, p99], dtype=np.float64)
+        if jax.process_count() > 1:
+            from jax.experimental import multihost_utils
+
+            rows = np.asarray(multihost_utils.process_allgather(local))
+        else:
+            rows = local[None, :]
+        hosts = tuple(HostStepStats(i, int(r[0]), float(r[1]), float(r[2]))
+                      for i, r in enumerate(rows))
+        means = sorted(h.mean_s for h in hosts)
+        median = means[len(means) // 2]
+        stragglers = tuple(h.process_index for h in hosts
+                           if median > 0 and h.mean_s > threshold * median)
+        return StragglerReport(hosts, median, stragglers)

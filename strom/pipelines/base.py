@@ -11,6 +11,7 @@ nothing and skips nothing.
 from __future__ import annotations
 
 import concurrent.futures
+import time
 from typing import Any, Callable, Iterator
 
 import numpy as np
@@ -30,10 +31,20 @@ class Pipeline:
                  depth: int = 2,
                  fingerprint: dict | None = None,
                  executor: concurrent.futures.Executor | None = None,
-                 on_close: Callable[[], None] | None = None):
+                 on_close: Callable[[], None] | None = None,
+                 epoch_sync: bool = False):
         self.sampler = sampler
         self.fingerprint = fingerprint or {}
         self._on_close = on_close
+        # epoch_sync: barrier every process at epoch boundaries so no host
+        # runs ahead into the next epoch's shuffle while a straggler still
+        # reads the previous one (SURVEY.md §2.3). Costs one DCN round trip
+        # per epoch; off by default for single-host use.
+        self._epoch_sync = epoch_sync
+        from strom.parallel.multihost import StragglerMonitor
+
+        self.monitor = StragglerMonitor()
+        self._last_next: float | None = None
         st = sampler.state
         self._consumed = st.epoch * sampler.batches_per_epoch + st.batch_in_epoch
         self._seed = st.seed
@@ -55,6 +66,16 @@ class Pipeline:
     def __next__(self) -> Any:
         batch = next(self._prefetcher)
         self._consumed += 1
+        # per-host step cadence (consumer compute + any data wait): the raw
+        # input to cross-host straggler accounting
+        now = time.monotonic()
+        if self._last_next is not None:
+            self.monitor.record(now - self._last_next)
+        self._last_next = now
+        if self._epoch_sync and self._consumed % self.sampler.batches_per_epoch == 0:
+            from strom.parallel.multihost import epoch_barrier
+
+            epoch_barrier(f"strom-epoch-{self._consumed // self.sampler.batches_per_epoch}")
         return batch
 
     # -- checkpoint/resume --------------------------------------------------
@@ -80,6 +101,10 @@ class Pipeline:
     @property
     def steps_delivered(self) -> int:
         return self._prefetcher.steps
+
+    def straggler_report(self, threshold: float = 1.25):
+        """Cross-host step-time skew (collective: every process must call)."""
+        return self.monitor.report(threshold)
 
     def close(self) -> None:
         self._prefetcher.close()

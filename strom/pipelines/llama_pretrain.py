@@ -28,7 +28,8 @@ def make_llama_pipeline(ctx: StromContext, paths: Sequence[str], *,
                         seed: int = 0,
                         shuffle: bool = True,
                         prefetch_depth: int | None = None,
-                        resume_from: str | SamplerState | None = None
+                        resume_from: str | SamplerState | None = None,
+                        epoch_sync: bool = False
                         ) -> Pipeline:
     """Infinite stream of token batches [batch, seq_len+1] (inputs+targets
     window), delivered as jax.Arrays with *sharding*.
@@ -50,4 +51,5 @@ def make_llama_pipeline(ctx: StromContext, paths: Sequence[str], *,
                                   sharding=sharding)
 
     depth = prefetch_depth if prefetch_depth is not None else ctx.config.prefetch_depth
-    return Pipeline(sampler, make_batch, depth=depth, fingerprint=fp)
+    return Pipeline(sampler, make_batch, depth=depth, fingerprint=fp,
+                    epoch_sync=epoch_sync)

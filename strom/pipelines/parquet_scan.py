@@ -41,12 +41,16 @@ def parquet_scan_aggregate(ctx: StromContext, paths: Sequence[str],
     globally. Returns the aggregate pytree (host numpy leaves).
 
     Multi-host: every process calls this with the same arguments; units are
-    assigned round-robin by process index (overridable for tests/manual
-    sharding), and the final cross-process reduction rides XLA collectives
-    via process_allgather.
+    assigned by BYTE SIZE (greedy LPT over the selected columns' compressed
+    chunk sizes — deterministic, computed identically on every process with
+    no coordination), so skewed row-group sizes don't make one host the
+    pod's critical path. The final cross-process reduction rides XLA
+    collectives via process_allgather.
     """
     import jax
     import jax.numpy as jnp
+
+    from strom.parallel.multihost import assign_balanced
 
     shards = [ParquetShard(p) for p in paths]
     units = scan_units(shards)
@@ -54,7 +58,9 @@ def parquet_scan_aggregate(ctx: StromContext, paths: Sequence[str],
         raise ValueError("no row groups to scan")
     n_proc = process_count if process_count is not None else jax.process_count()
     idx = process_index if process_index is not None else jax.process_index()
-    local_units = units[idx::n_proc]
+    sizes = [s.column_chunk_extents(g, columns).size for (s, g) in units]
+    bins = assign_balanced(sizes, n_proc)
+    local_units = [units[i] for i in bins[idx]]
     devs = list(devices) if devices is not None else jax.local_devices()
 
     def read_unit(shard: ParquetShard, rg: int) -> dict:

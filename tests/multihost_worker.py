@@ -13,9 +13,10 @@ def main() -> int:
     nproc = int(sys.argv[2])
     port = sys.argv[3]
     data_dir = sys.argv[4]
+    ndev = int(sys.argv[5]) if len(sys.argv) > 5 else 4
 
     os.environ["JAX_PLATFORMS"] = "cpu"
-    os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+    os.environ["XLA_FLAGS"] = f"--xla_force_host_platform_device_count={ndev}"
     import jax
 
     jax.config.update("jax_platforms", "cpu")
@@ -33,7 +34,7 @@ def main() -> int:
     from strom.pipelines import make_llama_pipeline
 
     n_global = len(jax.devices())
-    assert n_global == 4 * nproc, f"expected {4*nproc} global devices, got {n_global}"
+    assert n_global == ndev * nproc, f"expected {ndev*nproc} global devices, got {n_global}"
 
     paths = sorted(os.path.join(data_dir, f) for f in os.listdir(data_dir)
                    if f.endswith(".bin"))
@@ -57,16 +58,16 @@ def main() -> int:
             np.testing.assert_array_equal(np.asarray(shard.data),
                                           golden[lo:hi])
             checked += 1
-        assert checked == 4, checked
+        assert checked == ndev, checked
         print(f"worker {pid}: delivery ok ({checked} local shards)", flush=True)
 
-    # sharded train step across both processes (dp spans processes, tp local)
-    tmesh = make_mesh({"dp": nproc, "tp": 4}, devices=jax.devices())
+    # sharded train step across all processes (dp spans processes, tp local)
+    tmesh = make_mesh({"dp": nproc, "tp": ndev}, devices=jax.devices())
     cfg = LlamaConfig.tiny()
     opt = make_optimizer()
     state = init_train_state(jax.random.PRNGKey(0), cfg, tmesh, opt)
     step = make_train_step(cfg, tmesh, opt)
-    with make_llama_pipeline(ctx, paths, batch=4, seq_len=16,
+    with make_llama_pipeline(ctx, paths, batch=2 * nproc, seq_len=16,
                              sharding=NamedSharding(tmesh, P("dp", None)),
                              seed=3) as pipe:
         for _ in range(2):
@@ -75,6 +76,20 @@ def main() -> int:
     assert np.isfinite(loss)
     assert int(state.step) == 2
     print(f"worker {pid}: train ok loss={loss:.6f}", flush=True)
+
+    # epoch barrier + straggler accounting (SURVEY.md §2.3): consume one
+    # full epoch with epoch_sync=True (barrier is collective — a hang here
+    # fails the test by timeout), then a collective skew report
+    with make_llama_pipeline(ctx, paths, batch=2 * nproc, seq_len=16,
+                             sharding=NamedSharding(tmesh, P("dp", None)),
+                             seed=5, epoch_sync=True) as pipe:
+        bpe = pipe.sampler.batches_per_epoch
+        for _ in range(bpe + 1):  # crosses the epoch-0 boundary barrier
+            next(pipe)
+        rep = pipe.straggler_report()
+    assert len(rep.hosts) == nproc, rep
+    assert all(h.steps > 0 for h in rep.hosts), rep
+    print(f"worker {pid}: coordination ok ({rep})", flush=True)
     ctx.close()
     return 0
 
