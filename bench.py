@@ -19,6 +19,13 @@ network relay; see BASELINE.md §C):
                   achievable pipeline rate the software actually delivers —
                   on hardware whose host->device link is slower than the SSD,
                   vs_baseline is capped by the link, not by this framework
+  link_busy_frac  fraction of the delivered transfer's wall clock the
+                  host->HBM link was busy (instrumented inside the streamed
+                  delivery) — the weather-independent software metric: this
+                  box's relay link is token-bucket throttled and its capacity
+                  swings >50x run-to-run (BASELINE.md §C), so absolute GB/s
+                  and vs_baseline measure the weather, busy-fraction measures
+                  the framework
   loader_tokens_per_s, train_tokens_per_s, train_data_stalls
                   Llama packed-token pipeline on the real device (config #4
                   shape): flat-out loader rate, then the same loader feeding
@@ -129,32 +136,36 @@ def main() -> int:
     # best-of-2, same methodology as round 1's bench (the transfer relay on
     # this box content-caches, so a repeat pass can run warmer — taking the
     # max matches the r1 artifact this round is compared against)
+    from strom.utils.stats import global_stats
     s2t_gbps = 0.0
+    busy_frac = 0.0
+    link_gbps = 0.0
     for _ in range(2):
         _drop_cache_hint(path)
+        snap0 = global_stats.snapshot()
         t0 = time.perf_counter()
         arr = ctx.memcpy_ssd2tpu(path, length=size, device=dev)
         arr.block_until_ready()
         dt = time.perf_counter() - t0
-        s2t_gbps = max(s2t_gbps, size / dt / 1e9)
+        snap1 = global_stats.snapshot()
+        busy_s = (snap1.get("device_put_busy_us", 0)
+                  - snap0.get("device_put_busy_us", 0)) / 1e6
+        wall_s = (snap1.get("stream_wall_us", 0)
+                  - snap0.get("stream_wall_us", 0)) / 1e6
+        gbps = size / dt / 1e9
+        if gbps > s2t_gbps:
+            s2t_gbps = gbps
+            # link ceiling observed DURING this same pass: bytes / time the
+            # host->HBM link was actually busy. A separate post-run probe
+            # would measure a different throttle state of the shared relay
+            # (BASELINE.md §C) and make vs_link incoherent.
+            busy_frac = busy_s / wall_s if wall_s else 0.0
+            link_gbps = size / busy_s / 1e9 if busy_s else 0.0
         del arr
-    print(f"ssd2tpu delivered: {s2t_gbps:.3f} GB/s", file=sys.stderr)
-
-    # --- link ceiling: device_put alone from a warm slab (no disk I/O).
-    # Content = real file bytes: constant-fill would measure the relay's
-    # compressor, not the link.
-    probe_bytes = min(args.chunk, size)
-    probe = alloc_aligned(probe_bytes)
-    with open(path, "rb") as f:
-        probe[:] = np.frombuffer(f.read(probe_bytes), dtype=np.uint8)
-    jax.device_put(probe[: 1 << 20], dev).block_until_ready()
-    t0 = time.perf_counter()
-    reps = 2
-    for _ in range(reps):
-        jax.device_put(probe, dev).block_until_ready()
-    link_gbps = reps * probe_bytes / (time.perf_counter() - t0) / 1e9
     ctx.close()
-    print(f"host->HBM link ceiling: {link_gbps:.3f} GB/s", file=sys.stderr)
+    print(f"ssd2tpu delivered: {s2t_gbps:.3f} GB/s (host->HBM link busy "
+          f"{busy_frac:.1%} of the transfer, effective link "
+          f"{link_gbps:.3f} GB/s)", file=sys.stderr)
 
     out = {
         "metric": "ssd2hbm_bandwidth",
@@ -162,9 +173,18 @@ def main() -> int:
         "unit": "GB/s",
         "vs_baseline": round(s2t_gbps / raw_gbps, 4) if raw_gbps else 0.0,
         "raw_gbps": round(raw_gbps, 4),
-        "link_gbps": round(link_gbps, 4),
+        # null (not 0.0) when the transfer didn't take the streamed path
+        # (size < overlap_min_bytes): 0.0 would read as "link idle the whole
+        # transfer", the opposite of "not measured"
+        "link_gbps": round(link_gbps, 4) if link_gbps else None,
         "vs_link": round(s2t_gbps / min(raw_gbps, link_gbps), 4)
-        if raw_gbps and link_gbps else 0.0,
+        if raw_gbps and link_gbps else None,
+        # fraction of the delivered transfer's wall clock the host->HBM link
+        # was busy: the weather-independent software metric on a box whose
+        # relay link is token-bucket throttled (burst ~0.5-1 GiB at ~1 GB/s,
+        # then ~0.2 GB/s refill, measured 2026-07-30) — absolute GB/s and
+        # vs_baseline swing >50x run-to-run with relay congestion
+        "link_busy_frac": round(busy_frac, 4) if busy_frac else None,
     }
     out.update(loader_res)
 

@@ -63,6 +63,9 @@ class StromConfig:
     slab_pool_bytes: int = 512 * MiB   # recycled host slabs (0 = off); only
                                        # used on backends where device_put
                                        # copies (i.e. not the jax CPU backend)
+    slab_mlock_bytes: int = 0          # mlock recycled slabs up to this many
+                                       # bytes (0 = never pin pool slabs);
+                                       # past the cap slabs stay unpinned
     # intra-transfer streaming: overlap disk reads of chunk k+1 with the
     # host->HBM transfer of chunk k (double-buffered slab ring) for transfers
     # >= overlap_min_bytes. 0 disables streaming.

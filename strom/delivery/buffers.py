@@ -12,6 +12,7 @@ from __future__ import annotations
 import ctypes
 import mmap
 import threading
+import weakref
 
 import numpy as np
 
@@ -19,6 +20,12 @@ _libc = ctypes.CDLL(None, use_errno=True)
 
 PAGE = mmap.PAGESIZE
 _MAP_POPULATE = getattr(mmap, "MAP_POPULATE", 0x8000)
+
+
+def _mlock_mm(mm: mmap.mmap) -> bool:
+    """mlock an anonymous mapping. True on success (RLIMIT_MEMLOCK may say no)."""
+    addr = ctypes.addressof(ctypes.c_char.from_buffer(mm))
+    return _libc.mlock(ctypes.c_void_p(addr), ctypes.c_size_t(len(mm))) == 0
 
 
 def alloc_aligned(nbytes: int, *, pin: bool = False, populate: bool = False,
@@ -44,16 +51,40 @@ def alloc_aligned(nbytes: int, *, pin: bool = False, populate: bool = False,
     except (ValueError, OSError):
         mm = mmap.mmap(-1, padded)  # kernel without MAP_POPULATE
     if pin:
-        addr = ctypes.addressof(ctypes.c_char.from_buffer(mm))
-        _libc.mlock(ctypes.c_void_p(addr), ctypes.c_size_t(padded))  # best effort
+        _mlock_mm(mm)  # best effort
     arr = np.frombuffer(mm, dtype=np.uint8)[:nbytes]
     if dtype is not np.uint8:
         arr = arr.view(dtype)
     return arr
 
 
+def size_class(nbytes: int) -> int:
+    """Round a request up to its allocation size class.
+
+    Classes are quarter-power-of-two steps (4KiB, ..., 1MiB, 1.25MiB, 1.5MiB,
+    1.75MiB, 2MiB, 2.5MiB, ...): worst-case internal waste is 25%, and every
+    class is a page multiple. Quantizing means workloads with varying batch
+    geometry land on a handful of classes and recycle slabs, where exact-size
+    buckets degenerate to 100% misses + MAP_POPULATE faulting per transfer
+    (VERDICT.md weak #7).
+    """
+    n = max(int(nbytes), PAGE)
+    p = 1 << (n.bit_length() - 1)          # largest pow2 <= n
+    step = max(p // 4, PAGE)
+    return (n + step - 1) // step * step
+
+
 class SlabPool:
     """Recycles aligned slabs so steady-state transfers fault no pages.
+
+    Slabs are allocated at size-class granularity (see :func:`size_class`) and
+    acquire() hands out a view of the first ``nbytes``; release() walks the
+    view's ``.base`` chain back to the class-sized slab, so mixed-size
+    workloads recycle instead of missing on every distinct size.
+
+    Optionally mlocks slabs up to ``max_mlock_bytes`` (pinned pages keep the
+    host side of the HBM transfer from faulting mid-DMA); past the cap slabs
+    stay unpinned rather than failing.
 
     The recycle contract is the same lifetime handshake the reference does
     with P2P page refcounts + free callbacks (SURVEY.md §7.4 hard part #3):
@@ -63,34 +94,72 @@ class SlabPool:
     host memory (jax CPU) instead of copying.
     """
 
-    def __init__(self, max_bytes: int = 512 * 1024 * 1024):
+    def __init__(self, max_bytes: int = 512 * 1024 * 1024, *,
+                 pin: bool = False, max_mlock_bytes: int = 0):
         self.max_bytes = max_bytes
-        self._free: dict[int, list[np.ndarray]] = {}
+        self.pin = pin
+        self.max_mlock_bytes = max_mlock_bytes
+        self._free: dict[int, list[np.ndarray]] = {}  # class size -> base arrays
         self._cached_bytes = 0
         self._lock = threading.Lock()
+        self.mlocked_bytes = 0
         self.hits = 0
         self.misses = 0
 
-    def acquire(self, nbytes: int) -> np.ndarray:
+    @staticmethod
+    def _base(arr: np.ndarray) -> np.ndarray:
+        while isinstance(arr.base, np.ndarray):
+            arr = arr.base
+        return arr
+
+    def _unpin(self, n: int) -> None:
+        # weakref.finalize callback: the mmap was destroyed (munmap munlocks)
         with self._lock:
-            bucket = self._free.get(nbytes)
+            self.mlocked_bytes -= n
+
+    def acquire(self, nbytes: int) -> np.ndarray:
+        cls = size_class(nbytes)
+        with self._lock:
+            bucket = self._free.get(cls)
             if bucket:
                 self.hits += 1
-                self._cached_bytes -= nbytes
-                return bucket.pop()
+                self._cached_bytes -= cls
+                return bucket.pop()[:nbytes]
             self.misses += 1
-        return alloc_aligned(nbytes, populate=True)
+            # reserve under the lock: concurrent misses (prefetch workers +
+            # the stream reader share one pool) must not both pass a
+            # check-then-act cap test and pin past max_mlock_bytes
+            reserve = self.pin and \
+                self.mlocked_bytes + cls <= self.max_mlock_bytes
+            if reserve:
+                self.mlocked_bytes += cls
+        base = self._base(alloc_aligned(cls, populate=True))
+        if reserve:
+            mm = base.base
+            if isinstance(mm, mmap.mmap) and _mlock_mm(mm):
+                # exactly-once release of the reservation, tied to the mmap's
+                # own lifetime: slabs that are dropped, leaked by a failing
+                # caller, or GC'd all reach munmap, which munlocks
+                weakref.finalize(mm, self._unpin, cls)
+            else:
+                with self._lock:
+                    self.mlocked_bytes -= cls
+        return base[:nbytes]
 
     def release(self, arr: np.ndarray) -> None:
-        nbytes = arr.nbytes
+        base = self._base(arr)
+        cls = base.nbytes
         with self._lock:
-            if self._cached_bytes + nbytes > self.max_bytes:
-                return  # let it drop; GC unmaps
-            self._free.setdefault(nbytes, []).append(arr)
-            self._cached_bytes += nbytes
+            if self._cached_bytes + cls > self.max_bytes:
+                return  # let it drop; GC unmaps (finalizer settles mlock)
+            self._free.setdefault(cls, []).append(base)
+            self._cached_bytes += cls
 
     def stats(self) -> dict:
         with self._lock:
-            return {"cached_bytes": self._cached_bytes, "hits": self.hits,
+            return {"cached_bytes": self._cached_bytes,
+                    "mlocked_bytes": self.mlocked_bytes,
+                    "mlock_cap_bytes": self.max_mlock_bytes,
+                    "hits": self.hits,
                     "misses": self.misses,
                     "buckets": {k: len(v) for k, v in self._free.items()}}

@@ -21,6 +21,7 @@ import math
 import os
 import queue
 import threading
+import time
 from typing import Any, Sequence
 
 import numpy as np
@@ -167,7 +168,10 @@ class StromContext:
         # process-lifetime unique tags: stale completions from a failed
         # transfer can never alias a later transfer's ops
         self._tag_counter = 0
-        self._slab_pool = SlabPool(self.config.slab_pool_bytes) \
+        self._slab_pool = SlabPool(
+            self.config.slab_pool_bytes,
+            pin=self.config.slab_mlock_bytes > 0,
+            max_mlock_bytes=self.config.slab_mlock_bytes) \
             if self.config.slab_pool_bytes > 0 else None
         # one host->HBM stream at a time (see StromConfig.serialize_device_put)
         self._put_lock = threading.Lock() if self.config.serialize_device_put \
@@ -276,6 +280,8 @@ class StromContext:
         # where accumulating pieces + concatenating would peak at ~2x nbytes.
         bufs = [_alloc_on_device(n_elems, np_dtype, d) for d in devices]
         elem_off = 0
+        wall_t0 = time.perf_counter()
+        put_busy = 0.0
         try:
             while True:
                 item = ready.get()
@@ -286,6 +292,7 @@ class StromContext:
                 with self._put_lock, \
                         trace_span("strom.device_put",
                                    enabled=self.config.trace_annotations):
+                    put_t0 = time.perf_counter()
                     for i, d in enumerate(devices):
                         piece = jax.device_put(arr_host, d)
                         bufs[i] = _paste(bufs[i], piece, elem_off)
@@ -293,6 +300,7 @@ class StromContext:
                     # retires, and the read of the NEXT piece overlaps this
                     for b in bufs:
                         b.block_until_ready()
+                    put_busy += time.perf_counter() - put_t0
                 elem_off += arr_host.shape[0]
                 if pool is not None:
                     pool.release(slab)
@@ -305,6 +313,14 @@ class StromContext:
             t.join()
         if fail:
             raise fail[0]
+        # Overlap-quality counters: on a link-bound box, busy/wall ≈ 1.0 means
+        # the software kept the host->HBM link saturated the whole transfer —
+        # a weather-independent measure where absolute GB/s is hostage to the
+        # (shared, token-bucket-throttled) transfer relay (BASELINE.md §C).
+        global_stats.add("device_put_busy_us",
+                         int(put_busy * 1e6))
+        global_stats.add("stream_wall_us",
+                         int((time.perf_counter() - wall_t0) * 1e6))
         return [_reshape_donated(b, tuple(local_shape)) for b in bufs]
 
     # -- the public hot path -------------------------------------------------

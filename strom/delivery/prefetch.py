@@ -48,13 +48,24 @@ class Prefetcher(Generic[T]):
         self._fill()
 
     def _fill(self) -> None:
-        with self._lock:
-            while len(self._queue) < self._depth and not self._exhausted:
-                try:
-                    thunk = next(self._thunks)
-                except StopIteration:
+        # next(thunks) runs OUTSIDE the lock: thunk generators may block
+        # (e.g. the pipeline's epoch_sync DCN barrier sits at the epoch
+        # boundary of the generator), and blocking under the lock would hang
+        # any concurrent close(). Single-consumer discipline is assumed, as
+        # everywhere else in this class.
+        while True:
+            with self._lock:
+                if len(self._queue) >= self._depth or self._exhausted:
+                    return
+            try:
+                thunk = next(self._thunks)
+            except StopIteration:
+                with self._lock:
                     self._exhausted = True
-                    break
+                return
+            with self._lock:
+                if self._exhausted:  # close() raced the pull: drop, don't submit
+                    return
                 self._queue.append(self._executor.submit(thunk))
 
     def __iter__(self) -> Iterator[T]:

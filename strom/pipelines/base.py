@@ -37,9 +37,13 @@ class Pipeline:
         self.fingerprint = fingerprint or {}
         self._on_close = on_close
         # epoch_sync: barrier every process at epoch boundaries so no host
-        # runs ahead into the next epoch's shuffle while a straggler still
-        # reads the previous one (SURVEY.md §2.3). Costs one DCN round trip
-        # per epoch; off by default for single-host use.
+        # issues next-epoch reads while a straggler is still dispatching the
+        # previous epoch's (SURVEY.md §2.3). The barrier sits in the thunk
+        # generator — the point where the prefetcher would dispatch the first
+        # batch of a new epoch — NOT in __next__: the sampler runs ahead of
+        # consumption by the prefetch depth, so a consumer-side barrier would
+        # fire after next-epoch I/O was already in flight. Costs one DCN
+        # round trip per epoch; off by default for single-host use.
         self._epoch_sync = epoch_sync
         from strom.parallel.multihost import StragglerMonitor
 
@@ -49,11 +53,18 @@ class Pipeline:
         self._consumed = st.epoch * sampler.batches_per_epoch + st.batch_in_epoch
         self._seed = st.seed
 
+        start = self._consumed
+        bpe = sampler.batches_per_epoch
+
         def thunks() -> Iterator[Callable[[], Any]]:
             # make_batch gets (indices, serial): serial is the global batch
             # number, stable across resume — deterministic augmentation keys
-            serial = self._consumed
+            serial = start
             for indices in sampler:
+                if self._epoch_sync and serial % bpe == 0 and serial != start:
+                    from strom.parallel.multihost import epoch_barrier
+
+                    epoch_barrier(f"strom-epoch-{serial // bpe}")
                 yield lambda idx=indices, s=serial: make_batch(idx, s)
                 serial += 1
 
@@ -72,10 +83,6 @@ class Pipeline:
         if self._last_next is not None:
             self.monitor.record(now - self._last_next)
         self._last_next = now
-        if self._epoch_sync and self._consumed % self.sampler.batches_per_epoch == 0:
-            from strom.parallel.multihost import epoch_barrier
-
-            epoch_barrier(f"strom-epoch-{self._consumed // self.sampler.batches_per_epoch}")
         return batch
 
     # -- checkpoint/resume --------------------------------------------------

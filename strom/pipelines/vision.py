@@ -32,21 +32,37 @@ def default_train_transform(size: int) -> Transform:
     return tf
 
 
+def _validate_batch_only(sharding: Any, rank: int = 4) -> None:
+    """Image pipelines shard the batch dim only: reject specs that split
+    H/W/C at construction with a clear error, instead of failing later
+    inside make_array_from_single_device_arrays with an opaque shape
+    mismatch (VERDICT.md weak #4)."""
+    spec = tuple(sharding.spec) + (None,) * (rank - len(sharding.spec))
+    split_inner = [i for i, s in enumerate(spec[1:], start=1) if s is not None]
+    if split_inner:
+        raise ValueError(
+            "vision pipelines deliver batch-dim-sharded images only: "
+            f"PartitionSpec {tuple(sharding.spec)} shards inner dim(s) "
+            f"{split_inner} (H/W/C must be None/replicated)")
+
+
 def _local_batch_rows(sharding: Any, batch: int) -> dict:
-    """device -> (row_lo, row_hi) of the global batch this host must feed."""
-    # probe with a rank-1 view: only the batch dim's split matters
-    idx_map = sharding.addressable_devices_indices_map((batch,) + tuple(
-        1 for _ in range(_sharding_ndim(sharding) - 1)))
+    """device -> (row_lo, row_hi) of the global batch this host must feed.
+
+    Only valid for batch-dim-only shardings (enforced by
+    :func:`_validate_batch_only`); the probe collapses the sharding to its
+    batch axis, so each device's index is a contiguous row range."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    spec0 = sharding.spec[0] if len(sharding.spec) else None
+    probe = NamedSharding(sharding.mesh, P(spec0))
+    idx_map = probe.addressable_devices_indices_map((batch,))
     out = {}
     for device, index in idx_map.items():
         sl = index[0] if index else slice(None)
         lo, hi, _ = sl.indices(batch)
         out[device] = (lo, hi)
     return out
-
-
-def _sharding_ndim(sharding: Any) -> int:
-    return len(sharding.spec)
 
 
 def make_wds_vision_pipeline(ctx: StromContext, paths: Sequence[str], *,
@@ -75,8 +91,9 @@ def make_wds_vision_pipeline(ctx: StromContext, paths: Sequence[str], *,
     if not isinstance(sharding, NamedSharding):
         raise TypeError("vision pipelines need a NamedSharding (labels derive "
                         "their spec from its batch axis)")
-    if len(sharding.spec) != 4:
-        raise ValueError("sharding.spec must be rank 4 (B, H, W, C)")
+    if len(sharding.spec) > 4:
+        raise ValueError("sharding.spec must have rank <= 4 (B, H, W, C)")
+    _validate_batch_only(sharding)
     ss = WdsShardSet(paths)
     if len(ss) < batch:
         raise ValueError(f"dataset has {len(ss)} samples < batch {batch}")
@@ -85,7 +102,9 @@ def make_wds_vision_pipeline(ctx: StromContext, paths: Sequence[str], *,
                                   state=state)
     tf = transform or default_train_transform(image_size)
     pool = DecodePool(decode_workers)
-    label_sharding = NamedSharding(sharding.mesh, P(sharding.spec[0]))
+    label_sharding = NamedSharding(
+        sharding.mesh,
+        P(sharding.spec[0] if len(sharding.spec) else None))
     global_shape = (batch, image_size, image_size, 3)
     rows_by_device = _local_batch_rows(sharding, batch)
     # the union of rows this host decodes, and each device's slice into it

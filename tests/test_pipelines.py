@@ -169,6 +169,33 @@ class TestLlamaPipeline:
         assert int(state.step) == 2
 
 
+class TestEpochSync:
+    def test_barrier_gates_dispatch(self, monkeypatch):
+        """The epoch barrier must fire BEFORE the first next-epoch batch is
+        dispatched (the sampler runs ahead of consumption, so a consumer-side
+        barrier would let next-epoch I/O start early)."""
+        import strom.parallel.multihost as mh
+        from strom.pipelines.base import Pipeline
+
+        events = []
+        monkeypatch.setattr(mh, "epoch_barrier",
+                            lambda name: events.append(("barrier", name)))
+        sampler = EpochShuffleSampler(8, 4, seed=0)  # 2 batches/epoch
+
+        def make_batch(idx, serial):
+            events.append(("batch", serial))
+            return serial
+
+        pipe = Pipeline(sampler, make_batch, depth=1, epoch_sync=True)
+        assert [next(pipe) for _ in range(4)] == [0, 1, 2, 3]
+        pipe.close()
+        # the epoch-1 barrier is appended on the consumer thread before the
+        # serial-2 thunk is even submitted to the executor
+        bi = events.index(("barrier", "strom-epoch-1"))
+        b2 = events.index(("batch", 2))
+        assert bi < b2, events
+
+
 # --------------------------------------------------------- vision pipeline
 class TestVisionPipeline:
     @pytest.fixture(scope="class")
@@ -224,6 +251,50 @@ class TestVisionPipeline:
                                        decode_workers=2) as pipe:
                 outs.append(np.asarray(next(pipe)[0]))
         np.testing.assert_array_equal(outs[0], outs[1])
+
+    def test_rejects_inner_dim_sharding(self, ctx, mesh, wds_shards):
+        """VERDICT.md weak #4: splitting H/W/C must fail fast at construction
+        with a message naming the constraint, not opaquely inside
+        make_array_from_single_device_arrays."""
+        from strom.parallel.mesh import make_mesh
+        from strom.pipelines import make_imagenet_resnet_pipeline
+
+        paths, _ = wds_shards
+        m2 = make_mesh({"dp": 4, "mp": 2}, devices=jax.devices()[:8])
+        for bad in (P("dp", None, "mp", None), P("dp", "mp"),
+                    P(None, None, None, "mp")):
+            with pytest.raises(ValueError, match="batch-dim"):
+                make_imagenet_resnet_pipeline(
+                    ctx, paths, batch=8, image_size=32,
+                    sharding=NamedSharding(m2, bad), decode_workers=2)
+
+    def test_local_batch_rows_matches_indices_map(self, mesh):
+        """Property: for every legal batch-only 4-D sharding, the row ranges
+        the loader decodes equal what addressable_devices_indices_map says
+        each device owns of the REAL global shape."""
+        from strom.parallel.mesh import make_mesh
+        from strom.pipelines.vision import (_local_batch_rows,
+                                            _validate_batch_only)
+
+        m2 = make_mesh({"dp": 4, "mp": 2}, devices=jax.devices()[:8])
+        cases = [
+            (mesh, P("dp", None, None, None), 16),
+            (mesh, P("dp",), 8),                 # short spec, trailing None
+            (mesh, P(None, None, None, None), 4),  # fully replicated
+            (m2, P("dp", None, None, None), 8),  # mp axis replicates rows
+            (m2, P(("dp", "mp"), None, None, None), 16),  # product sharding
+        ]
+        for m, spec, batch in cases:
+            sharding = NamedSharding(m, spec)
+            _validate_batch_only(sharding)
+            got = _local_batch_rows(sharding, batch)
+            shape = (batch, 32, 32, 3)
+            expect = sharding.addressable_devices_indices_map(shape)
+            assert set(got) == set(expect)
+            for device, index in expect.items():
+                sl = index[0] if index else slice(None)
+                lo, hi, _ = sl.indices(batch)
+                assert got[device] == (lo, hi), (spec, batch, device)
 
     def test_feeds_resnet_step(self, ctx, mesh, wds_shards):
         from strom.models.resnet import ResNetConfig, init_params, loss_fn

@@ -3,10 +3,68 @@ with a strict release-after-transfer lifetime contract."""
 
 import numpy as np
 
-from strom.delivery.buffers import SlabPool, alloc_aligned
+from strom.delivery.buffers import PAGE, SlabPool, alloc_aligned, size_class
+
+
+class TestSizeClass:
+    def test_basic_properties(self):
+        for n in (1, 100, PAGE, PAGE + 1, 128 << 10, (128 << 10) + 7,
+                  1 << 20, (1 << 20) + 1, 777_777_777):
+            c = size_class(n)
+            assert c >= max(n, PAGE)          # never smaller than the request
+            assert c % PAGE == 0              # always a page multiple
+            if n >= 4 * PAGE:
+                assert c <= n * 1.25          # <= 25% internal waste
+            else:
+                assert c <= 2 * max(n, PAGE)  # tiny sizes: page-pow2 steps
+
+    def test_pow2_is_identity(self):
+        for shift in (12, 17, 20, 27, 30):
+            assert size_class(1 << shift) == 1 << shift
+
+    def test_quantizes_nearby_sizes(self):
+        # sizes within a quarter-step collapse to one class → recycling works
+        assert size_class((1 << 20) + 1) == size_class((1 << 20) + (1 << 18))
 
 
 class TestSlabPool:
+    def test_mixed_sizes_recycle(self):
+        """VERDICT.md weak #7: exact-match buckets degenerate to 100% misses
+        on mixed sizes; size classes must keep the hit rate high."""
+        rng = np.random.default_rng(0)
+        pool = SlabPool(max_bytes=1 << 30)
+        # variable batch geometry: sizes jitter ±12% around a few bases
+        bases = [256 << 10, 1 << 20, 3 << 20]
+        for _ in range(200):
+            base = bases[rng.integers(len(bases))]
+            n = int(base * (1 + rng.uniform(-0.12, 0.12)))
+            s = pool.acquire(n)
+            assert s.nbytes == n
+            pool.release(s)
+        st = pool.stats()
+        hit_rate = st["hits"] / (st["hits"] + st["misses"])
+        assert hit_rate > 0.9, st
+
+    def test_view_release_returns_full_class(self):
+        pool = SlabPool(max_bytes=1 << 30)
+        a = pool.acquire(5000)  # class 8192
+        assert a.nbytes == 5000
+        pool.release(a)
+        st = pool.stats()
+        assert st["cached_bytes"] == size_class(5000)
+        b = pool.acquire(6000)  # same class → recycled
+        assert b.nbytes == 6000 and pool.hits == 1
+
+    def test_mlock_cap(self):
+        pool = SlabPool(max_bytes=1 << 30, pin=True, max_mlock_bytes=64 << 10)
+        slabs = [pool.acquire(32 << 10) for _ in range(4)]
+        st = pool.stats()
+        # best-effort: never exceeds the cap (may be 0 if RLIMIT_MEMLOCK tiny)
+        assert st["mlocked_bytes"] <= 64 << 10
+        assert st["mlock_cap_bytes"] == 64 << 10
+        for s in slabs:
+            pool.release(s)
+        assert pool.stats()["mlocked_bytes"] <= 64 << 10
     def test_acquire_release_recycles(self):
         pool = SlabPool(max_bytes=1 << 20)
         a = pool.acquire(4096)
