@@ -60,12 +60,21 @@ def bench_nvme(args: argparse.Namespace) -> dict:
     size = min(os.path.getsize(path), args.size) // args.block * args.block
     cfg = StromConfig(engine=args.engine, block_size=args.block,
                       queue_depth=args.depth, num_buffers=max(args.depth * 2, 8))
+    numa_node = getattr(args, "numa_node", -1)
+    na = None
+    if numa_node >= 0:
+        from strom.utils.numa import NumaAffinity
+
+        na = NumaAffinity(node=numa_node)
+        na.ensure_thread(path)
     results = []
     for it in range(args.iters):
         _drop_cache_hint(path)
         eng = make_engine(cfg)
         fi = eng.register_file(path, o_direct=not args.buffered)
         dest = alloc_aligned(size)
+        if na is not None:
+            na.bind(dest)
         t0 = time.perf_counter()
         if getattr(args, "per_op", False):
             # legacy shape: one submit+wait ctypes round trip per block
@@ -89,6 +98,7 @@ def bench_nvme(args: argparse.Namespace) -> dict:
         "depth": args.depth, "bytes": size, "engine": cfg.engine,
         "o_direct": not args.buffered, "iters": args.iters,
         "per_op": bool(getattr(args, "per_op", False)),
+        "numa_node": numa_node,
         "file_created": created,
     }
     return out
@@ -318,6 +328,9 @@ def main(argv: list[str] | None = None) -> int:
     common(p_nvme)
     p_nvme.add_argument("--buffered", action="store_true",
                         help="use the page-cache path instead of O_DIRECT")
+    p_nvme.add_argument("--numa-node", type=int, default=-1, dest="numa_node",
+                        help="pin the submit thread + mbind the dest slab to "
+                             "this NUMA node (A/B the affinity knob; -1 = off)")
     p_nvme.add_argument("--per-op", action="store_true", dest="per_op",
                         help="legacy per-block submit/wait loop instead of the "
                              "native vectored gather")

@@ -77,6 +77,18 @@ class StromConfig:
     # serialized stream still saturates the DMA engine)
     serialize_device_put: bool = True
 
+    # NUMA affinity (multi-socket hosts): pin submitting threads to the NVMe's
+    # home node, mbind staging slabs there, optionally steer the device IRQs
+    # (needs root). Off by default; no-op on UMA boxes (strom/utils/numa.py).
+    numa_affinity: bool = False
+    numa_node: int = -1                # -1 = auto-discover from the device
+    irq_affinity: bool = False
+
+    # extent-aware gather planning: split chunks at FIEMAP extent boundaries
+    # and submit in physical-address order (helps fragmented files; no-op on
+    # contiguous ones). FIEMAP is probed once per registered file and cached.
+    extent_aware: bool = True
+
     # RAID0 (software striped reader over N member files/devices)
     raid_chunk: int = 512 * KiB
 

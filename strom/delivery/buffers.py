@@ -95,10 +95,14 @@ class SlabPool:
     """
 
     def __init__(self, max_bytes: int = 512 * 1024 * 1024, *,
-                 pin: bool = False, max_mlock_bytes: int = 0):
+                 pin: bool = False, max_mlock_bytes: int = 0,
+                 on_alloc=None):
         self.max_bytes = max_bytes
         self.pin = pin
         self.max_mlock_bytes = max_mlock_bytes
+        # called once per FRESH slab (recycled slabs keep their placement):
+        # delivery hooks NUMA mbind here
+        self.on_alloc = on_alloc
         self._free: dict[int, list[np.ndarray]] = {}  # class size -> base arrays
         self._cached_bytes = 0
         self._lock = threading.Lock()
@@ -144,6 +148,8 @@ class SlabPool:
             else:
                 with self._lock:
                     self.mlocked_bytes -= cls
+        if self.on_alloc is not None:
+            self.on_alloc(base)
         return base[:nbytes]
 
     def release(self, arr: np.ndarray) -> None:

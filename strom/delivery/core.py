@@ -158,6 +158,9 @@ class StromContext:
         self.config = config or StromConfig.from_env()
         self.engine = engine or make_engine(self.config)
         self._files: dict[str, int] = {}
+        # FIEMAP extent map per registered file: list[Extent] when mapped,
+        # None when the fs can't say (tmpfs, old kernels) — probed once
+        self._extent_maps: dict[str, list | None] = {}
         self._files_lock = threading.Lock()
         self._executor = concurrent.futures.ThreadPoolExecutor(
             max_workers=max(2, self.config.delivery_workers),
@@ -168,10 +171,18 @@ class StromContext:
         # process-lifetime unique tags: stale completions from a failed
         # transfer can never alias a later transfer's ops
         self._tag_counter = 0
+        if self.config.numa_affinity:
+            from strom.utils.numa import NumaAffinity
+
+            self._numa = NumaAffinity(node=self.config.numa_node,
+                                      steer_irqs=self.config.irq_affinity)
+        else:
+            self._numa = None
         self._slab_pool = SlabPool(
             self.config.slab_pool_bytes,
             pin=self.config.slab_mlock_bytes > 0,
-            max_mlock_bytes=self.config.slab_mlock_bytes) \
+            max_mlock_bytes=self.config.slab_mlock_bytes,
+            on_alloc=self._numa.bind if self._numa else None) \
             if self.config.slab_pool_bytes > 0 else None
         # one host->HBM stream at a time (see StromConfig.serialize_device_put)
         self._put_lock = threading.Lock() if self.config.serialize_device_put \
@@ -187,6 +198,32 @@ class StromContext:
                 self._files[path] = idx
             return idx
 
+    @staticmethod
+    def _numa_path(source: "Source") -> str | None:
+        """A representative file path for NUMA node discovery."""
+        if isinstance(source, str):
+            return source
+        if isinstance(source, StripedFile):
+            return source.members[0]
+        if isinstance(source, ExtentList) and len(source):
+            return source.extents[0].path
+        return None
+
+    def extent_map(self, path: str) -> list | None:
+        """Cached FIEMAP extent map for *path* (None: unavailable)."""
+        with self._files_lock:
+            if path in self._extent_maps:
+                return self._extent_maps[path]
+        from strom.probe.fiemap import fiemap
+
+        try:
+            em = fiemap(path)
+        except OSError:
+            em = None
+        with self._files_lock:
+            self._extent_maps[path] = em
+        return em
+
     # -- raw range read into a fresh aligned slab ---------------------------
     def _read_segments(self, source: "Source",
                        segments: Sequence[Segment], dest: np.ndarray,
@@ -195,6 +232,10 @@ class StromContext:
         block_size, pipelined at queue_depth. Returns total bytes read.
         Raises EngineError on any failed or short chunk."""
         cfg = self.config
+        if self._numa is not None:
+            # pin THIS thread (the engine submit path runs on it) to the
+            # device's home node; once per thread, resolved from the source
+            self._numa.ensure_thread(self._numa_path(source))
         # Expand logical segments to physical (file_index, offset) chunks.
         chunks: list[tuple[int, int, int, int]] = []  # (file_idx, file_off, dest_off, len)
         if isinstance(source, StripedFile):
@@ -214,6 +255,12 @@ class StromContext:
             fi = self.file_index(source)
             chunks = [(fi, base_offset + s.file_offset, s.dest_offset, s.length)
                       for s in segments]
+            if cfg.extent_aware:
+                em = self.extent_map(source)
+                if em:
+                    from strom.delivery.chunk_plan import plan_chunks
+
+                    chunks = plan_chunks(chunks, em)
 
         # The engine executes the whole gather (block_size chunking, queue
         # -depth pipelining, per-chunk retry, EOF topup): ONE boundary
@@ -263,8 +310,12 @@ class StromContext:
         def reader() -> None:
             try:
                 for idx, (_, piece_len, piece_segs) in enumerate(pieces):
-                    slab = pool.acquire(piece_len) if pool is not None \
-                        else alloc_aligned(piece_len)
+                    if pool is not None:
+                        slab = pool.acquire(piece_len)  # pool mbinds fresh slabs
+                    else:
+                        slab = alloc_aligned(piece_len)
+                        if self._numa is not None:
+                            self._numa.bind(slab)
                     self._read_segments(source, piece_segs, slab, base_offset)
                     ready.put((idx, slab))
                 ready.put(None)
@@ -350,6 +401,12 @@ class StromContext:
         if sharding is not None and device is not None:
             raise ValueError("pass either sharding or device, not both")
 
+        if self._numa is not None:
+            # resolve the target node BEFORE any slab leaves the pool: a slab
+            # allocated pre-resolution would skip its mbind and then recycle
+            # with wrong placement for the context's lifetime
+            self._numa.resolve(self._numa_path(source))
+
         np_dtype = np.dtype(dtype)
         if shape is None:
             if length is None:
@@ -384,8 +441,12 @@ class StromContext:
             pool = None if (pin or target_platform == "cpu") else self._slab_pool
 
             def acquire(n: int) -> np.ndarray:
-                return pool.acquire(n) if pool is not None \
-                    else alloc_aligned(n, pin=pin)
+                if pool is not None:
+                    return pool.acquire(n)  # pool mbinds fresh slabs
+                arr = alloc_aligned(n, pin=pin)
+                if self._numa is not None:
+                    self._numa.bind(arr)
+                return arr
 
             cfg = self.config
             def stream_eligible(n: int) -> bool:
@@ -456,6 +517,9 @@ class StromContext:
         if length == 0:
             return np.empty(0, dtype=np.uint8)
         dest = alloc_aligned(length)
+        if self._numa is not None and \
+                self._numa.resolve(self._numa_path(source)) is not None:
+            self._numa.bind(dest)
         self._read_segments(source, [Segment(0, 0, length)], dest, offset)
         return dest
 

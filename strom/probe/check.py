@@ -49,6 +49,8 @@ class FileReport:
     extents: int                  # number of mapped extents (0 = map unavailable)
     extent_coverage: float        # fraction of file covered by reliable extents
     reasons: tuple[str, ...]      # human-readable: why this tier
+    fragmented: bool = False      # >1 reliable extent with non-sequential placement
+    mean_extent_bytes: int = 0    # mean reliable extent length (0 = map unavailable)
 
     @property
     def supported(self) -> bool:
@@ -71,11 +73,25 @@ def check_file(path: str, *, want_extents: bool = True) -> FileReport:
 
     extents = 0
     cov = 0.0
+    fragmented = False
+    mean_extent = 0
     if want_extents and st.st_size > 0:
         try:
             ext = _fiemap.fiemap(path)
             extents = len(ext)
             cov = _fiemap.coverage([e for e in ext if e.is_reliable], st.st_size)
+            n_rel, mean_extent, seq_frac = _fiemap.fragmentation(ext)
+            # chunking advice: a logically-sequential read of a physically
+            # scattered file reaches the device as random LBA hops; the
+            # delivery layer's extent-aware planner reorders to fix that
+            # (strom.delivery.chunk_plan, on by default)
+            fragmented = n_rel > 1 and seq_frac < 1.0
+            if fragmented:
+                reasons.append(
+                    f"fragmented: {n_rel} extents, mean "
+                    f"{mean_extent >> 10} KiB, {seq_frac:.0%} physically "
+                    "sequential; extent-aware gather planning will reorder "
+                    "reads into physical-address order")
         except OSError:
             reasons.append("fiemap unavailable on this filesystem")
 
@@ -101,6 +117,8 @@ def check_file(path: str, *, want_extents: bool = True) -> FileReport:
         extents=extents,
         extent_coverage=cov,
         reasons=tuple(reasons),
+        fragmented=fragmented,
+        mean_extent_bytes=mean_extent,
     )
 
 

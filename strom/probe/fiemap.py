@@ -121,6 +121,23 @@ def fiemap(path_or_fd: str | int, start: int = 0, length: int | None = None,
             os.close(fd)
 
 
+def fragmentation(extents: list[Extent]) -> tuple[int, int, float]:
+    """(reliable extent count, mean extent bytes, physically-sequential
+    fraction). The last is the fraction of inter-extent transitions whose
+    physical placement continues where the previous extent ended — 1.0 means
+    logical order IS physical order and extent-aware planning cannot help."""
+    ext = sorted((e for e in extents if e.is_reliable and e.length > 0),
+                 key=lambda e: e.logical)
+    if not ext:
+        return 0, 0, 1.0
+    mean = sum(e.length for e in ext) // len(ext)
+    if len(ext) == 1:
+        return 1, mean, 1.0
+    seq = sum(1 for a, b in zip(ext, ext[1:])
+              if a.physical + a.length == b.physical)
+    return len(ext), mean, seq / (len(ext) - 1)
+
+
 def coverage(extents: list[Extent], file_size: int) -> float:
     """Fraction of [0, file_size) covered by mapped extents."""
     if file_size <= 0:

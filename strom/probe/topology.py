@@ -30,6 +30,7 @@ class BlockDevice:
     raid_level: str | None = None          # e.g. "raid0" for md arrays
     raid_chunk_bytes: int | None = None
     raid_members: tuple[str, ...] = ()
+    numa_node: int | None = None           # home NUMA node (None = unknown/UMA)
 
     @property
     def is_raid0_of_nvme(self) -> bool:
@@ -95,6 +96,13 @@ def _describe_disk(real: str) -> BlockDevice:
                 if os.path.exists(block_link):
                     ms.append(os.path.basename(os.path.realpath(block_link)))
         members = tuple(ms)
+    # the device's home NUMA node: <disk>/device/numa_node for virtio/scsi,
+    # one level deeper for NVMe namespaces (disk -> ctrl -> PCI function)
+    numa = _read_int(os.path.join(real, "device", "numa_node"))
+    if numa is None:
+        numa = _read_int(os.path.join(real, "device", "device", "numa_node"))
+    if numa is not None and numa < 0:  # kernel reports -1 on UMA boxes
+        numa = None
     return BlockDevice(
         name=name,
         major=major,
@@ -107,6 +115,7 @@ def _describe_disk(real: str) -> BlockDevice:
         raid_level=raid_level,
         raid_chunk_bytes=raid_chunk,
         raid_members=members,
+        numa_node=numa,
     )
 
 

@@ -1,0 +1,112 @@
+"""NUMA affinity knobs (SURVEY.md §7.4 hard part #1 / VERDICT.md next #10).
+
+The CI box is UMA (one node, node0) — so these tests exercise the real
+syscalls against node 0 where possible and the graceful no-op paths
+everywhere else; the multi-socket win itself can't be measured here."""
+
+import os
+
+import numpy as np
+import pytest
+
+from strom.delivery.buffers import alloc_aligned
+from strom.utils.numa import (NumaAffinity, mbind_array, node_cpus,
+                              pin_current_thread, set_irq_affinity)
+
+_HAS_NODE0 = os.path.isdir("/sys/devices/system/node/node0")
+
+
+class TestPrimitives:
+    @pytest.mark.skipif(not _HAS_NODE0, reason="no sysfs NUMA topology")
+    def test_node_cpus(self):
+        cpus = node_cpus(0)
+        assert cpus and all(isinstance(c, int) for c in cpus)
+        assert node_cpus(4096) == set()
+
+    @pytest.mark.skipif(not _HAS_NODE0, reason="no sysfs NUMA topology")
+    def test_pin_current_thread_roundtrip(self):
+        before = os.sched_getaffinity(0)
+        try:
+            assert pin_current_thread(0)
+            assert os.sched_getaffinity(0) <= node_cpus(0)
+        finally:
+            os.sched_setaffinity(0, before)
+        assert not pin_current_thread(4096)  # unknown node -> False, no raise
+
+    @pytest.mark.skipif(not _HAS_NODE0, reason="no sysfs NUMA topology")
+    def test_mbind_array(self):
+        arr = alloc_aligned(64 * 1024)
+        arr[:] = 7
+        ok = mbind_array(arr, 0)
+        # best-effort contract: either it bound, or the arch table had no
+        # syscall number — but it must never corrupt the data
+        assert ok in (True, False)
+        assert (arr == 7).all()
+
+    def test_irq_affinity_bogus_device(self):
+        assert set_irq_affinity("no-such-device-xyz", 0) == 0
+
+    def test_irq_matching_nvme_and_virtio(self):
+        """/proc/interrupts names IRQs after the CONTROLLER (nvme0q1,
+        virtio0-requests), never the namespace (nvme0n1) or disk (vda)."""
+        from strom.utils.numa import _find_irqs, _irq_candidates
+
+        lines = [
+            "            CPU0       CPU1\n",
+            "  24:          0          0  PCI-MSIX nvme0q0\n",
+            "  25:       1234          0  PCI-MSIX nvme0q1\n",
+            "  26:          0       5678  PCI-MSIX nvme1q1\n",
+            "  27:         42          0  virtio0-requests\n",
+            "  28:          0          0  virtio1-config\n",
+        ]
+        assert _find_irqs(lines, _irq_candidates("nvme0n1")) == [24, 25]
+        assert _find_irqs(lines, _irq_candidates("vda", "virtio0")) == [27]
+        assert _find_irqs(lines, _irq_candidates("sda")) == []
+
+
+class TestNumaAffinity:
+    @pytest.mark.skipif(not _HAS_NODE0, reason="no sysfs NUMA topology")
+    def test_explicit_node(self):
+        before = os.sched_getaffinity(0)
+        try:
+            na = NumaAffinity(node=0)
+            assert na.resolve(None) == 0
+            assert na.ensure_thread()
+            assert na.ensure_thread()  # idempotent per thread
+            arr = alloc_aligned(4096)
+            na.bind(arr)
+        finally:
+            os.sched_setaffinity(0, before)
+
+    def test_auto_resolve_uma_is_noop(self, tmp_path):
+        # on this box the backing device reports no NUMA node -> every call
+        # degrades to a no-op instead of raising
+        p = str(tmp_path / "f.bin")
+        with open(p, "wb") as f:
+            f.write(b"a" * 4096)
+        na = NumaAffinity(node=-1)
+        node = na.resolve(p)
+        if node is None:
+            assert not na.ensure_thread(p)
+            assert not na.bind(alloc_aligned(4096))
+
+    def test_delivery_integration(self, tmp_path):
+        """numa_affinity=True must not change delivered bytes."""
+        from strom.config import StromConfig
+        from strom.delivery.core import StromContext
+
+        p = str(tmp_path / "g.bin")
+        rng = np.random.default_rng(11)
+        golden = rng.integers(0, 256, size=64 * 1024, dtype=np.uint8)
+        with open(p, "wb") as f:
+            f.write(golden.tobytes())
+        before = os.sched_getaffinity(0)
+        ctx = StromContext(StromConfig(engine="python", queue_depth=8,
+                                       num_buffers=8, numa_affinity=True,
+                                       numa_node=0 if _HAS_NODE0 else -1))
+        try:
+            out = ctx.pread(p, length=64 * 1024)
+            np.testing.assert_array_equal(out, golden)
+        finally:
+            ctx.close()
+            os.sched_setaffinity(0, before)
