@@ -111,6 +111,7 @@ struct sc_stats {
   uint8_t fixed_files;    // 1 if IORING_REGISTER_FILES active
   uint8_t mlocked;        // 1 if pool mlock succeeded
   uint64_t chunk_retries; // vectored-read chunks transparently resubmitted
+  uint8_t coop_taskrun;   // 1 if IORING_SETUP_COOP_TASKRUN active
 };
 
 struct sc_engine {
@@ -144,6 +145,7 @@ struct sc_engine {
   bool fixed_buffers = false;
   bool fixed_files = false;
   bool mlocked = false;
+  bool coop_taskrun = false;
   bool has_ext_arg = false;  // IORING_FEAT_EXT_ARG (timed waits); 5.11+
 
   FileEntry files[kMaxFiles];
@@ -193,7 +195,8 @@ static void record_latency(sc_engine *e, uint64_t us) {
   e->lat_total_us.fetch_add(us, std::memory_order_relaxed);
 }
 
-// flags bit0: mlock pool; bit1: register buffers; bit2: register files
+// flags bit0: mlock pool; bit1: register buffers; bit2: register files;
+// bit3: IORING_SETUP_COOP_TASKRUN (falls back to 0 flags pre-5.19)
 sc_engine *sc_create(uint32_t queue_depth, uint32_t num_buffers,
                      uint64_t buffer_size, uint32_t flags) {
   if (queue_depth == 0 || num_buffers == 0 || buffer_size == 0) {
@@ -216,7 +219,26 @@ sc_engine *sc_create(uint32_t queue_depth, uint32_t num_buffers,
   if (flags & 1u) e->mlocked = (mlock(e->pool, e->pool_sz) == 0);
 
   memset(&e->params, 0, sizeof(e->params));
-  e->ring_fd = sys_io_uring_setup(queue_depth, &e->params);
+  if (flags & 8u) {
+    // COOP_TASKRUN (5.19+): completion task work runs at our next ring
+    // entry instead of IPI-interrupting the submitting thread mid-fill —
+    // the submit loop is the interruptee under load. DEFER_TASKRUN is
+    // deliberately NOT used: it requires SINGLE_ISSUER and this engine
+    // submits/reaps from arbitrary Python threads.
+#ifndef IORING_SETUP_COOP_TASKRUN
+#define IORING_SETUP_COOP_TASKRUN (1U << 8)
+#endif
+    e->params.flags = IORING_SETUP_COOP_TASKRUN;
+    e->ring_fd = sys_io_uring_setup(queue_depth, &e->params);
+    if (e->ring_fd < 0 && errno == EINVAL) {  // pre-5.19 kernel
+      memset(&e->params, 0, sizeof(e->params));
+      e->ring_fd = sys_io_uring_setup(queue_depth, &e->params);
+    } else if (e->ring_fd >= 0) {
+      e->coop_taskrun = true;
+    }
+  } else {
+    e->ring_fd = sys_io_uring_setup(queue_depth, &e->params);
+  }
   if (e->ring_fd < 0) {
     munmap(e->pool, e->pool_sz);
     e->pool = nullptr;
@@ -1011,6 +1033,7 @@ void sc_get_stats(sc_engine *e, sc_stats *s) {
   s->fixed_files = e->fixed_files ? 1 : 0;
   s->mlocked = e->mlocked ? 1 : 0;
   s->chunk_retries = e->chunk_retries.load(std::memory_order_relaxed);
+  s->coop_taskrun = e->coop_taskrun ? 1 : 0;
 }
 
 }  // extern "C"

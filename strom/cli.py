@@ -72,7 +72,7 @@ def bench_nvme(args: argparse.Namespace) -> dict:
         _drop_cache_hint(path)
         eng = make_engine(cfg)
         fi = eng.register_file(path, o_direct=not args.buffered)
-        dest = alloc_aligned(size)
+        dest = alloc_aligned(size, huge=getattr(args, "huge", False))
         if na is not None:
             na.bind(dest)
         t0 = time.perf_counter()
@@ -99,6 +99,7 @@ def bench_nvme(args: argparse.Namespace) -> dict:
         "o_direct": not args.buffered, "iters": args.iters,
         "per_op": bool(getattr(args, "per_op", False)),
         "numa_node": numa_node,
+        "huge": bool(getattr(args, "huge", False)),
         "file_created": created,
     }
     return out
@@ -300,14 +301,58 @@ def bench_resnet(args: argparse.Namespace) -> dict:
             imgs.block_until_ready()
         dt = time.perf_counter() - t0
         stalls = pipe.data_stall_steps
-    ctx.close()
-    return {
+    out = {
         "bench": "resnet_loader",
         "images_per_s": round(args.steps * args.batch / dt, 1),
         "batch": args.batch, "image_size": args.image_size,
         "steps": args.steps, "devices": n_dev, "data_stall_steps": stalls,
         "decode_workers": args.decode_workers, "engine": cfg.engine,
     }
+
+    if getattr(args, "train_step", False):
+        # north-star phase (BASELINE.json:5 "ResNet-50 input pipeline fully
+        # IO-overlapped, 0 data-stall steps"): a REAL jitted ResNet train
+        # step (fwd+bwd+SGD) consumes the batches; decode+delivery must hide
+        # behind its device time. Flat-out above stalls by construction —
+        # there is no compute to overlap with.
+        import functools
+
+        from strom.models.resnet import (ResNetConfig, init_params, loss_fn,
+                                         normalize_images)
+
+        mcfg = getattr(ResNetConfig, args.model)()
+        params, bn_state = init_params(jax.random.key(0), mcfg)
+
+        @functools.partial(jax.jit, donate_argnums=(0,))
+        def sgd_step(p, s, images, labels):
+            (loss, new_s), grads = jax.value_and_grad(
+                loss_fn, has_aux=True)(p, s, normalize_images(images),
+                                       labels, mcfg)
+            new_p = jax.tree.map(lambda w, g: w - 1e-3 * g, p, grads)
+            return new_p, new_s, loss
+
+        _drop_cache_hint(path)
+        with make_imagenet_resnet_pipeline(
+                ctx, [path], batch=args.batch, image_size=args.image_size,
+                sharding=sharding, prefetch_depth=args.prefetch,
+                decode_workers=args.decode_workers) as pipe:
+            imgs, lbls = next(pipe)
+            params, bn_state, loss = sgd_step(params, bn_state, imgs,
+                                              lbls % mcfg.num_classes)
+            jax.block_until_ready(loss)  # compile outside the timed region
+            base_stalls = pipe.data_stall_steps
+            t0 = time.perf_counter()
+            for _ in range(args.steps):
+                imgs, lbls = next(pipe)
+                params, bn_state, loss = sgd_step(params, bn_state, imgs,
+                                                  lbls % mcfg.num_classes)
+            jax.block_until_ready(loss)
+            dt = time.perf_counter() - t0
+            out["train_images_per_s"] = round(args.steps * args.batch / dt, 1)
+            out["train_data_stalls"] = pipe.data_stall_steps - base_stalls
+            out["train_model"] = args.model
+    ctx.close()
+    return out
 
 
 def main(argv: list[str] | None = None) -> int:
@@ -328,6 +373,9 @@ def main(argv: list[str] | None = None) -> int:
     common(p_nvme)
     p_nvme.add_argument("--buffered", action="store_true",
                         help="use the page-cache path instead of O_DIRECT")
+    p_nvme.add_argument("--huge", action="store_true",
+                        help="MAP_HUGETLB destination slab (A/B the 2MiB-page "
+                             "knob; silently falls back without reservation)")
     p_nvme.add_argument("--numa-node", type=int, default=-1, dest="numa_node",
                         help="pin the submit thread + mbind the dest slab to "
                              "this NUMA node (A/B the affinity knob; -1 = off)")
@@ -365,6 +413,12 @@ def main(argv: list[str] | None = None) -> int:
     p_rn.add_argument("--steps", type=int, default=20)
     p_rn.add_argument("--prefetch", type=int, default=2)
     p_rn.add_argument("--decode-workers", type=int, default=8, dest="decode_workers")
+    p_rn.add_argument("--train-step", action="store_true", dest="train_step",
+                      help="also run a REAL jitted ResNet train step over the "
+                           "loader (the 0-data-stall north-star measurement)")
+    p_rn.add_argument("--model", default="resnet50",
+                      choices=["tiny", "resnet50"],
+                      help="ResNet config for --train-step")
     p_rn.set_defaults(fn=bench_resnet)
 
     p_check = sub.add_parser("check", help="≙ CHECK_FILE: report a file's data-path tier")

@@ -56,6 +56,10 @@ class StromConfig:
     engine: str = "auto"               # "auto" | "uring" | "python"
     mlock: bool = True                 # pin staging pool (best effort)
     register_buffers: bool = True      # io_uring fixed buffers
+    coop_taskrun: bool = True          # IORING_SETUP_COOP_TASKRUN: run
+                                       # completion task work at ring entry
+                                       # instead of IPI-ing the submitter
+                                       # (5.19+; auto-falls back when absent)
 
     # delivery
     prefetch_depth: int = 2            # batches dispatched ahead of consumption
@@ -66,6 +70,9 @@ class StromConfig:
     slab_mlock_bytes: int = 0          # mlock recycled slabs up to this many
                                        # bytes (0 = never pin pool slabs);
                                        # past the cap slabs stay unpinned
+    huge_pages: bool = False           # back staging slabs with MAP_HUGETLB
+                                       # 2MiB pages (needs reserved hugepages;
+                                       # silently falls back to 4KiB pages)
     # intra-transfer streaming: overlap disk reads of chunk k+1 with the
     # host->HBM transfer of chunk k (double-buffered slab ring) for transfers
     # >= overlap_min_bytes. 0 disables streaming.

@@ -19,7 +19,9 @@ import numpy as np
 _libc = ctypes.CDLL(None, use_errno=True)
 
 PAGE = mmap.PAGESIZE
+HUGE_PAGE = 2 << 20  # default hugetlb size on x86_64/aarch64
 _MAP_POPULATE = getattr(mmap, "MAP_POPULATE", 0x8000)
+_MAP_HUGETLB = getattr(mmap, "MAP_HUGETLB", 0x40000)
 
 
 def _mlock_mm(mm: mmap.mmap) -> bool:
@@ -29,7 +31,7 @@ def _mlock_mm(mm: mmap.mmap) -> bool:
 
 
 def alloc_aligned(nbytes: int, *, pin: bool = False, populate: bool = False,
-                  dtype=np.uint8) -> np.ndarray:
+                  dtype=np.uint8, huge: bool = False) -> np.ndarray:
     """Allocate a page-aligned, optionally mlock'd uint8 slab as a numpy array.
 
     The mmap stays alive as long as the returned array (numpy holds the buffer
@@ -39,17 +41,31 @@ def alloc_aligned(nbytes: int, *, pin: bool = False, populate: bool = False,
     populate=True prefaults the pages inside the mmap call — lazy faulting
     during the read serializes against DMA submission (~0.5 ms/MiB measured),
     which is exactly the bounce-free hot path's enemy (SURVEY.md §7.4 #1).
+
+    huge=True tries MAP_HUGETLB (2MiB pages: 512x fewer TLB entries and
+    fewer per-IO page pins; SURVEY.md §2.2 staging-pool row) and silently
+    falls back to normal pages when no hugepages are reserved
+    (/proc/sys/vm/nr_hugepages = 0 is the common default).
     """
     if nbytes <= 0:
         raise ValueError("nbytes must be positive")
-    padded = (nbytes + PAGE - 1) // PAGE * PAGE
     flags = mmap.MAP_PRIVATE | mmap.MAP_ANONYMOUS
     if populate:
         flags |= _MAP_POPULATE
-    try:
-        mm = mmap.mmap(-1, padded, flags=flags)
-    except (ValueError, OSError):
-        mm = mmap.mmap(-1, padded)  # kernel without MAP_POPULATE
+    mm = None
+    if huge:
+        hpadded = (nbytes + HUGE_PAGE - 1) // HUGE_PAGE * HUGE_PAGE
+        try:
+            mm = mmap.mmap(-1, hpadded, flags=flags | _MAP_HUGETLB)
+            padded = hpadded
+        except OSError:
+            mm = None  # unreserved/unsupported → normal pages below
+    if mm is None:
+        padded = (nbytes + PAGE - 1) // PAGE * PAGE
+        try:
+            mm = mmap.mmap(-1, padded, flags=flags)
+        except (ValueError, OSError):
+            mm = mmap.mmap(-1, padded)  # kernel without MAP_POPULATE
     if pin:
         _mlock_mm(mm)  # best effort
     arr = np.frombuffer(mm, dtype=np.uint8)[:nbytes]
@@ -96,10 +112,13 @@ class SlabPool:
 
     def __init__(self, max_bytes: int = 512 * 1024 * 1024, *,
                  pin: bool = False, max_mlock_bytes: int = 0,
-                 on_alloc=None):
+                 huge: bool = False, on_alloc=None):
         self.max_bytes = max_bytes
         self.pin = pin
         self.max_mlock_bytes = max_mlock_bytes
+        # 2MiB-page slabs: size classes round up to HUGE_PAGE so the bucket
+        # key equals the mmap length whichever page size backs it
+        self.huge = huge
         # called once per FRESH slab (recycled slabs keep their placement):
         # delivery hooks NUMA mbind here
         self.on_alloc = on_alloc
@@ -123,6 +142,8 @@ class SlabPool:
 
     def acquire(self, nbytes: int) -> np.ndarray:
         cls = size_class(nbytes)
+        if self.huge:
+            cls = (cls + HUGE_PAGE - 1) // HUGE_PAGE * HUGE_PAGE
         with self._lock:
             bucket = self._free.get(cls)
             if bucket:
@@ -137,7 +158,7 @@ class SlabPool:
                 self.mlocked_bytes + cls <= self.max_mlock_bytes
             if reserve:
                 self.mlocked_bytes += cls
-        base = self._base(alloc_aligned(cls, populate=True))
+        base = self._base(alloc_aligned(cls, populate=True, huge=self.huge))
         if reserve:
             mm = base.base
             if isinstance(mm, mmap.mmap) and _mlock_mm(mm):
@@ -164,6 +185,7 @@ class SlabPool:
     def stats(self) -> dict:
         with self._lock:
             return {"cached_bytes": self._cached_bytes,
+                    "huge": self.huge,
                     "mlocked_bytes": self.mlocked_bytes,
                     "mlock_cap_bytes": self.max_mlock_bytes,
                     "hits": self.hits,

@@ -182,6 +182,7 @@ class StromContext:
             self.config.slab_pool_bytes,
             pin=self.config.slab_mlock_bytes > 0,
             max_mlock_bytes=self.config.slab_mlock_bytes,
+            huge=self.config.huge_pages,
             on_alloc=self._numa.bind if self._numa else None) \
             if self.config.slab_pool_bytes > 0 else None
         # one host->HBM stream at a time (see StromConfig.serialize_device_put)
@@ -313,7 +314,8 @@ class StromContext:
                     if pool is not None:
                         slab = pool.acquire(piece_len)  # pool mbinds fresh slabs
                     else:
-                        slab = alloc_aligned(piece_len)
+                        slab = alloc_aligned(piece_len,
+                                             huge=self.config.huge_pages)
                         if self._numa is not None:
                             self._numa.bind(slab)
                     self._read_segments(source, piece_segs, slab, base_offset)
@@ -443,7 +445,7 @@ class StromContext:
             def acquire(n: int) -> np.ndarray:
                 if pool is not None:
                     return pool.acquire(n)  # pool mbinds fresh slabs
-                arr = alloc_aligned(n, pin=pin)
+                arr = alloc_aligned(n, pin=pin, huge=self.config.huge_pages)
                 if self._numa is not None:
                     self._numa.bind(arr)
                 return arr

@@ -45,6 +45,7 @@ class _ScStats(ctypes.Structure):
         ("fixed_files", ctypes.c_uint8),
         ("mlocked", ctypes.c_uint8),
         ("chunk_retries", ctypes.c_uint64),
+        ("coop_taskrun", ctypes.c_uint8),
     ]
 
 
@@ -160,7 +161,8 @@ class UringEngine(Engine):
     def __init__(self, config: StromConfig, *, variant: str = ""):
         super().__init__(config)
         self._lib = _load_lib(variant)
-        flags = (1 if config.mlock else 0) | (2 if config.register_buffers else 0) | 4
+        flags = (1 if config.mlock else 0) | (2 if config.register_buffers else 0) \
+            | 4 | (8 if config.coop_taskrun else 0)
         handle = self._lib.sc_create(config.queue_depth, config.num_buffers,
                                      config.buffer_size, flags)
         if not handle:
@@ -358,6 +360,7 @@ class UringEngine(Engine):
             "fixed_buffers": bool(s.fixed_buffers),
             "fixed_files": bool(s.fixed_files),
             "mlocked": bool(s.mlocked),
+            "coop_taskrun": bool(s.coop_taskrun),
             "read_latency_mean_us": (s.lat_total_us / total) if total else 0.0,
             "read_latency_count": total,
         }

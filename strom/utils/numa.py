@@ -89,21 +89,22 @@ def mbind_array(arr: np.ndarray, node: int) -> bool:
 
 def _irq_candidates(device_name: str, parent_name: str | None = None
                     ) -> set[str]:
-    """Name prefixes a block device's IRQs carry in /proc/interrupts. The
-    namespace name itself never appears there: NVMe queue IRQs are named
+    """Regexes for the names a block device's IRQs carry in /proc/interrupts.
+    The namespace name itself never appears there: NVMe queue IRQs are named
     nvme0q0, nvme0q1, ... (not nvme0n1) and virtio disks virtio0-requests
-    (not vda) — match the controller, not the namespace."""
-    cands = {device_name}
+    (not vda) — match the controller, not the namespace. Both-sided word
+    boundaries so nvme1 never prefix-matches nvme10's IRQs."""
+    pats = {rf"\b{re.escape(device_name)}\b"}
     m = re.match(r"(nvme\d+)n\d+$", device_name)
     if m:
-        cands.add(m.group(1) + "q")
+        pats.add(rf"\b{re.escape(m.group(1))}q\d+\b")
     if parent_name:
-        cands.add(parent_name)
-    return cands
+        pats.add(rf"\b{re.escape(parent_name)}\b")
+    return pats
 
 
 def _find_irqs(lines: list[str], candidates: set[str]) -> list[int]:
-    pats = [re.compile(rf"\b{re.escape(c)}") for c in candidates]
+    pats = [re.compile(c) for c in candidates]
     out = []
     for line in lines:
         m = re.match(r"^\s*(\d+):", line)
@@ -157,6 +158,19 @@ class NumaAffinity:
         O(1) once resolved (node -2 = probed, unknown → permanent no-op)."""
         with self._lock:
             if self.node >= 0:
+                # an explicitly-configured node still needs the device lookup
+                # once if IRQ steering was asked for — the IRQs belong to the
+                # device, not the node
+                if self.steer_irqs and not self._irqs_done and path is not None:
+                    self._irqs_done = True
+                    from strom.probe.topology import device_for_file
+
+                    try:
+                        dev = device_for_file(path)
+                    except OSError:
+                        dev = None
+                    if dev is not None:
+                        set_irq_affinity(dev.name, self.node)
                 return self.node
             if self.node == -2 or path is None:
                 return None

@@ -118,6 +118,32 @@ def test_stats_accounting(engine, data_file):
     assert s["in_flight"] == 0
 
 
+def test_coop_taskrun_knob(data_file):
+    """coop_taskrun=True sets IORING_SETUP_COOP_TASKRUN (this CI kernel is
+    5.19+ so it must actually engage); =False must leave it off; reads work
+    identically either way."""
+    from strom.config import StromConfig
+    from strom.engine import make_engine
+    from strom.engine.uring_engine import UringEngine
+
+    path, data = data_file
+    for coop in (True, False):
+        eng = make_engine(StromConfig(coop_taskrun=coop, queue_depth=8,
+                                      num_buffers=8))
+        if not isinstance(eng, UringEngine):
+            eng.close()
+            return  # python fallback engine: knob is uring-only
+        try:
+            assert eng.stats()["coop_taskrun"] is coop
+            fi = eng.register_file(path)
+            out = np.zeros(8192, dtype=np.uint8)
+            assert eng.read_into(fi, 0, 8192, out) == 8192
+            np.testing.assert_array_equal(out, np.frombuffer(
+                bytes(data[:8192]), dtype=np.uint8))
+        finally:
+            eng.close()
+
+
 def test_o_direct_denied_falls_back(engine, tmp_path):
     """/proc files refuse O_DIRECT; registration must degrade, not fail."""
     fi = engine.register_file("/proc/self/status")

@@ -62,6 +62,32 @@ class TestPrimitives:
         assert _find_irqs(lines, _irq_candidates("nvme0n1")) == [24, 25]
         assert _find_irqs(lines, _irq_candidates("vda", "virtio0")) == [27]
         assert _find_irqs(lines, _irq_candidates("sda")) == []
+        # no prefix bleed on dense hosts: nvme1 must not claim nvme10's IRQs,
+        # virtio1 must not claim virtio10's
+        dense = [
+            " 30:  0  PCI-MSIX nvme1q0\n",
+            " 31:  0  PCI-MSIX nvme10q0\n",
+            " 32:  0  virtio1-requests\n",
+            " 33:  0  virtio10-requests\n",
+        ]
+        assert _find_irqs(dense, _irq_candidates("nvme1n1")) == [30]
+        assert _find_irqs(dense, _irq_candidates("vdb", "virtio1")) == [32]
+
+    def test_irq_steering_with_explicit_node(self, tmp_path, monkeypatch):
+        """irq_affinity must engage even when numa_node is set explicitly —
+        the IRQs belong to the device, which still needs one lookup."""
+        import strom.utils.numa as nmod
+
+        p = str(tmp_path / "f.bin")
+        with open(p, "wb") as f:
+            f.write(b"a" * 4096)
+        calls = []
+        monkeypatch.setattr(nmod, "set_irq_affinity",
+                            lambda name, node: calls.append((name, node)) or 1)
+        na = NumaAffinity(node=0, steer_irqs=True)
+        assert na.resolve(p) == 0
+        na.resolve(p)  # steering runs once, not per call
+        assert len(calls) == 1 and calls[0][1] == 0
 
 
 class TestNumaAffinity:

@@ -55,6 +55,38 @@ class TestSlabPool:
         b = pool.acquire(6000)  # same class → recycled
         assert b.nbytes == 6000 and pool.hits == 1
 
+    def test_huge_pages_recycle_and_fallback(self):
+        """huge=True: bucket key must equal the mmap length whichever page
+        size actually backed the slab (reserved hugepages OR the silent
+        4KiB fallback), so recycling keeps working either way."""
+        from strom.delivery.buffers import HUGE_PAGE
+
+        pool = SlabPool(max_bytes=1 << 30, huge=True)
+        a = pool.acquire(3 << 20)  # class rounds up to 4MiB
+        assert a.nbytes == 3 << 20
+        pool.release(a)
+        st = pool.stats()
+        assert st["huge"] is True
+        (cls,) = st["buckets"].keys()
+        assert cls % HUGE_PAGE == 0
+        b = pool.acquire(4 << 20)  # same 4MiB class -> recycled
+        assert pool.hits == 1 and b.nbytes == 4 << 20
+
+    def test_huge_alloc_oversubscribed_falls_back(self):
+        # more than THIS box's actual reservation (read, not guessed): the
+        # hugetlb mmap must fail with ENOMEM and silently fall back to
+        # normal pages, not raise or SIGBUS
+        from strom.delivery.buffers import HUGE_PAGE
+
+        total = 0
+        with open("/proc/meminfo") as f:
+            for line in f:
+                if line.startswith("HugePages_Total"):
+                    total = int(line.split()[1])
+        arr = alloc_aligned((total + 8) * HUGE_PAGE, huge=True)
+        arr[:100] = 5
+        assert (arr[:100] == 5).all()
+
     def test_mlock_cap(self):
         pool = SlabPool(max_bytes=1 << 30, pin=True, max_mlock_bytes=64 << 10)
         slabs = [pool.acquire(32 << 10) for _ in range(4)]
