@@ -58,6 +58,31 @@ int sys_io_uring_register(int fd, unsigned opcode, const void *arg,
 #define STATX_DIOALIGN 0x00002000U
 #endif
 
+// Sparse registered-buffer table (kernel 5.13+/5.19+): define the register
+// opcodes/structs ourselves so the engine still COMPILES against older uapi
+// headers (the file-header promise); at runtime an old kernel just fails the
+// BUFFERS2 call and we fall back to legacy REGISTER_BUFFERS.
+#ifndef IORING_RSRC_REGISTER_SPARSE
+#define IORING_RSRC_REGISTER_SPARSE (1U << 0)
+#endif
+constexpr unsigned kRegisterBuffers2 = 15;       // IORING_REGISTER_BUFFERS2
+constexpr unsigned kRegisterBuffersUpdate = 16;  // IORING_REGISTER_BUFFERS_UPDATE
+struct sc_rsrc_register {  // ABI of struct io_uring_rsrc_register
+  uint32_t nr;
+  uint32_t flags;
+  uint64_t resv2;
+  uint64_t data;
+  uint64_t tags;
+};
+struct sc_rsrc_update2 {  // ABI of struct io_uring_rsrc_update2
+  uint32_t offset;
+  uint32_t resv;
+  uint64_t data;
+  uint64_t tags;
+  uint32_t nr;
+  uint32_t resv2;
+};
+
 uint64_t now_ns() {
   struct timespec ts;
   clock_gettime(CLOCK_MONOTONIC, &ts);
@@ -112,6 +137,8 @@ struct sc_stats {
   uint8_t mlocked;        // 1 if pool mlock succeeded
   uint64_t chunk_retries; // vectored-read chunks transparently resubmitted
   uint8_t coop_taskrun;   // 1 if IORING_SETUP_COOP_TASKRUN active
+  uint8_t sparse_table;   // 1 if external dest registration is available
+  uint32_t ext_buffers;   // currently-registered external dest slabs
 };
 
 struct sc_engine {
@@ -147,6 +174,17 @@ struct sc_engine {
   bool mlocked = false;
   bool coop_taskrun = false;
   bool has_ext_arg = false;  // IORING_FEAT_EXT_ARG (timed waits); 5.11+
+
+  // sparse registered-buffer table (BUFFERS2, 5.13+): slots
+  // [0, num_buffers) hold the internal staging pool, slots
+  // [num_buffers, num_buffers + kExtBufSlots) are updatable at runtime so
+  // delivery can register ITS slabs and ride READ_FIXED in the vectored
+  // hot path (the round-1 design had registered buffers only on the per-op
+  // pool path, leaving the bulk gather on plain READ)
+  static constexpr uint32_t kExtBufSlots = 64;
+  bool sparse_table = false;
+  uint64_t ext_len[kExtBufSlots] = {};  // 0 = slot free
+  std::mutex ext_mu;
 
   FileEntry files[kMaxFiles];
   std::mutex files_mu;
@@ -288,9 +326,29 @@ sc_engine *sc_create(uint32_t queue_depth, uint32_t num_buffers,
       iovs[i].iov_base = e->pool + (size_t)i * buffer_size;
       iovs[i].iov_len = buffer_size;
     }
-    e->fixed_buffers = (sys_io_uring_register(e->ring_fd,
-                                              IORING_REGISTER_BUFFERS, iovs,
-                                              num_buffers) == 0);
+    // preferred: sparse table with trailing runtime-updatable slots for
+    // delivery slabs (sc_register_dest); legacy REGISTER_BUFFERS otherwise
+    struct sc_rsrc_register rr;
+    memset(&rr, 0, sizeof(rr));
+    rr.nr = num_buffers + sc_engine::kExtBufSlots;
+    rr.flags = IORING_RSRC_REGISTER_SPARSE;
+    if (sys_io_uring_register(e->ring_fd, kRegisterBuffers2, &rr,
+                              sizeof(rr)) == 0) {
+      struct sc_rsrc_update2 up;
+      memset(&up, 0, sizeof(up));
+      up.offset = 0;
+      up.data = (uint64_t)(uintptr_t)iovs;
+      up.nr = num_buffers;
+      // BUFFERS_UPDATE returns the number of entries updated, not 0
+      e->fixed_buffers = (sys_io_uring_register(e->ring_fd,
+                                                kRegisterBuffersUpdate,
+                                                &up, sizeof(up)) >= 0);
+      e->sparse_table = e->fixed_buffers;
+    } else {
+      e->fixed_buffers = (sys_io_uring_register(e->ring_fd,
+                                                IORING_REGISTER_BUFFERS, iovs,
+                                                num_buffers) == 0);
+    }
     delete[] iovs;
   }
   if (flags & 4u) {
@@ -472,7 +530,11 @@ static void fill_sqe_locked(sc_engine *e, const FileEntry &f, int file_index,
   uint32_t idx = tail & e->sq_mask;
   struct io_uring_sqe *sqe = &e->sqes[idx];
   memset(sqe, 0, sizeof(*sqe));
-  sqe->opcode = (direct && e->fixed_buffers && buf_index >= 0 && buf_offset == 0)
+  // READ_FIXED for any addr INSIDE the registered entry (the kernel bounds-
+  // checks addr against the entry's iovec) — gating on buf_offset == 0 kept
+  // the fixed path off every partial-slot and external-slab read
+  (void)buf_offset;
+  sqe->opcode = (direct && e->fixed_buffers && buf_index >= 0)
                     ? IORING_OP_READ_FIXED
                     : IORING_OP_READ;
   sqe->addr = (uint64_t)(uintptr_t)addr;
@@ -749,6 +811,8 @@ struct sc_raw_op {
   uint64_t offset;
   uint64_t tag;
   void *addr;
+  int32_t buf_index;  // registered-buffer table index for READ_FIXED
+                      // (addr must lie inside that entry); -1 = plain READ
 };
 
 // Batch submit into caller-owned memory: one lock, one io_uring_enter for the
@@ -812,7 +876,21 @@ int sc_submit_raw_batch(sc_engine *e, const sc_raw_op *ops, uint32_t n,
         f = e->files[op.file_index];
       }
       if (e->n_free == 0) break;  // queue depth reached: caller reaps + resumes
-      fill_sqe_locked(e, f, op.file_index, op.offset, op.length, -1, 0,
+      // honor a registered-buffer index only when it names a live table
+      // entry; anything else degrades to plain READ instead of an async
+      // kernel EINVAL
+      int64_t bi = -1;
+      if (op.buf_index >= 0 && e->fixed_buffers) {
+        if ((uint32_t)op.buf_index < e->num_buffers) {
+          bi = op.buf_index;
+        } else if (e->sparse_table &&
+                   (uint32_t)op.buf_index <
+                       e->num_buffers + sc_engine::kExtBufSlots) {
+          std::lock_guard<std::mutex> eg(e->ext_mu);
+          if (e->ext_len[op.buf_index - e->num_buffers] != 0) bi = op.buf_index;
+        }
+      }
+      fill_sqe_locked(e, f, op.file_index, op.offset, op.length, bi, 0,
                       (uint8_t *)op.addr, op.tag);
       ++filled;
       ++accepted;
@@ -849,7 +927,7 @@ struct sc_vec_seg {
 // (-ENODATA = short read: range extends past EOF).
 int64_t sc_read_vectored(sc_engine *e, const sc_vec_seg *segs, uint64_t n_segs,
                          void *dest_base, uint32_t block_size,
-                         uint32_t retries) {
+                         uint32_t retries, int32_t dest_buf_index) {
   if (block_size == 0 || dest_base == nullptr) return -EINVAL;
   struct Chunk {
     uint64_t offset, dest_off;
@@ -904,6 +982,7 @@ int64_t sc_read_vectored(sc_engine *e, const sc_vec_seg *segs, uint64_t n_segs,
         batch[k].offset = pend[slot].offset;
         batch[k].tag = slot;
         batch[k].addr = (uint8_t *)dest_base + pend[slot].dest_off;
+        batch[k].buf_index = dest_buf_index;
         ++k;
       }
     }
@@ -921,6 +1000,7 @@ int64_t sc_read_vectored(sc_engine *e, const sc_vec_seg *segs, uint64_t n_segs,
       batch[k].offset = pend[slot].offset;
       batch[k].tag = slot;
       batch[k].addr = (uint8_t *)dest_base + pend[slot].dest_off;
+      batch[k].buf_index = dest_buf_index;
       ++k;
     }
     if (k > 0) {
@@ -962,7 +1042,7 @@ int64_t sc_read_vectored(sc_engine *e, const sc_vec_seg *segs, uint64_t n_segs,
           ++c.attempts;
           e->chunk_retries.fetch_add(1, std::memory_order_relaxed);
           sc_raw_op rop{c.file_index, c.want, c.offset, slot,
-                        (uint8_t *)dest_base + c.dest_off};
+                        (uint8_t *)dest_base + c.dest_off, dest_buf_index};
           int acc = sc_submit_raw_batch(e, &rop, 1, nullptr);
           if (acc == 1) continue;  // still in flight
           if (acc < 0) {
@@ -1014,6 +1094,56 @@ int64_t sc_read_vectored(sc_engine *e, const sc_vec_seg *segs, uint64_t n_segs,
   return err != 0 ? err : (int64_t)total;
 }
 
+// Register a caller-owned slab in an external registered-buffer slot so the
+// vectored gather can ride READ_FIXED into it. Returns the TABLE index to
+// pass as dest_buf_index (>= num_buffers), or -errno. The memory must stay
+// mapped until sc_unregister_dest (or engine destruction — the ring's
+// registration dies with it, but the kernel holds page pins until then).
+int sc_register_dest(sc_engine *e, void *addr, uint64_t len) {
+  if (addr == nullptr || len == 0) return -EINVAL;
+  if (!e->sparse_table) return -EOPNOTSUPP;
+  std::lock_guard<std::mutex> g(e->ext_mu);
+  for (uint32_t i = 0; i < sc_engine::kExtBufSlots; ++i) {
+    if (e->ext_len[i] != 0) continue;
+    struct iovec iov;
+    iov.iov_base = addr;
+    iov.iov_len = len;
+    struct sc_rsrc_update2 up;
+    memset(&up, 0, sizeof(up));
+    up.offset = e->num_buffers + i;
+    up.data = (uint64_t)(uintptr_t)&iov;
+    up.nr = 1;
+    int rc = sys_io_uring_register(e->ring_fd, kRegisterBuffersUpdate,
+                                   &up, sizeof(up));
+    if (rc < 0) return -errno;
+    e->ext_len[i] = len;
+    return (int)(e->num_buffers + i);
+  }
+  return -ENOSPC;
+}
+
+int sc_unregister_dest(sc_engine *e, int index) {
+  if (!e->sparse_table) return -EOPNOTSUPP;
+  uint32_t i = (uint32_t)index - e->num_buffers;
+  if (index < (int)e->num_buffers || i >= sc_engine::kExtBufSlots)
+    return -EINVAL;
+  std::lock_guard<std::mutex> g(e->ext_mu);
+  if (e->ext_len[i] == 0) return -ENOENT;
+  struct iovec iov;
+  iov.iov_base = nullptr;  // empty iovec clears the slot
+  iov.iov_len = 0;
+  struct sc_rsrc_update2 up;
+  memset(&up, 0, sizeof(up));
+  up.offset = (uint32_t)index;
+  up.data = (uint64_t)(uintptr_t)&iov;
+  up.nr = 1;
+  int rc = sys_io_uring_register(e->ring_fd, IORING_REGISTER_BUFFERS_UPDATE,
+                                 &up, sizeof(up));
+  if (rc < 0) return -errno;
+  e->ext_len[i] = 0;
+  return 0;
+}
+
 void sc_get_stats(sc_engine *e, sc_stats *s) {
   memset(s, 0, sizeof(*s));
   s->ops_submitted = e->ops_submitted.load(std::memory_order_relaxed);
@@ -1034,6 +1164,14 @@ void sc_get_stats(sc_engine *e, sc_stats *s) {
   s->mlocked = e->mlocked ? 1 : 0;
   s->chunk_retries = e->chunk_retries.load(std::memory_order_relaxed);
   s->coop_taskrun = e->coop_taskrun ? 1 : 0;
+  s->sparse_table = e->sparse_table ? 1 : 0;
+  uint32_t ext = 0;
+  {
+    std::lock_guard<std::mutex> g(e->ext_mu);
+    for (uint32_t i = 0; i < sc_engine::kExtBufSlots; ++i)
+      if (e->ext_len[i] != 0) ++ext;
+  }
+  s->ext_buffers = ext;
 }
 
 }  // extern "C"

@@ -75,6 +75,7 @@ def bench_nvme(args: argparse.Namespace) -> dict:
         dest = alloc_aligned(size, huge=getattr(args, "huge", False))
         if na is not None:
             na.bind(dest)
+        eng.register_dest(dest)  # READ_FIXED where supported; -1 = plain READ
         t0 = time.perf_counter()
         if getattr(args, "per_op", False):
             # legacy shape: one submit+wait ctypes round trip per block

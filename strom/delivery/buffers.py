@@ -24,6 +24,13 @@ _MAP_POPULATE = getattr(mmap, "MAP_POPULATE", 0x8000)
 _MAP_HUGETLB = getattr(mmap, "MAP_HUGETLB", 0x40000)
 
 
+def buf_addr(arr: np.ndarray) -> int:
+    """Raw base address of an array's first byte — the key every
+    registration/binding layer (io_uring dest table, mbind, keepalives)
+    uses for host buffers."""
+    return arr.view(np.uint8).reshape(-1).__array_interface__["data"][0]
+
+
 def _mlock_mm(mm: mmap.mmap) -> bool:
     """mlock an anonymous mapping. True on success (RLIMIT_MEMLOCK may say no)."""
     addr = ctypes.addressof(ctypes.c_char.from_buffer(mm))

@@ -183,7 +183,7 @@ class StromContext:
             pin=self.config.slab_mlock_bytes > 0,
             max_mlock_bytes=self.config.slab_mlock_bytes,
             huge=self.config.huge_pages,
-            on_alloc=self._numa.bind if self._numa else None) \
+            on_alloc=self._on_slab_alloc) \
             if self.config.slab_pool_bytes > 0 else None
         # one host->HBM stream at a time (see StromConfig.serialize_device_put)
         self._put_lock = threading.Lock() if self.config.serialize_device_put \
@@ -198,6 +198,26 @@ class StromContext:
                 idx = self.engine.register_file(path, o_direct=self.config.o_direct)
                 self._files[path] = idx
             return idx
+
+    def _on_slab_alloc(self, base: np.ndarray) -> None:
+        """Fresh pool slab: NUMA-place it, then register it with the engine
+        so gathers into it ride READ_FIXED (pages pinned once at
+        registration, not per IO — the reference pins its DMA window once at
+        MAP_GPU_MEMORY for the same reason, SURVEY.md §3.2). Registration
+        lives exactly as long as the slab's mmap; recycled slabs stay
+        registered."""
+        if self._numa is not None:
+            self._numa.bind(base)
+        if self.engine.register_dest(base) >= 0:
+            import weakref
+
+            from strom.delivery.buffers import buf_addr
+
+            # finalizer args must not reference the array (a strong ref would
+            # keep the mmap alive and the finalizer would never run): key the
+            # unregistration by raw address, fired when the mmap dies
+            weakref.finalize(base.base, self.engine.unregister_dest_addr,
+                             buf_addr(base))
 
     @staticmethod
     def _numa_path(source: "Source") -> str | None:

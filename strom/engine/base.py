@@ -150,6 +150,19 @@ class Engine(abc.ABC):
     def __exit__(self, *exc) -> None:
         self.close()
 
+    # -- optional registered-dest support (io_uring READ_FIXED) -------------
+    def register_dest(self, arr: np.ndarray) -> int:
+        """Register a caller slab so gathers into it can use pre-pinned
+        fixed buffers. -1 = not supported by this engine (the default);
+        reads work identically either way."""
+        return -1
+
+    def unregister_dest(self, arr: np.ndarray) -> None:
+        pass
+
+    def unregister_dest_addr(self, addr: int) -> None:
+        pass
+
     # -- vectored gather: the delivery layer's hot path ---------------------
     def read_vectored(self, chunks: Sequence[tuple[int, int, int, int]],
                       dest: np.ndarray, *, retries: int = 1) -> int:

@@ -46,6 +46,8 @@ class _ScStats(ctypes.Structure):
         ("mlocked", ctypes.c_uint8),
         ("chunk_retries", ctypes.c_uint64),
         ("coop_taskrun", ctypes.c_uint8),
+        ("sparse_table", ctypes.c_uint8),
+        ("ext_buffers", ctypes.c_uint32),
     ]
 
 
@@ -65,6 +67,7 @@ class _ScRawOp(ctypes.Structure):
         ("offset", ctypes.c_uint64),
         ("tag", ctypes.c_uint64),
         ("addr", ctypes.c_void_p),
+        ("buf_index", ctypes.c_int32),  # registered table index; -1 = plain READ
     ]
 
 
@@ -136,7 +139,13 @@ def _load_lib(variant: str = ""):
         lib.sc_read_vectored.restype = ctypes.c_int64
         lib.sc_read_vectored.argtypes = [ctypes.c_void_p, ctypes.POINTER(_ScVecSeg),
                                          ctypes.c_uint64, ctypes.c_void_p,
-                                         ctypes.c_uint32, ctypes.c_uint32]
+                                         ctypes.c_uint32, ctypes.c_uint32,
+                                         ctypes.c_int32]
+        lib.sc_register_dest.restype = ctypes.c_int
+        lib.sc_register_dest.argtypes = [ctypes.c_void_p, ctypes.c_void_p,
+                                         ctypes.c_uint64]
+        lib.sc_unregister_dest.restype = ctypes.c_int
+        lib.sc_unregister_dest.argtypes = [ctypes.c_void_p, ctypes.c_int]
         if not variant:
             _lib = lib
         return lib
@@ -182,6 +191,14 @@ class UringEngine(Engine):
         self._closed = False
         self._comp_buf = (_ScCompletion * max(config.queue_depth, 64))()
         self._raw_keepalive: dict[int, np.ndarray] = {}
+        # caller slabs registered for READ_FIXED gathers: base addr -> (table
+        # index, length). read_vectored consults this so delivery transfers
+        # into a registered slab ride the fixed path with no API change.
+        # _dest_lock serializes registration changes against close(): a slab
+        # GC finalizer may call unregister_dest_addr from any thread while
+        # the main thread tears the ring down.
+        self._dest_regs: dict[int, tuple[int, int]] = {}
+        self._dest_lock = threading.Lock()
 
     def register_file(self, path: str, *, o_direct: bool | None = None) -> int:
         want = self.config.o_direct if o_direct is None else o_direct
@@ -205,6 +222,42 @@ class UringEngine(Engine):
             raise IndexError(buf_index)
         start = buf_index * self.config.buffer_size
         return self._np_pool[start: start + self.config.buffer_size]
+
+    def register_dest(self, arr: np.ndarray) -> int:
+        """Register a caller slab in the ring's sparse buffer table so
+        vectored gathers into it use IORING_OP_READ_FIXED (pages pre-pinned
+        once instead of per-IO). Returns the table index, or -1 when
+        unavailable (legacy table, slots exhausted, slab > 1GiB, RLIMIT).
+        The slab must outlive the registration (delivery ties it to the
+        backing mmap's lifetime)."""
+        from strom.delivery.buffers import buf_addr
+
+        nbytes = arr.nbytes
+        if nbytes > (1 << 30):  # kernel cap per registered entry
+            return -1
+        addr = buf_addr(arr)
+        with self._dest_lock:
+            if self._closed:
+                return -1
+            rc = self._lib.sc_register_dest(self._h, ctypes.c_void_p(addr),
+                                            nbytes)
+            if rc < 0:
+                return -1
+            self._dest_regs[addr] = (rc, nbytes)
+            return rc
+
+    def unregister_dest(self, arr: np.ndarray) -> None:
+        from strom.delivery.buffers import buf_addr
+
+        self.unregister_dest_addr(buf_addr(arr))
+
+    def unregister_dest_addr(self, addr: int) -> None:
+        with self._dest_lock:
+            if self._closed:
+                return
+            reg = self._dest_regs.pop(addr, None)
+            if reg is not None:
+                self._lib.sc_unregister_dest(self._h, reg[0])
 
     def submit(self, requests: Sequence[ReadRequest]) -> int:
         for r in requests:
@@ -246,7 +299,7 @@ class UringEngine(Engine):
                 raise EngineError(_errno.EINVAL, "RawRead.dest smaller than length")
             addr = r.dest.__array_interface__["data"][0]
             ops[i] = _ScRawOp(r.file_index, r.length, r.offset, r.tag,
-                              ctypes.c_void_p(addr))
+                              ctypes.c_void_p(addr), -1)
         # Register keepalives BEFORE the C call: the kernel can complete an op
         # inside sc_submit_raw_batch, and a concurrent wait() must find the
         # entry to pop — insert-after-submit would leak the pinned dest.
@@ -307,10 +360,13 @@ class UringEngine(Engine):
         for i, (fi, fo, do, ln) in enumerate(chunks):
             segs[i] = _ScVecSeg(fi, ln, fo, do)
         base = d8.__array_interface__["data"][0]
+        reg = self._dest_regs.get(base)
+        dest_buf_index = reg[0] if reg is not None and need <= reg[1] else -1
         before = self._native_chunk_retries()
         res = self._lib.sc_read_vectored(self._h, segs, len(chunks),
                                          ctypes.c_void_p(base),
-                                         self.config.block_size, retries)
+                                         self.config.block_size, retries,
+                                         dest_buf_index)
         retried = self._native_chunk_retries() - before
         if retried > 0:
             from strom.utils.stats import global_stats
@@ -361,6 +417,8 @@ class UringEngine(Engine):
             "fixed_files": bool(s.fixed_files),
             "mlocked": bool(s.mlocked),
             "coop_taskrun": bool(s.coop_taskrun),
+            "sparse_table": bool(s.sparse_table),
+            "ext_buffers": int(s.ext_buffers),
             "read_latency_mean_us": (s.lat_total_us / total) if total else 0.0,
             "read_latency_count": total,
         }
@@ -379,7 +437,14 @@ class UringEngine(Engine):
     def close(self) -> None:
         if self._closed:
             return
-        self._closed = True
+        # take the dest lock BEFORE flipping _closed and destroying the ring:
+        # a slab finalizer mid-unregister would otherwise race sc_destroy and
+        # call into a freed engine
+        with self._dest_lock:
+            if self._closed:
+                return
+            self._closed = True
+            self._dest_regs.clear()  # registrations die with the ring
         # numpy views over the pool die with the engine mapping: drop our
         # reference first so accidental use raises instead of faulting.
         self._np_pool = None
