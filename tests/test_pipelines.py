@@ -343,6 +343,26 @@ class TestParquetScan:
         got = parquet_count_where(ctx, paths, "value", lambda v: v > 0.5)
         assert got == int((vals > 0.5).sum())
 
+    @pytest.mark.parametrize("unit_batch", [2, 5, 100])
+    def test_unit_batch_identical_results(self, ctx, pq_shards, unit_batch):
+        """Batching row groups per dispatch must not change any aggregate
+        (scan map_fns are row-decomposable); 100 > total units exercises the
+        everything-in-one-dispatch edge."""
+        from strom.pipelines import parquet_count_where
+
+        paths, vals = pq_shards
+        got = parquet_count_where(ctx, paths, "value", lambda v: v > 0.5,
+                                  unit_batch=unit_batch)
+        assert got == int((vals > 0.5).sum())
+
+    def test_unit_batch_rejects_nonpositive(self, ctx, pq_shards):
+        from strom.pipelines import parquet_count_where
+
+        paths, _ = pq_shards
+        with pytest.raises(ValueError, match="unit_batch"):
+            parquet_count_where(ctx, paths, "value", lambda v: v > 0,
+                                unit_batch=0)
+
     def test_zero_units_contributes_zero(self, ctx, pq_shards):
         """A process with no assigned units must produce a zero aggregate of
         the right structure, not raise (multi-host allgather safety)."""
