@@ -139,6 +139,7 @@ struct sc_stats {
   uint8_t coop_taskrun;   // 1 if IORING_SETUP_COOP_TASKRUN active
   uint8_t sparse_table;   // 1 if external dest registration is available
   uint32_t ext_buffers;   // currently-registered external dest slabs
+  uint64_t ops_fixed;     // ops that rode IORING_OP_READ_FIXED
 };
 
 struct sc_engine {
@@ -217,7 +218,7 @@ struct sc_engine {
   // stats
   std::atomic<uint64_t> ops_submitted{0}, ops_completed{0}, ops_errored{0},
       ops_faulted{0}, bytes_read{0}, unaligned_fallback{0}, eof_topup{0},
-      lat_count{0}, lat_total_us{0}, chunk_retries{0};
+      lat_count{0}, lat_total_us{0}, chunk_retries{0}, ops_fixed{0};
   std::atomic<uint64_t> lat_hist[kHistBuckets]{};
 };
 
@@ -541,7 +542,10 @@ static void fill_sqe_locked(sc_engine *e, const FileEntry &f, int file_index,
   sqe->len = length;
   sqe->off = offset;
   sqe->user_data = slot_idx;
-  if (sqe->opcode == IORING_OP_READ_FIXED) sqe->buf_index = (uint16_t)buf_index;
+  if (sqe->opcode == IORING_OP_READ_FIXED) {
+    sqe->buf_index = (uint16_t)buf_index;
+    e->ops_fixed.fetch_add(1, std::memory_order_relaxed);
+  }
   if (direct && e->fixed_files) {
     sqe->fd = file_index;
     sqe->flags |= IOSQE_FIXED_FILE;
@@ -1165,6 +1169,7 @@ void sc_get_stats(sc_engine *e, sc_stats *s) {
   s->chunk_retries = e->chunk_retries.load(std::memory_order_relaxed);
   s->coop_taskrun = e->coop_taskrun ? 1 : 0;
   s->sparse_table = e->sparse_table ? 1 : 0;
+  s->ops_fixed = e->ops_fixed.load(std::memory_order_relaxed);
   uint32_t ext = 0;
   {
     std::lock_guard<std::mutex> g(e->ext_mu);

@@ -399,6 +399,13 @@ def bench_parquet(args: argparse.Namespace) -> dict:
             for g in range(meta.num_row_groups)
             for i in range(meta.num_columns)
             if meta.row_group(g).column(i).path_in_schema == "value")
+        # warmup pass: XLA compiles (body + tail shapes) outside the timed
+        # region — house pattern of every bench here; matters doubly for the
+        # --unit-batch A/B, which would otherwise partly measure compile count
+        parquet_count_where(ctx, [path], "value", lambda v: v > 0,
+                            prefetch_depth=args.prefetch,
+                            unit_batch=args.unit_batch)
+        _drop_cache_hint(path)
         t0 = time.perf_counter()
         hits = parquet_count_where(ctx, [path], "value", lambda v: v > 0,
                                    prefetch_depth=args.prefetch,

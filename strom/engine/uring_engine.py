@@ -48,6 +48,7 @@ class _ScStats(ctypes.Structure):
         ("coop_taskrun", ctypes.c_uint8),
         ("sparse_table", ctypes.c_uint8),
         ("ext_buffers", ctypes.c_uint32),
+        ("ops_fixed", ctypes.c_uint64),
     ]
 
 
@@ -419,6 +420,7 @@ class UringEngine(Engine):
             "coop_taskrun": bool(s.coop_taskrun),
             "sparse_table": bool(s.sparse_table),
             "ext_buffers": int(s.ext_buffers),
+            "ops_fixed": int(s.ops_fixed),
             "read_latency_mean_us": (s.lat_total_us / total) if total else 0.0,
             "read_latency_count": total,
         }

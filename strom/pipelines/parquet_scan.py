@@ -54,7 +54,11 @@ def parquet_scan_aggregate(ctx: StromContext, paths: Sequence[str],
     map_fn is row-decomposable (aggregate(rows_a ++ rows_b) ==
     aggregate(rows_a) + aggregate(rows_b)), which the canonical scan shapes
     (count/sum/min-max via jnp reductions) are; a map_fn that depends on
-    row-group boundaries needs the default of 1.
+    row-group boundaries needs the default of 1. Compile-count caveat: jit
+    caches per shape, so files with a uniform row_group_size (what every
+    common writer produces) compile twice (body + tail chunk); a heavily
+    skewed file can compile once per DISTINCT concatenated length, eating
+    the latency win — prefer unit_batch=1 there.
     """
     import jax
     import jax.numpy as jnp

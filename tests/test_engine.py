@@ -173,6 +173,7 @@ class TestRegisteredDest:
         n = uring.read_vectored([(fi, 0, 0, len(data))], slab)
         assert n == len(data)
         np.testing.assert_array_equal(slab, data)
+        assert uring.stats()["ops_fixed"] > 0  # the gather rode READ_FIXED
         uring.unregister_dest(slab)
         assert uring.stats()["ext_buffers"] == 0
         # unregistered: same gather still works via plain READ
