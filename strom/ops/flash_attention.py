@@ -236,8 +236,22 @@ def _fa_bwd_dq_kernel(q_ref, do_ref, k_ref, v_ref, lse_ref, delta_ref,
         dq_ref[0, 0] = dq_acc[:].astype(dq_ref.dtype)
 
 
+def _delta(out: jax.Array, g: jax.Array) -> jax.Array:
+    """Δ = rowsum(dO ∘ O) in the kernels' [B,H,S,1] column layout (same as
+    lse). Tiny elementwise reduce; XLA fuses it — no kernel needed."""
+    return jnp.sum(g.transpose(0, 2, 1, 3).astype(jnp.float32)
+                   * out.transpose(0, 2, 1, 3).astype(jnp.float32),
+                   axis=-1, keepdims=True)
+
+
 def _flash_bwd(q, k, v, out, lse, g, *, causal: bool, block_q: int,
-               block_k: int, interpret: bool):
+               block_k: int, interpret: bool, delta=None):
+    """Blockwise backward. With the default delta=None this is the vjp of
+    the single-device forward. Passing an explicit (lse, delta) pair makes
+    it a BLOCK-PAIR primitive for ring attention: fed the GLOBAL logsumexp
+    and Δ of the q rows, the kernels rebuild the globally-normalized tile
+    P = exp(q·kᵀ·scale − lse_global) directly, so each (q block, kv block)
+    call yields that pair's exact contribution to the global gradients."""
     B, S, H, Dh = q.shape
     KV = k.shape[2]
     G = H // KV
@@ -251,11 +265,8 @@ def _flash_bwd(q, k, v, out, lse, g, *, causal: bool, block_q: int,
     kt = k.transpose(0, 2, 1, 3)   # [B,KV,S,Dh]
     vt = v.transpose(0, 2, 1, 3)
     dot = g.transpose(0, 2, 1, 3)  # [B,H,S,Dh]
-    ot = out.transpose(0, 2, 1, 3)
-    # Δ_i = rowsum(dO ∘ O): tiny elementwise reduce, XLA fuses it — no need
-    # for a kernel. Column layout [B,H,S,1], same as lse.
-    delta = jnp.sum(dot.astype(jnp.float32) * ot.astype(jnp.float32),
-                    axis=-1, keepdims=True)
+    if delta is None:
+        delta = _delta(out, g)
 
     q_spec = pl.BlockSpec((1, 1, blk_q, Dh),
                           lambda b, kv, jk, gg, iq, G=G: (b, kv * G + gg, iq, 0))

@@ -66,7 +66,10 @@ def run_dryrun(n_devices: int) -> None:
         sp_axes = {"dp": n_devices // sp, "sp": sp}
         sp_mesh = make_mesh(sp_axes, devices=devs)
         state = init_train_state(jax.random.PRNGKey(0), cfg, sp_mesh, optimizer)
-        sp_step = make_train_step(cfg, sp_mesh, optimizer, sp=True)
+        # ring × flash: the Pallas kernels run inside the ring (interpret
+        # mode on the virtual mesh) — the flagship long-context combination
+        sp_step = make_train_step(cfg, sp_mesh, optimizer, sp=True,
+                                  attn="flash")
         B, L = 2 * sp_axes["dp"], 64  # record length divisible by sp
         tokens = jnp.asarray(
             np.random.default_rng(1).integers(0, cfg.vocab, (B, L), dtype=np.int32))

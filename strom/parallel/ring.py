@@ -92,9 +92,138 @@ def ring_attention_local(q: jax.Array, k: jax.Array, v: jax.Array, *,
     return out.reshape(B, Sq, H, Dh).astype(q.dtype)
 
 
+# ------------------------------------------------------------- ring × flash
+# The ring with the Pallas flash kernel as its per-block engine: each ring
+# step runs the real TPU kernel on (local q block, rotating kv block) and the
+# partials merge by logsumexp. With equal sequence shards, causal masking
+# degenerates to three static cases — the DIAGONAL block (src == idx) is the
+# ordinary aligned causal kernel, earlier blocks (src < idx) are fully
+# visible (non-causal kernel), later blocks contribute nothing — so the
+# kernel never needs position offsets. Like the dense ring, masked-out steps
+# still run (uniform lax.scan) and are discarded.
+#
+# Backward is a second ring pass: the forward's GLOBAL logsumexp (and
+# Δ = rowsum(dO∘O), local per q row) feed the blockwise backward kernels,
+# which then emit each (q block, kv block) pair's exact global-gradient
+# contribution (see _flash_bwd). dK/dV accumulators travel WITH the kv
+# blocks around the ring and take one final hop home.
+
+
+def _lse_merge(o: jax.Array, lse: jax.Array, o_b: jax.Array, lse_b: jax.Array
+               ) -> tuple[jax.Array, jax.Array]:
+    """Merge two attention partials, each normalized w.r.t. its own
+    logsumexp. o [B,S,H,Dh] f32; lse [B,H,S,1] f32 (kernel layout)."""
+    lse_new = jnp.logaddexp(lse, lse_b)
+    w = jnp.exp(lse - lse_new).transpose(0, 2, 1, 3)      # [B,S,H,1]
+    w_b = jnp.exp(lse_b - lse_new).transpose(0, 2, 1, 3)
+    return o * w + o_b * w_b, lse_new
+
+
+def _ring_flash_fwd_impl(q, k, v, axis_name, causal, block_q, block_k,
+                         interpret):
+    from strom.ops.flash_attention import _flash_fwd
+
+    n = lax.axis_size(axis_name)
+    idx = lax.axis_index(axis_name)
+    o, lse = _flash_fwd(q, k, v, causal=causal, block_q=block_q,
+                        block_k=block_k, interpret=interpret)
+    o = o.astype(jnp.float32)
+
+    def step(carry, s):
+        o, lse, k_blk, v_blk = carry
+        perm = [(i, (i + 1) % n) for i in range(n)]
+        k_blk = lax.ppermute(k_blk, axis_name, perm)
+        v_blk = lax.ppermute(v_blk, axis_name, perm)
+        src = (idx - s) % n
+        o_b, lse_b = _flash_fwd(q, k_blk, v_blk, causal=False,
+                                block_q=block_q, block_k=block_k,
+                                interpret=interpret)
+        o_b = o_b.astype(jnp.float32)
+        if causal:
+            visible = src < idx
+            lse_b = jnp.where(visible, lse_b, _NEG_BIG)
+            o_b = jnp.where(visible, o_b, 0.0)
+        o, lse = _lse_merge(o, lse, o_b, lse_b)
+        return (o, lse, k_blk, v_blk), None
+
+    (o, lse, _, _), _ = lax.scan(step, (o, lse, k, v), jnp.arange(1, n))
+    return o.astype(q.dtype), lse
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def ring_flash_attention_local(q, k, v, axis_name: str, causal: bool = True,
+                               block_q: int = 128, block_k: int = 128,
+                               interpret: bool = False):
+    """shard_map-inner ring attention running the Pallas flash kernels.
+    Same contract as :func:`ring_attention_local`."""
+    out, _ = _ring_flash_fwd_impl(q, k, v, axis_name, causal, block_q,
+                                  block_k, interpret)
+    return out
+
+
+def _ring_flash_vjp_fwd(q, k, v, axis_name, causal, block_q, block_k,
+                        interpret):
+    out, lse = _ring_flash_fwd_impl(q, k, v, axis_name, causal, block_q,
+                                    block_k, interpret)
+    return out, (q, k, v, out, lse)
+
+
+def _ring_flash_vjp_bwd(axis_name, causal, block_q, block_k, interpret,
+                        res, g):
+    from strom.ops.flash_attention import _delta, _flash_bwd
+
+    q, k, v, out, lse = res
+    n = lax.axis_size(axis_name)
+    idx = lax.axis_index(axis_name)
+    # Δ over the GLOBAL output's local rows, in the kernels' [B,H,S,1] layout
+    delta = _delta(out, g)
+
+    def pair(k_blk, v_blk, blk_causal):
+        return _flash_bwd(q, k_blk, v_blk, out, lse, g, causal=blk_causal,
+                          block_q=block_q, block_k=block_k,
+                          interpret=interpret, delta=delta)
+
+    dq, dk0, dv0 = pair(k, v, causal)  # diagonal block (aligned causal)
+    dq = dq.astype(jnp.float32)
+
+    def step(carry, s):
+        dq, k_blk, v_blk, dk_blk, dv_blk = carry
+        perm = [(i, (i + 1) % n) for i in range(n)]
+        # the grad accumulators travel WITH their kv block
+        k_blk = lax.ppermute(k_blk, axis_name, perm)
+        v_blk = lax.ppermute(v_blk, axis_name, perm)
+        dk_blk = lax.ppermute(dk_blk, axis_name, perm)
+        dv_blk = lax.ppermute(dv_blk, axis_name, perm)
+        src = (idx - s) % n
+        dq_c, dk_c, dv_c = pair(k_blk, v_blk, False)
+        if causal:
+            visible = src < idx
+            dq_c = jnp.where(visible, dq_c.astype(jnp.float32), 0.0)
+            dk_c = jnp.where(visible, dk_c.astype(jnp.float32), 0.0)
+            dv_c = jnp.where(visible, dv_c.astype(jnp.float32), 0.0)
+        dq = dq + dq_c.astype(jnp.float32)
+        dk_blk = dk_blk + dk_c.astype(jnp.float32)
+        dv_blk = dv_blk + dv_c.astype(jnp.float32)
+        return (dq, k_blk, v_blk, dk_blk, dv_blk), None
+
+    carry0 = (dq, k, v, dk0.astype(jnp.float32), dv0.astype(jnp.float32))
+    (dq, _, _, dk_blk, dv_blk), _ = lax.scan(step, carry0, jnp.arange(1, n))
+    # after n-1 rotations each kv block sits one hop short of its owner
+    perm = [(i, (i + 1) % n) for i in range(n)]
+    dk_home = lax.ppermute(dk_blk, axis_name, perm)
+    dv_home = lax.ppermute(dv_blk, axis_name, perm)
+    return (dq.astype(q.dtype), dk_home.astype(k.dtype),
+            dv_home.astype(v.dtype))
+
+
+ring_flash_attention_local.defvjp(_ring_flash_vjp_fwd, _ring_flash_vjp_bwd)
+
+
 def make_ring_attention(mesh: Mesh, *, axis: str = "sp",
                         batch_axis: str = "dp", head_axis: str = "tp",
-                        causal: bool = True):
+                        causal: bool = True, impl: str = "dense",
+                        block_q: int = 128, block_k: int = 128,
+                        interpret: bool | None = None):
     """A drop-in replacement for `strom.models.llama.attention` that runs the
     ring algorithm over *axis*: q,k,v sequence-sharded on it, output likewise.
 
@@ -102,14 +231,25 @@ def make_ring_attention(mesh: Mesh, *, axis: str = "sp",
     the shard_map reshards nothing: batch stays dp-sharded, heads stay
     tp-sharded (n_kv_heads must divide by the tp size), and only the sequence
     axis participates in the ring.
+
+    impl="flash" runs the Pallas flash kernels per ring block (forward AND
+    blockwise backward — the long-context training path); "dense" is the
+    pure-jax online-softmax ring (parity oracle, short sequences).
     """
+    if impl not in ("dense", "flash"):
+        raise ValueError(f"impl must be 'dense' or 'flash', got {impl!r}")
     b = batch_axis if batch_axis in mesh.axis_names else None
     h = head_axis if head_axis in mesh.axis_names else None
     spec = P(b, axis, h, None)
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
 
     @partial(jax.shard_map, mesh=mesh, in_specs=(spec, spec, spec),
              out_specs=spec, check_vma=False)
     def ring_attn(q, k, v):
+        if impl == "flash":
+            return ring_flash_attention_local(q, k, v, axis, causal,
+                                              block_q, block_k, interpret)
         return ring_attention_local(q, k, v, axis_name=axis, causal=causal)
 
     return ring_attn
