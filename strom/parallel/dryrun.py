@@ -99,3 +99,20 @@ def run_dryrun(n_devices: int) -> None:
         ep_loss = float(metrics["loss"])
         assert np.isfinite(ep_loss), f"non-finite ep loss {ep_loss}"
         print(f"dryrun ok: mesh={ep_axes} (MoE expert parallel), loss={ep_loss:.4f}")
+
+    # Composed 3-axis mesh: dp×tp×sp — ring×flash attention over sp with
+    # tp-sharded heads (n_kv_heads divides tp) and dp-sharded batch, all in
+    # one step: the full parallelism composition the loaders must feed.
+    if n_devices >= 8 and n_devices % 4 == 0:
+        axes3 = {"dp": n_devices // 4, "tp": 2, "sp": 2}
+        mesh3 = make_mesh(axes3, devices=devs)
+        state = init_train_state(jax.random.PRNGKey(0), cfg, mesh3, optimizer)
+        step3 = make_train_step(cfg, mesh3, optimizer, sp=True, attn="flash")
+        B = 2 * axes3["dp"]
+        tokens = jnp.asarray(np.random.default_rng(3).integers(
+            0, cfg.vocab, (B, 64), dtype=np.int32))
+        tokens = jax.device_put(tokens, NamedSharding(mesh3, P("dp", "sp")))
+        state, metrics = step3(state, tokens)
+        loss3 = float(metrics["loss"])
+        assert np.isfinite(loss3), f"non-finite 3-axis loss {loss3}"
+        print(f"dryrun ok: mesh={axes3} (dp×tp×sp ring×flash), loss={loss3:.4f}")
