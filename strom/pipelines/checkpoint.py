@@ -11,10 +11,12 @@ pairing atomic at the directory level.
 from __future__ import annotations
 
 import os
+import threading
 from typing import Any
 
 from strom.pipelines.base import Pipeline
-from strom.pipelines.sampler import SamplerState, load_loader_state
+from strom.pipelines.sampler import (SamplerState, load_loader_state,
+                                     save_loader_state)
 
 _LOADER_FILE = "loader_state.json"
 
@@ -28,17 +30,63 @@ class TrainCheckpointer:
         self.root = os.path.abspath(root)
         os.makedirs(self.root, exist_ok=True)
         self._ckptr = ocp.StandardCheckpointer()
+        self._pending: threading.Thread | None = None
+        self._pending_error: BaseException | None = None
 
     def _step_dir(self, step: int) -> str:
         return os.path.join(self.root, f"{step:08d}")
 
     def save(self, step: int, train_state: Any, pipeline: Pipeline,
-             extra: dict | None = None) -> str:
+             extra: dict | None = None, *, blocking: bool = True) -> str:
+        """blocking=False returns as soon as the device arrays are snapshotted
+        (orbax's async save) and commits in the background; training continues
+        while the checkpoint drains to disk. The loader cursor is captured AT
+        THE CALL — batches consumed while the save drains belong to the next
+        checkpoint — and the blob is still written only after orbax finishes,
+        preserving the completeness marker latest_step() relies on."""
+        import copy
+
+        self._join_pending()
         d = self._step_dir(step)
+        loader_state = pipeline.state()
+        fingerprint = pipeline.fingerprint
+        extra = copy.deepcopy(extra)  # snapshot: caller may mutate during drain
         self._ckptr.save(os.path.join(d, "state"), train_state)
-        self._ckptr.wait_until_finished()
-        pipeline.save_state(os.path.join(d, _LOADER_FILE), extra)
+
+        def commit() -> None:
+            try:
+                self._ckptr.wait_until_finished()
+                save_loader_state(os.path.join(d, _LOADER_FILE), loader_state,
+                                  fingerprint, extra)
+            except BaseException as e:  # re-raised at the next join point
+                self._pending_error = e
+
+        if blocking:
+            commit()
+            self._raise_pending_error()
+        else:
+            # non-daemon: a normal interpreter exit waits for the commit, so
+            # the final checkpoint of a run can't be silently discarded
+            self._pending = threading.Thread(target=commit,
+                                             name="strom-ckpt-commit")
+            self._pending.start()
         return d
+
+    def wait_until_finished(self) -> None:
+        """Block until an in-flight non-blocking save has fully committed.
+        Raises the commit's exception, if it failed."""
+        self._join_pending()
+
+    def _join_pending(self) -> None:
+        if self._pending is not None:
+            self._pending.join()
+            self._pending = None
+        self._raise_pending_error()
+
+    def _raise_pending_error(self) -> None:
+        e, self._pending_error = self._pending_error, None
+        if e is not None:
+            raise RuntimeError("checkpoint commit failed") from e
 
     def latest_step(self) -> int | None:
         steps = []
@@ -92,4 +140,5 @@ class TrainCheckpointer:
         return state, sampler_state, extra
 
     def close(self) -> None:
+        self._join_pending()
         self._ckptr.close()

@@ -89,6 +89,65 @@ def test_resume_against_changed_dataset_rejected(tmp_path, token_paths):
         ctx.close()
 
 
+def test_async_save_captures_cursor_at_call(tmp_path, token_paths):
+    """save(blocking=False): the loader cursor saved is the one AT the call —
+    batches consumed while the checkpoint drains must not leak into it — and
+    latest_step() only reports the step once fully committed."""
+    cfg = LlamaConfig.tiny()
+    mesh = make_mesh({"dp": 8}, devices=jax.devices()[:8])
+    sharding = NamedSharding(mesh, P("dp", None))
+    opt = make_optimizer()
+    step = make_train_step(cfg, mesh, opt, donate=False)
+    ctx = StromContext(StromConfig(engine="python", queue_depth=8, num_buffers=8))
+    ck = TrainCheckpointer(str(tmp_path / "ckpts"))
+    try:
+        state = init_train_state(jax.random.PRNGKey(0), cfg, mesh, opt)
+        with make_llama_pipeline(ctx, token_paths, batch=8, seq_len=16,
+                                 sharding=sharding, seed=5) as pipe:
+            state, _ = step(state, next(pipe))
+            ck.save(1, state, pipe, blocking=False)
+            expected = pipe.state()  # the resume point at the save call
+            # training races ahead while the checkpoint drains
+            for _ in range(2):
+                state, _ = step(state, next(pipe))
+            ck.wait_until_finished()
+        assert ck.latest_step() == 1
+        from strom.pipelines.sampler import load_loader_state
+
+        saved, _ = load_loader_state(ck.loader_state_path(1))
+        assert saved == expected
+    finally:
+        ck.close()
+        ctx.close()
+
+
+def test_async_commit_failure_surfaces(tmp_path, token_paths, monkeypatch):
+    """A failed background commit must raise at the next join point, not
+    report success and strand the operator at resume time."""
+    import strom.pipelines.checkpoint as cmod
+
+    cfg = LlamaConfig.tiny()
+    mesh = make_mesh({"dp": 8}, devices=jax.devices()[:8])
+    sharding = NamedSharding(mesh, P("dp", None))
+    opt = make_optimizer()
+    ctx = StromContext(StromConfig(engine="python", queue_depth=8, num_buffers=8))
+    ck = TrainCheckpointer(str(tmp_path / "ckpts"))
+    try:
+        state = init_train_state(jax.random.PRNGKey(0), cfg, mesh, opt)
+        monkeypatch.setattr(cmod, "save_loader_state",
+                            lambda *a, **k: (_ for _ in ()).throw(OSError(28, "disk full")))
+        with make_llama_pipeline(ctx, token_paths, batch=8, seq_len=16,
+                                 sharding=sharding, seed=5) as pipe:
+            next(pipe)
+            ck.save(1, state, pipe, blocking=False)
+            with pytest.raises(RuntimeError, match="checkpoint commit failed"):
+                ck.wait_until_finished()
+        assert ck.latest_step() is None  # no torn checkpoint visible
+    finally:
+        ck.close()
+        ctx.close()
+
+
 def test_latest_step_ignores_incomplete(tmp_path, token_paths):
     import os
 
