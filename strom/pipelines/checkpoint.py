@@ -53,23 +53,35 @@ class TrainCheckpointer:
         extra = copy.deepcopy(extra)  # snapshot: caller may mutate during drain
         self._ckptr.save(os.path.join(d, "state"), train_state)
 
-        def commit() -> None:
-            try:
-                self._ckptr.wait_until_finished()
-                save_loader_state(os.path.join(d, _LOADER_FILE), loader_state,
-                                  fingerprint, extra)
-            except BaseException as e:  # re-raised at the next join point
-                self._pending_error = e
+        def commit_inner() -> None:
+            self._ckptr.wait_until_finished()
+            save_loader_state(os.path.join(d, _LOADER_FILE), loader_state,
+                              fingerprint, extra)
 
         if blocking:
-            commit()
-            self._raise_pending_error()
-        else:
-            # non-daemon: a normal interpreter exit waits for the commit, so
-            # the final checkpoint of a run can't be silently discarded
-            self._pending = threading.Thread(target=commit,
-                                             name="strom-ckpt-commit")
-            self._pending.start()
+            # direct call: errors keep their own type, Ctrl-C stays a
+            # KeyboardInterrupt — the stash is only for the thread
+            commit_inner()
+            return d
+
+        def commit() -> None:
+            try:
+                commit_inner()
+            except BaseException as e:
+                # stashed for the next join point AND logged now: if the
+                # process exits without ever joining, the failure still
+                # leaves a trace instead of a silently-missing checkpoint
+                self._pending_error = e
+                import logging
+
+                logging.getLogger("strom.checkpoint").error(
+                    "async checkpoint commit for %s failed: %r", d, e)
+
+        # non-daemon: a normal interpreter exit waits for the commit, so
+        # the final checkpoint of a run can't be silently discarded
+        self._pending = threading.Thread(target=commit,
+                                         name="strom-ckpt-commit")
+        self._pending.start()
         return d
 
     def wait_until_finished(self) -> None:
@@ -140,5 +152,7 @@ class TrainCheckpointer:
         return state, sampler_state, extra
 
     def close(self) -> None:
-        self._join_pending()
-        self._ckptr.close()
+        try:
+            self._join_pending()  # may re-raise a failed async commit
+        finally:
+            self._ckptr.close()

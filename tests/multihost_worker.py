@@ -77,6 +77,27 @@ def main() -> int:
     assert int(state.step) == 2
     print(f"worker {pid}: train ok loss={loss:.6f}", flush=True)
 
+    # ring×flash across processes: the sp axis spans the PROCESS boundary
+    # (outer mesh axis), so every ppermute hop in the forward ring AND the
+    # custom-vjp backward ring rides the distributed backend, not intra-
+    # process device transfers
+    smesh = make_mesh({"sp": nproc, "dp": ndev}, devices=jax.devices())
+    ssharding = NamedSharding(smesh, P("dp", "sp"))
+    state = init_train_state(jax.random.PRNGKey(0), cfg, smesh, opt)
+    sstep = make_train_step(cfg, smesh, opt, sp=True, attn="flash")
+    B2, S = 2 * ndev, 32
+    toks_np = np.random.default_rng(11).integers(0, cfg.vocab, (B2, S),
+                                                 dtype=np.int32)
+    idx_map = ssharding.addressable_devices_indices_map((B2, S))
+    tokens = jax.make_array_from_single_device_arrays(
+        (B2, S), ssharding,
+        [jax.device_put(toks_np[i], d) for d, i in idx_map.items()])
+    state, metrics = sstep(state, tokens)
+    sloss = float(metrics["loss"])
+    assert np.isfinite(sloss), sloss
+    print(f"worker {pid}: ring-flash sp-across-processes ok loss={sloss:.6f}",
+          flush=True)
+
     # epoch barrier + straggler accounting (SURVEY.md §2.3): consume one
     # full epoch with epoch_sync=True (barrier is collective — a hang here
     # fails the test by timeout), then a collective skew report
