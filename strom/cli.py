@@ -230,18 +230,23 @@ def bench_llama(args: argparse.Namespace) -> dict:
                                      seq_len=args.seq_len, sharding=sharding,
                                      prefetch_depth=args.prefetch) as pipe:
                 state, m = run_step(state, next(pipe))  # compile outside timing
-                jax.block_until_ready(m)
+                float(m["loss"])
                 base_stalls = pipe.data_stall_steps
                 t0 = time.perf_counter()
                 for _ in range(args.steps):
                     state, m = run_step(state, next(pipe))
-                jax.block_until_ready(m)
+                # HOST FETCH, not block_until_ready: through the transfer
+                # relay block_until_ready acks dispatch long before the chain
+                # actually executes (measured 164ms vs 10.5s real on a matmul
+                # chain, BASELINE.md §C) — only fetching a value forces the
+                # full step chain to drain inside the timed region
+                train_loss = float(m["loss"])
                 dt = time.perf_counter() - t0
                 out["train_tokens_per_s"] = round(tokens / dt, 1)
                 out["train_data_stalls"] = pipe.data_stall_steps - base_stalls
                 out["train_model"] = args.model
                 out["train_attn"] = args.attn
-                out["train_loss"] = round(float(m["loss"]), 4)
+                out["train_loss"] = round(train_loss, 4)
     ctx.close()
     return out
 
@@ -340,18 +345,22 @@ def bench_resnet(args: argparse.Namespace) -> dict:
             imgs, lbls = next(pipe)
             params, bn_state, loss = sgd_step(params, bn_state, imgs,
                                               lbls % mcfg.num_classes)
-            jax.block_until_ready(loss)  # compile outside the timed region
+            float(loss)  # compile + drain outside the timed region
             base_stalls = pipe.data_stall_steps
             t0 = time.perf_counter()
             for _ in range(args.steps):
                 imgs, lbls = next(pipe)
                 params, bn_state, loss = sgd_step(params, bn_state, imgs,
                                                   lbls % mcfg.num_classes)
-            jax.block_until_ready(loss)
+            # host fetch forces the step chain to really drain (see the
+            # llama bench / BASELINE.md §C: block_until_ready acks dispatch,
+            # not execution, through the transfer relay)
+            train_loss = float(loss)
             dt = time.perf_counter() - t0
             out["train_images_per_s"] = round(args.steps * args.batch / dt, 1)
             out["train_data_stalls"] = pipe.data_stall_steps - base_stalls
             out["train_model"] = args.model
+            out["train_loss"] = round(train_loss, 4)
     ctx.close()
     return out
 
