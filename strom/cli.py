@@ -131,8 +131,12 @@ def bench_ssd2tpu(args: argparse.Namespace) -> dict:
         _drop_cache_hint(path)
         ctx = StromContext(cfg)
         dev = jax.devices()[0]
-        # warm up one transfer (compile/runtime init out of the timed region)
-        ctx.memcpy_ssd2tpu(path, offset=0, length=chunk, device=dev).block_until_ready()
+        # warm up one transfer (compile/runtime init out of the timed region,
+        # including the one-element fetch executable used below)
+        warm = ctx.memcpy_ssd2tpu(path, offset=0, length=chunk, device=dev)
+        warm.block_until_ready()
+        np.asarray(warm[:1])
+        del warm
         _drop_cache_hint(path)
         t0 = time.perf_counter()
         inflight = []
@@ -147,6 +151,10 @@ def bench_ssd2tpu(args: argparse.Namespace) -> dict:
             delivered.append(h.result())
         for a in delivered:
             a.block_until_ready()
+        # host fetch of the LAST chunk: block_until_ready only acks dispatch
+        # through the transfer relay (BASELINE.md §C)
+        if delivered:  # n_chunks can be 0 when the file is < one chunk
+            np.asarray(delivered[-1][:1])
         dt = time.perf_counter() - t0
         ctx.close()
         results.append(size / dt / 1e9)
