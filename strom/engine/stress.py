@@ -67,15 +67,61 @@ def run_stress(variant: str = "", *, seconds: float = 3.0,
                 errors.append(e)
                 stop.set()
 
-        threads = [threading.Thread(target=reader, args=(i,)) for i in range(readers)]
-        threads.append(threading.Thread(target=poller))
+        def registrar() -> None:
+            # churn the sparse dest-buffer table (register/read/unregister)
+            # against concurrent gathers + stats: exercises ext_mu and the
+            # _dest_regs/_dest_lock paths under the sanitizers. The gather
+            # itself holds the delivery layer's engine lock — read_vectored
+            # owns the whole tag space and is documented non-concurrent
+            # (engine/base.py); register/unregister stay outside the lock,
+            # racing the other threads' reads, which is the point.
+            from strom.delivery.buffers import alloc_aligned
+
+            rng = np.random.default_rng(99)
+            try:
+                while not stop.is_set():
+                    slab = alloc_aligned(int(rng.integers(1, 9)) * 128 * 1024)
+                    idx = ctx.engine.register_dest(slab)
+                    try:
+                        off = int(rng.integers(0, size - slab.nbytes)) & ~4095
+                        fi = ctx.file_index(path)
+                        with ctx._engine_lock:
+                            n = ctx.engine.read_vectored(
+                                [(fi, off, 0, slab.nbytes)], slab)
+                        if n != slab.nbytes or not np.array_equal(
+                                slab, golden[off: off + slab.nbytes]):
+                            raise AssertionError(
+                                f"registered-dest mismatch at {off}")
+                    finally:
+                        # the slab must outlive its registration even on the
+                        # error path (register_dest's documented contract)
+                        if idx >= 0:
+                            ctx.engine.unregister_dest(slab)
+            except BaseException as e:  # noqa: BLE001
+                errors.append(e)
+                stop.set()
+
+        # daemon: the leak-and-report path below must be able to EXIT with a
+        # wedged thread still alive; non-daemon threads would hang the
+        # interpreter in threading._shutdown and eat the diagnostic exit code
+        threads = [threading.Thread(target=reader, args=(i,), daemon=True)
+                   for i in range(readers)]
+        threads.append(threading.Thread(target=poller, daemon=True))
+        threads.append(threading.Thread(target=registrar, daemon=True))
         for t in threads:
             t.start()
         time.sleep(seconds)
         stop.set()
         for t in threads:
             t.join(timeout=30)
-        ctx.close()
+        alive = [t.name for t in threads if t.is_alive()]
+        if alive:
+            # closing under a live thread destroys the engine out from under
+            # it (guaranteed use-after-free — TSAN showed exactly this when a
+            # contract violation wedged a reader); report and leak instead
+            errors.append(RuntimeError(f"threads failed to stop: {alive}"))
+        else:
+            ctx.close()
         if errors:
             print(f"stress FAILED: {errors[0]!r}", file=sys.stderr)
             return 1
