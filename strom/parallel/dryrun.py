@@ -100,6 +100,35 @@ def run_dryrun(n_devices: int) -> None:
         assert np.isfinite(ep_loss), f"non-finite ep loss {ep_loss}"
         print(f"dryrun ok: mesh={ep_axes} (MoE expert parallel), loss={ep_loss:.4f}")
 
+    # Pipeline parallelism: dp×pp — layer stacks pp-sharded, microbatches
+    # pumped through the stages via ppermute, fed by the real delivery path
+    if n_devices >= 2 and n_devices % 2 == 0 and cfg.n_layers % 2 == 0:
+        from strom.parallel.pipeline import make_pp_train_step
+
+        pp_axes = {"dp": n_devices // 2, "pp": 2}
+        pp_mesh = make_mesh(pp_axes, devices=devs)
+        state = init_train_state(jax.random.PRNGKey(0), cfg, pp_mesh, optimizer)
+        pp_step = make_pp_train_step(cfg, pp_mesh, optimizer, microbatches=2)
+        B = 4 * pp_axes["dp"]  # local batch 4 → 2 microbatches of 2
+        rng_pp = np.random.default_rng(4)
+        tokens_host = rng_pp.integers(0, cfg.vocab, size=(B, 65), dtype=np.int32)
+        with tempfile.TemporaryDirectory() as td:
+            path = os.path.join(td, "pp_tokens.bin")
+            tokens_host.tofile(path)
+            ctx = StromContext(StromConfig(engine="python", queue_depth=8,
+                                           num_buffers=8))
+            try:
+                batch = ctx.memcpy_ssd2tpu(
+                    path, shape=(B, 65), dtype=np.int32,
+                    sharding=NamedSharding(pp_mesh, P("dp", None)))
+                state, metrics = pp_step(state, batch)
+                pp_loss = float(metrics["loss"])
+            finally:
+                ctx.close()
+        assert np.isfinite(pp_loss), f"non-finite pp loss {pp_loss}"
+        print(f"dryrun ok: mesh={pp_axes} (pipeline parallelism), "
+              f"loss={pp_loss:.4f}")
+
     # Composed 3-axis mesh: dp×tp×sp — ring×flash attention over sp with
     # tp-sharded heads (n_kv_heads divides tp) and dp-sharded batch, all in
     # one step: the full parallelism composition the loaders must feed.

@@ -1,0 +1,163 @@
+"""GPipe-style pipeline parallelism over a mesh "pp" axis.
+
+TPU-first mechanics, same recipe as the ring (SURVEY.md §7.1: pick a mesh,
+annotate shardings, let XLA place collectives): the stacked layer params'
+leading axis is sharded over "pp" (strom.parallel.sharding), so each stage
+holds n_layers/pp contiguous layers. Inside one `shard_map`, the batch
+splits into M microbatches and a `lax.scan` over M + pp − 1 ticks pumps them
+through the stages — each tick runs the local layer stack and rotates the
+activation one hop with `lax.ppermute` (neighbor ICI traffic, like the
+ring's kv rotation). The backward is plain autodiff through the scan:
+ppermute's transpose is the reverse rotation, so gradient activations flow
+backward through the pipe with no custom vjp.
+
+Simplifications (documented honestly):
+- fill/drain bubbles and non-edge stages' embed/head computations run and
+  are discarded via `where` masks — the uniform program keeps the scan body
+  compiled once; a production schedule (1F1B, interleaved stages) would
+  mask compute with `lax.cond`, not reduce the algorithmic bubble.
+- microbatching is over the BATCH dim, so every microbatch is a full
+  sequence and RoPE/causality are untouched.
+
+Composes with tp (head/ffn dims stay tp-sharded inside each stage) and dp
+(batch axis) on the same mesh. The loss is exactly next_token_loss's: a
+pp step and a plain step on the same params/tokens agree to float tolerance
+(tested).
+
+The reference has no compute parallelism at all (SURVEY.md §2.3); this
+exists because the build brief's multichip validation names tp/pp/dp/sp/ep
+as first-class shardings the data path must feed.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from strom.models.llama import LlamaConfig, block, init_params, rmsnorm
+from strom.parallel.sharding import param_specs
+from strom.parallel.train import TrainState
+
+
+def make_pp_train_step(cfg: LlamaConfig, mesh: Mesh,
+                       optimizer: optax.GradientTransformation, *,
+                       microbatches: int | None = None,
+                       attn: str = "dense", donate: bool = True):
+    """Compile a pipelined (state, tokens) -> (state, metrics) step.
+
+    tokens arrive P("dp", None) (replicated over pp) — the same batches the
+    strom loaders deliver. microbatches defaults to 2×pp (bubble fraction
+    (pp−1)/(M+pp−1)); the local batch must divide by it.
+    """
+    if "pp" not in mesh.axis_names:
+        raise ValueError("make_pp_train_step needs a 'pp' mesh axis")
+    if "tp" in mesh.axis_names:
+        # inside shard_map sharding is manual: block()'s head/ffn reshapes
+        # assume full logical dims, so tp would need hand-written collectives
+        # in the layer math. Refuse loudly rather than silently all-gathering
+        # tp-sharded params at every step entry.
+        raise NotImplementedError(
+            "tp inside the pipelined step is not wired; use a dp×pp mesh "
+            "(tp composes with the non-pipelined train steps)")
+    n_stage = mesh.shape["pp"]
+    if cfg.n_layers % n_stage:
+        raise ValueError(f"n_layers {cfg.n_layers} must divide by pp {n_stage}")
+    M = microbatches if microbatches is not None else max(2 * n_stage, 2)
+    if M < 1:
+        raise ValueError(f"microbatches must be >= 1, got {M}")
+    has_dp = "dp" in mesh.axis_names
+    tok_spec = P("dp", None) if has_dp else P(None, None)
+
+    if attn not in ("dense", "flash"):
+        raise ValueError(f"attn must be 'dense' or 'flash', got {attn!r}")
+    attn_fn = None
+    if attn == "flash":
+        from strom.ops.flash_attention import make_flash_attention
+
+        attn_fn = make_flash_attention()
+
+    def restrict(spec: P) -> P:
+        # manual sharding covers ONLY the pipeline axis here (tp rejected
+        # above; dp shards the token batch, not params)
+        return P(*(ax if ax == "pp" else None for ax in spec))
+
+    shapes = jax.eval_shape(partial(init_params, cfg=cfg), jax.random.key(0))
+    pspecs = jax.tree.map(restrict, param_specs(shapes),
+                          is_leaf=lambda x: isinstance(x, P))
+
+    blk = jax.checkpoint(block, static_argnums=(2, 4))
+
+    def pp_loss_local(params, tokens):
+        # params["layers"] leaves carry this stage's n_layers/pp layers
+        stage = lax.axis_index("pp")
+        Bl, S = tokens.shape
+        if Bl % M:
+            raise ValueError(f"local batch {Bl} must divide by "
+                             f"microbatches {M}")
+        mb = Bl // M
+        toks_mb = tokens.reshape(M, mb, S)
+        positions = jnp.broadcast_to(jnp.arange(S, dtype=jnp.int32), (mb, S))
+        dt = cfg.jdtype
+
+        def stage_fwd(x):
+            def body(c, lp):
+                return blk(c, lp, cfg, positions, attn_fn), None
+
+            y, _ = lax.scan(body, x, params["layers"])
+            return y
+
+        def tick(carry, t):
+            recv, loss_sum = carry
+            # stage 0 injects microbatch t (clipped garbage past the fill)
+            toks_in = toks_mb[jnp.clip(t, 0, M - 1)]
+            x0 = params["embed"][toks_in].astype(dt)
+            x = jnp.where(stage == 0, x0, recv)
+            y = stage_fwd(x)
+            # the LAST stage completes microbatch t − (pp−1) this tick
+            m_out = t - (n_stage - 1)
+            toks_out = toks_mb[jnp.clip(m_out, 0, M - 1)]
+            logits = (rmsnorm(y, params["final_norm"], cfg.norm_eps)
+                      @ params["lm_head"]).astype(jnp.float32)
+            targets = jnp.roll(toks_out, -1, axis=1)
+            logz = jax.nn.logsumexp(logits, axis=-1)
+            gold = jnp.take_along_axis(logits, targets[..., None],
+                                       axis=-1)[..., 0]
+            mask = (jnp.arange(S) < S - 1).astype(jnp.float32)
+            l = jnp.sum((logz - gold) * mask)
+            valid = jnp.logical_and(stage == n_stage - 1,
+                                    jnp.logical_and(m_out >= 0, m_out < M))
+            loss_sum = loss_sum + jnp.where(valid, l, 0.0)
+            perm = [(i, (i + 1) % n_stage) for i in range(n_stage)]
+            return (lax.ppermute(y, "pp", perm), loss_sum), None
+
+        recv0 = jnp.zeros((mb, S, cfg.d_model), dt)
+        (_, loss_sum), _ = lax.scan(tick, (recv0, jnp.float32(0.0)),
+                                    jnp.arange(M + n_stage - 1))
+        loss = lax.psum(loss_sum, "pp")  # only the last stage contributed
+        b_total = Bl
+        if has_dp:
+            loss = lax.psum(loss, "dp")
+            b_total = Bl * lax.axis_size("dp")
+        return loss / (b_total * (S - 1))
+
+    loss_fn = partial(jax.shard_map, mesh=mesh,
+                      in_specs=(pspecs, tok_spec), out_specs=P(),
+                      check_vma=False)(pp_loss_local)
+
+    def step(state: TrainState, tokens: jax.Array):
+        loss, grads = jax.value_and_grad(loss_fn)(state.params, tokens)
+        updates, new_opt = optimizer.update(grads, state.opt_state,
+                                            state.params)
+        new_params = optax.apply_updates(state.params, updates)
+        metrics = {"loss": loss, "grad_norm": optax.global_norm(grads)}
+        return TrainState(new_params, new_opt, state.step + 1), metrics
+
+    donate_argnums = (0,) if donate else ()
+    return jax.jit(step,
+                   in_shardings=(None, NamedSharding(mesh, tok_spec)),
+                   donate_argnums=donate_argnums)

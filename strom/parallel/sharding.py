@@ -7,21 +7,24 @@ from __future__ import annotations
 import jax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-# Per-leaf PartitionSpecs for strom.models.llama params (stacked layers =>
-# leading layer axis is never sharded). Column-parallel (output dim on tp)
-# feeding row-parallel (input dim on tp) pairs keep activations tp-local
-# between the two matmuls; XLA adds the reduce-scatter/all-reduce at the end.
+# Per-leaf PartitionSpecs for strom.models.llama params. The stacked layers'
+# LEADING axis carries "pp" (pipeline stages each hold n_layers/pp layers);
+# on meshes without a pp axis, param_shardings' restrict() degrades it to
+# replicated, so non-pipeline steps are unaffected. Column-parallel (output
+# dim on tp) feeding row-parallel (input dim on tp) pairs keep activations
+# tp-local between the two matmuls; XLA adds the reduce-scatter/all-reduce
+# at the end.
 _LLAMA_RULES = {
     ("embed",): P(None, "tp"),
-    ("layers", "attn_norm"): P(),
-    ("layers", "wq"): P(None, None, "tp"),
-    ("layers", "wk"): P(None, None, "tp"),
-    ("layers", "wv"): P(None, None, "tp"),
-    ("layers", "wo"): P(None, "tp", None),
-    ("layers", "mlp_norm"): P(),
-    ("layers", "w_gate"): P(None, None, "tp"),
-    ("layers", "w_up"): P(None, None, "tp"),
-    ("layers", "w_down"): P(None, "tp", None),
+    ("layers", "attn_norm"): P("pp"),
+    ("layers", "wq"): P("pp", None, "tp"),
+    ("layers", "wk"): P("pp", None, "tp"),
+    ("layers", "wv"): P("pp", None, "tp"),
+    ("layers", "wo"): P("pp", "tp", None),
+    ("layers", "mlp_norm"): P("pp"),
+    ("layers", "w_gate"): P("pp", None, "tp"),
+    ("layers", "w_up"): P("pp", None, "tp"),
+    ("layers", "w_down"): P("pp", "tp", None),
     ("final_norm",): P(),
     ("lm_head",): P(None, "tp"),
 }
@@ -60,10 +63,10 @@ def param_shardings(params: dict, mesh: Mesh) -> dict:
 # place), tp on the hidden dim within each expert.
 _MOE_RULES = {
     **_LLAMA_RULES,
-    ("layers", "router"): P(),
-    ("layers", "w_gate"): P(None, "ep", None, "tp"),
-    ("layers", "w_up"): P(None, "ep", None, "tp"),
-    ("layers", "w_down"): P(None, "ep", "tp", None),
+    ("layers", "router"): P("pp"),
+    ("layers", "w_gate"): P("pp", "ep", None, "tp"),
+    ("layers", "w_up"): P("pp", "ep", None, "tp"),
+    ("layers", "w_down"): P("pp", "ep", "tp", None),
 }
 
 
