@@ -37,13 +37,14 @@ def ref_metrics(cfg, tokens):
 
 
 class TestPipelineParallel:
-    @pytest.mark.parametrize("axes,micro", [
-        ({"dp": 4, "pp": 2}, None),   # default M = 2*pp
-        ({"pp": 2}, 8),               # pure pipeline, deep microbatching
-        ({"dp": 2, "pp": 2}, 2),      # minimal microbatching
+    @pytest.mark.parametrize("axes,micro,attn", [
+        ({"dp": 4, "pp": 2}, None, "dense"),   # default M = 2*pp
+        ({"pp": 2}, 8, "dense"),               # pure pipeline, deep microbatching
+        ({"dp": 2, "pp": 2}, 2, "dense"),      # minimal microbatching
+        ({"dp": 4, "pp": 2}, 2, "flash"),      # Pallas kernel inside each stage
     ])
     def test_loss_and_grad_match_plain_step(self, cfg, tokens, ref_metrics,
-                                            axes, micro):
+                                            axes, micro, attn):
         ref_loss, ref_gn = ref_metrics
         n = 1
         for v in axes.values():
@@ -52,9 +53,9 @@ class TestPipelineParallel:
         opt = make_optimizer()
         state = init_train_state(jax.random.PRNGKey(0), cfg, mesh, opt)
         step = make_pp_train_step(cfg, mesh, opt, donate=False,
-                                  microbatches=micro)
+                                  microbatches=micro, attn=attn)
         state, m = step(state, tokens)
-        assert abs(float(m["loss"]) - ref_loss) < 2e-3, (axes, micro)
+        assert abs(float(m["loss"]) - ref_loss) < 2e-3, (axes, micro, attn)
         assert abs(float(m["grad_norm"]) - ref_gn) / ref_gn < 1e-3
         assert int(state.step) == 1
 
