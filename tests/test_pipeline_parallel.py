@@ -59,6 +59,28 @@ class TestPipelineParallel:
         assert abs(float(m["grad_norm"]) - ref_gn) / ref_gn < 1e-3
         assert int(state.step) == 1
 
+    def test_multiple_layers_per_stage(self):
+        """4 layers over pp=2 → each stage scans 2 LOCAL layers; parity must
+        hold for the stage-local scan, not just the 1-layer-per-stage case."""
+        cfg4 = LlamaConfig(vocab=256, d_model=64, n_layers=4, n_heads=4,
+                           n_kv_heads=2, d_ff=128, rope_theta=10_000.0)
+        toks = jnp.array(
+            np.random.default_rng(2).integers(0, cfg4.vocab, (8, 32)),
+            jnp.int32)
+        opt = make_optimizer()
+        m1 = make_mesh({"dp": 1}, devices=jax.devices()[:1])
+        s1 = init_train_state(jax.random.PRNGKey(1), cfg4, m1, opt)
+        _, ref = make_train_step(cfg4, m1, opt, donate=False)(s1, toks)
+        mesh = make_mesh({"dp": 2, "pp": 2}, devices=jax.devices()[:4])
+        s2 = init_train_state(jax.random.PRNGKey(1), cfg4, mesh, opt)
+        step = make_pp_train_step(cfg4, mesh, opt, donate=False,
+                                  microbatches=2)
+        _, m = step(s2, toks)
+        assert abs(float(m["loss"]) - float(ref["loss"])) < 2e-3
+        rel = abs(float(m["grad_norm"]) - float(ref["grad_norm"])) \
+            / float(ref["grad_norm"])
+        assert rel < 1e-3
+
     def test_pp_sharded_params(self, cfg):
         """The layer stacks actually live pp-sharded (n_layers/pp per stage)."""
         mesh = make_mesh({"dp": 4, "pp": 2}, devices=jax.devices()[:8])
