@@ -17,6 +17,15 @@ from strom.pipelines import make_llama_pipeline
 from strom.pipelines.checkpoint import TrainCheckpointer
 
 
+def abstract_like(cfg, mesh, opt):
+    """Abstract train-state pytree (shapes + shardings) for ck.restore —
+    shared so the recipe lives in one place."""
+    state = init_train_state(jax.random.PRNGKey(0), cfg, mesh, opt)
+    return jax.tree.map(
+        lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype, sharding=x.sharding),
+        state)
+
+
 @pytest.fixture(scope="module")
 def token_paths(tmp_path_factory):
     td = tmp_path_factory.mktemp("ckpt_tokens")
@@ -49,9 +58,7 @@ def test_save_restore_resumes_exact_trajectory(tmp_path, token_paths):
             loss_step3 = float(metrics["loss"])
 
         assert ck.latest_step() == 2
-        abstract = jax.tree.map(
-            lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype, sharding=x.sharding),
-            init_train_state(jax.random.PRNGKey(0), cfg, mesh, opt))
+        abstract = abstract_like(cfg, mesh, opt)
         restored, sampler_state, extra = ck.restore(2, abstract)
         assert extra == {"note": "mid"}
         assert int(restored.step) == 2
@@ -143,6 +150,39 @@ def test_async_commit_failure_surfaces(tmp_path, token_paths, monkeypatch):
             with pytest.raises(RuntimeError, match="checkpoint commit failed"):
                 ck.wait_until_finished()
         assert ck.latest_step() is None  # no torn checkpoint visible
+    finally:
+        ck.close()
+        ctx.close()
+
+
+def test_pp_sharded_state_roundtrips(tmp_path, token_paths):
+    """Pipeline-parallel (pp-sharded layer stacks) train states must survive
+    save/restore with their shardings re-placed, like every other mesh."""
+    from strom.parallel.pipeline import make_pp_train_step
+
+    cfg = LlamaConfig.tiny()
+    mesh = make_mesh({"dp": 4, "pp": 2}, devices=jax.devices()[:8])
+    sharding = NamedSharding(mesh, P("dp", None))
+    opt = make_optimizer()
+    step = make_pp_train_step(cfg, mesh, opt, donate=False, microbatches=2)
+    ctx = StromContext(StromConfig(engine="python", queue_depth=8, num_buffers=8))
+    ck = TrainCheckpointer(str(tmp_path / "ckpts"))
+    try:
+        state = init_train_state(jax.random.PRNGKey(0), cfg, mesh, opt)
+        with make_llama_pipeline(ctx, token_paths, batch=8, seq_len=16,
+                                 sharding=sharding, seed=5) as pipe:
+            state, m1 = step(state, next(pipe))
+            ck.save(1, state, pipe)
+            batch2 = next(pipe)
+            state, m2 = step(state, batch2)
+        abstract = abstract_like(cfg, mesh, opt)
+        restored, _, _ = ck.restore(1, abstract)
+        assert int(restored.step) == 1
+        wq = restored.params["layers"]["wq"]
+        assert wq.sharding.spec[0] == "pp"  # sharding re-placed, not flattened
+        # same params + same batch ⇒ identical continuation
+        restored, m2b = step(restored, batch2)
+        assert float(m2b["loss"]) == float(m2["loss"])
     finally:
         ck.close()
         ctx.close()

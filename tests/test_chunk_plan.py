@@ -145,6 +145,51 @@ class TestDeliveryIntegration:
         finally:
             ctx.close()
 
+    def test_streamed_transfer_with_fragmented_map(self, tmp_path,
+                                                   monkeypatch):
+        """Extent-aware planning applies PER STREAMED PIECE (each piece's
+        _read_segments plans independently); a fragmented map must not
+        corrupt a multi-piece streamed delivery."""
+        import jax
+
+        from strom.config import StromConfig
+        from strom.delivery.core import StromContext
+
+        path = str(tmp_path / "big.bin")
+        rng = np.random.default_rng(9)
+        size = 1 << 20
+        golden = rng.integers(0, 256, size=size, dtype=np.uint8)
+        with open(path, "wb") as f:
+            f.write(golden.tobytes())
+        # 8 extents of 128KiB laid out physically in reverse
+        em = [ext(i << 17, (7 - i) << 21, 1 << 17) for i in range(8)]
+        ctx = StromContext(StromConfig(engine="python", queue_depth=8,
+                                       num_buffers=8,
+                                       overlap_chunk_bytes=256 * 1024,
+                                       overlap_min_bytes=256 * 1024))
+        try:
+            monkeypatch.setattr(ctx, "extent_map", lambda p: em)
+            seen = []
+            orig = ctx.engine.read_vectored
+
+            def spy(chunks, dest, **kw):
+                seen.append(list(chunks))
+                return orig(chunks, dest, **kw)
+
+            monkeypatch.setattr(ctx.engine, "read_vectored", spy)
+            arr = ctx.memcpy_ssd2tpu(path, length=size,
+                                     device=jax.devices()[0])
+            np.testing.assert_array_equal(np.asarray(arr), golden)
+            # planning must have run inside EVERY piece: with the extents
+            # physically reversed, each 256KiB piece's two 128KiB chunks
+            # submit in reverse file order
+            assert len(seen) >= 4, "expected one gather per streamed piece"
+            for chunks in seen:
+                offs = [off for (_, off, _, _) in chunks]
+                assert offs == sorted(offs, reverse=True), chunks
+        finally:
+            ctx.close()
+
     def test_extent_map_cached(self, tmp_path):
         import importlib
 
