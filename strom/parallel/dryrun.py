@@ -129,6 +129,38 @@ def run_dryrun(n_devices: int) -> None:
         print(f"dryrun ok: mesh={pp_axes} (pipeline parallelism), "
               f"loss={pp_loss:.4f}")
 
+    # Full 3-axis composition with the pipe: dp×tp×pp — manual-collective
+    # Megatron blocks inside each stage, microbatches over ppermute
+    if n_devices >= 8 and n_devices % 4 == 0 and cfg.n_layers % 2 == 0:
+        from strom.parallel.pipeline import make_pp_train_step
+
+        axes_tpp = {"dp": n_devices // 4, "tp": 2, "pp": 2}
+        mesh_tpp = make_mesh(axes_tpp, devices=devs)
+        state = init_train_state(jax.random.PRNGKey(0), cfg, mesh_tpp,
+                                 optimizer)
+        step_tpp = make_pp_train_step(cfg, mesh_tpp, optimizer,
+                                      microbatches=2)
+        B = 4 * axes_tpp["dp"]
+        tokens_host = np.random.default_rng(5).integers(
+            0, cfg.vocab, size=(B, 64), dtype=np.int32)
+        # through the real delivery path, like the other pipeline case
+        with tempfile.TemporaryDirectory() as td:
+            path = os.path.join(td, "tpp_tokens.bin")
+            tokens_host.tofile(path)
+            ctx = StromContext(StromConfig(engine="python", queue_depth=8,
+                                           num_buffers=8))
+            try:
+                tokens = ctx.memcpy_ssd2tpu(
+                    path, shape=(B, 64), dtype=np.int32,
+                    sharding=NamedSharding(mesh_tpp, P("dp", None)))
+                state, metrics = step_tpp(state, tokens)
+            finally:
+                ctx.close()
+        tpp_loss = float(metrics["loss"])
+        assert np.isfinite(tpp_loss), f"non-finite dp×tp×pp loss {tpp_loss}"
+        print(f"dryrun ok: mesh={axes_tpp} (dp×tp×pp pipeline), "
+              f"loss={tpp_loss:.4f}")
+
     # Composed 3-axis mesh: dp×tp×sp — ring×flash attention over sp with
     # tp-sharded heads (n_kv_heads divides tp) and dp-sharded batch, all in
     # one step: the full parallelism composition the loaders must feed.

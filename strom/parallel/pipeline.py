@@ -19,10 +19,14 @@ Simplifications (documented honestly):
 - microbatching is over the BATCH dim, so every microbatch is a full
   sequence and RoPE/causality are untouched.
 
-Composes with tp (head/ffn dims stay tp-sharded inside each stage) and dp
-(batch axis) on the same mesh. The loss is exactly next_token_loss's: a
-pp step and a plain step on the same params/tokens agree to float tolerance
-(tested).
+Composes with dp (batch axis) and tp on the same mesh: with a "tp" axis the
+stage body switches to :func:`_block_tp`, the Megatron block with MANUAL
+collectives — column-split qkv/gate/up, row-split wo/down, and the two
+psums closing each pair — since sharding inside shard_map is explicit.
+embed/lm_head stay replicated inside the pipe (every stage runs them,
+edge-masked). The loss is exactly next_token_loss's: a pp step and a plain
+step on the same params/tokens agree to float tolerance (tested, including
+dp×tp×pp and tp×pp×flash).
 
 The reference has no compute parallelism at all (SURVEY.md §2.3); this
 exists because the build brief's multichip validation names tp/pp/dp/sp/ep
@@ -39,9 +43,34 @@ import optax
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from strom.models.llama import LlamaConfig, block, init_params, rmsnorm
+from strom.models.llama import (LlamaConfig, attention, block, init_params,
+                                rmsnorm, rope)
 from strom.parallel.sharding import param_specs
 from strom.parallel.train import TrainState
+
+
+def _block_tp(x, lp, cfg: LlamaConfig, positions, attn_fn, tp_axis: str):
+    """Megatron block with MANUAL tensor parallelism for use inside
+    shard_map (where sharding is explicit): lp's matmul weights arrive
+    tp-sharded — wq/wk/wv/w_gate/w_up column-split (local output dims),
+    wo/w_down row-split — so activations stay full-width and the only
+    collectives are the two psums closing each column→row pair. Local heads
+    attend independently (GQA ratio preserved: both n_heads and n_kv_heads
+    divide by tp)."""
+    tp = lax.axis_size(tp_axis)
+    nh, nkv, hd = cfg.n_heads // tp, cfg.n_kv_heads // tp, cfg.head_dim
+    B, S, _ = x.shape
+
+    h = rmsnorm(x, lp["attn_norm"], cfg.norm_eps)
+    q = rope((h @ lp["wq"]).reshape(B, S, nh, hd), positions, cfg.rope_theta)
+    k = rope((h @ lp["wk"]).reshape(B, S, nkv, hd), positions, cfg.rope_theta)
+    v = (h @ lp["wv"]).reshape(B, S, nkv, hd)
+    attn = (attn_fn or attention)(q, k, v)
+    x = x + lax.psum(attn.reshape(B, S, nh * hd) @ lp["wo"], tp_axis)
+
+    h = rmsnorm(x, lp["mlp_norm"], cfg.norm_eps)
+    gated = jax.nn.silu(h @ lp["w_gate"]) * (h @ lp["w_up"])
+    return x + lax.psum(gated @ lp["w_down"], tp_axis)
 
 
 def make_pp_train_step(cfg: LlamaConfig, mesh: Mesh,
@@ -56,14 +85,14 @@ def make_pp_train_step(cfg: LlamaConfig, mesh: Mesh,
     """
     if "pp" not in mesh.axis_names:
         raise ValueError("make_pp_train_step needs a 'pp' mesh axis")
-    if "tp" in mesh.axis_names:
-        # inside shard_map sharding is manual: block()'s head/ffn reshapes
-        # assume full logical dims, so tp would need hand-written collectives
-        # in the layer math. Refuse loudly rather than silently all-gathering
-        # tp-sharded params at every step entry.
-        raise NotImplementedError(
-            "tp inside the pipelined step is not wired; use a dp×pp mesh "
-            "(tp composes with the non-pipelined train steps)")
+    has_tp = "tp" in mesh.axis_names
+    if has_tp:
+        tp = mesh.shape["tp"]
+        if cfg.n_heads % tp or cfg.n_kv_heads % tp or cfg.d_ff % tp:
+            raise ValueError(
+                f"n_heads {cfg.n_heads}, n_kv_heads {cfg.n_kv_heads} and "
+                f"d_ff {cfg.d_ff} must divide by tp {tp} for manual tensor "
+                "parallelism inside the pipelined step")
     n_stage = mesh.shape["pp"]
     if cfg.n_layers % n_stage:
         raise ValueError(f"n_layers {cfg.n_layers} must divide by pp {n_stage}")
@@ -81,16 +110,34 @@ def make_pp_train_step(cfg: LlamaConfig, mesh: Mesh,
 
         attn_fn = make_flash_attention()
 
-    def restrict(spec: P) -> P:
-        # manual sharding covers ONLY the pipeline axis here (tp rejected
-        # above; dp shards the token batch, not params)
+    # manual sharding covers the pipeline axis everywhere, plus tp on the
+    # LAYER matmuls (the _block_tp collectives close those). embed/lm_head
+    # stay replicated inside the pipe: every stage runs them (discarded off
+    # the edge stages), so a tp-sharded vocab dim would need its own
+    # gather/psum plumbing for no bubble-math benefit. On tp meshes whose
+    # params were initialized tp-sharded, jit inserts the entry all-gather.
+    def restrict_layers(spec: P) -> P:
+        # keep pp always; keep tp only when the mesh has a tp axis
+        return P(*(ax if ax == "pp" or (ax == "tp" and has_tp) else None
+                   for ax in spec))
+
+    def restrict_edge(spec: P) -> P:
         return P(*(ax if ax == "pp" else None for ax in spec))
 
     shapes = jax.eval_shape(partial(init_params, cfg=cfg), jax.random.key(0))
-    pspecs = jax.tree.map(restrict, param_specs(shapes),
-                          is_leaf=lambda x: isinstance(x, P))
+    base_specs = param_specs(shapes)
+    is_p = lambda x: isinstance(x, P)  # noqa: E731
+    pspecs = {
+        k: jax.tree.map(restrict_layers if k == "layers" else restrict_edge,
+                        v, is_leaf=is_p)
+        for k, v in base_specs.items()
+    }
 
-    blk = jax.checkpoint(block, static_argnums=(2, 4))
+    if has_tp:
+        blk = jax.checkpoint(partial(_block_tp, tp_axis="tp"),
+                             static_argnums=(2, 4))
+    else:
+        blk = jax.checkpoint(block, static_argnums=(2, 4))
 
     def pp_loss_local(params, tokens):
         # params["layers"] leaves carry this stage's n_layers/pp layers

@@ -42,6 +42,8 @@ class TestPipelineParallel:
         ({"pp": 2}, 8, "dense"),               # pure pipeline, deep microbatching
         ({"dp": 2, "pp": 2}, 2, "dense"),      # minimal microbatching
         ({"dp": 4, "pp": 2}, 2, "flash"),      # Pallas kernel inside each stage
+        ({"dp": 2, "tp": 2, "pp": 2}, 2, "dense"),  # manual tp inside the pipe
+        ({"tp": 2, "pp": 2}, 4, "flash"),      # tp×pp with the flash kernel
     ])
     def test_loss_and_grad_match_plain_step(self, cfg, tokens, ref_metrics,
                                             axes, micro, attn):
@@ -97,15 +99,20 @@ class TestPipelineParallel:
         with pytest.raises(ValueError, match="pp' mesh axis"):
             make_pp_train_step(cfg, make_mesh({"dp": 2},
                                               devices=jax.devices()[:2]), opt)
-        with pytest.raises(NotImplementedError, match="tp inside"):
+        with pytest.raises(ValueError, match="divide by tp"):
+            # tiny has 2 kv heads: tp=4 can't split them
             make_pp_train_step(
-                cfg, make_mesh({"tp": 2, "pp": 2}, devices=jax.devices()[:4]),
+                cfg, make_mesh({"tp": 4, "pp": 2}, devices=jax.devices()[:8]),
                 opt)
         with pytest.raises(ValueError, match="divide by pp"):
             bad = LlamaConfig(vocab=64, d_model=32, n_layers=3, n_heads=2,
                               n_kv_heads=2, d_ff=64)
             make_pp_train_step(
                 bad, make_mesh({"pp": 2}, devices=jax.devices()[:2]), opt)
+        with pytest.raises(ValueError, match="microbatches"):
+            make_pp_train_step(
+                cfg, make_mesh({"pp": 2}, devices=jax.devices()[:2]), opt,
+                microbatches=0)
 
     def test_microbatch_divisibility_surfaces(self, cfg, tokens):
         mesh = make_mesh({"pp": 2}, devices=jax.devices()[:2])
