@@ -219,6 +219,241 @@ def _ring_flash_vjp_bwd(axis_name, causal, block_q, block_k, interpret,
 ring_flash_attention_local.defvjp(_ring_flash_vjp_fwd, _ring_flash_vjp_bwd)
 
 
+# ------------------------------------------------------- zigzag (balanced)
+# The plain causal ring discards ~half its compute: at ring step s a device
+# whose kv source is "in its future" (src > idx) runs the kernel and throws
+# the result away (uniform SPMD). The classic fix re-layouts the sequence in
+# ZIGZAG order — split into 2n chunks, device i holds chunks (i, 2n−1−i) —
+# so every device owns one early and one late chunk and each ring step
+# leaves every device the same amount of VISIBLE work. The permutes are pure
+# chunk routing (4 full-bijection ppermutes total, entry + exit), sit
+# OUTSIDE the custom-vjp core (autodiff transposes them), and touch no model
+# code: RoPE/embeddings were applied before attention on the contiguous
+# layout, and the output returns to contiguous order.
+#
+# Per ring step the core runs 3 half-chunk flash calls — (q_early·kv_early)
+# causal-or-masked, (q_late·kv_early) always fully visible, (q_late·kv_late)
+# causal-or-masked; exactly one of the two maskable calls is discarded — so
+# waste is ~1/3 of 1/4-sized kernels vs ~1/2 of full-sized ones.
+
+
+def _zigzag_entry(x, axis_name: str):
+    """Contiguous shard (chunks 2i, 2i+1) → zigzag pair (chunk i, 2n−1−i).
+    x: [B, Sl, ...] with Sl even. Returns (early, late), each [B, Sl/2, ...]."""
+    n = lax.axis_size(axis_name)
+    idx = lax.axis_index(axis_name)
+    h0, h1 = jnp.split(x, 2, axis=1)
+    # owner(c) = c if c < n else 2n−1−c ; both perms are full bijections
+    perm_a = [(i, 2 * i if 2 * i < n else 2 * n - 1 - 2 * i)
+              for i in range(n)]
+    perm_b = [(i, 2 * i + 1 if 2 * i + 1 < n else 2 * n - 2 - 2 * i)
+              for i in range(n)]
+    ra = lax.ppermute(h0, axis_name, perm_a)   # arrives: chunk with parity 0
+    rb = lax.ppermute(h1, axis_name, perm_b)   # arrives: chunk with parity 1
+    # device d's early chunk is d (even→ra, odd→rb); late is 2n−1−d (opposite)
+    even = (idx % 2 == 0)
+    early = jnp.where(even, ra, rb)
+    late = jnp.where(even, rb, ra)
+    return early, late
+
+
+def _zigzag_exit(early, late, axis_name: str):
+    """Inverse of :func:`_zigzag_entry`: zigzag pair → contiguous shard."""
+    n = lax.axis_size(axis_name)
+    idx = lax.axis_index(axis_name)
+    even = (idx % 2 == 0)
+    # perm_a_inv targets half0 (chunk 2i): source d sends its chunk-2i slot —
+    # early when d even (chunk d == 2i), late when d odd (chunk 2n−1−d == 2i)
+    perm_a_inv = [(2 * i if 2 * i < n else 2 * n - 1 - 2 * i, i)
+                  for i in range(n)]
+    perm_b_inv = [(2 * i + 1 if 2 * i + 1 < n else 2 * n - 2 - 2 * i, i)
+                  for i in range(n)]
+    pay_a = jnp.where(even, early, late)
+    pay_b = jnp.where(even, late, early)   # chunk 2i+1 sits opposite
+    h0 = lax.ppermute(pay_a, axis_name, perm_a_inv)
+    h1 = lax.ppermute(pay_b, axis_name, perm_b_inv)
+    return jnp.concatenate([h0, h1], axis=1)
+
+
+def _zz_pairs(q1, q2, k1, k2, v1, v2, src, idx, block_q, block_k, interpret,
+              fwd_state, flash_fwd):
+    """One zigzag ring step's three half-chunk flash calls, merged into the
+    per-half running (o, lse) state. src: whose kv pair we hold (chunk ids
+    b1=src, b2=2n−1−src); q halves are chunks a1=idx, a2=2n−1−idx."""
+    (o1, l1), (o2, l2) = fwd_state
+
+    def call(q, k, v, causal):
+        o, l = flash_fwd(q, k, v, causal=causal, block_q=block_q,
+                         block_k=block_k, interpret=interpret)
+        return o.astype(jnp.float32), l
+
+    # a1 (early) vs b1: diagonal at src==idx, fully visible when src<idx.
+    # The diagonal needs the CAUSAL kernel; off-diagonal the non-causal one —
+    # run non-causal and fix the diagonal by select (diag only at step 0,
+    # handled by the caller passing causal=True there).
+    u1o, u1l = call(q1, k1, v1, False)
+    vis1 = src < idx
+    u1l = jnp.where(vis1, u1l, _NEG_BIG)
+    u1o = jnp.where(vis1, u1o, 0.0)
+    o1, l1 = _lse_merge(o1, l1, u1o, u1l)
+    # a2 (late) vs b1 (early): always fully visible
+    u2o, u2l = call(q2, k1, v1, False)
+    o2, l2 = _lse_merge(o2, l2, u2o, u2l)
+    # a2 vs b2: visible when src>idx (later early-chunk ⇒ EARLIER late-chunk)
+    u3o, u3l = call(q2, k2, v2, False)
+    vis3 = src > idx
+    u3l = jnp.where(vis3, u3l, _NEG_BIG)
+    u3o = jnp.where(vis3, u3o, 0.0)
+    o2, l2 = _lse_merge(o2, l2, u3o, u3l)
+    return (o1, l1), (o2, l2)
+
+
+def _zz_fwd_impl(q, k, v, axis_name, block_q, block_k, interpret):
+    from strom.ops.flash_attention import _flash_fwd
+
+    n = lax.axis_size(axis_name)
+    idx = lax.axis_index(axis_name)
+    # inputs arrive ALREADY in zigzag order (early ‖ late): just split
+    q1, q2 = jnp.split(q, 2, axis=1)
+    k1, k2 = jnp.split(k, 2, axis=1)
+    v1, v2 = jnp.split(v, 2, axis=1)
+
+    # step 0: own kv pair — the two diagonals run the causal kernel
+    o1, l1 = _flash_fwd(q1, k1, v1, causal=True, block_q=block_q,
+                        block_k=block_k, interpret=interpret)
+    o2a, l2a = _flash_fwd(q2, k1, v1, causal=False, block_q=block_q,
+                          block_k=block_k, interpret=interpret)
+    o2b, l2b = _flash_fwd(q2, k2, v2, causal=True, block_q=block_q,
+                          block_k=block_k, interpret=interpret)
+    o1 = o1.astype(jnp.float32)
+    o2, l2 = _lse_merge(o2a.astype(jnp.float32), l2a,
+                        o2b.astype(jnp.float32), l2b)
+
+    def step(carry, s):
+        (o1, l1), (o2, l2), kk1, kk2, vv1, vv2 = carry
+        perm = [(i, (i + 1) % n) for i in range(n)]
+        kk1 = lax.ppermute(kk1, axis_name, perm)
+        kk2 = lax.ppermute(kk2, axis_name, perm)
+        vv1 = lax.ppermute(vv1, axis_name, perm)
+        vv2 = lax.ppermute(vv2, axis_name, perm)
+        src = (idx - s) % n
+        st = _zz_pairs(q1, q2, kk1, kk2, vv1, vv2, src, idx, block_q,
+                       block_k, interpret, ((o1, l1), (o2, l2)), _flash_fwd)
+        return (st[0], st[1], kk1, kk2, vv1, vv2), None
+
+    ((o1, l1), (o2, l2), _, _, _, _), _ = lax.scan(
+        step, ((o1, l1), (o2, l2), k1, k2, v1, v2), jnp.arange(1, n))
+    return (o1.astype(q.dtype), o2.astype(q.dtype)), (l1, l2), (q1, q2, k1,
+                                                                k2, v1, v2)
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def _zz_core(q, k, v, axis_name, block_q, block_k, interpret):
+    (o1, o2), _, _ = _zz_fwd_impl(q, k, v, axis_name, block_q, block_k,
+                                  interpret)
+    return jnp.concatenate([o1, o2], axis=1)  # zigzag order (early ‖ late)
+
+
+def _zz_vjp_fwd(q, k, v, axis_name, block_q, block_k, interpret):
+    (o1, o2), (l1, l2), zz = _zz_fwd_impl(q, k, v, axis_name, block_q,
+                                          block_k, interpret)
+    return jnp.concatenate([o1, o2], axis=1), (zz, (o1, o2), (l1, l2))
+
+
+def _zz_vjp_bwd(axis_name, block_q, block_k, interpret, res, g):
+    from strom.ops.flash_attention import _delta, _flash_bwd
+
+    (q1, q2, k1, k2, v1, v2), (o1, o2), (l1, l2) = res
+    n = lax.axis_size(axis_name)
+    idx = lax.axis_index(axis_name)
+    g1, g2 = jnp.split(g, 2, axis=1)
+    d1 = _delta(o1, g1)
+    d2 = _delta(o2, g2)
+
+    def pair(qh, gh, oh, lh, dh, kb, vb, causal):
+        return _flash_bwd(qh, kb, vb, oh, lh, gh, causal=causal,
+                          block_q=block_q, block_k=block_k,
+                          interpret=interpret, delta=dh)
+
+    # step 0: own kv pair (diagonals causal)
+    dq1, dk1, dv1 = pair(q1, g1, o1, l1, d1, k1, v1, True)
+    dq2a, dk1b, dv1b = pair(q2, g2, o2, l2, d2, k1, v1, False)
+    dq2b, dk2, dv2 = pair(q2, g2, o2, l2, d2, k2, v2, True)
+    dq1 = dq1.astype(jnp.float32)
+    dq2 = dq2a.astype(jnp.float32) + dq2b.astype(jnp.float32)
+    dk1 = dk1.astype(jnp.float32) + dk1b.astype(jnp.float32)
+    dv1 = dv1.astype(jnp.float32) + dv1b.astype(jnp.float32)
+    dk2 = dk2.astype(jnp.float32)
+    dv2 = dv2.astype(jnp.float32)
+
+    def step(carry, s):
+        dq1, dq2, kk1, kk2, vv1, vv2, dkk1, dkk2, dvv1, dvv2 = carry
+        perm = [(i, (i + 1) % n) for i in range(n)]
+        kk1 = lax.ppermute(kk1, axis_name, perm)
+        kk2 = lax.ppermute(kk2, axis_name, perm)
+        vv1 = lax.ppermute(vv1, axis_name, perm)
+        vv2 = lax.ppermute(vv2, axis_name, perm)
+        dkk1 = lax.ppermute(dkk1, axis_name, perm)
+        dkk2 = lax.ppermute(dkk2, axis_name, perm)
+        dvv1 = lax.ppermute(dvv1, axis_name, perm)
+        dvv2 = lax.ppermute(dvv2, axis_name, perm)
+        src = (idx - s) % n
+        u_dq1, u_dk1, u_dv1 = pair(q1, g1, o1, l1, d1, kk1, vv1, False)
+        vis1 = src < idx
+        dq1n = dq1 + jnp.where(vis1, u_dq1.astype(jnp.float32), 0.0)
+        dkk1 = dkk1 + jnp.where(vis1, u_dk1.astype(jnp.float32), 0.0)
+        dvv1 = dvv1 + jnp.where(vis1, u_dv1.astype(jnp.float32), 0.0)
+        u_dq2, u_dk1b, u_dv1b = pair(q2, g2, o2, l2, d2, kk1, vv1, False)
+        dq2n = dq2 + u_dq2.astype(jnp.float32)
+        dkk1 = dkk1 + u_dk1b.astype(jnp.float32)
+        dvv1 = dvv1 + u_dv1b.astype(jnp.float32)
+        u_dq2b, u_dk2, u_dv2 = pair(q2, g2, o2, l2, d2, kk2, vv2, False)
+        vis3 = src > idx
+        dq2n = dq2n + jnp.where(vis3, u_dq2b.astype(jnp.float32), 0.0)
+        dkk2 = dkk2 + jnp.where(vis3, u_dk2.astype(jnp.float32), 0.0)
+        dvv2 = dvv2 + jnp.where(vis3, u_dv2.astype(jnp.float32), 0.0)
+        return (dq1n, dq2n, kk1, kk2, vv1, vv2, dkk1, dkk2, dvv1, dvv2), None
+
+    carry0 = (dq1, dq2, k1, k2, v1, v2, dk1, dk2, dv1, dv2)
+    (dq1, dq2, _, _, _, _, dk1, dk2, dv1, dv2), _ = lax.scan(
+        step, carry0, jnp.arange(1, n))
+    # kv (and their grads) sit one hop short of home after n−1 rotations
+    perm = [(i, (i + 1) % n) for i in range(n)]
+    dk1 = lax.ppermute(dk1, axis_name, perm)
+    dk2 = lax.ppermute(dk2, axis_name, perm)
+    dv1 = lax.ppermute(dv1, axis_name, perm)
+    dv2 = lax.ppermute(dv2, axis_name, perm)
+    return (jnp.concatenate([dq1, dq2], axis=1).astype(q1.dtype),
+            jnp.concatenate([dk1, dk2], axis=1).astype(k1.dtype),
+            jnp.concatenate([dv1, dv2], axis=1).astype(v1.dtype))
+
+
+_zz_core.defvjp(_zz_vjp_fwd, _zz_vjp_bwd)
+
+
+def zigzag_ring_flash_local(q, k, v, axis_name: str, block_q: int = 128,
+                            block_k: int = 128, interpret: bool = False):
+    """Causal ring×flash on the zigzag layout. Same contract as
+    :func:`ring_attention_local`: contiguous sequence shards in, contiguous
+    exact-attention output out — the zigzag relayout is internal."""
+    n = lax.axis_size(axis_name)
+    if n == 1:
+        return ring_flash_attention_local(q, k, v, axis_name, True, block_q,
+                                          block_k, interpret)
+    if q.shape[1] % 2:
+        raise ValueError(f"zigzag needs an even per-device sequence length, "
+                         f"got {q.shape[1]} (the shard splits into an "
+                         "early and a late half-chunk)")
+    qz = jnp.concatenate(_zigzag_entry(q, axis_name), axis=1)
+    kz = jnp.concatenate(_zigzag_entry(k, axis_name), axis=1)
+    vz = jnp.concatenate(_zigzag_entry(v, axis_name), axis=1)
+    # core consumes/produces zigzag order; entry/exit permutes live outside
+    # the custom vjp so autodiff transposes them
+    oz = _zz_core(qz, kz, vz, axis_name, block_q, block_k, interpret)
+    o1, o2 = jnp.split(oz, 2, axis=1)
+    return _zigzag_exit(o1, o2, axis_name)
+
+
 def make_ring_attention(mesh: Mesh, *, axis: str = "sp",
                         batch_axis: str = "dp", head_axis: str = "tp",
                         causal: bool = True, impl: str = "dense",
@@ -234,10 +469,16 @@ def make_ring_attention(mesh: Mesh, *, axis: str = "sp",
 
     impl="flash" runs the Pallas flash kernels per ring block (forward AND
     blockwise backward — the long-context training path); "dense" is the
-    pure-jax online-softmax ring (parity oracle, short sequences).
+    pure-jax online-softmax ring (parity oracle, short sequences);
+    "zigzag" is the load-balanced causal flash ring (internal zigzag
+    relayout; causal only — the imbalance it fixes is causality's).
     """
-    if impl not in ("dense", "flash"):
-        raise ValueError(f"impl must be 'dense' or 'flash', got {impl!r}")
+    if impl not in ("dense", "flash", "zigzag"):
+        raise ValueError(
+            f"impl must be 'dense', 'flash' or 'zigzag', got {impl!r}")
+    if impl == "zigzag" and not causal:
+        raise ValueError("zigzag balances the CAUSAL ring; use impl='flash' "
+                         "for non-causal attention")
     b = batch_axis if batch_axis in mesh.axis_names else None
     h = head_axis if head_axis in mesh.axis_names else None
     spec = P(b, axis, h, None)
@@ -247,6 +488,9 @@ def make_ring_attention(mesh: Mesh, *, axis: str = "sp",
     @partial(jax.shard_map, mesh=mesh, in_specs=(spec, spec, spec),
              out_specs=spec, check_vma=False)
     def ring_attn(q, k, v):
+        if impl == "zigzag":
+            return zigzag_ring_flash_local(q, k, v, axis, block_q, block_k,
+                                           interpret)
         if impl == "flash":
             return ring_flash_attention_local(q, k, v, axis, causal,
                                               block_q, block_k, interpret)

@@ -70,8 +70,12 @@ def make_train_step(cfg: LlamaConfig, mesh: Mesh,
     algorithm (kv blocks rotate over ICI neighbor hops) instead of letting
     XLA all-gather the whole sequence — peak memory O(S/n_sp) per device.
     """
-    if attn not in ("dense", "flash"):
-        raise ValueError(f"attn must be 'dense' or 'flash', got {attn!r}")
+    if attn not in ("dense", "flash", "zigzag"):
+        raise ValueError(
+            f"attn must be 'dense', 'flash' or 'zigzag', got {attn!r}")
+    if attn == "zigzag" and not sp:
+        raise ValueError("attn='zigzag' is the load-balanced causal RING; "
+                         "it needs sp=True")
     batch_sharding = NamedSharding(mesh, P("dp", "sp") if sp else P("dp", None))
     attn_fn = None
     if sp:
@@ -121,8 +125,12 @@ def make_moe_train_step(cfg, mesh: Mesh,
     all-to-alls the dispatch einsums imply."""
     from strom.models import moe
 
-    if attn not in ("dense", "flash"):
-        raise ValueError(f"attn must be 'dense' or 'flash', got {attn!r}")
+    if attn not in ("dense", "flash", "zigzag"):
+        raise ValueError(
+            f"attn must be 'dense', 'flash' or 'zigzag', got {attn!r}")
+    if attn == "zigzag" and not sp:
+        raise ValueError("attn='zigzag' is the load-balanced causal RING; "
+                         "it needs sp=True")
     batch_sharding = NamedSharding(mesh, P("dp", "sp") if sp else P("dp", None))
     attn_fn = None
     if sp:
