@@ -104,6 +104,17 @@ def main() -> int:
     print(f"worker {pid}: ring-flash sp-across-processes ok loss={sloss:.6f}",
           flush=True)
 
+    # zigzag variant over the same cross-process sp axis: its entry/exit
+    # relayout bijections and balanced ring must agree with the flash ring
+    # (same params, same tokens) across the process boundary
+    zstate = init_train_state(jax.random.PRNGKey(0), cfg, smesh, opt)
+    zstep = make_train_step(cfg, smesh, opt, sp=True, attn="zigzag")
+    _, zmetrics = zstep(zstate, make_sharded_batch(ssharding, toks_np))
+    zloss = float(zmetrics["loss"])
+    assert abs(zloss - sloss) < 2e-3, (zloss, sloss)
+    print(f"worker {pid}: zigzag sp-across-processes ok loss={zloss:.6f}",
+          flush=True)
+
     # pipeline parallelism across processes: pp as the OUTER mesh axis means
     # every activation hop between stages crosses the process boundary —
     # microbatch pipelining over DCN, fed per-process
