@@ -161,6 +161,38 @@ def run_dryrun(n_devices: int) -> None:
         print(f"dryrun ok: mesh={axes_tpp} (dp×tp×pp pipeline), "
               f"loss={tpp_loss:.4f}")
 
+    # Deepest composition: tp×sp×pp in ONE step — manual-tp Megatron blocks,
+    # ring×flash attention over sp inside every pipeline stage
+    if n_devices >= 8 and n_devices % 8 == 0 and cfg.n_layers % 2 == 0:
+        from strom.parallel.pipeline import make_pp_train_step
+
+        axes4 = {"tp": 2, "sp": 2, "pp": n_devices // 4}
+        mesh4 = make_mesh(axes4, devices=devs)
+        if cfg.n_layers % axes4["pp"] == 0:
+            state = init_train_state(jax.random.PRNGKey(0), cfg, mesh4,
+                                     optimizer)
+            step4 = make_pp_train_step(cfg, mesh4, optimizer,
+                                       microbatches=2, attn="flash")
+            tokens_host = np.random.default_rng(6).integers(
+                0, cfg.vocab, size=(4, 64), dtype=np.int32)
+            # sequence-sharded delivery through the real data path
+            with tempfile.TemporaryDirectory() as td:
+                path = os.path.join(td, "tspp_tokens.bin")
+                tokens_host.tofile(path)
+                ctx = StromContext(StromConfig(engine="python",
+                                               queue_depth=8, num_buffers=8))
+                try:
+                    tokens = ctx.memcpy_ssd2tpu(
+                        path, shape=(4, 64), dtype=np.int32,
+                        sharding=NamedSharding(mesh4, P(None, "sp")))
+                    state, metrics = step4(state, tokens)
+                finally:
+                    ctx.close()
+            loss4 = float(metrics["loss"])
+            assert np.isfinite(loss4), f"non-finite tp×sp×pp loss {loss4}"
+            print(f"dryrun ok: mesh={axes4} (tp×sp×pp, flash ring in-pipe), "
+                  f"loss={loss4:.4f}")
+
     # Composed 3-axis mesh: dp×tp×sp — ring×flash attention over sp with
     # tp-sharded heads (n_kv_heads divides tp) and dp-sharded batch, all in
     # one step: the full parallelism composition the loaders must feed.

@@ -19,14 +19,19 @@ Simplifications (documented honestly):
 - microbatching is over the BATCH dim, so every microbatch is a full
   sequence and RoPE/causality are untouched.
 
-Composes with dp (batch axis) and tp on the same mesh: with a "tp" axis the
-stage body switches to :func:`_block_tp`, the Megatron block with MANUAL
-collectives — column-split qkv/gate/up, row-split wo/down, and the two
-psums closing each pair — since sharding inside shard_map is explicit.
+Composes with dp (batch axis), tp and sp on the same mesh — sharding inside
+shard_map is explicit, so each composition is manual:
+- "tp": the stage body switches to :func:`_block_tp`, the Megatron block
+  with MANUAL collectives — column-split qkv/gate/up, row-split wo/down,
+  and the two psums closing each pair;
+- "sp": activations stay sequence-sharded inside every stage, attention
+  runs the ring (dense / flash / zigzag local bodies called directly —
+  we're already inside shard_map), RoPE positions offset per shard, and
+  next-token targets cross shard boundaries via one neighbor ppermute;
 embed/lm_head stay replicated inside the pipe (every stage runs them,
 edge-masked). The loss is exactly next_token_loss's: a pp step and a plain
-step on the same params/tokens agree to float tolerance (tested, including
-dp×tp×pp and tp×pp×flash).
+step on the same params/tokens agree to float tolerance (tested through
+dp×tp×pp, dp×sp×pp with all three ring impls, and tp×sp×pp).
 
 The reference has no compute parallelism at all (SURVEY.md §2.3); this
 exists because the build brief's multichip validation names tp/pp/dp/sp/ep
@@ -79,8 +84,9 @@ def make_pp_train_step(cfg: LlamaConfig, mesh: Mesh,
                        attn: str = "dense", donate: bool = True):
     """Compile a pipelined (state, tokens) -> (state, metrics) step.
 
-    tokens arrive P("dp", None) (replicated over pp) — the same batches the
-    strom loaders deliver. microbatches defaults to 2×pp (bubble fraction
+    tokens arrive P("dp", "sp") — batch on dp, sequence on sp when those
+    axes exist, replicated over pp — the same batches the strom loaders
+    deliver. microbatches defaults to 2×pp (bubble fraction
     (pp−1)/(M+pp−1)); the local batch must divide by it.
     """
     if "pp" not in mesh.axis_names:
@@ -100,12 +106,24 @@ def make_pp_train_step(cfg: LlamaConfig, mesh: Mesh,
     if M < 1:
         raise ValueError(f"microbatches must be >= 1, got {M}")
     has_dp = "dp" in mesh.axis_names
-    tok_spec = P("dp", None) if has_dp else P(None, None)
+    has_sp = "sp" in mesh.axis_names
+    tok_spec = P("dp" if has_dp else None, "sp" if has_sp else None)
 
-    if attn not in ("dense", "flash"):
-        raise ValueError(f"attn must be 'dense' or 'flash', got {attn!r}")
+    if attn not in ("dense", "flash", "zigzag"):
+        raise ValueError(
+            f"attn must be 'dense', 'flash' or 'zigzag', got {attn!r}")
+    if attn == "zigzag" and not has_sp:
+        raise ValueError("attn='zigzag' is a ring variant; it needs an 'sp' "
+                         "mesh axis")
     attn_fn = None
-    if attn == "flash":
+    if has_sp:
+        # sequence parallelism INSIDE each pipeline stage: activations stay
+        # sp-sharded and attention runs the ring over the sp axis (we are
+        # already inside shard_map, so take the local ring body directly)
+        from strom.parallel.ring import make_ring_attention_local
+
+        attn_fn = make_ring_attention_local(attn, axis="sp")
+    elif attn == "flash":
         from strom.ops.flash_attention import make_flash_attention
 
         attn_fn = make_flash_attention()
@@ -148,8 +166,20 @@ def make_pp_train_step(cfg: LlamaConfig, mesh: Mesh,
                              f"microbatches {M}")
         mb = Bl // M
         toks_mb = tokens.reshape(M, mb, S)
-        positions = jnp.broadcast_to(jnp.arange(S, dtype=jnp.int32), (mb, S))
+        # S here is the LOCAL sequence slice; absolute positions offset by
+        # this sp shard's start (RoPE + ring causality both key on them)
+        pos0 = lax.axis_index("sp") * S if has_sp else 0
+        sp_n = lax.axis_size("sp") if has_sp else 1
+        positions = jnp.broadcast_to(pos0 + jnp.arange(S, dtype=jnp.int32),
+                                     (mb, S))
         dt = cfg.jdtype
+        if has_sp:
+            # cross-shard next-token targets: fetch every microbatch's
+            # NEXT-shard first token with ONE neighbor ppermute, hoisted out
+            # of the tick scan (per-tick permutes would issue M+pp−1
+            # collectives for static data)
+            nxt_mb = lax.ppermute(toks_mb[:, :, :1], "sp",
+                                  [(i, (i - 1) % sp_n) for i in range(sp_n)])
 
         def stage_fwd(x):
             def body(c, lp):
@@ -170,11 +200,21 @@ def make_pp_train_step(cfg: LlamaConfig, mesh: Mesh,
             toks_out = toks_mb[jnp.clip(m_out, 0, M - 1)]
             logits = (rmsnorm(y, params["final_norm"], cfg.norm_eps)
                       @ params["lm_head"]).astype(jnp.float32)
-            targets = jnp.roll(toks_out, -1, axis=1)
+            if has_sp:
+                # stitch the pre-fetched next-shard first token on; only the
+                # globally-last column has no target
+                nxt = nxt_mb[jnp.clip(m_out, 0, M - 1)]
+                targets = jnp.concatenate([toks_out[:, 1:], nxt], axis=1)
+                is_last_shard = lax.axis_index("sp") == sp_n - 1
+                mask = jnp.where(is_last_shard,
+                                 (jnp.arange(S) < S - 1), True
+                                 ).astype(jnp.float32)
+            else:
+                targets = jnp.roll(toks_out, -1, axis=1)
+                mask = (jnp.arange(S) < S - 1).astype(jnp.float32)
             logz = jax.nn.logsumexp(logits, axis=-1)
             gold = jnp.take_along_axis(logits, targets[..., None],
                                        axis=-1)[..., 0]
-            mask = (jnp.arange(S) < S - 1).astype(jnp.float32)
             l = jnp.sum((logz - gold) * mask)
             valid = jnp.logical_and(stage == n_stage - 1,
                                     jnp.logical_and(m_out >= 0, m_out < M))
@@ -187,10 +227,14 @@ def make_pp_train_step(cfg: LlamaConfig, mesh: Mesh,
                                     jnp.arange(M + n_stage - 1))
         loss = lax.psum(loss_sum, "pp")  # only the last stage contributed
         b_total = Bl
+        s_total = S
+        if has_sp:
+            loss = lax.psum(loss, "sp")  # per-shard partial sums
+            s_total = S * sp_n
         if has_dp:
             loss = lax.psum(loss, "dp")
             b_total = Bl * lax.axis_size("dp")
-        return loss / (b_total * (S - 1))
+        return loss / (b_total * (s_total - 1))
 
     loss_fn = partial(jax.shard_map, mesh=mesh,
                       in_specs=(pspecs, tok_spec), out_specs=P(),

@@ -454,6 +454,31 @@ def zigzag_ring_flash_local(q, k, v, axis_name: str, block_q: int = 128,
     return _zigzag_exit(o1, o2, axis_name)
 
 
+def make_ring_attention_local(impl: str, *, axis: str = "sp",
+                              causal: bool = True, block_q: int = 128,
+                              block_k: int = 128,
+                              interpret: bool | None = None):
+    """The shard_map-INNER ring body for *impl* — shared by
+    :func:`make_ring_attention` (which wraps it in its own shard_map) and
+    the pipelined step (already inside a shard_map). One dispatch, one
+    interpret default, one place to tune block sizes."""
+    if impl not in ("dense", "flash", "zigzag"):
+        raise ValueError(
+            f"impl must be 'dense', 'flash' or 'zigzag', got {impl!r}")
+    if impl == "zigzag" and not causal:
+        raise ValueError("zigzag balances the CAUSAL ring; use impl='flash' "
+                         "for non-causal attention")
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    if impl == "zigzag":
+        return lambda q, k, v: zigzag_ring_flash_local(
+            q, k, v, axis, block_q, block_k, interpret)
+    if impl == "flash":
+        return lambda q, k, v: ring_flash_attention_local(
+            q, k, v, axis, causal, block_q, block_k, interpret)
+    return partial(ring_attention_local, axis_name=axis, causal=causal)
+
+
 def make_ring_attention(mesh: Mesh, *, axis: str = "sp",
                         batch_axis: str = "dp", head_axis: str = "tp",
                         causal: bool = True, impl: str = "dense",
@@ -473,27 +498,16 @@ def make_ring_attention(mesh: Mesh, *, axis: str = "sp",
     "zigzag" is the load-balanced causal flash ring (internal zigzag
     relayout; causal only — the imbalance it fixes is causality's).
     """
-    if impl not in ("dense", "flash", "zigzag"):
-        raise ValueError(
-            f"impl must be 'dense', 'flash' or 'zigzag', got {impl!r}")
-    if impl == "zigzag" and not causal:
-        raise ValueError("zigzag balances the CAUSAL ring; use impl='flash' "
-                         "for non-causal attention")
+    local = make_ring_attention_local(impl, axis=axis, causal=causal,
+                                      block_q=block_q, block_k=block_k,
+                                      interpret=interpret)
     b = batch_axis if batch_axis in mesh.axis_names else None
     h = head_axis if head_axis in mesh.axis_names else None
     spec = P(b, axis, h, None)
-    if interpret is None:
-        interpret = jax.default_backend() != "tpu"
 
     @partial(jax.shard_map, mesh=mesh, in_specs=(spec, spec, spec),
              out_specs=spec, check_vma=False)
     def ring_attn(q, k, v):
-        if impl == "zigzag":
-            return zigzag_ring_flash_local(q, k, v, axis, block_q, block_k,
-                                           interpret)
-        if impl == "flash":
-            return ring_flash_attention_local(q, k, v, axis, causal,
-                                              block_q, block_k, interpret)
-        return ring_attention_local(q, k, v, axis_name=axis, causal=causal)
+        return local(q, k, v)
 
     return ring_attn

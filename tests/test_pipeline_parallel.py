@@ -44,6 +44,10 @@ class TestPipelineParallel:
         ({"dp": 4, "pp": 2}, 2, "flash"),      # Pallas kernel inside each stage
         ({"dp": 2, "tp": 2, "pp": 2}, 2, "dense"),  # manual tp inside the pipe
         ({"tp": 2, "pp": 2}, 4, "flash"),      # tp×pp with the flash kernel
+        ({"dp": 2, "sp": 2, "pp": 2}, 2, "dense"),   # ring inside each stage
+        ({"dp": 2, "sp": 2, "pp": 2}, 2, "flash"),   # flash ring in-pipe
+        ({"dp": 2, "sp": 2, "pp": 2}, 2, "zigzag"),  # balanced ring in-pipe
+        ({"tp": 2, "sp": 2, "pp": 2}, 2, "flash"),   # tp+sp+pp, flash ring
     ])
     def test_loss_and_grad_match_plain_step(self, cfg, tokens, ref_metrics,
                                             axes, micro, attn):
