@@ -31,6 +31,19 @@ network relay; see BASELINE.md §C):
                   shape): flat-out loader rate, then the same loader feeding
                   a real jitted train step (small llama + flash attention) —
                   the second north star is train_data_stalls == 0
+  resnet_images_per_s, resnet_train_images_per_s, resnet_data_stalls
+                  ResNet-50 JPEG pipeline on the real device (config #2
+                  shape) — "ResNet-50 images/sec (IO-bound)" is the other
+                  half of BASELINE.json's headline metric: flat-out decode+
+                  delivery rate, then the loader feeding a real jitted
+                  ResNet-50 train step. The 0-stall north star is
+                  structurally unreachable on THIS box (one CPU core: the
+                  tunnel client's per-step RPC work and the JPEG decode pool
+                  share it, so decode only progresses while the consumer
+                  idles — BASELINE.md §C analysis); the number is reported
+                  honestly anyway, with the llama phase (decode-free loader,
+                  same overlap machinery) as the box-feasible 0-stall
+                  measurement
 """
 
 import argparse
@@ -118,6 +131,30 @@ def main() -> int:
                   file=sys.stderr)
         except Exception as e:  # loader bench must never sink the bandwidth result
             print(f"loader bench failed: {e!r}", file=sys.stderr)
+
+        # config #2: ResNet-50 images/s (the headline metric's second half)
+        # — still before the bulk phase, same relay-congestion reasoning
+        from strom.cli import bench_resnet
+
+        rargs = argparse.Namespace(
+            file=None, size=size, block=cfg.block_size, depth=32, iters=1,
+            engine="auto", tmpdir=args.tmpdir, json=True, batch=64,
+            image_size=224, steps=10, prefetch=2, decode_workers=8,
+            train_step=True, model="resnet50")
+        try:
+            rres = bench_resnet(rargs)
+            loader_res.update({
+                "resnet_images_per_s": rres["images_per_s"],
+                "resnet_train_images_per_s": rres.get("train_images_per_s"),
+                "resnet_data_stalls": rres.get("train_data_stalls"),
+            })
+            print(f"resnet loader flat-out: {rres['images_per_s']:.0f} img/s; "
+                  f"with {rres.get('train_model')} train step: "
+                  f"{rres.get('train_images_per_s')} img/s, "
+                  f"{rres.get('train_data_stalls')} data-stall steps",
+                  file=sys.stderr)
+        except Exception as e:
+            print(f"resnet bench failed: {e!r}", file=sys.stderr)
 
     # --- numerator: one streamed memcpy_ssd2tpu ----------------------------
     # (engine reads piece k+1 while piece k streams host->HBM)

@@ -67,6 +67,15 @@ def memcpy_wait(handle: DMAHandle, timeout: float | None = None):
     return handle.result(timeout)
 
 
+def register_striped(path: str, members: "StripedFile | Any",
+                     chunk: int | None = None,
+                     size: int | None = None) -> StripedFile:
+    """Alias *path* to a RAID0 striped set on the process-wide context: reads
+    addressed to the path — including format-reader extents — stripe-decode
+    across the members. See StromContext.register_striped."""
+    return context().register_striped(path, members, chunk, size)
+
+
 def buffer_info() -> dict:
     return context().buffer_info()
 

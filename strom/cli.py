@@ -14,6 +14,7 @@ reference cite UNVERIFIED — empty mount, SURVEY.md §0):
 from __future__ import annotations
 
 import argparse
+import dataclasses
 import json
 import os
 import sys
@@ -170,6 +171,31 @@ def bench_ssd2tpu(args: argparse.Namespace) -> dict:
     }
 
 
+def _timed_train_phase(pipe_factory, step, steps: int,
+                       items_per_step: int) -> tuple[float, int, float]:
+    """Shared harness for the --train-step north-star phases (llama, resnet,
+    vit): one warmup step (compile + drain) outside the timed region, a
+    stall-counter baseline, *steps* timed steps, then a HOST FETCH of the
+    loss — through the transfer relay block_until_ready acks dispatch long
+    before the chain executes (measured 164ms vs 10.5s real on a matmul
+    chain, BASELINE.md §C); only fetching a value forces the full step chain
+    to drain inside the timed region.
+
+    *step(batch) -> loss* threads model state via closure. Returns
+    (items_per_s, data_stall_steps, final_loss)."""
+    with pipe_factory() as pipe:
+        loss = step(next(pipe))  # warmup; also the reported loss at steps=0
+        float(loss)
+        base_stalls = pipe.data_stall_steps
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            loss = step(next(pipe))
+        train_loss = float(loss)
+        dt = time.perf_counter() - t0
+        return (round(steps * items_per_step / dt, 1),
+                pipe.data_stall_steps - base_stalls, round(train_loss, 4))
+
+
 def bench_llama(args: argparse.Namespace) -> dict:
     """Config #4 loader shape: packed-token pipeline throughput (tokens/s)
     + the 0-data-stall counter, feeding a dp mesh on the local device(s).
@@ -230,41 +256,58 @@ def bench_llama(args: argparse.Namespace) -> dict:
             state = init_train_state(jax.random.key(0), mcfg, mesh, opt)
             step_fn = make_train_step(mcfg, mesh, opt, attn=args.attn)
 
-            def run_step(st, toks):
+            def step(toks):
+                nonlocal state
                 # bench tokens are random bytes; clamp into vocab on device
-                return step_fn(st, toks % mcfg.vocab)
+                state, m = step_fn(state, toks % mcfg.vocab)
+                return m["loss"]
 
-            with make_llama_pipeline(ctx, [path], batch=args.batch,
-                                     seq_len=args.seq_len, sharding=sharding,
-                                     prefetch_depth=args.prefetch) as pipe:
-                state, m = run_step(state, next(pipe))  # compile outside timing
-                float(m["loss"])
-                base_stalls = pipe.data_stall_steps
-                t0 = time.perf_counter()
-                for _ in range(args.steps):
-                    state, m = run_step(state, next(pipe))
-                # HOST FETCH, not block_until_ready: through the transfer
-                # relay block_until_ready acks dispatch long before the chain
-                # actually executes (measured 164ms vs 10.5s real on a matmul
-                # chain, BASELINE.md §C) — only fetching a value forces the
-                # full step chain to drain inside the timed region
-                train_loss = float(m["loss"])
-                dt = time.perf_counter() - t0
-                out["train_tokens_per_s"] = round(tokens / dt, 1)
-                out["train_data_stalls"] = pipe.data_stall_steps - base_stalls
-                out["train_model"] = args.model
-                out["train_attn"] = args.attn
-                out["train_loss"] = round(train_loss, 4)
+            rate, stalls, loss = _timed_train_phase(
+                lambda: make_llama_pipeline(ctx, [path], batch=args.batch,
+                                            seq_len=args.seq_len,
+                                            sharding=sharding,
+                                            prefetch_depth=args.prefetch),
+                step, args.steps, args.batch * (args.seq_len + 1))
+            out["train_tokens_per_s"] = rate
+            out["train_data_stalls"] = stalls
+            out["train_model"] = args.model
+            out["train_attn"] = args.attn
+            out["train_loss"] = loss
     ctx.close()
     return out
+
+
+def _mk_wds_fixture(tmpdir: str, batch: int, image_size: int) -> str:
+    """WebDataset .tar fixture of random JPEGs (keyed by both knobs so a
+    bigger --batch regenerates it). Shared by the resnet and vit benches."""
+    import io
+    import tarfile
+
+    n_samples = max(batch * 4, 256)
+    path = os.path.join(tmpdir, f"strom_bench_wds_{image_size}_{n_samples}.tar")
+    if not os.path.exists(path):
+        import cv2
+
+        rng = np.random.default_rng(0)
+        with tarfile.open(path, "w") as tf:
+            for i in range(n_samples):
+                img = rng.integers(0, 256, (image_size * 2, image_size * 2, 3),
+                                   dtype=np.uint8)
+                ok, buf = cv2.imencode(".jpg", img,
+                                       [cv2.IMWRITE_JPEG_QUALITY, 90])
+                assert ok
+                for name, data in ((f"s{i:06d}.jpg", buf.tobytes()),
+                                   (f"s{i:06d}.cls", str(i % 1000).encode())):
+                    info = tarfile.TarInfo(name)
+                    info.size = len(data)
+                    tf.addfile(info, io.BytesIO(data))
+        os.sync()
+    return path
 
 
 def bench_resnet(args: argparse.Namespace) -> dict:
     """Config #2 shape: JPEG WebDataset -> decode -> device, images/s
     (IO-bound: a throttled fake 'train step' just blocks on delivery)."""
-    import io
-    import tarfile
-
     import jax
     from jax.sharding import NamedSharding, PartitionSpec as P
 
@@ -275,28 +318,7 @@ def bench_resnet(args: argparse.Namespace) -> dict:
 
     path = args.file
     if path is None:
-        n_samples = max(args.batch * 4, 256)
-        # fixture keyed by BOTH knobs so a bigger --batch regenerates it
-        path = os.path.join(args.tmpdir,
-                            f"strom_bench_wds_{args.image_size}_{n_samples}.tar")
-        if not os.path.exists(path):
-            import cv2
-
-            rng = np.random.default_rng(0)
-            with tarfile.open(path, "w") as tf:
-                for i in range(n_samples):
-                    img = rng.integers(0, 256, (args.image_size * 2,
-                                                args.image_size * 2, 3),
-                                       dtype=np.uint8)
-                    ok, buf = cv2.imencode(".jpg", img,
-                                           [cv2.IMWRITE_JPEG_QUALITY, 90])
-                    assert ok
-                    for name, data in ((f"s{i:06d}.jpg", buf.tobytes()),
-                                       (f"s{i:06d}.cls", str(i % 1000).encode())):
-                        info = tarfile.TarInfo(name)
-                        info.size = len(data)
-                        tf.addfile(info, io.BytesIO(data))
-            os.sync()
+        path = _mk_wds_fixture(args.tmpdir, args.batch, args.image_size)
     cfg = StromConfig(engine=args.engine, block_size=args.block,
                       queue_depth=args.depth, num_buffers=max(args.depth * 2, 8))
     ctx = StromContext(cfg)
@@ -345,30 +367,126 @@ def bench_resnet(args: argparse.Namespace) -> dict:
             new_p = jax.tree.map(lambda w, g: w - 1e-3 * g, p, grads)
             return new_p, new_s, loss
 
-        _drop_cache_hint(path)
-        with make_imagenet_resnet_pipeline(
-                ctx, [path], batch=args.batch, image_size=args.image_size,
-                sharding=sharding, prefetch_depth=args.prefetch,
-                decode_workers=args.decode_workers) as pipe:
-            imgs, lbls = next(pipe)
+        def step(batch):
+            nonlocal params, bn_state
+            imgs, lbls = batch
             params, bn_state, loss = sgd_step(params, bn_state, imgs,
                                               lbls % mcfg.num_classes)
-            float(loss)  # compile + drain outside the timed region
-            base_stalls = pipe.data_stall_steps
-            t0 = time.perf_counter()
-            for _ in range(args.steps):
-                imgs, lbls = next(pipe)
-                params, bn_state, loss = sgd_step(params, bn_state, imgs,
-                                                  lbls % mcfg.num_classes)
-            # host fetch forces the step chain to really drain (see the
-            # llama bench / BASELINE.md §C: block_until_ready acks dispatch,
-            # not execution, through the transfer relay)
-            train_loss = float(loss)
-            dt = time.perf_counter() - t0
-            out["train_images_per_s"] = round(args.steps * args.batch / dt, 1)
-            out["train_data_stalls"] = pipe.data_stall_steps - base_stalls
-            out["train_model"] = args.model
-            out["train_loss"] = round(train_loss, 4)
+            return loss
+
+        _drop_cache_hint(path)
+        rate, stalls, loss = _timed_train_phase(
+            lambda: make_imagenet_resnet_pipeline(
+                ctx, [path], batch=args.batch, image_size=args.image_size,
+                sharding=sharding, prefetch_depth=args.prefetch,
+                decode_workers=args.decode_workers),
+            step, args.steps, args.batch)
+        out["train_images_per_s"] = rate
+        out["train_data_stalls"] = stalls
+        out["train_model"] = args.model
+        out["train_loss"] = loss
+    ctx.close()
+    return out
+
+
+def bench_vit(args: argparse.Namespace) -> dict:
+    """Config #3 shape: WebDataset .tar shards -> ViT training loader on a
+    RAID0 striped set. The tar is striped over --raid member files
+    (``stripe_file``) and registered as a path alias, so every member gather
+    stripe-decodes across the set — the userspace twin of the tar living on
+    a 4xNVMe md-raid0 mount (BASELINE.json:9)."""
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from strom.config import StromConfig
+    from strom.delivery.core import StromContext
+    from strom.engine.raid0 import SIZE_SIDECAR_SUFFIX, stripe_file
+    from strom.parallel.mesh import make_mesh
+    from strom.pipelines import make_vit_wds_pipeline
+
+    plain = args.file or _mk_wds_fixture(args.tmpdir, args.batch,
+                                         args.image_size)
+    # member names keyed by BOTH raid knobs: reusing members striped with a
+    # different chunk would decode interleaved-wrong bytes. The size sidecar
+    # (written atomically last) also revalidates against a changed --file.
+    members = [f"{plain}.r{i}of{args.raid}.c{args.raid_chunk}"
+               for i in range(args.raid)]
+    sidecar = members[0] + SIZE_SIDECAR_SUFFIX
+    try:
+        with open(sidecar) as f:
+            fresh = int(f.read()) == os.path.getsize(plain) \
+                and all(os.path.getmtime(m) >= os.path.getmtime(plain)
+                        for m in members)  # same-size content change → restripe
+    except (OSError, ValueError):
+        fresh = False
+    if not fresh:
+        stripe_file(plain, members, args.raid_chunk)
+    cfg = StromConfig(engine=args.engine, block_size=args.block,
+                      queue_depth=args.depth, num_buffers=max(args.depth * 2, 8))
+    ctx = StromContext(cfg)
+    virt = plain + ".raid0"  # never exists on disk: reads resolve via alias
+    ctx.register_striped(virt, members, args.raid_chunk)
+    n_dev = max(d for d in range(len(jax.devices()), 0, -1) if args.batch % d == 0)
+    mesh = make_mesh({"dp": n_dev}, devices=jax.devices()[:n_dev])
+    sharding = NamedSharding(mesh, P("dp", None, None, None))
+    for m in members:
+        _drop_cache_hint(m)
+    with make_vit_wds_pipeline(
+            ctx, [virt], batch=args.batch, image_size=args.image_size,
+            sharding=sharding, prefetch_depth=args.prefetch,
+            decode_workers=args.decode_workers) as pipe:
+        next(pipe)[0].block_until_ready()
+        t0 = time.perf_counter()
+        for _ in range(args.steps):
+            imgs, _ = next(pipe)
+            imgs.block_until_ready()
+        dt = time.perf_counter() - t0
+        stalls = pipe.data_stall_steps
+    out = {
+        "bench": "vit_loader", "images_per_s": round(args.steps * args.batch / dt, 1),
+        "batch": args.batch, "image_size": args.image_size,
+        "steps": args.steps, "devices": n_dev, "raid_members": args.raid,
+        "data_stall_steps": stalls, "engine": cfg.engine,
+    }
+
+    if getattr(args, "train_step", False):
+        # north-star phase: a REAL jitted ViT train step consumes the batches
+        # (decode+stripe-gather must hide behind its device time)
+        import functools
+
+        from strom.models.resnet import normalize_images
+        from strom.models.vit import ViTConfig, init_params, loss_fn
+
+        mcfg = getattr(ViTConfig, args.model)()
+        if mcfg.image_size != args.image_size:
+            mcfg = dataclasses.replace(mcfg, image_size=args.image_size)
+        params = init_params(jax.random.key(0), mcfg)
+
+        @functools.partial(jax.jit, donate_argnums=(0,))
+        def sgd_step(p, images, labels):
+            loss, grads = jax.value_and_grad(loss_fn)(
+                p, normalize_images(images), labels, mcfg)
+            new_p = jax.tree.map(lambda w, g: w - 1e-3 * g, p, grads)
+            return new_p, loss
+
+        def step(batch):
+            nonlocal params
+            imgs, lbls = batch
+            params, loss = sgd_step(params, imgs, lbls % mcfg.num_classes)
+            return loss
+
+        for m in members:
+            _drop_cache_hint(m)
+        rate, stalls, loss = _timed_train_phase(
+            lambda: make_vit_wds_pipeline(
+                ctx, [virt], batch=args.batch, image_size=args.image_size,
+                sharding=sharding, prefetch_depth=args.prefetch,
+                decode_workers=args.decode_workers),
+            step, args.steps, args.batch)
+        out["train_images_per_s"] = rate
+        out["train_data_stalls"] = stalls
+        out["train_model"] = args.model
+        out["train_loss"] = loss
     ctx.close()
     return out
 
@@ -506,6 +624,27 @@ def main(argv: list[str] | None = None) -> int:
                       choices=["tiny", "resnet50"],
                       help="ResNet config for --train-step")
     p_rn.set_defaults(fn=bench_resnet)
+
+    p_vit = sub.add_parser("vit", help="config #3: WDS .tar -> ViT loader "
+                                       "images/s over a RAID0 striped set")
+    common(p_vit)
+    p_vit.add_argument("--batch", type=int, default=64)
+    p_vit.add_argument("--image-size", type=int, default=224, dest="image_size")
+    p_vit.add_argument("--steps", type=int, default=20)
+    p_vit.add_argument("--prefetch", type=int, default=2)
+    p_vit.add_argument("--decode-workers", type=int, default=8, dest="decode_workers")
+    p_vit.add_argument("--raid", type=int, default=4,
+                       help="RAID0 member count (config #3: 4xNVMe)")
+    p_vit.add_argument("--raid-chunk", type=int, default=512 * 1024,
+                       dest="raid_chunk", help="RAID0 chunk size")
+    p_vit.add_argument("--train-step", action="store_true", dest="train_step",
+                       help="also run a REAL jitted ViT train step over the "
+                            "loader (the 0-data-stall north-star measurement)")
+    p_vit.add_argument("--model", default="vit_b16",
+                       choices=["tiny", "vit_b16"],
+                       help="ViT config for --train-step (image_size is "
+                            "overridden to --image-size)")
+    p_vit.set_defaults(fn=bench_vit)
 
     p_pq = sub.add_parser("parquet", help="config #5: PG-Strom-style columnar "
                                           "scan fan-out rows/s")

@@ -17,6 +17,7 @@ import concurrent.futures
 import contextlib
 import dataclasses
 import errno
+import io
 import math
 import os
 import queue
@@ -48,9 +49,26 @@ class StripedFile:
 
     members: tuple[str, ...]
     chunk: int
+    # logical size override: a file striped with zero padding to a full
+    # stripe width (engine/raid0.stripe_file) reports its TRUE size here, so
+    # formats with trailing metadata (parquet footers) see the real EOF and
+    # record counting (rawbin) never counts padding as data
+    size_bytes: int | None = None
 
     @property
     def size(self) -> int:
+        if self.size_bytes is not None:
+            return self.size_bytes
+        # sets written by stripe_file carry their true size in a sidecar;
+        # honoring it here closes the silent-zero-pad trap even when the
+        # caller forgot to pass size= at registration
+        from strom.engine.raid0 import SIZE_SIDECAR_SUFFIX
+
+        try:
+            with open(self.members[0] + SIZE_SIDECAR_SUFFIX) as f:
+                return int(f.read())
+        except (OSError, ValueError):
+            pass
         sizes = [os.stat(m).st_size for m in self.members]
         usable = min(sizes) // self.chunk * self.chunk
         return usable * len(self.members)
@@ -147,6 +165,61 @@ def source_size(source: Source) -> int:
         else os.stat(source).st_size
 
 
+class SourceIO(io.RawIOBase):
+    """Minimal seekable file-like over any delivery Source (StripedFile,
+    ExtentList, or path), reading through ``ctx.pread``. For library code
+    that wants a file object against engine-backed sources — e.g. indexing a
+    tar or reading Parquet metadata on a striped set.
+
+    Small reads are served from a *readahead* window (one engine round-trip
+    per window, not per read): a tar header walk issues one 512-byte read
+    per member, which naively costs an engine submit/wait + fresh slab each
+    — ~100k round-trips to index a 50k-sample shard. Bulk payload bytes
+    should still flow through gather reads, not this adapter."""
+
+    def __init__(self, ctx: "StromContext", source: Source,
+                 readahead: int = 1 << 20):
+        self._ctx = ctx
+        self._source = source
+        self._size = source_size(ctx.resolve_source(source))
+        self._pos = 0
+        self._ra = max(readahead, 1)
+        self._buf = b""
+        self._buf_off = 0  # source offset of _buf[0]
+
+    def readable(self) -> bool:
+        return True
+
+    def seekable(self) -> bool:
+        return True
+
+    def seek(self, offset: int, whence: int = io.SEEK_SET) -> int:
+        base = {io.SEEK_SET: 0, io.SEEK_CUR: self._pos,
+                io.SEEK_END: self._size}[whence]
+        self._pos = base + offset
+        return self._pos
+
+    def tell(self) -> int:
+        return self._pos
+
+    def read(self, n: int = -1) -> bytes:
+        if n < 0:
+            n = self._size - self._pos
+        n = min(n, self._size - self._pos)
+        if n <= 0:
+            return b""
+        lo = self._pos - self._buf_off
+        if not (0 <= lo and lo + n <= len(self._buf)):
+            fetch = min(max(n, self._ra), self._size - self._pos)
+            self._buf = self._ctx.pread(self._source, self._pos,
+                                        fetch).tobytes()
+            self._buf_off = self._pos
+            lo = 0
+        data = self._buf[lo: lo + n]
+        self._pos += len(data)
+        return data
+
+
 class StromContext:
     """Owns the engine, file-registration cache and delivery executor.
 
@@ -158,6 +231,10 @@ class StromContext:
         self.config = config or StromConfig.from_env()
         self.engine = engine or make_engine(self.config)
         self._files: dict[str, int] = {}
+        # path → StripedFile aliases (register_striped): lets format readers
+        # that traffic in path-keyed extents (tar members, Parquet column
+        # chunks) ride RAID0 without knowing about striping
+        self._striped: dict[str, StripedFile] = {}
         # FIEMAP extent map per registered file: list[Extent] when mapped,
         # None when the fs can't say (tmpfs, old kernels) — probed once
         self._extent_maps: dict[str, list | None] = {}
@@ -198,6 +275,49 @@ class StromContext:
                 idx = self.engine.register_file(path, o_direct=self.config.o_direct)
                 self._files[path] = idx
             return idx
+
+    def register_striped(self, path: str, striped: "StripedFile | Sequence[str]",
+                         chunk: int | None = None,
+                         size: int | None = None) -> StripedFile:
+        """Alias *path* to a RAID0 striped set: every read addressed to the
+        path — including extents a format reader planned against it — is
+        stripe-decoded across the members. The userspace twin of mounting a
+        filesystem on an md-raid0 array: files keep ordinary names while the
+        block layer stripes underneath (SURVEY.md §2.2 "md-raid0 decode").
+        """
+        if isinstance(striped, StripedFile):
+            # don't silently drop the extra args against a prebuilt instance
+            if chunk is not None and chunk != striped.chunk:
+                raise ValueError(
+                    f"chunk={chunk} conflicts with StripedFile.chunk="
+                    f"{striped.chunk}; pass one or the other")
+            if size is not None:
+                striped = dataclasses.replace(striped, size_bytes=size)
+        else:
+            if chunk is None:
+                # the stripe chunk is a property of how the members were
+                # WRITTEN; defaulting it (e.g. to the IO block size) would
+                # de-interleave with the wrong geometry and return
+                # byte-shuffled data with no error
+                raise ValueError("chunk is required when registering a "
+                                 "member list: it must match the chunk the "
+                                 "set was striped with")
+            striped = StripedFile(tuple(striped), chunk, size)
+        with self._files_lock:
+            self._striped[path] = striped
+        return striped
+
+    def striped_source(self, path: str) -> StripedFile | None:
+        """The StripedFile aliased to *path*, if any."""
+        with self._files_lock:
+            return self._striped.get(path)
+
+    def resolve_source(self, source: "Source") -> "Source":
+        """*source* with any registered striped alias applied."""
+        if isinstance(source, str):
+            with self._files_lock:
+                return self._striped.get(source, source)
+        return source
 
     def _on_slab_alloc(self, base: np.ndarray) -> None:
         """Fresh pool slab: NUMA-place it, then register it with the engine
@@ -253,25 +373,46 @@ class StromContext:
         block_size, pipelined at queue_depth. Returns total bytes read.
         Raises EngineError on any failed or short chunk."""
         cfg = self.config
+        source = self.resolve_source(source)
         if self._numa is not None:
             # pin THIS thread (the engine submit path runs on it) to the
             # device's home node; once per thread, resolved from the source
             self._numa.ensure_thread(self._numa_path(source))
+
+        # member fds resolved once per transfer, not once per extent run (a
+        # WDS batch produces one run per sample component)
+        member_cache: dict[StripedFile, list[int]] = {}
+
+        def stripe_chunks(sf: StripedFile, file_off: int, dest_off: int,
+                          length: int) -> None:
+            member_idx = member_cache.get(sf)
+            if member_idx is None:
+                member_idx = [self.file_index(m) for m in sf.members]
+                member_cache[sf] = member_idx
+            for s in plan_stripe_reads(file_off, length, len(sf.members),
+                                       sf.chunk):
+                chunks.append((member_idx[s.member], s.member_offset,
+                               dest_off + (s.logical_offset - file_off),
+                               s.length))
+
         # Expand logical segments to physical (file_index, offset) chunks.
         chunks: list[tuple[int, int, int, int]] = []  # (file_idx, file_off, dest_off, len)
         if isinstance(source, StripedFile):
-            member_idx = [self.file_index(m) for m in source.members]
             for seg in segments:
-                for s in plan_stripe_reads(base_offset + seg.file_offset, seg.length,
-                                           len(source.members), source.chunk):
-                    dest_off = seg.dest_offset + (s.logical_offset - (base_offset + seg.file_offset))
-                    chunks.append((member_idx[s.member], s.member_offset, dest_off, s.length))
+                stripe_chunks(source, base_offset + seg.file_offset,
+                              seg.dest_offset, seg.length)
         elif isinstance(source, ExtentList):
             for seg in segments:
                 for r in source.locate(base_offset + seg.file_offset, seg.length,
                                        seg.dest_offset):
-                    chunks.append((self.file_index(r.path), r.offset,
-                                   r.dest_offset, r.length))
+                    sf = self.striped_source(r.path)
+                    if sf is not None:
+                        # extent planned against an aliased path: stripe-decode
+                        # it here, exactly where a plain path resolves to an fd
+                        stripe_chunks(sf, r.offset, r.dest_offset, r.length)
+                    else:
+                        chunks.append((self.file_index(r.path), r.offset,
+                                       r.dest_offset, r.length))
         else:
             fi = self.file_index(source)
             chunks = [(fi, base_offset + s.file_offset, s.dest_offset, s.length)
@@ -422,6 +563,7 @@ class StromContext:
             raise RuntimeError("StromContext is closed")
         if sharding is not None and device is not None:
             raise ValueError("pass either sharding or device, not both")
+        source = self.resolve_source(source)
 
         if self._numa is not None:
             # resolve the target node BEFORE any slab leaves the pool: a slab
@@ -534,6 +676,7 @@ class StromContext:
         payloads before decode."""
         if self._closed:
             raise RuntimeError("StromContext is closed")
+        source = self.resolve_source(source)
         if length is None:
             length = source_size(source) - offset
         if length == 0:

@@ -12,6 +12,8 @@ files/devices opened directly.
 from __future__ import annotations
 
 import dataclasses
+import os
+from typing import Sequence
 
 
 @dataclasses.dataclass(frozen=True)
@@ -63,6 +65,59 @@ def coalesce(segs: list[StripeSegment]) -> list[StripeSegment]:
                 continue
         out.append(s)
     return out
+
+
+SIZE_SIDECAR_SUFFIX = ".stromsz"
+
+
+def stripe_file(src: str, members: Sequence[str], chunk: int) -> int:
+    """Write *src*'s bytes into RAID0 member files (logical chunk k → member
+    k % n at member-chunk k // n), zero-padding the tail to a full stripe
+    width so the striped logical size covers the whole source. Fixture/bench
+    helper: the inverse of what :func:`plan_stripe_reads` decodes.
+
+    Returns the TRUE source size, and records it in a ``.stromsz`` sidecar
+    next to the first member: without it, ``StripedFile.size`` reports the
+    zero-padded stripe width, and formats that trust the size — trailing
+    parquet footers, rawbin record counting — silently read the padding as
+    data. Members are written to temp names and renamed only on completion,
+    so an interrupted stripe can never be mistaken for a finished one.
+    """
+    n = len(members)
+    if n <= 0 or chunk <= 0:
+        raise ValueError("need >= 1 member and a positive chunk")
+    size = os.stat(src).st_size
+    width = chunk * n
+    padded = -(-size // width) * width
+    tmps = [m + ".tmp" for m in members] \
+        + [members[0] + SIZE_SIDECAR_SUFFIX + ".tmp"]
+    outs = [open(t, "wb") for t in tmps[:-1]]
+    try:
+        try:
+            with open(src, "rb") as f:
+                for pos in range(0, padded, chunk):
+                    data = f.read(chunk)
+                    if len(data) < chunk:
+                        data = data.ljust(chunk, b"\0")
+                    outs[(pos // chunk) % n].write(data)
+        finally:
+            for o in outs:
+                o.close()
+        with open(tmps[-1], "w") as f:
+            f.write(str(size))
+        for m in members:
+            os.replace(m + ".tmp", m)
+        os.replace(tmps[-1], members[0] + SIZE_SIDECAR_SUFFIX)
+    except BaseException:
+        # a failed stripe (ENOSPC mid-write) must not leave GiB-scale .tmp
+        # garbage next to the dataset
+        for t in tmps:
+            try:
+                os.unlink(t)
+            except OSError:
+                pass
+        raise
+    return size
 
 
 def logical_size(member_sizes: list[int], chunk: int) -> int:

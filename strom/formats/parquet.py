@@ -39,9 +39,10 @@ class _RangeCache:
         self._offsets.insert(i, offset)
         self._bufs.insert(i, buf)
 
-    def read(self, offset: int, length: int, fallback_fd: int) -> bytes:
+    def read(self, offset: int, length: int, fallback) -> bytes:
         """Serve [offset, +length), stitching cached ranges; gaps fall back to
-        pread on the real file (counted as miss bytes)."""
+        *fallback(offset, length) -> bytes* on the real source (counted as
+        miss bytes)."""
         out = bytearray(length)
         pos = offset
         end = offset + length
@@ -59,7 +60,7 @@ class _RangeCache:
             # miss: read up to the next cached range (or to end)
             j = bisect.bisect_right(self._offsets, pos)
             stop = min(end, self._offsets[j]) if j < len(self._offsets) else end
-            data = os.pread(fallback_fd, stop - pos, pos)
+            data = fallback(pos, stop - pos)
             if not data:
                 return bytes(out[: pos - offset])  # EOF
             out[pos - offset: pos - offset + len(data)] = data
@@ -74,10 +75,23 @@ class RangeCachedFile:
     pyarrow wraps this in a PythonFile; all reads it issues for the footer and
     the selected column chunks are served from engine-prefetched ranges."""
 
-    def __init__(self, path: str, cache: _RangeCache):
+    def __init__(self, path: str, cache: _RangeCache, *,
+                 ctx: "StromContext | None" = None):
+        """Misses pread the real file — or, when *ctx* aliases *path* to a
+        striped set (``register_striped``), gather through the engine."""
         self._cache = cache
-        self._fd = os.open(path, os.O_RDONLY)
-        self._size = os.fstat(self._fd).st_size
+        striped = ctx.striped_source(path) if ctx is not None else None
+        if striped is not None:
+            from strom.delivery.core import source_size
+
+            self._fd = -1
+            self._size = source_size(striped)
+            self._fallback = lambda off, ln: ctx.pread(
+                striped, off, min(ln, self._size - off)).tobytes()
+        else:
+            self._fd = os.open(path, os.O_RDONLY)
+            self._size = os.fstat(self._fd).st_size
+            self._fallback = lambda off, ln: os.pread(self._fd, ln, off)
         self._pos = 0
         self._closed = False
 
@@ -85,7 +99,7 @@ class RangeCachedFile:
         if n < 0:
             n = self._size - self._pos
         n = max(0, min(n, self._size - self._pos))
-        data = self._cache.read(self._pos, n, self._fd)
+        data = self._cache.read(self._pos, n, self._fallback)
         self._pos += len(data)
         return data
 
@@ -127,17 +141,29 @@ class RangeCachedFile:
     def close(self) -> None:
         if not self._closed:
             self._closed = True
-            os.close(self._fd)
+            if self._fd >= 0:
+                os.close(self._fd)
 
 
 class ParquetShard:
     """One Parquet file: metadata once, column chunks as ExtentLists."""
 
-    def __init__(self, path: str):
+    def __init__(self, path: str, *, ctx: "StromContext | None" = None):
+        """*ctx*: when it aliases *path* to a striped set
+        (``register_striped``), metadata is read through the engine and every
+        chunk/footer gather stripe-decodes — the file need not exist on disk.
+        """
         import pyarrow.parquet as pq
 
         self.path = path
-        self.metadata = pq.read_metadata(path)
+        self._ctx = ctx
+        self._striped = ctx.striped_source(path) if ctx is not None else None
+        if self._striped is not None:
+            from strom.delivery.core import SourceIO
+
+            self.metadata = pq.read_metadata(SourceIO(ctx, self._striped))
+        else:
+            self.metadata = pq.read_metadata(path)
         self._footer_bytes: np.ndarray | None = None  # engine-read once, reused
         self._col_index = {
             self.metadata.schema.column(i).path: i
@@ -185,7 +211,12 @@ class ParquetShard:
         """The footer region. pyarrow speculatively reads the trailing 64KiB
         to find the footer, so cover at least that (or the whole thrift
         metadata + 4-byte length + 'PAR1' when it's bigger)."""
-        fsize = os.stat(self.path).st_size
+        if self._striped is not None:
+            from strom.delivery.core import source_size
+
+            fsize = source_size(self._striped)
+        else:
+            fsize = os.stat(self.path).st_size
         flen = min(fsize, max(self.metadata.serialized_size + 8, 64 * 1024))
         return ExtentList([Extent(self.path, fsize - flen, flen)])
 
@@ -206,7 +237,7 @@ class ParquetShard:
         for e in chunk_ext.extents:
             cache.insert(e.offset, buf[pos: pos + e.length])
             pos += e.length
-        f = RangeCachedFile(self.path, cache)
+        f = RangeCachedFile(self.path, cache, ctx=self._ctx)
         try:
             pf = pq.ParquetFile(f)
             table = pf.read_row_group(

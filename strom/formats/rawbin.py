@@ -26,6 +26,9 @@ class TokenShardSet:
     paths: tuple[str, ...]
     record_tokens: int                 # tokens per record (seq_len + 1 for LM loss)
     dtype: np.dtype = np.dtype(np.int32)
+    # per-shard byte sizes, for paths that aren't plain files (e.g. aliased
+    # to a RAID0 striped set via StromContext.register_striped); None → stat
+    shard_sizes: tuple[int, ...] | None = None
 
     def __post_init__(self) -> None:
         if not self.paths:
@@ -34,9 +37,13 @@ class TokenShardSet:
             raise ValueError("record_tokens must be positive")
         object.__setattr__(self, "paths", tuple(self.paths))
         object.__setattr__(self, "dtype", np.dtype(self.dtype))
+        sizes = self.shard_sizes
+        if sizes is not None and len(sizes) != len(self.paths):
+            raise ValueError("shard_sizes must match paths")
         counts = []
-        for p in self.paths:
-            counts.append(os.stat(p).st_size // self.record_bytes)
+        for i, p in enumerate(self.paths):
+            nbytes = sizes[i] if sizes is not None else os.stat(p).st_size
+            counts.append(nbytes // self.record_bytes)
         object.__setattr__(self, "_records_per_shard", tuple(counts))
         starts = [0]
         for c in counts:

@@ -11,12 +11,18 @@ Consumer: the ViT training loader (BASELINE config #3, BASELINE.json:9).
 from __future__ import annotations
 
 import dataclasses
+import io
 import json
 import os
 import tarfile
-from typing import Iterator, Mapping, Sequence
+from typing import Any, Iterator, Mapping, Sequence
 
 from strom.delivery.extents import Extent, ExtentList
+
+
+from strom.delivery.core import SourceIO  # noqa: F401  (re-export: tar
+# indexing over striped sets uses it; the adapter lives in the delivery
+# layer it operates on)
 
 _IDX_SUFFIX = ".stromidx.json"
 _IDX_VERSION = 1
@@ -66,13 +72,20 @@ class TarIndex:
         self.members = members
 
     @classmethod
-    def build(cls, path: str, *, cache: bool = True) -> "TarIndex":
+    def build(cls, path: str, *, cache: bool = True,
+              fileobj: io.RawIOBase | None = None) -> "TarIndex":
+        """Index the shard at *path*. With *fileobj* (e.g. a :class:`SourceIO`
+        over a striped set aliased to *path*), headers are read through it and
+        the sidecar cache is skipped — the path need not exist on disk."""
+        if fileobj is not None:
+            cache = False
         cached = cls._load_cache(path) if cache else None
         if cached is not None:
             return cached
         members: list[TarMember] = []
         # tarfile in stream-less mode seeks header→header, never reads payloads
-        with tarfile.open(path, "r:") as tf:
+        # (fileobj=None → tarfile opens the path itself)
+        with tarfile.open(path, "r:", fileobj=fileobj) as tf:
             for m in tf:
                 if m.isfile():
                     members.append(TarMember(m.name, m.offset_data, m.size))
@@ -132,11 +145,22 @@ class TarIndex:
 class WdsShardSet:
     """Multiple tar shards addressed as one sample collection."""
 
-    def __init__(self, paths: Sequence[str], *, cache_index: bool = True):
+    def __init__(self, paths: Sequence[str], *, cache_index: bool = True,
+                 ctx: Any = None):
+        """*ctx*: a StromContext; shard paths it aliases to striped sets
+        (``ctx.register_striped``) are indexed through the engine instead of
+        the (non-existent) plain path — the samples' extents keep the aliased
+        path, so payload gathers stripe-decode in the delivery layer."""
         if not paths:
             raise ValueError("need at least one shard")
         self.paths = tuple(paths)
-        self.indexes = [TarIndex.build(p, cache=cache_index) for p in self.paths]
+        self.indexes = []
+        for p in self.paths:
+            sf = ctx.striped_source(p) if ctx is not None else None
+            self.indexes.append(
+                TarIndex.build(p, cache=cache_index,
+                               fileobj=SourceIO(ctx, sf) if sf is not None
+                               else None))
         self._samples: list[WdsSample] = []
         for idx in self.indexes:
             self._samples.extend(idx.samples())

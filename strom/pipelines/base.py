@@ -126,12 +126,12 @@ class Pipeline:
 
 
 def resolve_state(paths: tuple[str, ...], *, seed: int,
-                  resume_from: str | SamplerState | None
-                  ) -> tuple[SamplerState | None, dict]:
+                  resume_from: str | SamplerState | None,
+                  ctx=None) -> tuple[SamplerState | None, dict]:
     """Common resume plumbing: fingerprint the shard list and, when resuming,
     validate both the dataset identity and the shuffle seed — a checkpoint
     saved under a different seed describes a different data order."""
-    fp = dataset_fingerprint(paths)
+    fp = dataset_fingerprint(paths, ctx)
     if resume_from is None:
         return None, fp
     if isinstance(resume_from, SamplerState):

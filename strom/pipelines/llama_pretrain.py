@@ -38,9 +38,15 @@ def make_llama_pipeline(ctx: StromContext, paths: Sequence[str], *,
     sampler is deterministic in (seed, epoch)); the sharded read planner then
     fetches only host-local bytes.
     """
-    shards = TokenShardSet(tuple(paths), record_tokens=seq_len + 1,
-                           dtype=np.dtype(dtype))
-    state, fp = resolve_state(shards.paths, seed=seed, resume_from=resume_from)
+    from strom.delivery.core import source_size
+
+    # shard paths the ctx aliases to striped sets size via the alias (they
+    # need not exist on disk); plain paths behave as before
+    shards = TokenShardSet(
+        tuple(paths), record_tokens=seq_len + 1, dtype=np.dtype(dtype),
+        shard_sizes=tuple(source_size(ctx.resolve_source(p)) for p in paths))
+    state, fp = resolve_state(shards.paths, seed=seed, resume_from=resume_from,
+                              ctx=ctx)
     sampler = EpochShuffleSampler(shards.num_records, batch, seed=seed,
                                   shuffle=shuffle, state=state)
     shape = (batch, seq_len + 1)

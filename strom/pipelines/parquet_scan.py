@@ -65,7 +65,7 @@ def parquet_scan_aggregate(ctx: StromContext, paths: Sequence[str],
 
     from strom.parallel.multihost import assign_balanced
 
-    shards = [ParquetShard(p) for p in paths]
+    shards = [ParquetShard(p, ctx=ctx) for p in paths]
     units = scan_units(shards)
     if not units:
         raise ValueError("no row groups to scan")

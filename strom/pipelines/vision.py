@@ -94,10 +94,11 @@ def make_wds_vision_pipeline(ctx: StromContext, paths: Sequence[str], *,
     if len(sharding.spec) > 4:
         raise ValueError("sharding.spec must have rank <= 4 (B, H, W, C)")
     _validate_batch_only(sharding)
-    ss = WdsShardSet(paths)
+    ss = WdsShardSet(paths, ctx=ctx)
     if len(ss) < batch:
         raise ValueError(f"dataset has {len(ss)} samples < batch {batch}")
-    state, fp = resolve_state(tuple(paths), seed=seed, resume_from=resume_from)
+    state, fp = resolve_state(tuple(paths), seed=seed, resume_from=resume_from,
+                              ctx=ctx)
     sampler = EpochShuffleSampler(len(ss), batch, seed=seed, shuffle=shuffle,
                                   state=state)
     tf = transform or default_train_transform(image_size)

@@ -156,3 +156,28 @@ def test_module_level_api(data_file, engine_name):
         assert rep.size == len(data)
     finally:
         strom.close()
+
+
+def test_registered_striped_alias(ctx, tmp_path, rng):
+    """register_striped: reads addressed to the aliased PATH — directly or
+    via an ExtentList a format reader planned against it — stripe-decode
+    across the members (the md-raid0 'files keep ordinary names' contract)."""
+    from strom.delivery.extents import ExtentList
+    from strom.engine.raid0 import stripe_file
+
+    n, chunk = 3, 4096
+    data = rng.integers(0, 256, size=n * chunk * 4, dtype=np.uint8)
+    src = tmp_path / "logical.bin"
+    data.tofile(src)
+    members = [str(tmp_path / f"am{i}.bin") for i in range(n)]
+    stripe_file(str(src), members, chunk)
+    virt = str(tmp_path / "virtual.bin")  # never exists on disk
+    ctx.register_striped(virt, members, chunk)
+
+    arr = ctx.memcpy_ssd2tpu(virt, length=len(data))
+    np.testing.assert_array_equal(np.asarray(arr), data)
+
+    el = ExtentList([(virt, 100, 5000), (virt, 9000, 300)])
+    got = ctx.pread(el)
+    np.testing.assert_array_equal(
+        got, np.concatenate([data[100:5100], data[9000:9300]]))

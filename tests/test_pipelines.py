@@ -408,3 +408,33 @@ class TestParquetScan:
         out = parquet_scan_aggregate(ctx, paths, ["value"], map_fn_sum)
         assert out["n"] == len(vals)
         np.testing.assert_allclose(out["sum"], vals.sum(), rtol=1e-6)
+
+
+class TestLlamaStriped:
+    def test_striped_token_shards_golden(self, ctx, tmp_path):
+        """Packed-token shards on a RAID0 striped set via path alias:
+        sequential batches equal the logical token stream."""
+        import os
+
+        from jax.sharding import Mesh
+
+        from strom.engine.raid0 import stripe_file
+        from strom.pipelines import make_llama_pipeline
+
+        seq, batch = 31, 8
+        tokens = np.arange(8 * batch * (seq + 1), dtype=np.int32)
+        plain = tmp_path / "tok.bin"
+        tokens.tofile(plain)
+        members = [str(tmp_path / f"tm{i}.bin") for i in range(4)]
+        stripe_file(str(plain), members, 512)  # 8 chunks -> 2 per member
+        virt = str(tmp_path / "tok_striped.bin")
+        ctx.register_striped(virt, members, 512,
+                             size=os.path.getsize(plain))
+
+        mesh = Mesh(np.array(jax.devices()).reshape(8), ("dp",))
+        sharding = NamedSharding(mesh, P("dp", None))
+        with make_llama_pipeline(ctx, [virt], batch=batch, seq_len=seq,
+                                 sharding=sharding, shuffle=False) as pipe:
+            got = np.concatenate([np.asarray(next(pipe)).ravel()
+                                  for _ in range(4)])
+        np.testing.assert_array_equal(got, tokens[:got.size])

@@ -85,3 +85,28 @@ def test_stripe_read_integrity_over_files(tmp_path, rng):
             out[s.logical_offset:s.logical_offset + s.length] = \
                 np.frombuffer(f.read(s.length), dtype=np.uint8)
     np.testing.assert_array_equal(out, logical)
+
+
+def test_stripe_file_roundtrip(tmp_path, rng):
+    """stripe_file writes the layout plan_stripe_reads decodes: striping a
+    file then reading it back through StripedFile returns the original bytes
+    (zero-padded tail past EOF)."""
+    from strom.config import StromConfig
+    from strom.delivery.core import StripedFile, StromContext
+    from strom.engine.raid0 import stripe_file
+
+    n, chunk = 4, 4096
+    data = rng.integers(0, 256, size=n * chunk * 3 + 999, dtype=np.uint8)
+    src = tmp_path / "src.bin"
+    data.tofile(src)
+    members = [str(tmp_path / f"sf{i}.bin") for i in range(n)]
+    stripe_file(str(src), members, chunk)
+    sf = StripedFile(tuple(members), chunk)
+    assert sf.size >= len(data)
+    ctx = StromContext(StromConfig(engine="python", queue_depth=8, num_buffers=8))
+    try:
+        got = np.asarray(ctx.memcpy_ssd2tpu(sf, length=sf.size))
+    finally:
+        ctx.close()
+    np.testing.assert_array_equal(got[:len(data)], data)
+    assert not got[len(data):].any()
