@@ -140,6 +140,7 @@ struct sc_stats {
   uint8_t sparse_table;   // 1 if external dest registration is available
   uint32_t ext_buffers;   // currently-registered external dest slabs
   uint64_t ops_fixed;     // ops that rode IORING_OP_READ_FIXED
+  uint8_t sqpoll;         // 1 if IORING_SETUP_SQPOLL active
 };
 
 struct sc_engine {
@@ -174,6 +175,8 @@ struct sc_engine {
   bool fixed_files = false;
   bool mlocked = false;
   bool coop_taskrun = false;
+  bool sqpoll = false;
+  std::atomic<uint32_t> *sq_flags = nullptr;  // kernel-written SQ ring flags
   bool has_ext_arg = false;  // IORING_FEAT_EXT_ARG (timed waits); 5.11+
 
   // sparse registered-buffer table (BUFFERS2, 5.13+): slots
@@ -235,7 +238,8 @@ static void record_latency(sc_engine *e, uint64_t us) {
 }
 
 // flags bit0: mlock pool; bit1: register buffers; bit2: register files;
-// bit3: IORING_SETUP_COOP_TASKRUN (falls back to 0 flags pre-5.19)
+// bit3: IORING_SETUP_COOP_TASKRUN (falls back to 0 flags pre-5.19);
+// bit4: IORING_SETUP_SQPOLL (falls back to bit3/plain when refused)
 sc_engine *sc_create(uint32_t queue_depth, uint32_t num_buffers,
                      uint64_t buffer_size, uint32_t flags) {
   if (queue_depth == 0 || num_buffers == 0 || buffer_size == 0) {
@@ -258,7 +262,26 @@ sc_engine *sc_create(uint32_t queue_depth, uint32_t num_buffers,
   if (flags & 1u) e->mlocked = (mlock(e->pool, e->pool_sz) == 0);
 
   memset(&e->params, 0, sizeof(e->params));
-  if (flags & 8u) {
+  e->ring_fd = -1;
+  if (flags & 16u) {
+    // SQPOLL: a kernel thread polls the SQ, so publishing a batch needs no
+    // syscall unless the poller idled out (IORING_SQ_NEED_WAKEUP) — the
+    // closest userspace analogue of the reference's in-kernel submission
+    // path: no user->kernel crossing per IO. Mutually exclusive with
+    // COOP_TASKRUN (task work needs the submitting task's context; SQPOLL
+    // has none), so bit3 is ignored when the poller comes up. Falls back to
+    // the bit3/plain setup when refused (pre-5.13 unprivileged, old
+    // kernels, rlimit on kernel threads).
+    e->params.flags = IORING_SETUP_SQPOLL;
+    e->params.sq_thread_idle = 1000;  // ms of idle before the poller sleeps
+    e->ring_fd = sys_io_uring_setup(queue_depth, &e->params);
+    if (e->ring_fd >= 0) {
+      e->sqpoll = true;
+    } else {
+      memset(&e->params, 0, sizeof(e->params));
+    }
+  }
+  if (e->ring_fd < 0 && (flags & 8u)) {
     // COOP_TASKRUN (5.19+): completion task work runs at our next ring
     // entry instead of IPI-interrupting the submitting thread mid-fill —
     // the submit loop is the interruptee under load. DEFER_TASKRUN is
@@ -275,7 +298,7 @@ sc_engine *sc_create(uint32_t queue_depth, uint32_t num_buffers,
     } else if (e->ring_fd >= 0) {
       e->coop_taskrun = true;
     }
-  } else {
+  } else if (e->ring_fd < 0) {
     e->ring_fd = sys_io_uring_setup(queue_depth, &e->params);
   }
   if (e->ring_fd < 0) {
@@ -316,6 +339,7 @@ sc_engine *sc_create(uint32_t queue_depth, uint32_t num_buffers,
   e->sq_tail = (std::atomic<uint32_t> *)(e->sq_ring + e->params.sq_off.tail);
   e->sq_mask = *(uint32_t *)(e->sq_ring + e->params.sq_off.ring_mask);
   e->sq_array = (uint32_t *)(e->sq_ring + e->params.sq_off.array);
+  e->sq_flags = (std::atomic<uint32_t> *)(e->sq_ring + e->params.sq_off.flags);
   e->cq_head = (std::atomic<uint32_t> *)(e->cq_ring + e->params.cq_off.head);
   e->cq_tail = (std::atomic<uint32_t> *)(e->cq_ring + e->params.cq_off.tail);
   e->cq_mask = *(uint32_t *)(e->cq_ring + e->params.cq_off.ring_mask);
@@ -576,6 +600,29 @@ static EnterResult ring_enter_submit(sc_engine *e, unsigned k,
                                      sc_completion *staged) {
   unsigned remaining = k;
   int fatal = e->enter_fail_once.exchange(0, std::memory_order_relaxed);
+  if (e->sqpoll && fatal == 0) {
+    // The poller thread consumes published SQEs on its own; enter only to
+    // wake it when it idled out. No rollback arm exists here: once sq_tail
+    // is published under SQPOLL the kernel may already be consuming, so
+    // rewinding would race the poller. (The enter_fail_once test hook still
+    // takes the rollback path below — tests inject it on non-SQPOLL rings.)
+    // full barrier between the sq_tail release-store (fill_sqe_locked) and
+    // this flags load: release/acquire does not order an older store against
+    // a younger load, and the poller's NEED_WAKEUP set + tail re-check can
+    // otherwise interleave so that neither side sees the other — the app
+    // skips the wakeup, the poller sleeps, the batch is never consumed
+    // (io_uring_enter(2) mandates a smp_mb() here; liburing does the same)
+    std::atomic_thread_fence(std::memory_order_seq_cst);
+    if (e->sq_flags->load(std::memory_order_relaxed) & IORING_SQ_NEED_WAKEUP) {
+      while (sys_io_uring_enter(e->ring_fd, 0, 0, IORING_ENTER_SQ_WAKEUP,
+                                nullptr, 0) < 0 &&
+             (errno == EINTR || errno == EAGAIN || errno == EBUSY)) {
+      }
+    }
+    e->ops_submitted.fetch_add(k, std::memory_order_relaxed);
+    e->in_flight.fetch_add(k, std::memory_order_relaxed);
+    return EnterResult{k, 0};
+  }
   while (fatal == 0 && remaining > 0) {
     int ret = sys_io_uring_enter(e->ring_fd, remaining, 0, 0, nullptr, 0);
     if (ret >= 0) {
@@ -1168,6 +1215,7 @@ void sc_get_stats(sc_engine *e, sc_stats *s) {
   s->mlocked = e->mlocked ? 1 : 0;
   s->chunk_retries = e->chunk_retries.load(std::memory_order_relaxed);
   s->coop_taskrun = e->coop_taskrun ? 1 : 0;
+  s->sqpoll = e->sqpoll ? 1 : 0;
   s->sparse_table = e->sparse_table ? 1 : 0;
   s->ops_fixed = e->ops_fixed.load(std::memory_order_relaxed);
   uint32_t ext = 0;

@@ -60,7 +60,8 @@ def bench_nvme(args: argparse.Namespace) -> dict:
         created = True
     size = min(os.path.getsize(path), args.size) // args.block * args.block
     cfg = StromConfig(engine=args.engine, block_size=args.block,
-                      queue_depth=args.depth, num_buffers=max(args.depth * 2, 8))
+                      queue_depth=args.depth, num_buffers=max(args.depth * 2, 8),
+                      sqpoll=getattr(args, "sqpoll", False))
     numa_node = getattr(args, "numa_node", -1)
     na = None
     if numa_node >= 0:
@@ -102,6 +103,9 @@ def bench_nvme(args: argparse.Namespace) -> dict:
         "per_op": bool(getattr(args, "per_op", False)),
         "numa_node": numa_node,
         "huge": bool(getattr(args, "huge", False)),
+        # ACTIVE state from the engine, not the request: SQPOLL falls back
+        # silently when the kernel refuses it
+        "sqpoll": bool(stats.get("sqpoll", False)),
         "file_created": created,
     }
     return out
@@ -171,6 +175,14 @@ def bench_ssd2tpu(args: argparse.Namespace) -> dict:
     }
 
 
+def _fit_dp_devices(batch: int) -> int:
+    """Largest local device count that divides *batch* (benches shard the
+    batch dim over a dp mesh of this size)."""
+    import jax
+
+    return max(d for d in range(len(jax.devices()), 0, -1) if batch % d == 0)
+
+
 def _timed_train_phase(pipe_factory, step, steps: int,
                        items_per_step: int) -> tuple[float, int, float]:
     """Shared harness for the --train-step north-star phases (llama, resnet,
@@ -225,7 +237,7 @@ def bench_llama(args: argparse.Namespace) -> dict:
     cfg = StromConfig(engine=args.engine, block_size=args.block,
                       queue_depth=args.depth, num_buffers=max(args.depth * 2, 8))
     ctx = StromContext(cfg)
-    n_dev = max(d for d in range(len(jax.devices()), 0, -1) if args.batch % d == 0)
+    n_dev = _fit_dp_devices(args.batch)
     mesh = make_mesh({"dp": n_dev}, devices=jax.devices()[:n_dev])
     sharding = NamedSharding(mesh, P("dp", None))
     _drop_cache_hint(path)
@@ -322,7 +334,7 @@ def bench_resnet(args: argparse.Namespace) -> dict:
     cfg = StromConfig(engine=args.engine, block_size=args.block,
                       queue_depth=args.depth, num_buffers=max(args.depth * 2, 8))
     ctx = StromContext(cfg)
-    n_dev = max(d for d in range(len(jax.devices()), 0, -1) if args.batch % d == 0)
+    n_dev = _fit_dp_devices(args.batch)
     mesh = make_mesh({"dp": n_dev}, devices=jax.devices()[:n_dev])
     sharding = NamedSharding(mesh, P("dp", None, None, None))
     _drop_cache_hint(path)
@@ -426,7 +438,7 @@ def bench_vit(args: argparse.Namespace) -> dict:
     ctx = StromContext(cfg)
     virt = plain + ".raid0"  # never exists on disk: reads resolve via alias
     ctx.register_striped(virt, members, args.raid_chunk)
-    n_dev = max(d for d in range(len(jax.devices()), 0, -1) if args.batch % d == 0)
+    n_dev = _fit_dp_devices(args.batch)
     mesh = make_mesh({"dp": n_dev}, devices=jax.devices()[:n_dev])
     sharding = NamedSharding(mesh, P("dp", None, None, None))
     for m in members:
@@ -586,6 +598,10 @@ def main(argv: list[str] | None = None) -> int:
     p_nvme.add_argument("--per-op", action="store_true", dest="per_op",
                         help="legacy per-block submit/wait loop instead of the "
                              "native vectored gather")
+    p_nvme.add_argument("--sqpoll", action="store_true",
+                        help="IORING_SETUP_SQPOLL ring: kernel thread polls "
+                             "the SQ, zero syscalls per batch (A/B; wins "
+                             "only with spare cores; falls back when refused)")
     p_nvme.set_defaults(fn=bench_nvme)
 
     p_s2t = sub.add_parser("ssd2tpu", help="async SSD->TPU copy loop")

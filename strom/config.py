@@ -60,6 +60,13 @@ class StromConfig:
                                        # completion task work at ring entry
                                        # instead of IPI-ing the submitter
                                        # (5.19+; auto-falls back when absent)
+    sqpoll: bool = False               # IORING_SETUP_SQPOLL: kernel thread
+                                       # polls the SQ — zero syscalls per
+                                       # submitted batch, at the cost of a
+                                       # busy kernel thread. Wins only when
+                                       # spare cores exist; auto-falls back
+                                       # (and supersedes coop_taskrun) when
+                                       # active
 
     # delivery
     prefetch_depth: int = 2            # batches dispatched ahead of consumption

@@ -49,6 +49,7 @@ class _ScStats(ctypes.Structure):
         ("sparse_table", ctypes.c_uint8),
         ("ext_buffers", ctypes.c_uint32),
         ("ops_fixed", ctypes.c_uint64),
+        ("sqpoll", ctypes.c_uint8),
     ]
 
 
@@ -172,7 +173,8 @@ class UringEngine(Engine):
         super().__init__(config)
         self._lib = _load_lib(variant)
         flags = (1 if config.mlock else 0) | (2 if config.register_buffers else 0) \
-            | 4 | (8 if config.coop_taskrun else 0)
+            | 4 | (8 if config.coop_taskrun else 0) \
+            | (16 if config.sqpoll else 0)
         handle = self._lib.sc_create(config.queue_depth, config.num_buffers,
                                      config.buffer_size, flags)
         if not handle:
@@ -418,6 +420,7 @@ class UringEngine(Engine):
             "fixed_files": bool(s.fixed_files),
             "mlocked": bool(s.mlocked),
             "coop_taskrun": bool(s.coop_taskrun),
+            "sqpoll": bool(s.sqpoll),
             "sparse_table": bool(s.sparse_table),
             "ext_buffers": int(s.ext_buffers),
             "ops_fixed": int(s.ops_fixed),

@@ -38,8 +38,11 @@ class TokenShardSet:
         object.__setattr__(self, "paths", tuple(self.paths))
         object.__setattr__(self, "dtype", np.dtype(self.dtype))
         sizes = self.shard_sizes
-        if sizes is not None and len(sizes) != len(self.paths):
-            raise ValueError("shard_sizes must match paths")
+        if sizes is not None:
+            sizes = tuple(sizes)  # keep the frozen dataclass hashable
+            object.__setattr__(self, "shard_sizes", sizes)
+            if len(sizes) != len(self.paths):
+                raise ValueError("shard_sizes must match paths")
         counts = []
         for i, p in enumerate(self.paths):
             nbytes = sizes[i] if sizes is not None else os.stat(p).st_size

@@ -181,3 +181,32 @@ def test_registered_striped_alias(ctx, tmp_path, rng):
     got = ctx.pread(el)
     np.testing.assert_array_equal(
         got, np.concatenate([data[100:5100], data[9000:9300]]))
+
+
+def test_sourceio_readahead_windows(ctx, tmp_path, rng):
+    """SourceIO must serve tarfile/pyarrow-style access (small reads, seeks
+    back and forth, reads straddling the readahead window) correctly, with
+    far fewer engine reads than client reads."""
+    from strom.delivery.core import SourceIO
+
+    data = rng.integers(0, 256, 100_000, dtype=np.uint8).tobytes()
+    p = tmp_path / "sio.bin"
+    (p).write_bytes(data)
+    f = SourceIO(ctx, str(p), readahead=4096)
+    # forward walk in 512B steps: one engine read per 4KiB window
+    for off in range(0, 8192, 512):
+        assert f.read(512) == data[off: off + 512]
+    # seek back (cache miss behind the window) and straddle windows
+    f.seek(100)
+    assert f.read(8000) == data[100:8100]
+    # read past EOF clamps; read at EOF returns b""
+    f.seek(99_000)
+    assert f.read(5000) == data[99_000:]
+    assert f.read(10) == b""
+    # SEEK_END / SEEK_CUR
+    import io as _io
+    f.seek(-100, _io.SEEK_END)
+    assert f.read(-1) == data[-100:]
+    f.seek(0)
+    f.seek(50, _io.SEEK_CUR)
+    assert f.read(10) == data[50:60]

@@ -291,3 +291,41 @@ class TestReadVectored:
         dest = alloc_aligned(512 * 1024)
         with pytest.raises(EngineError, match="after 3 attempts"):
             engine.read_vectored([(fi, 0, 0, 512 * 1024)], dest, retries=2)
+
+
+def test_sqpoll_knob(data_file):
+    """sqpoll=True asks for an IORING_SETUP_SQPOLL ring (kernel thread polls
+    the SQ; zero io_uring_enter per submitted batch). The kernel may refuse
+    it (privileges, rlimits) — then the engine must fall back silently and
+    reads must be identical either way. When it IS active, a full vectored
+    gather must complete through the poller thread (including the
+    need-wakeup path after the poller idles)."""
+    import time as _time
+
+    from strom.config import StromConfig
+    from strom.delivery.buffers import alloc_aligned
+    from strom.engine import make_engine
+    from strom.engine.uring_engine import UringEngine
+
+    path, data = data_file
+    eng = make_engine(StromConfig(sqpoll=True, queue_depth=8, num_buffers=8))
+    if not isinstance(eng, UringEngine):
+        eng.close()
+        return  # python fallback engine: knob is uring-only
+    try:
+        active = eng.stats()["sqpoll"]
+        fi = eng.register_file(path)
+        n = 1 << 20
+        dest = alloc_aligned(n)
+        assert eng.read_vectored([(fi, 0, 0, n)], dest) == n
+        np.testing.assert_array_equal(dest, data[:n])
+        if active:
+            # second gather after a pause still works (exercises the
+            # IORING_SQ_NEED_WAKEUP arm once sq_thread_idle elapses; the
+            # 1.2s sleep matches the engine's 1000ms idle setting)
+            _time.sleep(1.2)
+            dest2 = alloc_aligned(n)
+            assert eng.read_vectored([(fi, n, 0, n)], dest2) == n
+            np.testing.assert_array_equal(dest2, data[n:2 * n])
+    finally:
+        eng.close()
