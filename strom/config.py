@@ -65,8 +65,8 @@ class StromConfig:
                                        # submitted batch, at the cost of a
                                        # busy kernel thread. Wins only when
                                        # spare cores exist; auto-falls back
-                                       # (and supersedes coop_taskrun) when
-                                       # active
+                                       # when the kernel refuses it, and
+                                       # supersedes coop_taskrun when active
 
     # delivery
     prefetch_depth: int = 2            # batches dispatched ahead of consumption

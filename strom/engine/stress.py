@@ -19,12 +19,13 @@ import numpy as np
 
 
 def run_stress(variant: str = "", *, seconds: float = 3.0,
-               readers: int = 3, size: int = 8 * 1024 * 1024) -> int:
+               readers: int = 3, size: int = 8 * 1024 * 1024,
+               sqpoll: bool = False) -> int:
     from strom.config import StromConfig
     from strom.delivery.core import StromContext
     from strom.engine.uring_engine import UringEngine, uring_available
 
-    cfg = StromConfig(queue_depth=16, num_buffers=32)
+    cfg = StromConfig(queue_depth=16, num_buffers=32, sqpoll=sqpoll)
     if variant:
         if not uring_available():
             print("io_uring unavailable; nothing to stress", file=sys.stderr)
@@ -33,6 +34,7 @@ def run_stress(variant: str = "", *, seconds: float = 3.0,
     else:
         engine = None  # auto
     ctx = StromContext(cfg, engine=engine)
+    sqpoll_active = ctx.engine.stats().get("sqpoll", False)
 
     with tempfile.TemporaryDirectory() as td:
         path = os.path.join(td, "stress.bin")
@@ -125,7 +127,8 @@ def run_stress(variant: str = "", *, seconds: float = 3.0,
         if errors:
             print(f"stress FAILED: {errors[0]!r}", file=sys.stderr)
             return 1
-        print(f"stress ok: engine={ctx.engine.name} variant={variant or 'default'}")
+        print(f"stress ok: engine={ctx.engine.name} "
+              f"variant={variant or 'default'} sqpoll={sqpoll_active}")
         return 0
 
 
@@ -134,8 +137,12 @@ def main() -> int:
     ap.add_argument("--variant", default="", choices=["", "tsan", "asan"])
     ap.add_argument("--seconds", type=float, default=3.0)
     ap.add_argument("--readers", type=int, default=3)
+    ap.add_argument("--sqpoll", action="store_true",
+                    help="stress an IORING_SETUP_SQPOLL ring (covers the "
+                         "need-wakeup fence under the sanitizers)")
     args = ap.parse_args()
-    return run_stress(args.variant, seconds=args.seconds, readers=args.readers)
+    return run_stress(args.variant, seconds=args.seconds,
+                      readers=args.readers, sqpoll=args.sqpoll)
 
 
 if __name__ == "__main__":

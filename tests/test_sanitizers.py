@@ -21,13 +21,14 @@ def _runtime(name: str) -> str | None:
     return out if out and os.path.isabs(out) and os.path.exists(out) else None
 
 
-def _run_stress(variant: str, preload: str, extra_env: dict) -> subprocess.CompletedProcess:
+def _run_stress(variant: str, preload: str, extra_env: dict,
+                *extra_args: str) -> subprocess.CompletedProcess:
     env = os.environ.copy()
     env["LD_PRELOAD"] = preload
     env.update(extra_env)
     return subprocess.run(
         [sys.executable, "-m", "strom.engine.stress", "--variant", variant,
-         "--seconds", "2"],
+         "--seconds", "2", *extra_args],
         capture_output=True, text=True, timeout=600, env=env,
         cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
@@ -67,3 +68,37 @@ def test_asan_stress_clean():
     assert "AddressSanitizer" not in proc.stderr, proc.stderr[-4000:]
     assert proc.returncode == 0, (proc.returncode, proc.stderr[-4000:])
     assert "stress ok" in proc.stdout
+
+
+@pytest.mark.slow
+def test_tsan_stress_sqpoll_clean():
+    """The SQPOLL submit path (seq_cst fence + NEED_WAKEUP check racing the
+    kernel poller, zero-syscall publishes racing reapers) under TSAN."""
+    from strom.engine.uring_engine import uring_available
+
+    if not uring_available():
+        pytest.skip("io_uring unavailable")
+    rt = _runtime("libtsan.so")
+    if rt is None:
+        pytest.skip("libtsan runtime not found")
+    # probe first: the kernel may legitimately refuse SQPOLL (unprivileged
+    # pre-5.13, rlimit-constrained containers) and the engine's contract is
+    # silent fallback — a vacuous fallback run here should skip, not fail
+    from strom.config import StromConfig
+    from strom.engine import make_engine
+
+    probe = make_engine(StromConfig(sqpoll=True, queue_depth=8, num_buffers=8))
+    try:
+        if not probe.stats().get("sqpoll"):
+            pytest.skip("kernel refuses IORING_SETUP_SQPOLL here")
+    finally:
+        probe.close()
+    proc = _run_stress("tsan", rt, {
+        "TSAN_OPTIONS": "exitcode=66 report_bugs=1 history_size=2",
+    }, "--sqpoll")
+    assert "ThreadSanitizer" not in proc.stderr, proc.stderr[-4000:]
+    assert proc.returncode == 0, (proc.returncode, proc.stderr[-4000:])
+    assert "stress ok" in proc.stdout
+    # the probe said SQPOLL engages on this kernel, so a fallback in the
+    # stress subprocess means the flag plumbing regressed — fail loudly
+    assert "sqpoll=True" in proc.stdout, proc.stdout
