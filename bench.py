@@ -115,8 +115,13 @@ def main() -> int:
         largs = argparse.Namespace(
             file=None, size=size, block=cfg.block_size, depth=32, iters=1,
             engine="auto", tmpdir=args.tmpdir, json=True, batch=8,
-            seq_len=2047, steps=12, prefetch=2, train_step=True,
+            seq_len=2047, steps=12, prefetch=6, train_step=True,
             model="small", attn="flash")
+        # prefetch 6, not the minimum 2: the flat-out loader runs ~1000x
+        # faster than the relay-bound train step, so any stall is device_put
+        # latency JITTER, not rate — measured on-chip 2026-07-30: stalls
+        # 8/12 at depth 2, 1/12 at depth 6 under identical weather. The
+        # spec's north star allows prefetch >= 2.
         try:
             lres = bench_llama(largs)
             loader_res = {
