@@ -8,7 +8,8 @@ BASELINE.json).  API ≙ the reference's ioctl contract (SURVEY.md §7.1):
 =============================  ==========================================
 reference (ioctl ABI)          strom (this module)
 =============================  ==========================================
-STROM_IOCTL__CHECK_FILE        strom.check_file(path)
+STROM_IOCTL__CHECK_FILE        strom.check_file(path | StripedFile)
+(in-kernel md-raid0 decode)    strom.StripedFile / strom.register_striped
 STROM_IOCTL__MAP_GPU_MEMORY    strom.init(config) / engine staging pool
 STROM_IOCTL__LIST/INFO...      strom.buffer_info()
 STROM_IOCTL__MEMCPY_SSD2GPU    strom.memcpy_ssd2tpu(..., async_=False)
@@ -28,12 +29,24 @@ from strom.delivery.core import Source, StripedFile, StromContext  # noqa: F401
 from strom.delivery.extents import Extent, ExtentList  # noqa: F401
 from strom.delivery.handle import DMAHandle  # noqa: F401
 from strom.delivery.prefetch import Prefetcher  # noqa: F401
-from strom.probe.check import FileReport, PathTier, check_file  # noqa: F401
+from strom.probe.check import FileReport, PathTier  # noqa: F401
+from strom.probe.check import check_file as _probe_check_file
 
 __version__ = "0.1.0"
 
 _ctx: StromContext | None = None
 _ctx_lock = threading.Lock()
+
+
+def check_file(path, **kwargs) -> FileReport:
+    """≙ STROM_IOCTL__CHECK_FILE. Accepts a path or a StripedFile; a path
+    the process context aliases to a striped set (``register_striped``) is
+    checked as that set — without creating a context as a side effect."""
+    source = path
+    with _ctx_lock:
+        if _ctx is not None and isinstance(path, str):
+            source = _ctx.resolve_source(path)
+    return _probe_check_file(source, **kwargs)
 
 
 def init(config: StromConfig | None = None) -> StromContext:

@@ -59,7 +59,15 @@ class FileReport:
         return self.tier in (PathTier.DIRECT_NVME, PathTier.DIRECT)
 
 
-def check_file(path: str, *, want_extents: bool = True) -> FileReport:
+def check_file(path, *, want_extents: bool = True) -> FileReport:
+    """Tier *path*. Also accepts a striped set (any object with ``members``
+    and ``chunk`` — e.g. ``strom.StripedFile``; duck-typed so the probe
+    layer needs no delivery import): every member is checked and the set
+    reports the WORST member tier, mirroring the reference's CHECK_FILE
+    rule that an md-raid0 file is fast-path only when every member device
+    is NVMe (SURVEY.md §3.1)."""
+    if hasattr(path, "members") and hasattr(path, "chunk"):
+        return _check_striped(path, want_extents=want_extents)
     st = os.stat(path)
     fs_type = _fs_type(path)
     reasons: list[str] = []
@@ -119,6 +127,45 @@ def check_file(path: str, *, want_extents: bool = True) -> FileReport:
         reasons=tuple(reasons),
         fragmented=fragmented,
         mean_extent_bytes=mean_extent,
+    )
+
+
+# fast -> slow; a striped set rides the tier of its SLOWEST member
+_TIER_RANK = {PathTier.DIRECT_NVME: 2, PathTier.DIRECT: 1, PathTier.BUFFERED: 0}
+
+
+def _check_striped(sf, *, want_extents: bool = True) -> FileReport:
+    reports = [check_file(m, want_extents=want_extents) for m in sf.members]
+    worst = min(reports, key=lambda r: _TIER_RANK[r.tier])
+    reasons = [
+        f"raid0 set: {len(sf.members)} members, chunk {sf.chunk >> 10} KiB; "
+        f"set tier = worst member tier ({worst.tier.value})"
+    ]
+    if all(r.tier is PathTier.DIRECT_NVME for r in reports):
+        reasons.append("all members on NVMe-class devices "
+                       "(≙ reference's md-raid0-of-NVMe requirement)")
+    for r in reports:
+        if r.tier is not PathTier.DIRECT_NVME:
+            reasons.append(f"member {r.path}: {r.tier.value} ({r.reasons[-1]})")
+    mixed_fs = {r.fs_type for r in reports}
+    total = sum(r.size for r in reports)
+    return FileReport(
+        path="+".join(os.path.abspath(m) for m in sf.members),
+        size=sf.size,
+        fs_type=next(iter(mixed_fs)) if len(mixed_fs) == 1
+        else "mixed(" + ",".join(sorted(mixed_fs)) + ")",
+        tier=worst.tier,
+        dio=worst.dio,
+        device=None,  # one report spans N devices; per-member in reasons
+        extents=sum(r.extents for r in reports),
+        extent_coverage=(sum(r.extent_coverage * r.size for r in reports)
+                         / total) if total else 0.0,
+        reasons=tuple(reasons),
+        fragmented=any(r.fragmented for r in reports),
+        # size-weighted like extent_coverage, preserving the field's "mean"
+        # semantics across single-file and set reports
+        mean_extent_bytes=int(sum(r.mean_extent_bytes * r.size
+                                  for r in reports) / total) if total else 0,
     )
 
 

@@ -56,3 +56,54 @@ def test_check_file_report(data_file):
 def test_check_file_missing(tmp_path):
     with pytest.raises(FileNotFoundError):
         check_file(str(tmp_path / "nope.bin"))
+
+
+class TestCheckStriped:
+    def test_striped_set_reports_worst_member_tier(self, tmp_path, rng):
+        """check_file on a StripedFile checks every member and reports the
+        set at the worst member tier (the reference's md-raid0 rule: fast
+        path only when every member qualifies)."""
+        from strom.delivery.core import StripedFile
+        from strom.engine.raid0 import stripe_file
+        from strom.probe.check import _TIER_RANK, check_file
+
+        data = rng.integers(0, 256, 256 * 1024, dtype=np.uint8)
+        src = tmp_path / "src.bin"
+        data.tofile(src)
+        members = [str(tmp_path / f"cm{i}.bin") for i in range(3)]
+        stripe_file(str(src), members, 8192)
+        sf = StripedFile(tuple(members), 8192)
+
+        rep = check_file(sf)
+        member_reps = [check_file(m) for m in members]
+        worst = min((m.tier for m in member_reps), key=_TIER_RANK.__getitem__)
+        assert rep.tier is worst
+        assert rep.size == sf.size
+        assert rep.extents == sum(m.extents for m in member_reps)
+        assert any("raid0 set: 3 members" in r for r in rep.reasons)
+        assert all(os.path.abspath(m) in rep.path for m in members)
+
+    def test_module_level_alias_resolution(self, tmp_path, rng):
+        """strom.check_file on an aliased path checks the striped set, and
+        does NOT create a context when none exists."""
+        import strom
+        from strom.engine.raid0 import stripe_file
+
+        data = rng.integers(0, 256, 64 * 1024, dtype=np.uint8)
+        src = tmp_path / "asrc.bin"
+        data.tofile(src)
+        members = [str(tmp_path / f"acm{i}.bin") for i in range(2)]
+        stripe_file(str(src), members, 4096)
+
+        # no context yet: plain path semantics, no side-effect context
+        strom.close()
+        rep_plain = strom.check_file(members[0])
+        assert strom._ctx is None, "check_file must not create a context"
+
+        strom.register_striped(str(tmp_path / "avirt.bin"), members, 4096)
+        try:
+            rep = strom.check_file(str(tmp_path / "avirt.bin"))
+            assert any("raid0 set: 2 members" in r for r in rep.reasons)
+            assert rep.tier is rep_plain.tier
+        finally:
+            strom.close()
