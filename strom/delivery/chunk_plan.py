@@ -22,6 +22,24 @@ from strom.probe.fiemap import Extent
 Chunk = tuple[int, int, int, int]
 
 
+def plan_chunks_multi(chunks: Sequence[Chunk],
+                      extent_maps: dict[int, Sequence[Extent]]) -> list[Chunk]:
+    """Extent-aware planning over a gather spanning several files (format
+    readers' ExtentLists, striped members): chunks group by file — stable in
+    first-appearance order, so a per-sample interleaving becomes per-file
+    runs — and each group is planned against its file's FIEMAP map when one
+    is available. Any submission order is valid (dest offsets are explicit);
+    only locality changes."""
+    groups: dict[int, list[Chunk]] = {}  # insertion-ordered
+    for c in chunks:
+        groups.setdefault(c[0], []).append(c)
+    out: list[Chunk] = []
+    for fi, g in groups.items():
+        em = extent_maps.get(fi)
+        out.extend(plan_chunks(g, em) if em else g)
+    return out
+
+
 def plan_chunks(chunks: Sequence[Chunk], extents: Sequence[Extent]
                 ) -> list[Chunk]:
     """Split *chunks* (all for one file, mapped by *extents*) at extent

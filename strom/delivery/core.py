@@ -382,12 +382,18 @@ class StromContext:
         # member fds resolved once per transfer, not once per extent run (a
         # WDS batch produces one run per sample component)
         member_cache: dict[StripedFile, list[int]] = {}
+        idx_paths: dict[int, str] = {}  # file_idx -> path (for FIEMAP lookup)
+
+        def findex(path: str) -> int:
+            idx = self.file_index(path)
+            idx_paths[idx] = path
+            return idx
 
         def stripe_chunks(sf: StripedFile, file_off: int, dest_off: int,
                           length: int) -> None:
             member_idx = member_cache.get(sf)
             if member_idx is None:
-                member_idx = [self.file_index(m) for m in sf.members]
+                member_idx = [findex(m) for m in sf.members]
                 member_cache[sf] = member_idx
             for s in plan_stripe_reads(file_off, length, len(sf.members),
                                        sf.chunk):
@@ -411,18 +417,29 @@ class StromContext:
                         # it here, exactly where a plain path resolves to an fd
                         stripe_chunks(sf, r.offset, r.dest_offset, r.length)
                     else:
-                        chunks.append((self.file_index(r.path), r.offset,
+                        chunks.append((findex(r.path), r.offset,
                                        r.dest_offset, r.length))
         else:
-            fi = self.file_index(source)
-            chunks = [(fi, base_offset + s.file_offset, s.dest_offset, s.length)
-                      for s in segments]
-            if cfg.extent_aware:
-                em = self.extent_map(source)
-                if em:
-                    from strom.delivery.chunk_plan import plan_chunks
+            chunks = [(findex(source), base_offset + s.file_offset,
+                       s.dest_offset, s.length) for s in segments]
 
-                    chunks = plan_chunks(chunks, em)
+        if cfg.extent_aware and chunks and not member_cache:
+            # extent-aware planning for plain-file gathers of every source
+            # kind (whole-file reads AND format-reader ExtentLists): group
+            # into per-file runs, each submitted in physical-address order.
+            # Striped gathers are exempt: the engine submits in list order
+            # within a queue-depth window, so regrouping the round-robin
+            # member interleave into per-member runs would serialize the
+            # very multi-device parallelism RAID0 exists for.
+            from strom.delivery.chunk_plan import plan_chunks_multi
+
+            maps = {}
+            for fi, p in idx_paths.items():
+                em = self.extent_map(p)
+                if em:
+                    maps[fi] = em
+            if maps:
+                chunks = plan_chunks_multi(chunks, maps)
 
         # The engine executes the whole gather (block_size chunking, queue
         # -depth pipelining, per-chunk retry, EOF topup): ONE boundary

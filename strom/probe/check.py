@@ -149,6 +149,11 @@ def _check_striped(sf, *, want_extents: bool = True) -> FileReport:
             reasons.append(f"member {r.path}: {r.tier.value} ({r.reasons[-1]})")
     mixed_fs = {r.fs_type for r in reports}
     total = sum(r.size for r in reports)
+    # count-weighted: the mean over ALL the set's extents, so one heavily-
+    # fragmented member isn't averaged away by a large contiguous one
+    n_ext = sum(r.extents for r in reports if r.mean_extent_bytes)
+    mean_extent = int(sum(r.mean_extent_bytes * r.extents
+                          for r in reports) / n_ext) if n_ext else 0
     return FileReport(
         path="+".join(os.path.abspath(m) for m in sf.members),
         size=sf.size,
@@ -162,10 +167,7 @@ def _check_striped(sf, *, want_extents: bool = True) -> FileReport:
                          / total) if total else 0.0,
         reasons=tuple(reasons),
         fragmented=any(r.fragmented for r in reports),
-        # size-weighted like extent_coverage, preserving the field's "mean"
-        # semantics across single-file and set reports
-        mean_extent_bytes=int(sum(r.mean_extent_bytes * r.size
-                                  for r in reports) / total) if total else 0,
+        mean_extent_bytes=mean_extent,
     )
 
 

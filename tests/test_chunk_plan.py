@@ -248,3 +248,52 @@ class TestCheckFileAdvice:
         rep = check_file(path)  # small files are contiguous (or unmapped)
         if rep.extents <= 1:
             assert not rep.fragmented
+
+
+class TestPlanChunksMulti:
+    def test_groups_by_file_first_appearance(self):
+        from strom.delivery.chunk_plan import plan_chunks_multi
+
+        chunks = [(2, 0, 0, 512), (1, 0, 512, 512), (2, 512, 1024, 512),
+                  (1, 512, 1536, 512)]
+        out = plan_chunks_multi(chunks, {})
+        assert out == [(2, 0, 0, 512), (2, 512, 1024, 512),
+                       (1, 0, 512, 512), (1, 512, 1536, 512)]
+
+    def test_per_file_maps_reorder_only_their_file(self):
+        from strom.delivery.chunk_plan import plan_chunks_multi
+
+        # file 0 fragmented (physical order reversed), file 1 unmapped
+        em0 = [ext(0, 1 << 20, 4096), ext(4096, 0, 4096)]
+        chunks = [(0, 0, 0, 8192), (1, 0, 8192, 4096)]
+        out = plan_chunks_multi(chunks, {0: em0})
+        assert out == [(0, 4096, 4096, 4096), (0, 0, 0, 4096),
+                       (1, 0, 8192, 4096)]
+
+    def test_multi_file_byte_map_preserved(self):
+        from strom.delivery.chunk_plan import plan_chunks_multi
+
+        rng = np.random.default_rng(11)
+        for _ in range(25):
+            chunks = []
+            doff = 0
+            for fi in range(3):
+                pos = 0
+                for _ in range(int(rng.integers(1, 5))):
+                    ln = int(rng.integers(1, 4)) * 4096
+                    chunks.append((fi, pos, doff, ln))
+                    pos += ln + int(rng.integers(0, 2)) * 4096
+                    doff += ln
+            rng.shuffle(chunks)
+            # rebuild dest offsets non-overlapping after the shuffle
+            chunks = [(fi, off, i * 16384, ln)
+                      for i, (fi, off, _, ln) in enumerate(chunks)]
+            em = {0: [ext(0, 5 << 20, 1 << 20)],
+                  2: [ext(0, 1 << 20, 8192), ext(8192, 0, 8192)]}
+
+            def mf_map(cs):
+                return {(fi, off + k): doff + k
+                        for fi, off, doff, ln in cs for k in range(ln)}
+
+            out = plan_chunks_multi(chunks, em)
+            assert mf_map(out) == mf_map(chunks)
