@@ -175,6 +175,27 @@ def bench_ssd2tpu(args: argparse.Namespace) -> dict:
     }
 
 
+def _ensure_striped(plain: str, raid: int, chunk: int) -> list[str]:
+    """Member files of *plain* striped RAID0-style (fixture helper shared by
+    the vit and parquet benches). Member names are keyed by both raid knobs
+    — reusing members striped with a different chunk would decode
+    interleaved-wrong bytes — and the size sidecar (written atomically last)
+    revalidates against a changed source file."""
+    from strom.engine.raid0 import SIZE_SIDECAR_SUFFIX, stripe_file
+
+    members = [f"{plain}.r{i}of{raid}.c{chunk}" for i in range(raid)]
+    try:
+        with open(members[0] + SIZE_SIDECAR_SUFFIX) as f:
+            fresh = int(f.read()) == os.path.getsize(plain) \
+                and all(os.path.getmtime(m) >= os.path.getmtime(plain)
+                        for m in members)
+    except (OSError, ValueError):
+        fresh = False
+    if not fresh:
+        stripe_file(plain, members, chunk)
+    return members
+
+
 def _fit_dp_devices(batch: int) -> int:
     """Largest local device count that divides *batch* (benches shard the
     batch dim over a dp mesh of this size)."""
@@ -412,27 +433,12 @@ def bench_vit(args: argparse.Namespace) -> dict:
 
     from strom.config import StromConfig
     from strom.delivery.core import StromContext
-    from strom.engine.raid0 import SIZE_SIDECAR_SUFFIX, stripe_file
     from strom.parallel.mesh import make_mesh
     from strom.pipelines import make_vit_wds_pipeline
 
     plain = args.file or _mk_wds_fixture(args.tmpdir, args.batch,
                                          args.image_size)
-    # member names keyed by BOTH raid knobs: reusing members striped with a
-    # different chunk would decode interleaved-wrong bytes. The size sidecar
-    # (written atomically last) also revalidates against a changed --file.
-    members = [f"{plain}.r{i}of{args.raid}.c{args.raid_chunk}"
-               for i in range(args.raid)]
-    sidecar = members[0] + SIZE_SIDECAR_SUFFIX
-    try:
-        with open(sidecar) as f:
-            fresh = int(f.read()) == os.path.getsize(plain) \
-                and all(os.path.getmtime(m) >= os.path.getmtime(plain)
-                        for m in members)  # same-size content change → restripe
-    except (OSError, ValueError):
-        fresh = False
-    if not fresh:
-        stripe_file(plain, members, args.raid_chunk)
+    members = _ensure_striped(plain, args.raid, args.raid_chunk)
     cfg = StromConfig(engine=args.engine, block_size=args.block,
                       queue_depth=args.depth, num_buffers=max(args.depth * 2, 8))
     ctx = StromContext(cfg)
@@ -534,12 +540,33 @@ def bench_parquet(args: argparse.Namespace) -> dict:
                            row_group_size=max(rows // args.row_groups, 1),
                            compression="snappy")
             os.sync()
+    raid = args.raid
+    members: list[str] = []
+    if raid:
+        # the reference's flagship deployment scans from md-raid0-of-NVMe
+        # (BASELINE.json:11 is the PG-Strom-style config): stripe the file
+        # and scan through the path alias so every column-chunk gather
+        # stripe-decodes across the set (the size sidecar keeps the footer
+        # at the true EOF). Striped BEFORE the context exists so a failed
+        # stripe can't leak the engine.
+        members = _ensure_striped(path, raid, args.raid_chunk)
     cfg = StromConfig(engine=args.engine, block_size=args.block,
                       queue_depth=args.depth, num_buffers=max(args.depth * 2, 8))
     ctx = StromContext(cfg)
     try:
-        _drop_cache_hint(path)
-        meta = pq.read_metadata(path)
+        from strom.formats.parquet import ParquetShard
+
+        if raid:
+            virt = path + ".raid0"
+            ctx.register_striped(virt, members, args.raid_chunk)
+            path = virt
+            for m in members:
+                _drop_cache_hint(m)
+        else:
+            _drop_cache_hint(path)
+        # ParquetShard owns the plain-vs-striped metadata dispatch — the
+        # bench reads through the same path the library scan does
+        meta = ParquetShard(path, ctx=ctx).metadata
         n_rows = meta.num_rows
         sel_bytes = sum(
             meta.row_group(g).column(i).total_compressed_size
@@ -552,7 +579,8 @@ def bench_parquet(args: argparse.Namespace) -> dict:
         parquet_count_where(ctx, [path], "value", lambda v: v > 0,
                             prefetch_depth=args.prefetch,
                             unit_batch=args.unit_batch)
-        _drop_cache_hint(path)
+        for p in (members if raid else [path]):
+            _drop_cache_hint(p)
         t0 = time.perf_counter()
         hits = parquet_count_where(ctx, [path], "value", lambda v: v > 0,
                                    prefetch_depth=args.prefetch,
@@ -566,8 +594,12 @@ def bench_parquet(args: argparse.Namespace) -> dict:
         "selected_gbps": round(sel_bytes / dt / 1e9, 4),
         "rows": n_rows, "row_groups": meta.num_row_groups,
         "selected_bytes": sel_bytes, "hits": int(hits),
-        "total_bytes": os.path.getsize(path), "engine": cfg.engine,
-        "unit_batch": args.unit_batch,
+        # logical bytes either way (the striped size is sidecar-trimmed, so
+        # raid and plain runs of the same file agree)
+        "total_bytes": ctx.striped_source(path).size if raid
+        else os.path.getsize(path),
+        "engine": cfg.engine,
+        "unit_batch": args.unit_batch, "raid_members": raid,
     }
 
 
@@ -672,6 +704,12 @@ def main(argv: list[str] | None = None) -> int:
                       help="row groups concatenated per device dispatch "
                            "(amortizes per-call latency; scan aggregates "
                            "are row-decomposable so results are identical)")
+    p_pq.add_argument("--raid", type=int, default=0,
+                      help="scan from a RAID0 striped set of this many "
+                           "members (0 = plain file) — the reference's "
+                           "flagship md-raid0-of-NVMe deployment shape")
+    p_pq.add_argument("--raid-chunk", type=int, default=512 * 1024,
+                      dest="raid_chunk", help="RAID0 chunk size")
     p_pq.set_defaults(fn=bench_parquet)
 
     p_check = sub.add_parser("check", help="≙ CHECK_FILE: report a file's data-path tier")
