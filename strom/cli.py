@@ -175,25 +175,26 @@ def bench_ssd2tpu(args: argparse.Namespace) -> dict:
     }
 
 
-def _ensure_striped(plain: str, raid: int, chunk: int) -> list[str]:
-    """Member files of *plain* striped RAID0-style (fixture helper shared by
-    the vit and parquet benches). Member names are keyed by both raid knobs
-    — reusing members striped with a different chunk would decode
-    interleaved-wrong bytes — and the size sidecar (written atomically last)
-    revalidates against a changed source file."""
+def _ensure_striped(plain: str, raid: int, chunk: int) -> tuple[list[str], int]:
+    """(member files, true size) of *plain* striped RAID0-style (fixture
+    helper shared by the vit and parquet benches). Member names are keyed by
+    both raid knobs — reusing members striped with a different chunk would
+    decode interleaved-wrong bytes — and the size sidecar (written
+    atomically last) revalidates against a changed source file."""
     from strom.engine.raid0 import SIZE_SIDECAR_SUFFIX, stripe_file
 
     members = [f"{plain}.r{i}of{raid}.c{chunk}" for i in range(raid)]
+    size = os.path.getsize(plain)
     try:
         with open(members[0] + SIZE_SIDECAR_SUFFIX) as f:
-            fresh = int(f.read()) == os.path.getsize(plain) \
+            fresh = int(f.read()) == size \
                 and all(os.path.getmtime(m) >= os.path.getmtime(plain)
                         for m in members)
     except (OSError, ValueError):
         fresh = False
     if not fresh:
         stripe_file(plain, members, chunk)
-    return members
+    return members, size
 
 
 def _fit_dp_devices(batch: int) -> int:
@@ -438,7 +439,7 @@ def bench_vit(args: argparse.Namespace) -> dict:
 
     plain = args.file or _mk_wds_fixture(args.tmpdir, args.batch,
                                          args.image_size)
-    members = _ensure_striped(plain, args.raid, args.raid_chunk)
+    members, _ = _ensure_striped(plain, args.raid, args.raid_chunk)
     cfg = StromConfig(engine=args.engine, block_size=args.block,
                       queue_depth=args.depth, num_buffers=max(args.depth * 2, 8))
     ctx = StromContext(cfg)
@@ -549,7 +550,7 @@ def bench_parquet(args: argparse.Namespace) -> dict:
         # stripe-decodes across the set (the size sidecar keeps the footer
         # at the true EOF). Striped BEFORE the context exists so a failed
         # stripe can't leak the engine.
-        members = _ensure_striped(path, raid, args.raid_chunk)
+        members, logical_bytes = _ensure_striped(path, raid, args.raid_chunk)
     cfg = StromConfig(engine=args.engine, block_size=args.block,
                       queue_depth=args.depth, num_buffers=max(args.depth * 2, 8))
     ctx = StromContext(cfg)
@@ -594,10 +595,9 @@ def bench_parquet(args: argparse.Namespace) -> dict:
         "selected_gbps": round(sel_bytes / dt / 1e9, 4),
         "rows": n_rows, "row_groups": meta.num_row_groups,
         "selected_bytes": sel_bytes, "hits": int(hits),
-        # logical bytes either way (the striped size is sidecar-trimmed, so
-        # raid and plain runs of the same file agree)
-        "total_bytes": ctx.striped_source(path).size if raid
-        else os.path.getsize(path),
+        # logical bytes either way, so raid and plain runs of the same
+        # file agree
+        "total_bytes": logical_bytes if raid else os.path.getsize(path),
         "engine": cfg.engine,
         "unit_batch": args.unit_batch, "raid_members": raid,
     }
