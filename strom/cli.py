@@ -603,6 +603,54 @@ def bench_parquet(args: argparse.Namespace) -> dict:
     }
 
 
+def bench_all(args: argparse.Namespace) -> dict:
+    """Every BASELINE config in one run (quick shapes): nvme raw baseline,
+    ssd2tpu delivered, resnet/vit/llama loaders with real train steps,
+    parquet scan plain + striped. One failed phase never sinks the rest."""
+    size = args.size
+    # --file/--iters apply to the byte-oriented phases (any file is valid
+    # input there); the format-bound phases (resnet/vit/parquet) always use
+    # their generated fixtures — stated in the subcommand help
+    common = dict(file=None, size=size, block=args.block, depth=args.depth,
+                  iters=1, engine=args.engine, tmpdir=args.tmpdir, json=True)
+    byte_file = dict(file=args.file, iters=args.iters)
+    phases = [
+        ("nvme", bench_nvme, dict(buffered=False, huge=False, numa_node=-1,
+                                  per_op=False, sqpoll=False, **byte_file)),
+        ("ssd2tpu", bench_ssd2tpu, dict(chunk=min(32 * 1024 * 1024, size),
+                                        prefetch=2, **byte_file)),
+        ("llama", bench_llama, dict(batch=8, seq_len=2047, steps=8,
+                                    prefetch=6, train_step=True,
+                                    model="small", attn="flash",
+                                    file=args.file)),
+        ("resnet", bench_resnet, dict(batch=32, image_size=176, steps=6,
+                                      prefetch=2, decode_workers=8,
+                                      train_step=True, model="resnet50")),
+        ("vit", bench_vit, dict(batch=32, image_size=176, steps=6, prefetch=2,
+                                decode_workers=8, raid=4,
+                                raid_chunk=512 * 1024, train_step=True,
+                                model="vit_b16")),
+        ("parquet", bench_parquet, dict(rows=500_000, row_groups=16,
+                                        prefetch=2, unit_batch=4, raid=0,
+                                        raid_chunk=512 * 1024)),
+        ("parquet_raid0", bench_parquet, dict(rows=500_000, row_groups=16,
+                                              prefetch=2, unit_batch=4,
+                                              raid=4,
+                                              raid_chunk=512 * 1024)),
+    ]
+    out: dict = {"bench": "all", "failed": []}
+    for name, fn, extra in phases:
+        try:
+            t0 = time.perf_counter()
+            out[name] = fn(argparse.Namespace(**{**common, **extra}))
+            out[name]["wall_s"] = round(time.perf_counter() - t0, 1)
+        except Exception as e:  # noqa: BLE001 - keep the matrix going
+            out[name] = {"error": repr(e)}
+            out["failed"].append(name)
+            print(f"bench {name} failed: {e!r}", file=sys.stderr)
+    return out
+
+
 def main(argv: list[str] | None = None) -> int:
     ap = argparse.ArgumentParser(prog="strom-bench")
     sub = ap.add_subparsers(dest="cmd", required=True)
@@ -712,6 +760,15 @@ def main(argv: list[str] | None = None) -> int:
                       dest="raid_chunk", help="RAID0 chunk size")
     p_pq.set_defaults(fn=bench_parquet)
 
+    p_all = sub.add_parser("all", help="every BASELINE config, quick shapes, "
+                                       "one combined JSON; exit 3 if any "
+                                       "phase fails. --file/--iters apply to "
+                                       "the byte-oriented phases (nvme, "
+                                       "ssd2tpu, llama); vision/parquet "
+                                       "always use generated fixtures")
+    common(p_all)
+    p_all.set_defaults(fn=bench_all, size=256 * 1024 * 1024)
+
     p_check = sub.add_parser("check", help="≙ CHECK_FILE: report a file's data-path tier")
     p_check.add_argument("path")
     p_check.add_argument("--json", action="store_true")
@@ -733,7 +790,9 @@ def main(argv: list[str] | None = None) -> int:
         return 0
     out = args.fn(args)
     print(json.dumps(out))
-    return 0
+    # a failed phase in the combined matrix must fail the process: CI
+    # running `strom-bench all` should not read errors-in-JSON as green
+    return 3 if out.get("failed") else 0
 
 
 if __name__ == "__main__":
