@@ -259,55 +259,57 @@ def bench_llama(args: argparse.Namespace) -> dict:
     cfg = StromConfig(engine=args.engine, block_size=args.block,
                       queue_depth=args.depth, num_buffers=max(args.depth * 2, 8))
     ctx = StromContext(cfg)
-    n_dev = _fit_dp_devices(args.batch)
-    mesh = make_mesh({"dp": n_dev}, devices=jax.devices()[:n_dev])
-    sharding = NamedSharding(mesh, P("dp", None))
-    _drop_cache_hint(path)
-    with make_llama_pipeline(ctx, [path], batch=args.batch, seq_len=args.seq_len,
-                             sharding=sharding, prefetch_depth=args.prefetch) as pipe:
-        next(pipe).block_until_ready()  # warmup outside the timed region
-        t0 = time.perf_counter()
-        for _ in range(args.steps):
-            next(pipe).block_until_ready()
-        dt = time.perf_counter() - t0
-        stalls = pipe.data_stall_steps
-    tokens = args.steps * args.batch * (args.seq_len + 1)
-    out = {
-        "bench": "llama_loader", "tokens_per_s": round(tokens / dt, 1),
-        "gbps": round(tokens * 4 / dt / 1e9, 4), "batch": args.batch,
-        "seq_len": args.seq_len, "steps": args.steps, "devices": n_dev,
-        "data_stall_steps": stalls, "engine": cfg.engine,
-    }
+    try:
+        n_dev = _fit_dp_devices(args.batch)
+        mesh = make_mesh({"dp": n_dev}, devices=jax.devices()[:n_dev])
+        sharding = NamedSharding(mesh, P("dp", None))
+        _drop_cache_hint(path)
+        with make_llama_pipeline(ctx, [path], batch=args.batch, seq_len=args.seq_len,
+                                 sharding=sharding, prefetch_depth=args.prefetch) as pipe:
+            next(pipe).block_until_ready()  # warmup outside the timed region
+            t0 = time.perf_counter()
+            for _ in range(args.steps):
+                next(pipe).block_until_ready()
+            dt = time.perf_counter() - t0
+            stalls = pipe.data_stall_steps
+        tokens = args.steps * args.batch * (args.seq_len + 1)
+        out = {
+            "bench": "llama_loader", "tokens_per_s": round(tokens / dt, 1),
+            "gbps": round(tokens * 4 / dt / 1e9, 4), "batch": args.batch,
+            "seq_len": args.seq_len, "steps": args.steps, "devices": n_dev,
+            "data_stall_steps": stalls, "engine": cfg.engine,
+        }
 
-    if getattr(args, "train_step", False):
-        from strom.models.llama import LlamaConfig
-        from strom.parallel.train import (init_train_state, make_optimizer,
-                                          make_train_step)
+        if getattr(args, "train_step", False):
+            from strom.models.llama import LlamaConfig
+            from strom.parallel.train import (init_train_state, make_optimizer,
+                                              make_train_step)
 
-        mcfg = getattr(LlamaConfig, args.model)()
-        opt = make_optimizer()
-        with mesh:
-            state = init_train_state(jax.random.key(0), mcfg, mesh, opt)
-            step_fn = make_train_step(mcfg, mesh, opt, attn=args.attn)
+            mcfg = getattr(LlamaConfig, args.model)()
+            opt = make_optimizer()
+            with mesh:
+                state = init_train_state(jax.random.key(0), mcfg, mesh, opt)
+                step_fn = make_train_step(mcfg, mesh, opt, attn=args.attn)
 
-            def step(toks):
-                nonlocal state
-                # bench tokens are random bytes; clamp into vocab on device
-                state, m = step_fn(state, toks % mcfg.vocab)
-                return m["loss"]
+                def step(toks):
+                    nonlocal state
+                    # bench tokens are random bytes; clamp into vocab on device
+                    state, m = step_fn(state, toks % mcfg.vocab)
+                    return m["loss"]
 
-            rate, stalls, loss = _timed_train_phase(
-                lambda: make_llama_pipeline(ctx, [path], batch=args.batch,
-                                            seq_len=args.seq_len,
-                                            sharding=sharding,
-                                            prefetch_depth=args.prefetch),
-                step, args.steps, args.batch * (args.seq_len + 1))
-            out["train_tokens_per_s"] = rate
-            out["train_data_stalls"] = stalls
-            out["train_model"] = args.model
-            out["train_attn"] = args.attn
-            out["train_loss"] = loss
-    ctx.close()
+                rate, stalls, loss = _timed_train_phase(
+                    lambda: make_llama_pipeline(ctx, [path], batch=args.batch,
+                                                seq_len=args.seq_len,
+                                                sharding=sharding,
+                                                prefetch_depth=args.prefetch),
+                    step, args.steps, args.batch * (args.seq_len + 1))
+                out["train_tokens_per_s"] = rate
+                out["train_data_stalls"] = stalls
+                out["train_model"] = args.model
+                out["train_attn"] = args.attn
+                out["train_loss"] = loss
+    finally:
+        ctx.close()
     return out
 
 
@@ -356,70 +358,72 @@ def bench_resnet(args: argparse.Namespace) -> dict:
     cfg = StromConfig(engine=args.engine, block_size=args.block,
                       queue_depth=args.depth, num_buffers=max(args.depth * 2, 8))
     ctx = StromContext(cfg)
-    n_dev = _fit_dp_devices(args.batch)
-    mesh = make_mesh({"dp": n_dev}, devices=jax.devices()[:n_dev])
-    sharding = NamedSharding(mesh, P("dp", None, None, None))
-    _drop_cache_hint(path)
-    with make_imagenet_resnet_pipeline(
-            ctx, [path], batch=args.batch, image_size=args.image_size,
-            sharding=sharding, prefetch_depth=args.prefetch,
-            decode_workers=args.decode_workers) as pipe:
-        next(pipe)[0].block_until_ready()
-        t0 = time.perf_counter()
-        for _ in range(args.steps):
-            imgs, _ = next(pipe)
-            imgs.block_until_ready()
-        dt = time.perf_counter() - t0
-        stalls = pipe.data_stall_steps
-    out = {
-        "bench": "resnet_loader",
-        "images_per_s": round(args.steps * args.batch / dt, 1),
-        "batch": args.batch, "image_size": args.image_size,
-        "steps": args.steps, "devices": n_dev, "data_stall_steps": stalls,
-        "decode_workers": args.decode_workers, "engine": cfg.engine,
-    }
-
-    if getattr(args, "train_step", False):
-        # north-star phase (BASELINE.json:5 "ResNet-50 input pipeline fully
-        # IO-overlapped, 0 data-stall steps"): a REAL jitted ResNet train
-        # step (fwd+bwd+SGD) consumes the batches; decode+delivery must hide
-        # behind its device time. Flat-out above stalls by construction —
-        # there is no compute to overlap with.
-        import functools
-
-        from strom.models.resnet import (ResNetConfig, init_params, loss_fn,
-                                         normalize_images)
-
-        mcfg = getattr(ResNetConfig, args.model)()
-        params, bn_state = init_params(jax.random.key(0), mcfg)
-
-        @functools.partial(jax.jit, donate_argnums=(0,))
-        def sgd_step(p, s, images, labels):
-            (loss, new_s), grads = jax.value_and_grad(
-                loss_fn, has_aux=True)(p, s, normalize_images(images),
-                                       labels, mcfg)
-            new_p = jax.tree.map(lambda w, g: w - 1e-3 * g, p, grads)
-            return new_p, new_s, loss
-
-        def step(batch):
-            nonlocal params, bn_state
-            imgs, lbls = batch
-            params, bn_state, loss = sgd_step(params, bn_state, imgs,
-                                              lbls % mcfg.num_classes)
-            return loss
-
+    try:
+        n_dev = _fit_dp_devices(args.batch)
+        mesh = make_mesh({"dp": n_dev}, devices=jax.devices()[:n_dev])
+        sharding = NamedSharding(mesh, P("dp", None, None, None))
         _drop_cache_hint(path)
-        rate, stalls, loss = _timed_train_phase(
-            lambda: make_imagenet_resnet_pipeline(
+        with make_imagenet_resnet_pipeline(
                 ctx, [path], batch=args.batch, image_size=args.image_size,
                 sharding=sharding, prefetch_depth=args.prefetch,
-                decode_workers=args.decode_workers),
-            step, args.steps, args.batch)
-        out["train_images_per_s"] = rate
-        out["train_data_stalls"] = stalls
-        out["train_model"] = args.model
-        out["train_loss"] = loss
-    ctx.close()
+                decode_workers=args.decode_workers) as pipe:
+            next(pipe)[0].block_until_ready()
+            t0 = time.perf_counter()
+            for _ in range(args.steps):
+                imgs, _ = next(pipe)
+                imgs.block_until_ready()
+            dt = time.perf_counter() - t0
+            stalls = pipe.data_stall_steps
+        out = {
+            "bench": "resnet_loader",
+            "images_per_s": round(args.steps * args.batch / dt, 1),
+            "batch": args.batch, "image_size": args.image_size,
+            "steps": args.steps, "devices": n_dev, "data_stall_steps": stalls,
+            "decode_workers": args.decode_workers, "engine": cfg.engine,
+        }
+
+        if getattr(args, "train_step", False):
+            # north-star phase (BASELINE.json:5 "ResNet-50 input pipeline fully
+            # IO-overlapped, 0 data-stall steps"): a REAL jitted ResNet train
+            # step (fwd+bwd+SGD) consumes the batches; decode+delivery must hide
+            # behind its device time. Flat-out above stalls by construction —
+            # there is no compute to overlap with.
+            import functools
+
+            from strom.models.resnet import (ResNetConfig, init_params, loss_fn,
+                                             normalize_images)
+
+            mcfg = getattr(ResNetConfig, args.model)()
+            params, bn_state = init_params(jax.random.key(0), mcfg)
+
+            @functools.partial(jax.jit, donate_argnums=(0,))
+            def sgd_step(p, s, images, labels):
+                (loss, new_s), grads = jax.value_and_grad(
+                    loss_fn, has_aux=True)(p, s, normalize_images(images),
+                                           labels, mcfg)
+                new_p = jax.tree.map(lambda w, g: w - 1e-3 * g, p, grads)
+                return new_p, new_s, loss
+
+            def step(batch):
+                nonlocal params, bn_state
+                imgs, lbls = batch
+                params, bn_state, loss = sgd_step(params, bn_state, imgs,
+                                                  lbls % mcfg.num_classes)
+                return loss
+
+            _drop_cache_hint(path)
+            rate, stalls, loss = _timed_train_phase(
+                lambda: make_imagenet_resnet_pipeline(
+                    ctx, [path], batch=args.batch, image_size=args.image_size,
+                    sharding=sharding, prefetch_depth=args.prefetch,
+                    decode_workers=args.decode_workers),
+                step, args.steps, args.batch)
+            out["train_images_per_s"] = rate
+            out["train_data_stalls"] = stalls
+            out["train_model"] = args.model
+            out["train_loss"] = loss
+    finally:
+        ctx.close()
     return out
 
 
@@ -443,70 +447,72 @@ def bench_vit(args: argparse.Namespace) -> dict:
     cfg = StromConfig(engine=args.engine, block_size=args.block,
                       queue_depth=args.depth, num_buffers=max(args.depth * 2, 8))
     ctx = StromContext(cfg)
-    virt = plain + ".raid0"  # never exists on disk: reads resolve via alias
-    ctx.register_striped(virt, members, args.raid_chunk)
-    n_dev = _fit_dp_devices(args.batch)
-    mesh = make_mesh({"dp": n_dev}, devices=jax.devices()[:n_dev])
-    sharding = NamedSharding(mesh, P("dp", None, None, None))
-    for m in members:
-        _drop_cache_hint(m)
-    with make_vit_wds_pipeline(
-            ctx, [virt], batch=args.batch, image_size=args.image_size,
-            sharding=sharding, prefetch_depth=args.prefetch,
-            decode_workers=args.decode_workers) as pipe:
-        next(pipe)[0].block_until_ready()
-        t0 = time.perf_counter()
-        for _ in range(args.steps):
-            imgs, _ = next(pipe)
-            imgs.block_until_ready()
-        dt = time.perf_counter() - t0
-        stalls = pipe.data_stall_steps
-    out = {
-        "bench": "vit_loader", "images_per_s": round(args.steps * args.batch / dt, 1),
-        "batch": args.batch, "image_size": args.image_size,
-        "steps": args.steps, "devices": n_dev, "raid_members": args.raid,
-        "data_stall_steps": stalls, "engine": cfg.engine,
-    }
-
-    if getattr(args, "train_step", False):
-        # north-star phase: a REAL jitted ViT train step consumes the batches
-        # (decode+stripe-gather must hide behind its device time)
-        import functools
-
-        from strom.models.resnet import normalize_images
-        from strom.models.vit import ViTConfig, init_params, loss_fn
-
-        mcfg = getattr(ViTConfig, args.model)()
-        if mcfg.image_size != args.image_size:
-            mcfg = dataclasses.replace(mcfg, image_size=args.image_size)
-        params = init_params(jax.random.key(0), mcfg)
-
-        @functools.partial(jax.jit, donate_argnums=(0,))
-        def sgd_step(p, images, labels):
-            loss, grads = jax.value_and_grad(loss_fn)(
-                p, normalize_images(images), labels, mcfg)
-            new_p = jax.tree.map(lambda w, g: w - 1e-3 * g, p, grads)
-            return new_p, loss
-
-        def step(batch):
-            nonlocal params
-            imgs, lbls = batch
-            params, loss = sgd_step(params, imgs, lbls % mcfg.num_classes)
-            return loss
-
+    try:
+        virt = plain + ".raid0"  # never exists on disk: reads resolve via alias
+        ctx.register_striped(virt, members, args.raid_chunk)
+        n_dev = _fit_dp_devices(args.batch)
+        mesh = make_mesh({"dp": n_dev}, devices=jax.devices()[:n_dev])
+        sharding = NamedSharding(mesh, P("dp", None, None, None))
         for m in members:
             _drop_cache_hint(m)
-        rate, stalls, loss = _timed_train_phase(
-            lambda: make_vit_wds_pipeline(
+        with make_vit_wds_pipeline(
                 ctx, [virt], batch=args.batch, image_size=args.image_size,
                 sharding=sharding, prefetch_depth=args.prefetch,
-                decode_workers=args.decode_workers),
-            step, args.steps, args.batch)
-        out["train_images_per_s"] = rate
-        out["train_data_stalls"] = stalls
-        out["train_model"] = args.model
-        out["train_loss"] = loss
-    ctx.close()
+                decode_workers=args.decode_workers) as pipe:
+            next(pipe)[0].block_until_ready()
+            t0 = time.perf_counter()
+            for _ in range(args.steps):
+                imgs, _ = next(pipe)
+                imgs.block_until_ready()
+            dt = time.perf_counter() - t0
+            stalls = pipe.data_stall_steps
+        out = {
+            "bench": "vit_loader", "images_per_s": round(args.steps * args.batch / dt, 1),
+            "batch": args.batch, "image_size": args.image_size,
+            "steps": args.steps, "devices": n_dev, "raid_members": args.raid,
+            "data_stall_steps": stalls, "engine": cfg.engine,
+        }
+
+        if getattr(args, "train_step", False):
+            # north-star phase: a REAL jitted ViT train step consumes the batches
+            # (decode+stripe-gather must hide behind its device time)
+            import functools
+
+            from strom.models.resnet import normalize_images
+            from strom.models.vit import ViTConfig, init_params, loss_fn
+
+            mcfg = getattr(ViTConfig, args.model)()
+            if mcfg.image_size != args.image_size:
+                mcfg = dataclasses.replace(mcfg, image_size=args.image_size)
+            params = init_params(jax.random.key(0), mcfg)
+
+            @functools.partial(jax.jit, donate_argnums=(0,))
+            def sgd_step(p, images, labels):
+                loss, grads = jax.value_and_grad(loss_fn)(
+                    p, normalize_images(images), labels, mcfg)
+                new_p = jax.tree.map(lambda w, g: w - 1e-3 * g, p, grads)
+                return new_p, loss
+
+            def step(batch):
+                nonlocal params
+                imgs, lbls = batch
+                params, loss = sgd_step(params, imgs, lbls % mcfg.num_classes)
+                return loss
+
+            for m in members:
+                _drop_cache_hint(m)
+            rate, stalls, loss = _timed_train_phase(
+                lambda: make_vit_wds_pipeline(
+                    ctx, [virt], batch=args.batch, image_size=args.image_size,
+                    sharding=sharding, prefetch_depth=args.prefetch,
+                    decode_workers=args.decode_workers),
+                step, args.steps, args.batch)
+            out["train_images_per_s"] = rate
+            out["train_data_stalls"] = stalls
+            out["train_model"] = args.model
+            out["train_loss"] = loss
+    finally:
+        ctx.close()
     return out
 
 
@@ -608,9 +614,10 @@ def bench_all(args: argparse.Namespace) -> dict:
     ssd2tpu delivered, resnet/vit/llama loaders with real train steps,
     parquet scan plain + striped. One failed phase never sinks the rest."""
     size = args.size
-    # --file/--iters apply to the byte-oriented phases (any file is valid
-    # input there); the format-bound phases (resnet/vit/parquet) always use
-    # their generated fixtures — stated in the subcommand help
+    # --file applies to the byte-oriented phases (any file is valid input
+    # there; llama reads it as packed tokens) and --iters to the two
+    # bandwidth phases; the format-bound phases (resnet/vit/parquet) always
+    # use their generated fixtures — stated in the subcommand help
     common = dict(file=None, size=size, block=args.block, depth=args.depth,
                   iters=1, engine=args.engine, tmpdir=args.tmpdir, json=True)
     byte_file = dict(file=args.file, iters=args.iters)
@@ -762,10 +769,11 @@ def main(argv: list[str] | None = None) -> int:
 
     p_all = sub.add_parser("all", help="every BASELINE config, quick shapes, "
                                        "one combined JSON; exit 3 if any "
-                                       "phase fails. --file/--iters apply to "
-                                       "the byte-oriented phases (nvme, "
-                                       "ssd2tpu, llama); vision/parquet "
-                                       "always use generated fixtures")
+                                       "phase fails. --file applies to nvme/"
+                                       "ssd2tpu/llama and --iters to nvme/"
+                                       "ssd2tpu; the other phases are "
+                                       "format-bound to generated fixtures "
+                                       "and single-pass")
     common(p_all)
     p_all.set_defaults(fn=bench_all, size=256 * 1024 * 1024)
 

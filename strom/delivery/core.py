@@ -242,6 +242,13 @@ class StromContext:
         self._executor = concurrent.futures.ThreadPoolExecutor(
             max_workers=max(2, self.config.delivery_workers),
             thread_name_prefix="strom-delivery")
+        # per-device-group tasks within ONE sharded transfer; a separate pool
+        # from _executor because async transfers run their whole run() there —
+        # submitting group tasks to the same pool could deadlock with every
+        # worker occupied by a transfer waiting on its own groups
+        self._group_executor = concurrent.futures.ThreadPoolExecutor(
+            max_workers=max(2, self.config.delivery_workers),
+            thread_name_prefix="strom-groups")
         # engine ops are pipelined internally; serialize whole-transfer use of
         # the engine so concurrent handles don't interleave queue-depth budgets
         self._engine_lock = threading.Lock()
@@ -657,21 +664,64 @@ class StromContext:
                 groups = dedupe_plans(plans)
                 shards = []
                 dests = []
-                for segs, group in groups.items():
-                    if stream_eligible(group[0].nbytes):
-                        shards.extend(self._deliver_streamed(
-                            source, list(segs), offset, group[0].nbytes,
-                            np_dtype, group[0].local_shape,
-                            [p.device for p in group], pool))
-                        continue
+                group_items = list(groups.items())
+
+                def deliver_group(segs, group) -> tuple[list, np.ndarray]:
                     dest = acquire(group[0].nbytes)
-                    dests.append(dest)
                     self._read_segments(source, list(segs), dest, offset)
                     arr_host = dest.view(np_dtype).reshape(group[0].local_shape)
+                    out = []
                     for p in group:
                         with self._put_lock, \
-                                trace_span("strom.device_put", enabled=cfg.trace_annotations):
-                            shards.append(jax.device_put(arr_host, p.device))
+                                trace_span("strom.device_put",
+                                           enabled=cfg.trace_annotations):
+                            out.append(jax.device_put(arr_host, p.device))
+                    return out, dest
+
+                any_stream = any(stream_eligible(g[0].nbytes)
+                                 for _, g in group_items)
+                if (len(group_items) > 1 and not any_stream
+                        and cfg.delivery_workers > 1):
+                    # group-parallel: group k+1's engine read (serialized by
+                    # _engine_lock) overlaps group k's host->HBM put — the
+                    # only overlap available to small-shard sync transfers,
+                    # which the intra-transfer streaming path doesn't cover
+                    # (streamed groups keep the sequential arm: they overlap
+                    # internally and concurrency would multiply peak memory)
+                    futs = [self._group_executor.submit(deliver_group, segs, g)
+                            for segs, g in group_items]
+                    # drain EVERY future before acting on any error: the old
+                    # sequential path could never raise with reads still in
+                    # flight, and neither may this one (a caller reacting to
+                    # the error — deleting the file, closing the context —
+                    # must not race live engine reads)
+                    concurrent.futures.wait(futs)
+                    first_err = next((f.exception() for f in futs
+                                      if f.exception() is not None), None)
+                    ok = [f.result() for f in futs if f.exception() is None]
+                    if first_err is not None:
+                        if pool is not None:
+                            # successful groups' slabs go back to the pool
+                            # once their puts retire; shards die with us
+                            for s, d in ok:
+                                for a in s:
+                                    a.block_until_ready()
+                                pool.release(d)
+                        raise first_err
+                    for s, d in ok:
+                        shards.extend(s)
+                        dests.append(d)
+                else:
+                    for segs, group in group_items:
+                        if stream_eligible(group[0].nbytes):
+                            shards.extend(self._deliver_streamed(
+                                source, list(segs), offset, group[0].nbytes,
+                                np_dtype, group[0].local_shape,
+                                [p.device for p in group], pool))
+                            continue
+                        s, d = deliver_group(segs, group)
+                        shards.extend(s)
+                        dests.append(d)
                 out = jax.make_array_from_single_device_arrays(
                     shape, sharding, shards)
                 if pool is not None:
@@ -724,4 +774,5 @@ class StromContext:
             return
         self._closed = True
         self._executor.shutdown(wait=True)
+        self._group_executor.shutdown(wait=True)
         self.engine.close()
