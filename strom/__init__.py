@@ -106,9 +106,19 @@ def stats() -> dict:
 
 
 def prometheus() -> str:
-    from strom.utils.stats import global_stats
+    """One scrape of the whole data path: global counters plus — when the
+    process context exists — context/slab-pool/engine counters and the
+    engine's read-latency histogram (≙ the reference's /proc stats node)."""
+    from strom.utils.stats import global_stats, sections_prometheus
 
-    return global_stats.prometheus()
+    text = global_stats.prometheus()
+    # stats() runs INSIDE the lock: a concurrent close()/init() would
+    # otherwise destroy the engine under the scrape (sc_get_stats on a
+    # dead handle)
+    with _ctx_lock:
+        if _ctx is not None:
+            text += sections_prometheus(_ctx.stats())
+    return text
 
 
 def close() -> None:

@@ -426,6 +426,10 @@ class UringEngine(Engine):
             "ops_fixed": int(s.ops_fixed),
             "read_latency_mean_us": (s.lat_total_us / total) if total else 0.0,
             "read_latency_count": total,
+            # raw log2 buckets (bucket i ≈ [2^i, 2^(i+1)) us): feeds the
+            # Prometheus histogram exposition (≙ the reference's /proc stats)
+            "read_latency_hist": [int(s.lat_hist[i])
+                                  for i in range(_HIST_BUCKETS)],
         }
         # percentiles from the log2 histogram
         for q, name in ((0.5, "read_latency_p50_us"), (0.99, "read_latency_p99_us")):

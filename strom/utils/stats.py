@@ -41,7 +41,9 @@ class _Histogram:
         self._lock = threading.Lock()
 
     def observe_us(self, us: float) -> None:
-        b = max(0, min(self.N_BUCKETS - 1, int(us).bit_length()))
+        # bucket i holds [2^i, 2^(i+1)) — the same convention as the C
+        # engine's record_latency, so one Prometheus exposition serves both
+        b = max(0, min(self.N_BUCKETS - 1, int(us).bit_length() - 1))
         with self._lock:
             self.buckets[b] += 1
             self.count += 1
@@ -57,8 +59,8 @@ class _Histogram:
             for i, n in enumerate(self.buckets):
                 acc += n
                 if acc >= target:
-                    return float(2 ** i)
-            return float(2 ** (self.N_BUCKETS - 1))
+                    return float(2 ** (i + 1))
+            return float(2 ** self.N_BUCKETS)
 
     @property
     def mean_us(self) -> float:
@@ -107,6 +109,7 @@ class StatsRegistry:
             out[k + "_p99_us"] = h.percentile(0.99)
             out[k + "_mean_us"] = h.mean_us
             out[k + "_count"] = h.count
+            out[k + "_hist"] = list(h.buckets)
         return out
 
     def merge(self, others: Iterable["StatsRegistry"]) -> dict:
@@ -119,13 +122,61 @@ class StatsRegistry:
 
     def prometheus(self) -> str:
         """Prometheus text exposition of every counter/histogram summary."""
-        lines = []
-        snap = self.snapshot()
-        for k, v in sorted(snap.items()):
-            metric = f"{self.name}_{k}".replace(".", "_").replace("-", "_")
-            lines.append(f"# TYPE {metric} gauge")
-            lines.append(f"{metric} {v}")
-        return "\n".join(lines) + "\n"
+        return _flat_prometheus(self.snapshot(), self.name)
+
+
+def _metric(*parts: str) -> str:
+    return "_".join(parts).replace(".", "_").replace("-", "_")
+
+
+def _hist_lines(base: str, buckets, mean_us: float) -> list[str]:
+    """Proper cumulative Prometheus histogram from log2 microsecond buckets
+    (bucket i = [2^i, 2^(i+1)) us). _count/_sum derive from the SAME bucket
+    snapshot (not a separately-read count field), so +Inf always equals
+    _count even when observations race the scrape."""
+    lines = [f"# TYPE {base}_us histogram"]
+    acc = 0
+    for i, n in enumerate(buckets):
+        acc += int(n)
+        lines.append(f'{base}_us_bucket{{le="{2 ** (i + 1)}"}} {acc}')
+    lines.append(f'{base}_us_bucket{{le="+Inf"}} {acc}')
+    lines.append(f"{base}_us_sum {mean_us * acc}")
+    lines.append(f"{base}_us_count {acc}")
+    return lines
+
+
+def _flat_prometheus(snap: dict, prefix: str) -> str:
+    """Gauges for numeric/bool leaves; ``*_hist`` bucket lists become real
+    histograms (with ``_sum``/``_count`` from their sibling mean/count keys).
+    Non-numeric leaves (e.g. the engine-name string) are skipped."""
+    lines: list[str] = []
+    for k, v in sorted(snap.items()):
+        if k.endswith("_hist") and isinstance(v, (list, tuple)):
+            stem = k[: -len("_hist")]
+            lines.extend(_hist_lines(
+                _metric(prefix, stem), v,
+                float(snap.get(stem + "_mean_us", 0.0))))
+        elif isinstance(v, bool):
+            m = _metric(prefix, k)
+            lines.append(f"# TYPE {m} gauge")
+            lines.append(f"{m} {int(v)}")
+        elif isinstance(v, (int, float)):
+            m = _metric(prefix, k)
+            lines.append(f"# TYPE {m} gauge")
+            lines.append(f"{m} {v}")
+    return "\n".join(lines) + "\n"
+
+
+def sections_prometheus(sections: dict, prefix: str = "strom") -> str:
+    """Prometheus text for a nested stats dict ({section: {key: value}}) —
+    the shape ``StromContext.stats()`` returns. ≙ the reference exposing its
+    per-module DMA counters and latency clocks via /proc (SURVEY.md §2.1
+    "Stats/observability"): this is the whole data path's state in one
+    scrape — context counters, slab pool, engine counters + latency
+    histogram."""
+    return "".join(
+        _flat_prometheus(vals, f"{prefix}_{sec}")
+        for sec, vals in sections.items() if isinstance(vals, dict))
 
 
 global_stats = StatsRegistry("strom")

@@ -210,3 +210,31 @@ def test_sourceio_readahead_windows(ctx, tmp_path, rng):
     f.seek(0)
     f.seek(50, _io.SEEK_CUR)
     assert f.read(10) == data[50:60]
+
+
+def test_prometheus_engine_histogram(data_file, engine_name):
+    """strom.prometheus() must expose the ENGINE's counters and a valid
+    cumulative read-latency histogram, not just the global counters (the
+    reference exposes exactly these via its /proc node)."""
+    import strom
+    from strom.config import StromConfig
+
+    path, data = data_file
+    strom.close()
+    strom.init(StromConfig(engine=engine_name, queue_depth=8, num_buffers=8))
+    try:
+        strom.memcpy_ssd2tpu(path, length=1 << 20).block_until_ready()
+        txt = strom.prometheus()
+        assert "strom_engine_read_latency_us_bucket" in txt
+        assert "strom_engine_bytes_read" in txt
+        assert "strom_context_ssd2tpu_bytes" in txt
+        # cumulative monotonicity + +Inf == count
+        counts = [int(line.rsplit(" ", 1)[1])
+                  for line in txt.splitlines()
+                  if line.startswith("strom_engine_read_latency_us_bucket")]
+        assert counts == sorted(counts) and counts[-1] > 0
+        count_line = [l for l in txt.splitlines()
+                      if l.startswith("strom_engine_read_latency_us_count")]
+        assert int(count_line[0].rsplit(" ", 1)[1]) == counts[-1]
+    finally:
+        strom.close()
