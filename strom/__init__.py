@@ -102,7 +102,13 @@ def map_buffers() -> list:
 
 
 def stats() -> dict:
-    return context().stats()
+    global _ctx
+    # snapshot INSIDE the lock, same reason as prometheus(): a concurrent
+    # close()/init() must not destroy the engine under the scrape
+    with _ctx_lock:
+        if _ctx is None:
+            _ctx = StromContext()
+        return _ctx.stats()
 
 
 def prometheus() -> str:

@@ -431,14 +431,16 @@ class UringEngine(Engine):
             "read_latency_hist": [int(s.lat_hist[i])
                                   for i in range(_HIST_BUCKETS)],
         }
-        # percentiles from the log2 histogram
+        # percentiles from the log2 histogram — UPPER bucket edge, the same
+        # convention as utils.stats._Histogram.percentile, so the two
+        # engines' percentile gauges agree for identical distributions
         for q, name in ((0.5, "read_latency_p50_us"), (0.99, "read_latency_p99_us")):
             acc, val = 0, 0.0
             target = q * total
             for i in range(_HIST_BUCKETS):
                 acc += s.lat_hist[i]
                 if total and acc >= target:
-                    val = float(2 ** i)
+                    val = float(2 ** (i + 1))
                     break
             out[name] = val
         return out

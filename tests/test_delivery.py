@@ -238,3 +238,28 @@ def test_prometheus_engine_histogram(data_file, engine_name):
         assert int(count_line[0].rsplit(" ", 1)[1]) == counts[-1]
     finally:
         strom.close()
+
+
+def test_sharded_group_failure_drains_cleanly(ctx, tmp_path, rng):
+    """Group-parallel sharded delivery: when one device group's read fails
+    (EOF short read), the transfer raises EngineError only after every
+    in-flight group drained, and the context stays fully usable."""
+    data = rng.integers(0, 256, 64 * 1024, dtype=np.uint8)
+    p = str(tmp_path / "short.bin")
+    data.tofile(p)
+    mesh = Mesh(np.array(jax.devices()).reshape(8), ("dp",))
+    sharding = NamedSharding(mesh, P("dp", None))
+    from strom.engine.base import EngineError
+
+    # plan 128KiB over 8 groups; the file holds 64KiB, so late groups fail
+    with pytest.raises(EngineError):
+        ctx.memcpy_ssd2tpu(p, shape=(8, 16 * 1024), dtype=np.uint8,
+                           sharding=sharding)
+    # the drain contract: at raise time no group read may still be in
+    # flight inside the engine
+    assert ctx.engine.in_flight() == 0
+    # reuse after failure: the engine and executors must be intact
+    arr = ctx.memcpy_ssd2tpu(p, shape=(8, 8 * 1024), dtype=np.uint8,
+                             sharding=sharding)
+    np.testing.assert_array_equal(
+        np.asarray(arr).ravel(), data)
