@@ -141,6 +141,7 @@ struct sc_stats {
   uint32_t ext_buffers;   // currently-registered external dest slabs
   uint64_t ops_fixed;     // ops that rode IORING_OP_READ_FIXED
   uint8_t sqpoll;         // 1 if IORING_SETUP_SQPOLL active
+  uint32_t sqpoll_wakeup_errno;  // last fatal SQ_WAKEUP errno (0 = none)
 };
 
 struct sc_engine {
@@ -223,6 +224,9 @@ struct sc_engine {
       ops_faulted{0}, bytes_read{0}, unaligned_fallback{0}, eof_topup{0},
       lat_count{0}, lat_total_us{0}, chunk_retries{0}, ops_fixed{0};
   std::atomic<uint64_t> lat_hist[kHistBuckets]{};
+  // last non-transient errno from the SQPOLL SQ_WAKEUP enter (0 = none):
+  // a dead/unwakeable poller otherwise presents only as a read timeout
+  std::atomic<uint32_t> sqpoll_wakeup_errno{0};
 };
 
 static void record_latency(sc_engine *e, uint64_t us) {
@@ -614,9 +618,19 @@ static EnterResult ring_enter_submit(sc_engine *e, unsigned k,
     // (io_uring_enter(2) mandates a smp_mb() here; liburing does the same)
     std::atomic_thread_fence(std::memory_order_seq_cst);
     if (e->sq_flags->load(std::memory_order_relaxed) & IORING_SQ_NEED_WAKEUP) {
-      while (sys_io_uring_enter(e->ring_fd, 0, 0, IORING_ENTER_SQ_WAKEUP,
-                                nullptr, 0) < 0 &&
-             (errno == EINTR || errno == EAGAIN || errno == EBUSY)) {
+      for (;;) {
+        if (sys_io_uring_enter(e->ring_fd, 0, 0, IORING_ENTER_SQ_WAKEUP,
+                               nullptr, 0) >= 0)
+          break;
+        if (errno == EINTR || errno == EAGAIN || errno == EBUSY) continue;
+        // non-transient: the poller may be dead/unwakeable. Record the errno
+        // so a stalled batch is diagnosable from stats() instead of
+        // presenting only as sc_wait's bounded-timeout read timeout. The
+        // batch itself is NOT rolled back (the poller may already be
+        // consuming it — see the no-rollback rule above).
+        e->sqpoll_wakeup_errno.store((uint32_t)errno,
+                                     std::memory_order_relaxed);
+        break;
       }
     }
     e->ops_submitted.fetch_add(k, std::memory_order_relaxed);
@@ -1225,6 +1239,8 @@ void sc_get_stats(sc_engine *e, sc_stats *s) {
       if (e->ext_len[i] != 0) ++ext;
   }
   s->ext_buffers = ext;
+  s->sqpoll_wakeup_errno =
+      e->sqpoll_wakeup_errno.load(std::memory_order_relaxed);
 }
 
 }  // extern "C"

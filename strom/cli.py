@@ -179,22 +179,27 @@ def _ensure_striped(plain: str, raid: int, chunk: int) -> tuple[list[str], int]:
     """(member files, true size) of *plain* striped RAID0-style (fixture
     helper shared by the vit and parquet benches). Member names are keyed by
     both raid knobs — reusing members striped with a different chunk would
-    decode interleaved-wrong bytes — and the size sidecar (written
-    atomically last) revalidates against a changed source file."""
-    from strom.engine.raid0 import SIZE_SIDECAR_SUFFIX, stripe_file
+    decode interleaved-wrong bytes — and a fingerprint sidecar (source
+    size + mtime_ns, written last) revalidates against a changed source:
+    mtime ordering alone misses a same-size rewrite within mtime granularity
+    and would silently benchmark stale bytes."""
+    from strom.engine.raid0 import stripe_file
 
     members = [f"{plain}.r{i}of{raid}.c{chunk}" for i in range(raid)]
-    size = os.path.getsize(plain)
+    st = os.stat(plain)
+    fingerprint = f"{st.st_size}:{st.st_mtime_ns}"
+    fp_path = members[0] + ".stromfp"
     try:
-        with open(members[0] + SIZE_SIDECAR_SUFFIX) as f:
-            fresh = int(f.read()) == size \
-                and all(os.path.getmtime(m) >= os.path.getmtime(plain)
-                        for m in members)
-    except (OSError, ValueError):
+        with open(fp_path) as f:
+            fresh = f.read() == fingerprint \
+                and all(os.path.exists(m) for m in members)
+    except OSError:
         fresh = False
     if not fresh:
         stripe_file(plain, members, chunk)
-    return members, size
+        with open(fp_path, "w") as f:
+            f.write(fingerprint)
+    return members, st.st_size
 
 
 def _fit_dp_devices(batch: int) -> int:

@@ -59,6 +59,16 @@ class StripedFile:
     def size(self) -> int:
         if self.size_bytes is not None:
             return self.size_bytes
+        # cached: size is consulted per pread/memcpy (via source_size), and
+        # re-opening the sidecar each time is both a syscall tax and a window
+        # for a mid-run rewrite to shift the perceived EOF
+        cached = getattr(self, "_size_cache", None)
+        if cached is not None:
+            return cached
+        sizes = [os.stat(m).st_size for m in self.members]
+        usable = min(sizes) // self.chunk * self.chunk
+        capacity = usable * len(self.members)
+        size = capacity
         # sets written by stripe_file carry their true size in a sidecar;
         # honoring it here closes the silent-zero-pad trap even when the
         # caller forgot to pass size= at registration
@@ -66,12 +76,15 @@ class StripedFile:
 
         try:
             with open(self.members[0] + SIZE_SIDECAR_SUFFIX) as f:
-                return int(f.read())
+                claimed = int(f.read())
+            # a stale sidecar (members re-striped underneath it) could claim
+            # anything; only trust a value the members can actually hold
+            if 0 < claimed <= capacity:
+                size = claimed
         except (OSError, ValueError):
             pass
-        sizes = [os.stat(m).st_size for m in self.members]
-        usable = min(sizes) // self.chunk * self.chunk
-        return usable * len(self.members)
+        object.__setattr__(self, "_size_cache", size)
+        return size
 
 
 # anything memcpy_ssd2tpu / pread can read from
@@ -194,9 +207,17 @@ class SourceIO(io.RawIOBase):
         return True
 
     def seek(self, offset: int, whence: int = io.SEEK_SET) -> int:
-        base = {io.SEEK_SET: 0, io.SEEK_CUR: self._pos,
-                io.SEEK_END: self._size}[whence]
-        self._pos = base + offset
+        try:
+            base = {io.SEEK_SET: 0, io.SEEK_CUR: self._pos,
+                    io.SEEK_END: self._size}[whence]
+        except KeyError:
+            raise ValueError(f"unsupported whence {whence}") from None
+        pos = base + offset
+        if pos < 0:
+            # io.IOBase semantics: fail here, not as a confusing EngineError
+            # from a later pread at a negative offset
+            raise ValueError(f"negative seek position {pos}")
+        self._pos = pos
         return self._pos
 
     def tell(self) -> int:
@@ -668,14 +689,25 @@ class StromContext:
 
                 def deliver_group(segs, group) -> tuple[list, np.ndarray]:
                     dest = acquire(group[0].nbytes)
-                    self._read_segments(source, list(segs), dest, offset)
-                    arr_host = dest.view(np_dtype).reshape(group[0].local_shape)
                     out = []
-                    for p in group:
-                        with self._put_lock, \
-                                trace_span("strom.device_put",
-                                           enabled=cfg.trace_annotations):
-                            out.append(jax.device_put(arr_host, p.device))
+                    try:
+                        self._read_segments(source, list(segs), dest, offset)
+                        arr_host = dest.view(np_dtype).reshape(group[0].local_shape)
+                        for p in group:
+                            with self._put_lock, \
+                                    trace_span("strom.device_put",
+                                               enabled=cfg.trace_annotations):
+                                out.append(jax.device_put(arr_host, p.device))
+                    except BaseException:
+                        # recycle the slab on failure: dropping it silently
+                        # defeats pool recycling under transient-EIO retry
+                        # storms (each retry would fault+mbind fresh pages)
+                        if pool is not None:
+                            for a in out:  # in-flight puts still read dest
+                                with contextlib.suppress(Exception):
+                                    a.block_until_ready()
+                            pool.release(dest)
+                        raise
                     return out, dest
 
                 any_stream = any(stream_eligible(g[0].nbytes)

@@ -50,6 +50,7 @@ class _ScStats(ctypes.Structure):
         ("ext_buffers", ctypes.c_uint32),
         ("ops_fixed", ctypes.c_uint64),
         ("sqpoll", ctypes.c_uint8),
+        ("sqpoll_wakeup_errno", ctypes.c_uint32),
     ]
 
 
@@ -421,6 +422,7 @@ class UringEngine(Engine):
             "mlocked": bool(s.mlocked),
             "coop_taskrun": bool(s.coop_taskrun),
             "sqpoll": bool(s.sqpoll),
+            "sqpoll_wakeup_errno": int(s.sqpoll_wakeup_errno),
             "sparse_table": bool(s.sparse_table),
             "ext_buffers": int(s.ext_buffers),
             "ops_fixed": int(s.ops_fixed),

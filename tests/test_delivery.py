@@ -210,6 +210,15 @@ def test_sourceio_readahead_windows(ctx, tmp_path, rng):
     f.seek(0)
     f.seek(50, _io.SEEK_CUR)
     assert f.read(10) == data[50:60]
+    # io.IOBase semantics: negative computed positions and unknown whence
+    # raise ValueError here, not a confusing EngineError/KeyError later
+    with pytest.raises(ValueError):
+        f.seek(-5)
+    with pytest.raises(ValueError):
+        f.seek(10)
+        f.seek(-11, _io.SEEK_CUR)
+    with pytest.raises(ValueError):
+        f.seek(0, 7)
 
 
 def test_prometheus_engine_histogram(data_file, engine_name):

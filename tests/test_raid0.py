@@ -1,5 +1,6 @@
 """RAID0 stripe math: pure-function property tests (SURVEY.md §4.2 Unit row)."""
 
+import os
 import numpy as np
 import pytest
 
@@ -110,3 +111,37 @@ def test_stripe_file_roundtrip(tmp_path, rng):
         ctx.close()
     np.testing.assert_array_equal(got[:len(data)], data)
     assert not got[len(data):].any()
+
+
+def test_sidecar_size_sanity_and_cache(tmp_path, rng):
+    """A stale size sidecar (members re-striped underneath it) claiming more
+    bytes than the members can hold is distrusted: size falls back to the
+    computed padded capacity. And the lookup is cached — rewriting the
+    sidecar after the first .size access does not shift the perceived EOF
+    mid-run."""
+    from strom.delivery.core import StripedFile
+    from strom.engine.raid0 import SIZE_SIDECAR_SUFFIX, stripe_file
+
+    n, chunk = 2, 4096
+    data = rng.integers(0, 256, size=n * chunk * 2, dtype=np.uint8)
+    src = tmp_path / "src.bin"
+    data.tofile(src)
+    members = [str(tmp_path / f"sc{i}.bin") for i in range(n)]
+    stripe_file(str(src), members, chunk)
+    capacity = sum(os.path.getsize(m) for m in members)
+
+    # stale sidecar claims 10x the capacity → distrusted, capacity wins
+    with open(members[0] + SIZE_SIDECAR_SUFFIX, "w") as f:
+        f.write(str(capacity * 10))
+    sf = StripedFile(tuple(members), chunk)
+    assert sf.size == capacity
+
+    # honest sidecar is honored...
+    with open(members[0] + SIZE_SIDECAR_SUFFIX, "w") as f:
+        f.write(str(len(data)))
+    sf2 = StripedFile(tuple(members), chunk)
+    assert sf2.size == len(data)
+    # ...and cached: a later rewrite cannot shift the EOF mid-run
+    with open(members[0] + SIZE_SIDECAR_SUFFIX, "w") as f:
+        f.write(str(chunk))
+    assert sf2.size == len(data)
