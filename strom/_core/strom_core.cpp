@@ -142,6 +142,11 @@ struct sc_stats {
   uint64_t ops_fixed;     // ops that rode IORING_OP_READ_FIXED
   uint8_t sqpoll;         // 1 if IORING_SETUP_SQPOLL active
   uint32_t sqpoll_wakeup_errno;  // last fatal SQ_WAKEUP errno (0 = none)
+  // residency-hybrid accounting for the vectored gather path: bytes served
+  // through the page cache because the range was RESIDENT (cached_bytes) vs
+  // bytes read from media O_DIRECT (media_bytes)
+  uint64_t cached_bytes;
+  uint64_t media_bytes;
 };
 
 struct sc_engine {
@@ -227,7 +232,68 @@ struct sc_engine {
   // last non-transient errno from the SQPOLL SQ_WAKEUP enter (0 = none):
   // a dead/unwakeable poller otherwise presents only as a read timeout
   std::atomic<uint32_t> sqpoll_wakeup_errno{0};
+  // residency hybrid (sc_create flags bit 5): route page-cache-RESIDENT
+  // chunks of a vectored gather through the buffered fd (a memcpy from the
+  // cache) instead of re-reading them from media O_DIRECT
+  bool residency_hybrid = false;
+  std::atomic<uint64_t> cached_bytes{0}, media_bytes{0};
 };
+
+// ---- page-cache residency probe (hybrid read path) -------------------------
+// The reference's hybrid submit checks per-block page-cache residency and
+// memcpy-serves warm blocks instead of re-reading flash (SURVEY.md §0.5
+// mechanism #5, §2.1 "Page-cache fallback"; reference cite UNVERIFIED —
+// empty mount, SURVEY.md §0). Userspace twin: cachestat(2) on kernels
+// >= 6.5, else mincore(2) on a transient buffered mapping (neither probe
+// populates the cache, so a cold file stays cold).
+#ifndef __NR_cachestat
+#define __NR_cachestat 451
+#endif
+struct sc_cachestat_range {
+  uint64_t off, len;
+};
+struct sc_cachestat {
+  uint64_t nr_cache, nr_dirty, nr_writeback, nr_evicted, nr_recently_evicted;
+};
+
+// process-wide probe capability: 0 untried, 1 cachestat, 2 mincore
+static std::atomic<int> g_residency_probe{0};
+
+// Resident page count of [off, off+len) on *fd* (a buffered fd), with the
+// covering page count in *total_out*. Returns -1 when unprobeable.
+static int64_t resident_pages(int fd, uint64_t off, uint64_t len,
+                              uint64_t *total_out) {
+  static const uint64_t ps = (uint64_t)sysconf(_SC_PAGESIZE);
+  uint64_t start = off / ps * ps;
+  uint64_t end = (off + len + ps - 1) / ps * ps;
+  uint64_t npages = (end - start) / ps;
+  if (total_out) *total_out = npages;
+  if (npages == 0) return 0;
+  int probe = g_residency_probe.load(std::memory_order_relaxed);
+  if (probe <= 1) {
+    sc_cachestat_range r{off, len};
+    sc_cachestat cs;
+    memset(&cs, 0, sizeof(cs));
+    if (syscall(__NR_cachestat, fd, &r, &cs, 0) == 0) {
+      if (probe == 0) g_residency_probe.store(1, std::memory_order_relaxed);
+      return (int64_t)cs.nr_cache;
+    }
+    if (probe == 1) return -1;  // transient failure on a working probe
+    // first failure, whatever the errno (ENOSYS pre-6.5, EPERM under
+    // syscall-denying seccomp profiles): demote to mincore permanently
+    g_residency_probe.store(2, std::memory_order_relaxed);
+  }
+  void *m = mmap(nullptr, (size_t)(end - start), PROT_READ, MAP_SHARED, fd,
+                 (off_t)start);
+  if (m == MAP_FAILED) return -1;
+  std::vector<unsigned char> vec(npages);
+  int rc = mincore(m, (size_t)(end - start), vec.data());
+  munmap(m, (size_t)(end - start));
+  if (rc != 0) return -1;
+  int64_t n = 0;
+  for (unsigned char b : vec) n += (b & 1);
+  return n;
+}
 
 static void record_latency(sc_engine *e, uint64_t us) {
   int b = 0;
@@ -264,6 +330,7 @@ sc_engine *sc_create(uint32_t queue_depth, uint32_t num_buffers,
     return nullptr;
   }
   if (flags & 1u) e->mlocked = (mlock(e->pool, e->pool_sz) == 0);
+  if (flags & 32u) e->residency_hybrid = true;
 
   memset(&e->params, 0, sizeof(e->params));
   e->ring_fd = -1;
@@ -537,7 +604,8 @@ void sc_set_enter_fail_once(sc_engine *e, int err) {
 static void fill_sqe_locked(sc_engine *e, const FileEntry &f, int file_index,
                             uint64_t offset, uint32_t length,
                             int64_t buf_index, uint32_t buf_offset,
-                            uint8_t *addr, uint64_t tag) {
+                            uint8_t *addr, uint64_t tag,
+                            bool force_buffered = false) {
   uint32_t slot_idx = e->free_slots[--e->n_free];
   OpSlot &slot = e->slots[slot_idx];
   slot.tag = tag;
@@ -551,8 +619,10 @@ static void fill_sqe_locked(sc_engine *e, const FileEntry &f, int file_index,
   bool aligned = (offset % f.offset_align == 0) &&
                  (length % f.offset_align == 0) &&
                  (((uintptr_t)addr) % f.mem_align == 0);
-  bool direct = f.o_direct && aligned;
-  if (f.o_direct && !aligned)
+  // force_buffered: the residency hybrid routed this cache-warm chunk to the
+  // buffered fd on purpose — a deliberate route, not an alignment fallback
+  bool direct = f.o_direct && aligned && !force_buffered;
+  if (f.o_direct && !aligned && !force_buffered)
     e->unaligned_fallback.fetch_add(1, std::memory_order_relaxed);
 
   uint32_t tail = e->sq_tail->load(std::memory_order_relaxed);
@@ -878,7 +948,10 @@ struct sc_raw_op {
   void *addr;
   int32_t buf_index;  // registered-buffer table index for READ_FIXED
                       // (addr must lie inside that entry); -1 = plain READ
+  int32_t op_flags;   // bit0 (SC_OP_BUFFERED): force the buffered fd —
+                      // the residency hybrid routes cache-warm chunks here
 };
+static constexpr int32_t SC_OP_BUFFERED = 1;
 
 // Batch submit into caller-owned memory: one lock, one io_uring_enter for the
 // whole vector (the per-op path costs one syscall per 128KiB block — at NVMe
@@ -956,7 +1029,8 @@ int sc_submit_raw_batch(sc_engine *e, const sc_raw_op *ops, uint32_t n,
         }
       }
       fill_sqe_locked(e, f, op.file_index, op.offset, op.length, bi, 0,
-                      (uint8_t *)op.addr, op.tag);
+                      (uint8_t *)op.addr, op.tag,
+                      (op.op_flags & SC_OP_BUFFERED) != 0);
       ++filled;
       ++accepted;
     }
@@ -1000,6 +1074,10 @@ int64_t sc_read_vectored(sc_engine *e, const sc_vec_seg *segs, uint64_t n_segs,
     int32_t file_index;
     bool live;       // byte range claimed from the cursor, not yet retired
     bool submitted;  // currently in flight inside the engine
+    bool buffered;   // residency hybrid routed this cache-warm chunk to the
+                     // buffered fd (memcpy from page cache, not media)
+    bool direct;     // this chunk actually rides O_DIRECT (file capable,
+                     // aligned, not hybrid-routed): counts as media_bytes
   };
   uint32_t qd = e->queue_depth;
   Chunk *pend = new Chunk[qd];
@@ -1011,6 +1089,68 @@ int64_t sc_read_vectored(sc_engine *e, const sc_vec_seg *segs, uint64_t n_segs,
   uint32_t n_inflight = 0;      // subset of live actually submitted
   uint64_t total = 0;
   int64_t err = 0;
+
+  // Residency snapshot (hybrid): EVERY segment is probed upfront, before any
+  // read is submitted. Probing lazily at claim time lets the warm chunks'
+  // buffered reads trigger kernel readahead that warms ranges AHEAD of the
+  // cursor, cascading the whole gather onto the page-cache path — the cold
+  // tail must stay O_DIRECT. Fully-warm and fully-cold segments (the common
+  // cases) cost ONE probe syscall; mixed segments get a per-block_size-chunk
+  // bitmap. seg_state: 0 direct (cold / hybrid off / unprobeable / file not
+  // O_DIRECT), 1 buffered (warm), 2 consult seg_chunk_warm bitmap.
+  std::vector<uint8_t> seg_state(n_segs, 0);
+  std::vector<std::vector<uint8_t>> seg_chunk_warm(n_segs);
+  // per-seg file meta, always collected: the cached/media counters must only
+  // account bytes whose route is KNOWN (O_DIRECT-capable file, aligned
+  // chunk) — a --buffered run or an unaligned fallback is neither cache-warm
+  // service nor a media read, matching the Python engine's accounting
+  std::vector<uint8_t> seg_odirect(n_segs, 0);
+  std::vector<uint32_t> seg_oa(n_segs, 1), seg_ma(n_segs, 1);
+  {
+    int last_fi = -2, fdb = -1;
+    bool od = false;
+    uint32_t oa = 1, ma = 1;
+    for (uint64_t i = 0; i < n_segs; ++i) {
+      const sc_vec_seg &s = segs[i];
+      if (s.file_index != last_fi) {
+        last_fi = s.file_index;
+        fdb = -1;
+        od = false;
+        oa = ma = 1;
+        std::lock_guard<std::mutex> fg(e->files_mu);
+        if (s.file_index >= 0 && s.file_index < (int)kMaxFiles &&
+            e->files[s.file_index].in_use) {
+          fdb = e->files[s.file_index].fd_buffered;
+          od = e->files[s.file_index].o_direct;
+          oa = e->files[s.file_index].offset_align;
+          ma = e->files[s.file_index].mem_align;
+        }
+      }
+      seg_odirect[i] = od ? 1 : 0;
+      seg_oa[i] = oa ? oa : 1;
+      seg_ma[i] = ma ? ma : 1;
+      if (!e->residency_hybrid || !od || fdb < 0 || s.length == 0) continue;
+      uint64_t tot = 0;
+      int64_t res = resident_pages(fdb, s.offset, s.length, &tot);
+      if (res <= 0) continue;  // cold or unprobeable: direct
+      if ((uint64_t)res >= tot) {
+        seg_state[i] = 1;
+        continue;
+      }
+      uint64_t nch = (s.length + block_size - 1) / block_size;
+      std::vector<uint8_t> &bm = seg_chunk_warm[i];
+      bm.assign(nch, 0);
+      for (uint64_t ci = 0; ci < nch; ++ci) {
+        uint64_t coff = s.offset + ci * block_size;
+        uint64_t remain = s.length - ci * block_size;
+        uint32_t take = remain < block_size ? (uint32_t)remain : block_size;
+        uint64_t t2 = 0;
+        int64_t r2 = resident_pages(fdb, coff, take, &t2);
+        bm[ci] = (r2 >= 0 && (uint64_t)r2 >= t2) ? 1 : 0;
+      }
+      seg_state[i] = 2;
+    }
+  }
 
   auto next_chunk = [&](Chunk &c) -> bool {
     while (si < n_segs && within >= segs[si].length) {
@@ -1029,6 +1169,13 @@ int64_t sc_read_vectored(sc_engine *e, const sc_vec_seg *segs, uint64_t n_segs,
     c.file_index = s.file_index;
     c.live = true;
     c.submitted = false;
+    uint8_t st = seg_state[si];
+    c.buffered = st == 1 ||
+                 (st == 2 && seg_chunk_warm[si][within / block_size] != 0);
+    c.direct =
+        !c.buffered && seg_odirect[si] != 0 &&
+        c.offset % seg_oa[si] == 0 && take % seg_oa[si] == 0 &&
+        ((uintptr_t)dest_base + c.dest_off) % seg_ma[si] == 0;
     within += take;
     return true;
   };
@@ -1048,6 +1195,7 @@ int64_t sc_read_vectored(sc_engine *e, const sc_vec_seg *segs, uint64_t n_segs,
         batch[k].tag = slot;
         batch[k].addr = (uint8_t *)dest_base + pend[slot].dest_off;
         batch[k].buf_index = dest_buf_index;
+        batch[k].op_flags = pend[slot].buffered ? SC_OP_BUFFERED : 0;
         ++k;
       }
     }
@@ -1066,6 +1214,7 @@ int64_t sc_read_vectored(sc_engine *e, const sc_vec_seg *segs, uint64_t n_segs,
       batch[k].tag = slot;
       batch[k].addr = (uint8_t *)dest_base + pend[slot].dest_off;
       batch[k].buf_index = dest_buf_index;
+      batch[k].op_flags = pend[slot].buffered ? SC_OP_BUFFERED : 0;
       ++k;
     }
     if (k > 0) {
@@ -1107,7 +1256,8 @@ int64_t sc_read_vectored(sc_engine *e, const sc_vec_seg *segs, uint64_t n_segs,
           ++c.attempts;
           e->chunk_retries.fetch_add(1, std::memory_order_relaxed);
           sc_raw_op rop{c.file_index, c.want, c.offset, slot,
-                        (uint8_t *)dest_base + c.dest_off, dest_buf_index};
+                        (uint8_t *)dest_base + c.dest_off, dest_buf_index,
+                        c.buffered ? SC_OP_BUFFERED : 0};
           int acc = sc_submit_raw_batch(e, &rop, 1, nullptr);
           if (acc == 1) continue;  // still in flight
           if (acc < 0) {
@@ -1129,11 +1279,23 @@ int64_t sc_read_vectored(sc_engine *e, const sc_vec_seg *segs, uint64_t n_segs,
       } else if ((uint32_t)comps[i].res < c.want) {
         if (err == 0) err = -ENODATA;  // short read: past EOF
         total += (uint64_t)comps[i].res;
+        if (c.buffered)
+          e->cached_bytes.fetch_add((uint64_t)comps[i].res,
+                                    std::memory_order_relaxed);
+        else if (c.direct)
+          e->media_bytes.fetch_add((uint64_t)comps[i].res,
+                                   std::memory_order_relaxed);
         c.live = false;
         --n_live;
         --n_inflight;
       } else {
         total += (uint64_t)comps[i].res;
+        if (c.buffered)
+          e->cached_bytes.fetch_add((uint64_t)comps[i].res,
+                                    std::memory_order_relaxed);
+        else if (c.direct)
+          e->media_bytes.fetch_add((uint64_t)comps[i].res,
+                                   std::memory_order_relaxed);
         c.live = false;
         --n_live;
         --n_inflight;
@@ -1241,6 +1403,8 @@ void sc_get_stats(sc_engine *e, sc_stats *s) {
   s->ext_buffers = ext;
   s->sqpoll_wakeup_errno =
       e->sqpoll_wakeup_errno.load(std::memory_order_relaxed);
+  s->cached_bytes = e->cached_bytes.load(std::memory_order_relaxed);
+  s->media_bytes = e->media_bytes.load(std::memory_order_relaxed);
 }
 
 }  // extern "C"

@@ -59,9 +59,12 @@ def bench_nvme(args: argparse.Namespace) -> dict:
             _mk_testfile(path, args.size)
         created = True
     size = min(os.path.getsize(path), args.size) // args.block * args.block
-    cfg = StromConfig(engine=args.engine, block_size=args.block,
-                      queue_depth=args.depth, num_buffers=max(args.depth * 2, 8),
-                      sqpoll=getattr(args, "sqpoll", False))
+    # from_env: STROM_* overrides stay honored so knobs without a dedicated
+    # flag (e.g. STROM_RESIDENCY_HYBRID=0 for the --warm A/B) are testable
+    cfg = StromConfig.from_env(engine=args.engine, block_size=args.block,
+                               queue_depth=args.depth,
+                               num_buffers=max(args.depth * 2, 8),
+                               sqpoll=getattr(args, "sqpoll", False))
     numa_node = getattr(args, "numa_node", -1)
     na = None
     if numa_node >= 0:
@@ -69,9 +72,18 @@ def bench_nvme(args: argparse.Namespace) -> dict:
 
         na = NumaAffinity(node=numa_node)
         na.ensure_thread(path)
+    warm = bool(getattr(args, "warm", False))
     results = []
     for it in range(args.iters):
-        _drop_cache_hint(path)
+        if warm:
+            # A/B arm for the residency hybrid: pre-warm the page cache so
+            # the engine serves the file as memcpys (counters prove it);
+            # compare against --warm with STROM_RESIDENCY_HYBRID=0
+            with open(path, "rb", buffering=0) as f:
+                while f.read(64 * 1024 * 1024):
+                    pass
+        else:
+            _drop_cache_hint(path)
         eng = make_engine(cfg)
         fi = eng.register_file(path, o_direct=not args.buffered)
         dest = alloc_aligned(size, huge=getattr(args, "huge", False))
@@ -106,6 +118,10 @@ def bench_nvme(args: argparse.Namespace) -> dict:
         # ACTIVE state from the engine, not the request: SQPOLL falls back
         # silently when the kernel refuses it
         "sqpoll": bool(stats.get("sqpoll", False)),
+        # which path the last iter's bytes took (residency hybrid A/B proof)
+        "warm": warm,
+        "cached_bytes": int(stats.get("cached_bytes", 0)),
+        "media_bytes": int(stats.get("media_bytes", 0)),
         "file_created": created,
     }
     return out
@@ -694,6 +710,10 @@ def main(argv: list[str] | None = None) -> int:
                         help="IORING_SETUP_SQPOLL ring: kernel thread polls "
                              "the SQ, zero syscalls per batch (A/B; wins "
                              "only with spare cores; falls back when refused)")
+    p_nvme.add_argument("--warm", action="store_true",
+                        help="pre-warm the page cache each iter instead of "
+                             "dropping it: A/B arm for the residency hybrid "
+                             "(pair with STROM_RESIDENCY_HYBRID=0)")
     p_nvme.set_defaults(fn=bench_nvme)
 
     p_s2t = sub.add_parser("ssd2tpu", help="async SSD->TPU copy loop")

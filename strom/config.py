@@ -103,6 +103,14 @@ class StromConfig:
     # contiguous ones). FIEMAP is probed once per registered file and cached.
     extent_aware: bool = True
 
+    # residency-aware hybrid reads: probe per-range page-cache residency
+    # (cachestat(2), else mincore) and serve WARM ranges through the buffered
+    # fd — a memcpy from the cache — instead of re-reading them from media
+    # O_DIRECT (SURVEY.md §0.5 mechanism #5, §2.1 "Page-cache fallback").
+    # Cold ranges are unchanged: one probe syscall per gather segment.
+    # Observable via the cached_bytes / media_bytes engine counters.
+    residency_hybrid: bool = True
+
     # RAID0 (software striped reader over N member files/devices)
     raid_chunk: int = 512 * KiB
 

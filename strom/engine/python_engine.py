@@ -21,6 +21,7 @@ import numpy as np
 from strom.config import StromConfig
 from strom.engine.base import Completion, Engine, EngineError, RawRead, ReadRequest
 from strom.probe.odirect import probe_dio
+from strom.probe.residency import cached_pages, range_fully_cached
 from strom.utils.stats import StatsRegistry
 
 _libc = ctypes.CDLL(None, use_errno=True)
@@ -65,6 +66,10 @@ class PythonEngine(Engine):
         self._stats = StatsRegistry("engine.python")
         self._fault_counter = 0
         self._closed = False
+        # residency snapshot for the gather in flight: {(file_index, offset):
+        # warm} at block_size granularity, taken UPFRONT by read_vectored
+        # (see _snapshot_residency); None between gathers
+        self._warm_map: dict[tuple[int, int], bool] | None = None
         self._workers = [
             threading.Thread(target=self._worker, name=f"strom-io-{i}", daemon=True)
             for i in range(n_workers)
@@ -189,6 +194,39 @@ class PythonEngine(Engine):
         # numpy views over the mmap may be held by callers; keep the mmap object
         # referenced by self to avoid invalidating them until GC.
 
+    # -- vectored gather: snapshot residency upfront ------------------------
+    def _snapshot_residency(self, chunks) -> dict[tuple[int, int], bool] | None:
+        """{(file_index, block_offset): warm} for every block_size piece the
+        gather will submit, probed BEFORE any read runs. One probe per
+        fully-warm/fully-cold chunk; per-piece probes only for mixed ones."""
+        if not self.config.residency_hybrid:
+            return None
+        block = self.config.block_size
+        m: dict[tuple[int, int], bool] = {}
+        for fi, fo, _do, ln in chunks:
+            f = self._files.get(fi)
+            if f is None or not f.o_direct or ln <= 0:
+                continue
+            r = cached_pages(f.fd_buffered, fo, ln)
+            if r is None:
+                continue  # unprobeable: worker falls back to a lazy probe
+            res, tot = r
+            # explicit False for cold pieces too — an absent key would make
+            # the worker probe lazily, after readahead may have warmed it
+            state = True if res >= tot else (False if res == 0 else None)
+            for p in range(0, ln, block):
+                m[(fi, fo + p)] = state if state is not None else \
+                    range_fully_cached(f.fd_buffered, fo + p,
+                                       min(block, ln - p)) is True
+        return m
+
+    def read_vectored(self, chunks, dest, *, retries: int = 1) -> int:
+        self._warm_map = self._snapshot_residency(chunks)
+        try:
+            return super().read_vectored(chunks, dest, retries=retries)
+        finally:
+            self._warm_map = None
+
     # -- worker -------------------------------------------------------------
     def _take_fault(self) -> bool:
         n = self.config.fault_every
@@ -222,16 +260,34 @@ class PythonEngine(Engine):
             aligned = (req.offset % f.offset_align == 0
                        and req.length % f.offset_align == 0
                        and addr % f.mem_align == 0)
-            fd = f.fd if (f.o_direct and aligned) else f.fd_buffered
+            # residency hybrid: a cache-WARM chunk is served through the
+            # buffered fd (a memcpy from the page cache) instead of being
+            # re-read from media O_DIRECT (SURVEY.md §2.1 "Page-cache
+            # fallback"). Gathers consult the upfront snapshot (lazy per-op
+            # probing would let warm reads' readahead warm ranges ahead of
+            # the cursor and cascade cold bytes onto the cache path);
+            # stand-alone ops probe here. Neither probe populates the cache.
+            warm = False
+            if f.o_direct and aligned and self.config.residency_hybrid:
+                wm = self._warm_map
+                hint = None if wm is None else \
+                    wm.get((req.file_index, req.offset))
+                warm = hint if hint is not None else \
+                    range_fully_cached(f.fd_buffered, req.offset,
+                                       req.length) is True
+            direct = f.o_direct and aligned and not warm
+            fd = f.fd if direct else f.fd_buffered
             if f.o_direct and not aligned:
                 self._stats.add("unaligned_fallback_reads")
             try:
                 n = os.preadv(fd, [view], req.offset)
-                if f.o_direct and aligned and n < req.length:
+                if direct and n < req.length:
                     # O_DIRECT EOF semantics: may return short at aligned EOF;
                     # top up the unaligned tail via the buffered fd.
                     tail = os.preadv(f.fd_buffered, [view[n:]], req.offset + n)
                     n += tail
+                if f.o_direct and aligned:
+                    self._stats.add("cached_bytes" if warm else "media_bytes", n)
                 self._stats.add("bytes_read", n)
                 self._stats.add("ops_completed")
                 self._stats.observe_us("read_latency", (time.monotonic() - t0) * 1e6)

@@ -51,6 +51,8 @@ class _ScStats(ctypes.Structure):
         ("ops_fixed", ctypes.c_uint64),
         ("sqpoll", ctypes.c_uint8),
         ("sqpoll_wakeup_errno", ctypes.c_uint32),
+        ("cached_bytes", ctypes.c_uint64),
+        ("media_bytes", ctypes.c_uint64),
     ]
 
 
@@ -71,6 +73,7 @@ class _ScRawOp(ctypes.Structure):
         ("tag", ctypes.c_uint64),
         ("addr", ctypes.c_void_p),
         ("buf_index", ctypes.c_int32),  # registered table index; -1 = plain READ
+        ("op_flags", ctypes.c_int32),   # bit0: force the buffered fd (hybrid)
     ]
 
 
@@ -175,7 +178,8 @@ class UringEngine(Engine):
         self._lib = _load_lib(variant)
         flags = (1 if config.mlock else 0) | (2 if config.register_buffers else 0) \
             | 4 | (8 if config.coop_taskrun else 0) \
-            | (16 if config.sqpoll else 0)
+            | (16 if config.sqpoll else 0) \
+            | (32 if config.residency_hybrid else 0)
         handle = self._lib.sc_create(config.queue_depth, config.num_buffers,
                                      config.buffer_size, flags)
         if not handle:
@@ -423,6 +427,8 @@ class UringEngine(Engine):
             "coop_taskrun": bool(s.coop_taskrun),
             "sqpoll": bool(s.sqpoll),
             "sqpoll_wakeup_errno": int(s.sqpoll_wakeup_errno),
+            "cached_bytes": int(s.cached_bytes),
+            "media_bytes": int(s.media_bytes),
             "sparse_table": bool(s.sparse_table),
             "ext_buffers": int(s.ext_buffers),
             "ops_fixed": int(s.ops_fixed),

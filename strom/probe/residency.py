@@ -1,0 +1,103 @@
+"""Per-range page-cache residency probing.
+
+The reference's hybrid submit checks per-block page-cache residency and
+memcpy-serves warm blocks instead of re-reading them from flash (SURVEY.md
+§0.5 mechanism #5, §2.1 "Page-cache fallback"; reference cite UNVERIFIED —
+empty mount, SURVEY.md §0).  This module is the userspace probe both the
+Python engine and tests use: ``cachestat(2)`` on kernels >= 6.5, else
+``mincore(2)`` on a transient buffered mapping.  Neither probe populates the
+page cache, so probing a cold file leaves it cold.
+
+The C++ engine carries its own copy of this logic (strom_core.cpp
+``resident_pages``) so the native hot loop never crosses back into Python.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import mmap
+import os
+
+_NR_CACHESTAT = 451  # same number on every 64-bit Linux arch (6.5+)
+
+
+class _CachestatRange(ctypes.Structure):
+    _fields_ = [("off", ctypes.c_uint64), ("len", ctypes.c_uint64)]
+
+
+class _Cachestat(ctypes.Structure):
+    _fields_ = [
+        ("nr_cache", ctypes.c_uint64),
+        ("nr_dirty", ctypes.c_uint64),
+        ("nr_writeback", ctypes.c_uint64),
+        ("nr_evicted", ctypes.c_uint64),
+        ("nr_recently_evicted", ctypes.c_uint64),
+    ]
+
+
+_libc = ctypes.CDLL(None, use_errno=True)
+# 0 = untried, 1 = cachestat, 2 = mincore (cachestat ENOSYS)
+_probe_state = 0
+
+
+def cached_pages(fd: int, offset: int, length: int) -> tuple[int, int] | None:
+    """(resident_pages, covering_pages) for file byte range [offset,
+    offset+length) on buffered *fd*, or None when unprobeable."""
+    global _probe_state
+    ps = mmap.PAGESIZE
+    start = offset // ps * ps
+    end = (offset + length + ps - 1) // ps * ps
+    npages = (end - start) // ps
+    if npages == 0:
+        return (0, 0)
+    if _probe_state <= 1:
+        r = _CachestatRange(offset, length)
+        cs = _Cachestat()
+        rc = _libc.syscall(_NR_CACHESTAT, fd, ctypes.byref(r),
+                           ctypes.byref(cs), 0)
+        if rc == 0:
+            _probe_state = 1
+            return (int(cs.nr_cache), npages)
+        if _probe_state == 1:
+            return None  # transient failure on a probe that was working
+        # first failure, whatever the errno (ENOSYS on pre-6.5 kernels,
+        # EPERM under seccomp profiles that deny unknown syscalls, ...):
+        # demote to mincore, which exists everywhere
+        _probe_state = 2
+    # mincore fallback on a transient mapping mapped via raw libc (the fd is
+    # O_RDONLY, so the mapping is PROT_READ and ctypes' from_buffer refuses
+    # it — we need the raw address anyway); mincore never faults pages in
+    sz = end - start
+    _libc.mmap.restype = ctypes.c_void_p
+    addr = _libc.mmap(None, ctypes.c_size_t(sz), mmap.PROT_READ,
+                      mmap.MAP_SHARED, fd, ctypes.c_long(start))
+    if addr is None or addr == ctypes.c_void_p(-1).value:
+        return None
+    try:
+        vec = (ctypes.c_ubyte * npages)()
+        rc = _libc.mincore(ctypes.c_void_p(addr), ctypes.c_size_t(sz), vec)
+        if rc != 0:
+            return None
+        return (sum(b & 1 for b in vec), npages)
+    finally:
+        _libc.munmap(ctypes.c_void_p(addr), ctypes.c_size_t(sz))
+
+
+def range_fully_cached(fd: int, offset: int, length: int) -> bool | None:
+    """True if every page covering the range is resident; None = unprobeable."""
+    r = cached_pages(fd, offset, length)
+    if r is None:
+        return None
+    resident, total = r
+    return resident >= total
+
+
+def drop_cache(path: str) -> None:
+    """Best-effort eviction of *path*'s clean pages (fsync + FADV_DONTNEED).
+    Test/bench helper for forcing the cold path."""
+    fd = os.open(path, os.O_RDONLY)
+    try:
+        os.fsync(fd)
+        os.posix_fadvise(fd, 0, 0, os.POSIX_FADV_DONTNEED)
+    finally:
+        os.close(fd)

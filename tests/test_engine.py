@@ -165,6 +165,12 @@ class TestRegisteredDest:
 
     def test_register_read_unregister(self, uring, data_file):
         path, data = data_file
+        # evict the just-written pages: a warm file rides the hybrid's
+        # buffered path (no READ_FIXED), and this test asserts the O_DIRECT
+        # fixed-buffer arm specifically
+        from strom.probe.residency import drop_cache
+
+        drop_cache(path)
         fi = uring.register_file(path)
         slab = alloc_aligned(len(data))
         idx = uring.register_dest(slab)

@@ -1,0 +1,214 @@
+"""Residency-aware hybrid read path (SURVEY.md §0.5 mechanism #5, §2.1
+"Page-cache fallback"; reference cite UNVERIFIED — empty mount, SURVEY.md §0).
+
+Cache-WARM ranges of a gather are served through the buffered fd (a memcpy
+from the page cache) instead of being re-read from media O_DIRECT; cold
+ranges are unchanged. The cached_bytes / media_bytes engine counters prove
+which path every byte took.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+from strom.config import StromConfig
+from strom.delivery.core import StromContext
+from strom.probe.residency import cached_pages, drop_cache, range_fully_cached
+
+
+def _probe_works(tmp_path) -> bool:
+    p = tmp_path / "probe.bin"
+    p.write_bytes(b"x" * 8192)
+    fd = os.open(str(p), os.O_RDONLY)
+    try:
+        return cached_pages(fd, 0, 8192) is not None
+    finally:
+        os.close(fd)
+
+
+@pytest.fixture()
+def warmable_file(tmp_path, rng):
+    """An 8MiB file plus a probe-availability gate (cachestat or mincore)."""
+    if not _probe_works(tmp_path):
+        pytest.skip("no residency probe on this kernel (cachestat+mincore)")
+    data = rng.integers(0, 256, size=8 * 1024 * 1024, dtype=np.uint8)
+    p = tmp_path / "warm.bin"
+    data.tofile(p)
+    return str(p), data
+
+
+def test_probe_warm_cold_partial(warmable_file):
+    path, data = warmable_file
+    n = len(data)
+    fd = os.open(path, os.O_RDONLY)
+    try:
+        # just written: dirty pages are resident
+        assert range_fully_cached(fd, 0, n) is True
+        drop_cache(path)
+        res, tot = cached_pages(fd, 0, n)
+        assert res == 0 and tot == n // 4096
+        # exactly-half-warm, deterministically: warm everything, then evict
+        # the tail (warming "half" by reading half is readahead-hostage — a
+        # single 4MiB buffered read warms this box's whole file)
+        with open(path, "rb") as f:
+            f.read()
+        os.posix_fadvise(fd, n // 2, n // 2, os.POSIX_FADV_DONTNEED)
+        assert range_fully_cached(fd, 0, n // 2) is True
+        assert range_fully_cached(fd, n - 4096, 4096) is False
+        res, tot = cached_pages(fd, 0, n)
+        assert res == n // 2 // 4096 and tot == n // 4096
+        # probing must not populate: the tail stays cold after all the above
+        assert range_fully_cached(fd, n - 4096, 4096) is False
+    finally:
+        os.close(fd)
+
+
+def test_mincore_fallback_agrees(warmable_file, monkeypatch):
+    """Force the mincore arm (dead code on cachestat-capable kernels) and
+    check it reports the same warm/cold picture as the primary probe."""
+    import strom.probe.residency as res_mod
+
+    path, data = warmable_file
+    n = len(data)
+    fd = os.open(path, os.O_RDONLY)
+    try:
+        drop_cache(path)
+        with open(path, "rb") as f:
+            f.read()
+        os.posix_fadvise(fd, n // 2, n // 2, os.POSIX_FADV_DONTNEED)
+        primary = cached_pages(fd, 0, n)
+        monkeypatch.setattr(res_mod, "_probe_state", 2)
+        fallback = cached_pages(fd, 0, n)
+        assert fallback is not None, "mincore fallback unprobeable"
+        assert fallback == primary
+        assert range_fully_cached(fd, 0, n // 2) is True
+        assert range_fully_cached(fd, n - 4096, 4096) is False
+    finally:
+        os.close(fd)
+
+
+def _counters(ctx) -> tuple[int, int]:
+    s = ctx.engine.stats()
+    return int(s.get("cached_bytes", 0)), int(s.get("media_bytes", 0))
+
+
+@pytest.mark.parametrize("engine", ["python", "uring"])
+def test_hybrid_counters_and_integrity(warmable_file, engine):
+    """Cold file → all bytes from media; warmed file → all bytes from cache;
+    identical bytes either way."""
+    if engine == "uring":
+        from strom.engine.uring_engine import uring_available
+
+        if not uring_available():
+            pytest.skip("io_uring unavailable")
+    path, data = warmable_file
+    n = len(data)
+    ctx = StromContext(StromConfig(engine=engine))
+    try:
+        if not ctx.engine.file_uses_o_direct(ctx.file_index(path)):
+            pytest.skip("O_DIRECT unavailable here: hybrid is moot")
+        drop_cache(path)
+        cold = bytes(memoryview(ctx.pread(path)))
+        c1, m1 = _counters(ctx)
+        assert cold == data.tobytes()
+        assert c1 == 0 and m1 == n, (c1, m1)
+
+        with open(path, "rb") as f:  # warm the whole file
+            f.read()
+        warm = bytes(memoryview(ctx.pread(path)))
+        c2, m2 = _counters(ctx)
+        assert warm == data.tobytes()
+        assert c2 - c1 == n and m2 == m1, (c2 - c1, m2 - m1)
+    finally:
+        ctx.close()
+
+
+@pytest.mark.parametrize("engine", ["python", "uring"])
+def test_hybrid_partial_warm_splits(warmable_file, engine):
+    """A half-warm file splits the gather: warm chunks ride the cache, cold
+    chunks ride media, and the counters account for every byte."""
+    if engine == "uring":
+        from strom.engine.uring_engine import uring_available
+
+        if not uring_available():
+            pytest.skip("io_uring unavailable")
+    path, data = warmable_file
+    n = len(data)
+    ctx = StromContext(StromConfig(engine=engine))
+    try:
+        if not ctx.engine.file_uses_o_direct(ctx.file_index(path)):
+            pytest.skip("O_DIRECT unavailable here: hybrid is moot")
+        # exactly-half-warm: sync+drop (dirty pages are unevictable), warm
+        # everything clean, then evict the tail (reading just the first half
+        # would readahead-warm the rest on this box)
+        drop_cache(path)
+        with open(path, "rb") as f:
+            f.read()
+        fd = os.open(path, os.O_RDONLY)
+        os.posix_fadvise(fd, n // 2, n // 2, os.POSIX_FADV_DONTNEED)
+        os.close(fd)
+        got = bytes(memoryview(ctx.pread(path)))
+        c, m = _counters(ctx)
+        assert got == data.tobytes()
+        assert c + m == n, (c, m)
+        assert c == n // 2, (c, m)
+        assert m == n // 2, (c, m)
+    finally:
+        ctx.close()
+
+
+def test_hybrid_off_reads_media(warmable_file):
+    """residency_hybrid=False: a fully-warm file is still read O_DIRECT
+    (cold-path behavior preserved, counters prove it)."""
+    from strom.engine.uring_engine import uring_available
+
+    if not uring_available():
+        pytest.skip("io_uring unavailable")
+    path, data = warmable_file
+    ctx = StromContext(StromConfig(engine="uring", residency_hybrid=False))
+    try:
+        if not ctx.engine.file_uses_o_direct(ctx.file_index(path)):
+            pytest.skip("O_DIRECT unavailable here: hybrid is moot")
+        with open(path, "rb") as f:
+            f.read()
+        got = bytes(memoryview(ctx.pread(path)))
+        c, m = _counters(ctx)
+        assert got == data.tobytes()
+        assert c == 0 and m == len(data), (c, m)
+    finally:
+        ctx.close()
+
+
+def test_hybrid_striped_set(tmp_path, rng):
+    """RAID0 gathers ride the hybrid per member: warming the members routes
+    the striped read through the cache."""
+    if not _probe_works(tmp_path):
+        pytest.skip("no residency probe on this kernel")
+    from strom.delivery.core import StripedFile
+    from strom.engine.raid0 import stripe_file
+
+    n_mem, chunk = 2, 64 * 1024
+    data = rng.integers(0, 256, size=4 * 1024 * 1024, dtype=np.uint8)
+    src = tmp_path / "src.bin"
+    data.tofile(src)
+    members = [str(tmp_path / f"m{i}.bin") for i in range(n_mem)]
+    stripe_file(str(src), members, chunk)
+    sf = StripedFile(tuple(members), chunk)
+    ctx = StromContext(StromConfig(engine="uring"))
+    try:
+        from strom.engine.uring_engine import uring_available
+
+        if not uring_available():
+            pytest.skip("io_uring unavailable")
+        if not ctx.engine.file_uses_o_direct(ctx.file_index(members[0])):
+            pytest.skip("O_DIRECT unavailable here")
+        for m in members:
+            with open(m, "rb") as f:
+                f.read()
+        got = np.asarray(ctx.memcpy_ssd2tpu(sf, length=len(data)))
+        c, _ = _counters(ctx)
+        np.testing.assert_array_equal(got, data)
+        assert c == len(data), c
+    finally:
+        ctx.close()
