@@ -1170,12 +1170,15 @@ int64_t sc_read_vectored(sc_engine *e, const sc_vec_seg *segs, uint64_t n_segs,
     c.live = true;
     c.submitted = false;
     uint8_t st = seg_state[si];
-    c.buffered = st == 1 ||
-                 (st == 2 && seg_chunk_warm[si][within / block_size] != 0);
-    c.direct =
-        !c.buffered && seg_odirect[si] != 0 &&
-        c.offset % seg_oa[si] == 0 && take % seg_oa[si] == 0 &&
-        ((uintptr_t)dest_base + c.dest_off) % seg_ma[si] == 0;
+    bool aligned = c.offset % seg_oa[si] == 0 && take % seg_oa[si] == 0 &&
+                   ((uintptr_t)dest_base + c.dest_off) % seg_ma[si] == 0;
+    // hybrid routing only for aligned chunks, matching the Python engine:
+    // unaligned chunks keep their existing fallback route (and its
+    // unaligned_fallback accounting) whether warm or not
+    c.buffered = aligned &&
+                 (st == 1 ||
+                  (st == 2 && seg_chunk_warm[si][within / block_size] != 0));
+    c.direct = !c.buffered && aligned && seg_odirect[si] != 0;
     within += take;
     return true;
   };

@@ -37,12 +37,14 @@ def _mk_testfile(path: str, size: int) -> None:
 
 
 def _drop_cache_hint(path: str) -> None:
-    """posix_fadvise(DONTNEED) so repeat runs measure media, not page cache."""
-    fd = os.open(path, os.O_RDONLY)
-    try:
-        os.posix_fadvise(fd, 0, 0, os.POSIX_FADV_DONTNEED)
-    finally:
-        os.close(fd)
+    """fsync + posix_fadvise(DONTNEED) so repeat runs measure media, not page
+    cache. The fsync matters: freshly-written fixture pages are DIRTY and
+    unevictable, so without it the first run after generation would ride the
+    residency hybrid's cache path while every later run hits media — the
+    bench must be deterministic-cold."""
+    from strom.probe.residency import drop_cache
+
+    drop_cache(path)
 
 
 def bench_nvme(args: argparse.Namespace) -> dict:
