@@ -54,6 +54,14 @@ network relay; see BASELINE.md §C):
                   round's artifact. The counter itself is untouched: every
                   timed step still counts, warmup exclusion unchanged
                   (cli.py _timed_train_phase).
+  resnet_predecoded_images_per_s, resnet_predecoded_train_images_per_s,
+  resnet_predecoded_stalls
+                  Config #2's decode-free arm: the WDS tar staged ONCE as a
+                  packed uint8 shard (strom.formats.predecoded), so the
+                  training loader is a pure engine gather + device_put.
+                  This is the box-feasible 0-stall demonstration for the
+                  vision overlap machinery (the JPEG arm's decode shares
+                  the single core with the consumer).
   vit_images_per_s, vit_train_images_per_s, vit_data_stalls
                   Config #3: ViT-B/16 over WebDataset tar shards on a
                   4-member RAID0 striped set (register_striped aliasing).
@@ -139,6 +147,18 @@ def main() -> int:
     # --- prior bulk traffic leaves the transfer relay congested enough to
     # --- fake stalls that aren't the loader's.
     loader_res: dict = {}
+
+    def attempt(name: str, fn, tries: int = 2):
+        """Run a bench phase with retry: relay flakes (remote_compile resets,
+        tunnel hiccups) are transient and must not blank a field in the
+        round's artifact. Returns the phase dict or None."""
+        for a in range(tries):
+            try:
+                return fn()
+            except Exception as e:
+                print(f"{name} attempt {a} failed: {e!r}", file=sys.stderr)
+        return None
+
     if not args.skip_loader:
         from strom.cli import bench_llama
 
@@ -163,16 +183,16 @@ def main() -> int:
         # untouched. Best-of-3 (min stalls) on top, same methodology as
         # the bandwidth phase's best-of-2; early-out on a 0-stall run.
         best = None
-        for attempt in range(3):
+        for att in range(3):  # NOT named `attempt`: that's the helper above
             # per-attempt try: a relay flake on attempt 2 must not discard a
             # successful attempt's result (nor sink the bandwidth phase)
             try:
                 lres = bench_llama(largs)
             except Exception as e:
-                print(f"llama attempt {attempt} failed: {e!r}", file=sys.stderr)
+                print(f"llama attempt {att} failed: {e!r}", file=sys.stderr)
                 continue
             stalls = lres.get("train_data_stalls")
-            print(f"llama attempt {attempt}: "
+            print(f"llama attempt {att}: "
                   f"{lres['tokens_per_s']:.0f} tok/s flat-out; "
                   f"with {lres.get('train_model')}+{lres.get('train_attn')}"
                   f" train step: {lres.get('train_tokens_per_s')} tok/s, "
@@ -199,8 +219,8 @@ def main() -> int:
             engine="auto", tmpdir=args.tmpdir, json=True, batch=64,
             image_size=224, steps=10, prefetch=2, decode_workers=8,
             train_step=True, model="resnet50")
-        try:
-            rres = bench_resnet(rargs)
+        rres = attempt("resnet", lambda: bench_resnet(rargs))
+        if rres is not None:
             loader_res.update({
                 "resnet_images_per_s": rres["images_per_s"],
                 "resnet_train_images_per_s": rres.get("train_images_per_s"),
@@ -211,8 +231,28 @@ def main() -> int:
                   f"{rres.get('train_images_per_s')} img/s, "
                   f"{rres.get('train_data_stalls')} data-stall steps",
                   file=sys.stderr)
-        except Exception as e:
-            print(f"resnet bench failed: {e!r}", file=sys.stderr)
+
+        # config #2, decode-free arm: the JPEG numbers above stall by
+        # construction on this 1-core box (decode and the consumer share the
+        # core — BASELINE.md §C); the predecoded staged-shard loader removes
+        # per-step decode, making the overlap machinery demonstrable here
+        # (VERDICT.md r2 weak #3 / next #6). prefetch 16: same step-dispatch
+        # -burst reasoning as the llama phase above.
+        prargs = argparse.Namespace(**{**vars(rargs), "prefetch": 16,
+                                       "predecoded": True})
+        prres = attempt("resnet predecoded", lambda: bench_resnet(prargs))
+        if prres is not None:
+            loader_res.update({
+                "resnet_predecoded_images_per_s": prres["images_per_s"],
+                "resnet_predecoded_train_images_per_s":
+                    prres.get("train_images_per_s"),
+                "resnet_predecoded_stalls": prres.get("train_data_stalls"),
+            })
+            print(f"resnet PREDECODED flat-out: {prres['images_per_s']:.0f} "
+                  f"img/s; with {prres.get('train_model')} train step: "
+                  f"{prres.get('train_images_per_s')} img/s, "
+                  f"{prres.get('train_data_stalls')} data-stall steps",
+                  file=sys.stderr)
 
         # config #3: ViT-B/16 over WDS tar shards on a 4-member RAID0
         # striped set (BASELINE.json:9) — previously only in BASELINE.md §C
@@ -225,8 +265,8 @@ def main() -> int:
             engine="auto", tmpdir=args.tmpdir, json=True, batch=64,
             image_size=224, steps=10, prefetch=2, decode_workers=8,
             raid=4, raid_chunk=512 * 1024, train_step=True, model="vit_b16")
-        try:
-            vres = bench_vit(vargs)
+        vres = attempt("vit", lambda: bench_vit(vargs))
+        if vres is not None:
             loader_res.update({
                 "vit_images_per_s": vres["images_per_s"],
                 "vit_train_images_per_s": vres.get("train_images_per_s"),
@@ -237,8 +277,6 @@ def main() -> int:
                   f"step: {vres.get('train_images_per_s')} img/s, "
                   f"{vres.get('train_data_stalls')} data-stall steps",
                   file=sys.stderr)
-        except Exception as e:
-            print(f"vit bench failed: {e!r}", file=sys.stderr)
 
         # config #5: PG-Strom-style columnar scan from a RAID0 striped set
         # (BASELINE.json:11) — also artifact-tracked now
@@ -249,8 +287,8 @@ def main() -> int:
             engine="auto", tmpdir=args.tmpdir, json=True, rows=2_000_000,
             row_groups=32, prefetch=2, unit_batch=4, raid=4,
             raid_chunk=512 * 1024)
-        try:
-            pres = bench_parquet(pargs)
+        pres = attempt("parquet", lambda: bench_parquet(pargs))
+        if pres is not None:
             loader_res.update({
                 "parquet_rows_per_s": pres["rows_per_s"],
                 "parquet_selected_gbps": pres["selected_gbps"],
@@ -259,8 +297,6 @@ def main() -> int:
                   f"{pargs.unit_batch}): {pres['rows_per_s']:.0f} rows/s, "
                   f"selected columns {pres['selected_gbps']:.3f} GB/s",
                   file=sys.stderr)
-        except Exception as e:
-            print(f"parquet bench failed: {e!r}", file=sys.stderr)
 
     # --- numerator: one streamed memcpy_ssd2tpu ----------------------------
     # (engine reads piece k+1 while piece k streams host->HBM)

@@ -364,16 +364,47 @@ def _mk_wds_fixture(tmpdir: str, batch: int, image_size: int) -> str:
     return path
 
 
+def _ensure_predecoded(ctx, tar_path: str, image_size: int, tmpdir: str) -> str:
+    """Decode-once fixture for the --predecoded arm: the WDS tar staged as a
+    packed uint8 shard (strom.formats.predecoded), revalidated by source
+    fingerprint like _ensure_striped."""
+    from strom.formats.predecoded import predecode_wds
+
+    st = os.stat(tar_path)
+    out = os.path.join(
+        tmpdir, f"{os.path.basename(tar_path)}.{image_size}.pdec")
+    fingerprint = f"{st.st_size}:{st.st_mtime_ns}:{image_size}"
+    fp_path = out + ".srcfp"
+    from strom.formats.predecoded import LABELS_SUFFIX
+
+    try:
+        with open(fp_path) as f:
+            if f.read() == fingerprint and os.path.exists(out) \
+                    and os.path.exists(out + LABELS_SUFFIX):
+                return out
+    except OSError:
+        pass
+    predecode_wds(ctx, [tar_path], out, image_size=image_size)
+    with open(fp_path, "w") as f:
+        f.write(fingerprint)
+    return out
+
+
 def bench_resnet(args: argparse.Namespace) -> dict:
     """Config #2 shape: JPEG WebDataset -> decode -> device, images/s
-    (IO-bound: a throttled fake 'train step' just blocks on delivery)."""
+    (IO-bound: a throttled fake 'train step' just blocks on delivery).
+    --predecoded swaps in the decode-free staged-shard loader: decode
+    happens ONCE offline and the training loader is a pure engine gather,
+    so the 0-stall overlap machinery is demonstrable even on hosts where
+    decode and the consumer share one core (BASELINE.md §C)."""
     import jax
     from jax.sharding import NamedSharding, PartitionSpec as P
 
     from strom.config import StromConfig
     from strom.delivery.core import StromContext
     from strom.parallel.mesh import make_mesh
-    from strom.pipelines import make_imagenet_resnet_pipeline
+    from strom.pipelines import (make_imagenet_resnet_pipeline,
+                                 make_predecoded_vision_pipeline)
 
     path = args.file
     if path is None:
@@ -385,11 +416,28 @@ def bench_resnet(args: argparse.Namespace) -> dict:
         n_dev = _fit_dp_devices(args.batch)
         mesh = make_mesh({"dp": n_dev}, devices=jax.devices()[:n_dev])
         sharding = NamedSharding(mesh, P("dp", None, None, None))
-        _drop_cache_hint(path)
-        with make_imagenet_resnet_pipeline(
-                ctx, [path], batch=args.batch, image_size=args.image_size,
-                sharding=sharding, prefetch_depth=args.prefetch,
-                decode_workers=args.decode_workers) as pipe:
+        predecoded = bool(getattr(args, "predecoded", False))
+        if predecoded:
+            pdec = _ensure_predecoded(ctx, path, args.image_size, args.tmpdir)
+            data_paths = [pdec]
+
+            def pipe_factory():
+                return make_predecoded_vision_pipeline(
+                    ctx, [pdec], batch=args.batch,
+                    image_size=args.image_size, sharding=sharding,
+                    prefetch_depth=args.prefetch)
+        else:
+            data_paths = [path]
+
+            def pipe_factory():
+                return make_imagenet_resnet_pipeline(
+                    ctx, [path], batch=args.batch,
+                    image_size=args.image_size, sharding=sharding,
+                    prefetch_depth=args.prefetch,
+                    decode_workers=args.decode_workers)
+        for p in data_paths:
+            _drop_cache_hint(p)
+        with pipe_factory() as pipe:
             next(pipe)[0].block_until_ready()
             t0 = time.perf_counter()
             for _ in range(args.steps):
@@ -403,6 +451,7 @@ def bench_resnet(args: argparse.Namespace) -> dict:
             "batch": args.batch, "image_size": args.image_size,
             "steps": args.steps, "devices": n_dev, "data_stall_steps": stalls,
             "decode_workers": args.decode_workers, "engine": cfg.engine,
+            "predecoded": predecoded,
         }
 
         if getattr(args, "train_step", False):
@@ -434,13 +483,10 @@ def bench_resnet(args: argparse.Namespace) -> dict:
                                                   lbls % mcfg.num_classes)
                 return loss
 
-            _drop_cache_hint(path)
+            for p in data_paths:
+                _drop_cache_hint(p)
             rate, stalls, loss = _timed_train_phase(
-                lambda: make_imagenet_resnet_pipeline(
-                    ctx, [path], batch=args.batch, image_size=args.image_size,
-                    sharding=sharding, prefetch_depth=args.prefetch,
-                    decode_workers=args.decode_workers),
-                step, args.steps, args.batch)
+                pipe_factory, step, args.steps, args.batch)
             out["train_images_per_s"] = rate
             out["train_data_stalls"] = stalls
             out["train_model"] = args.model
@@ -753,6 +799,10 @@ def main(argv: list[str] | None = None) -> int:
     p_rn.add_argument("--model", default="resnet50",
                       choices=["tiny", "resnet50"],
                       help="ResNet config for --train-step")
+    p_rn.add_argument("--predecoded", action="store_true",
+                      help="decode-free loader over a decode-once staged "
+                           "shard (strom.formats.predecoded): pure engine "
+                           "gather + device_put, no per-step JPEG decode")
     p_rn.set_defaults(fn=bench_resnet)
 
     p_vit = sub.add_parser("vit", help="config #3: WDS .tar -> ViT loader "

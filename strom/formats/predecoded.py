@@ -1,0 +1,142 @@
+"""Pre-decoded image shards: decode once offline, train decode-free.
+
+BASELINE config #2's 0-data-stall demonstration is JPEG-decode-bound on
+single-core hosts (the decode pool and the consumer share the CPU, so decode
+only progresses while the consumer idles — BASELINE.md §C). This format
+moves the decode offline, the same trade the reference's flagship deployment
+makes by staging decoded tensors on flash (SURVEY.md §7.1 "zero-copy"
+pipeline shape; reference cite UNVERIFIED — empty mount, SURVEY.md §0): a
+shard is a flat array of ``HxWx3`` uint8 records plus a tiny ``.labels.npy``
+sidecar, so the training loader is a pure engine gather + device_put — byte
+-identical mechanics to the packed-token Llama loader, which demonstrably
+reaches 0 stalls on this box.
+
+On-disk layout for ``foo.pdec``:
+  foo.pdec             packed records, record = image_size*image_size*3 bytes
+  foo.pdec.labels.npy  int32 [n] labels, loaded whole at pipeline build
+  foo.pdec.meta.json   {"image_size": S, "n": N} (sanity-checked at load)
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+from typing import Sequence
+
+import numpy as np
+
+from strom.delivery.extents import ExtentList
+from strom.formats.rawbin import TokenShardSet
+
+LABELS_SUFFIX = ".labels.npy"
+META_SUFFIX = ".meta.json"
+
+
+def predecode_wds(ctx, tar_paths: Sequence[str], out_path: str, *,
+                  image_size: int,
+                  image_ext: str = "jpg",
+                  label_ext: str = "cls",
+                  decode_workers: int = 8) -> str:
+    """Decode every sample of the WDS *tar_paths* once: resize to
+    ``image_size`` (deterministic — augmentation belongs to training-time
+    transforms, not the staged bytes) and write the packed shard at
+    *out_path*. Reads ride the engine (striped aliases included). Returns
+    *out_path*."""
+    from strom.formats.jpeg import DecodePool, center_crop_resize, decode_jpeg
+    from strom.formats.wds import WdsShardSet
+
+    ss = WdsShardSet(tar_paths, ctx=ctx)
+    record_bytes = image_size * image_size * 3
+    labels = np.zeros(len(ss), dtype=np.int32)
+    pool = DecodePool(decode_workers)
+
+    def decode_one(blob: np.ndarray) -> np.ndarray:
+        return center_crop_resize(decode_jpeg(blob), image_size)
+
+    try:
+        with open(out_path + ".tmp", "wb") as f:
+            batch = 64
+            for lo in range(0, len(ss), batch):
+                idxs = list(range(lo, min(lo + batch, len(ss))))
+                el = ss.batch_extents(idxs, [image_ext, label_ext])
+                buf = ctx.pread(el)
+                blobs, pos = [], 0
+                for i in idxs:
+                    s = ss.samples[i]
+                    isz = s.members[image_ext].size
+                    lsz = s.members[label_ext].size
+                    blobs.append(buf[pos: pos + isz])
+                    labels[i] = int(buf[pos + isz: pos + isz + lsz].tobytes()
+                                    or b"0")
+                    pos += isz + lsz
+                for img in pool.map(decode_one, blobs):
+                    assert img.nbytes == record_bytes
+                    f.write(np.ascontiguousarray(img).tobytes())
+    finally:
+        pool.close()
+    np.save(out_path + LABELS_SUFFIX, labels)
+    with open(out_path + META_SUFFIX, "w") as f:
+        json.dump({"image_size": image_size, "n": len(ss)}, f)
+    os.replace(out_path + ".tmp", out_path)  # records land last: a crashed
+    # predecode leaves no half-valid shard behind
+    return out_path
+
+
+@dataclasses.dataclass(frozen=True)
+class PredecodedShardSet:
+    """Pre-decoded image shards addressed as one global record array.
+
+    Record addressing and gather planning are exactly the packed-token
+    layout, so this composes :class:`TokenShardSet` with uint8 pixel
+    records; labels live host-side (they are 4 bytes/sample — engine reads
+    are for the 150KiB images)."""
+
+    paths: tuple[str, ...]
+    image_size: int
+    shard_sizes: tuple[int, ...] | None = None
+
+    def __post_init__(self) -> None:
+        object.__setattr__(self, "paths", tuple(self.paths))
+        for p in self.paths:
+            meta = None
+            try:
+                with open(p + META_SUFFIX) as f:
+                    meta = json.load(f)
+            except (OSError, json.JSONDecodeError):
+                pass  # meta sidecar is advisory; record math is the contract
+            if meta is not None and meta.get("image_size") != self.image_size:
+                raise ValueError(
+                    f"{p}: predecoded at image_size {meta.get('image_size')},"
+                    f" loader wants {self.image_size}")
+        inner = TokenShardSet(self.paths, record_tokens=self.record_bytes,
+                              dtype=np.dtype(np.uint8),
+                              shard_sizes=self.shard_sizes)
+        object.__setattr__(self, "_inner", inner)
+        labels = []
+        for p in self.paths:
+            lp = p + LABELS_SUFFIX
+            if not os.path.exists(lp):
+                # refusing beats silently training against label 0 for every
+                # sample (a lost sidecar would be invisible in the loss curve
+                # until far too late)
+                raise FileNotFoundError(
+                    f"{p}: labels sidecar {lp} is missing — re-run "
+                    f"predecode_wds (records and labels are written together)")
+            labels.append(np.load(lp).astype(np.int32))
+        object.__setattr__(self, "_labels", np.concatenate(labels)
+                           if labels else np.zeros(0, np.int32))
+
+    @property
+    def record_bytes(self) -> int:
+        return self.image_size * self.image_size * 3
+
+    @property
+    def num_records(self) -> int:
+        return self._inner.num_records  # type: ignore[attr-defined]
+
+    def labels(self, records: Sequence[int]) -> np.ndarray:
+        return self._labels[np.asarray(records, dtype=np.int64)]  # type: ignore[attr-defined]
+
+    def extents(self, records: Sequence[int]) -> ExtentList:
+        return self._inner.extents(records)  # type: ignore[attr-defined]

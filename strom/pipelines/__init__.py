@@ -9,5 +9,5 @@ from strom.pipelines.parquet_scan import (  # noqa: F401
 from strom.pipelines.sampler import (  # noqa: F401
     EpochShuffleSampler, SamplerState, load_loader_state, save_loader_state)
 from strom.pipelines.vision import (  # noqa: F401
-    make_imagenet_resnet_pipeline, make_vit_wds_pipeline,
-    make_wds_vision_pipeline)
+    make_imagenet_resnet_pipeline, make_predecoded_vision_pipeline,
+    make_vit_wds_pipeline, make_wds_vision_pipeline)

@@ -149,6 +149,56 @@ def make_wds_vision_pipeline(ctx: StromContext, paths: Sequence[str], *,
                     on_close=pool.close)
 
 
+def make_predecoded_vision_pipeline(ctx: StromContext, paths: Sequence[str], *,
+                                    batch: int,
+                                    image_size: int,
+                                    sharding: Any,
+                                    seed: int = 0,
+                                    shuffle: bool = True,
+                                    prefetch_depth: int | None = None,
+                                    resume_from: str | SamplerState | None = None
+                                    ) -> Pipeline:
+    """Decode-free vision loader over pre-decoded shards (see
+    :mod:`strom.formats.predecoded`): batches are pure engine gathers +
+    device_put — the packed-token Llama loader's mechanics with pixel
+    records — so no host decode competes with the consumer for CPU.
+    Normalization/augmentation belongs in the (jitted) train step.
+
+    Yields (images [B,S,S,3] uint8, labels [B] int32) sharded per
+    *sharding* (batch-dim only, like every vision pipeline here)."""
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from strom.formats.predecoded import PredecodedShardSet
+
+    if not isinstance(sharding, NamedSharding):
+        raise TypeError("vision pipelines need a NamedSharding (labels derive "
+                        "their spec from its batch axis)")
+    _validate_batch_only(sharding)
+    shards = PredecodedShardSet(tuple(paths), image_size)
+    if shards.num_records < batch:
+        raise ValueError(f"dataset has {shards.num_records} samples < batch "
+                         f"{batch}")
+    state, fp = resolve_state(tuple(paths), seed=seed, resume_from=resume_from,
+                              ctx=ctx)
+    sampler = EpochShuffleSampler(shards.num_records, batch, seed=seed,
+                                  shuffle=shuffle, state=state)
+    label_sharding = NamedSharding(
+        sharding.mesh,
+        P(sharding.spec[0] if len(sharding.spec) else None))
+    shape = (batch, image_size, image_size, 3)
+
+    def make_batch(indices: np.ndarray, serial: int) -> tuple[Any, Any]:
+        el = shards.extents([int(i) for i in indices])
+        imgs = ctx.memcpy_ssd2tpu(el, shape=shape, dtype=np.uint8,
+                                  sharding=sharding)
+        lbls = jax.device_put(shards.labels(indices), label_sharding)
+        return imgs, lbls
+
+    depth = prefetch_depth if prefetch_depth is not None else ctx.config.prefetch_depth
+    return Pipeline(sampler, make_batch, depth=depth, fingerprint=fp)
+
+
 def make_imagenet_resnet_pipeline(ctx: StromContext, paths: Sequence[str], *,
                                   batch: int, sharding: Any,
                                   image_size: int = 224,

@@ -438,3 +438,87 @@ class TestLlamaStriped:
             got = np.concatenate([np.asarray(next(pipe)).ravel()
                                   for _ in range(4)])
         np.testing.assert_array_equal(got, tokens[:got.size])
+
+
+class TestPredecodedPipeline:
+    @pytest.fixture(scope="class")
+    def pdec_shard(self, tmp_path_factory, ctx):
+        """A WDS tar decoded once into a packed uint8 shard."""
+        import cv2
+
+        from strom.formats.predecoded import predecode_wds
+        from tests.test_formats import make_wds_shard
+
+        rng = np.random.default_rng(31)
+        td = tmp_path_factory.mktemp("pdec")
+        samples = []
+        for i in range(20):
+            img = rng.integers(0, 256, (48, 64, 3), dtype=np.uint8)
+            ok, buf = cv2.imencode(".jpg", img)
+            assert ok
+            samples.append((f"s{i:04d}", {"jpg": buf.tobytes(),
+                                          "cls": str(i % 7).encode()}))
+        tar = str(td / "src.tar")
+        make_wds_shard(tar, samples)
+        out = predecode_wds(ctx, [tar], str(td / "imgs.pdec"), image_size=32,
+                            decode_workers=2)
+        return out
+
+    def test_format_roundtrip(self, ctx, pdec_shard):
+        """Records are image_size^2*3 bytes, labels ride the sidecar, and
+        the extents gather returns exactly the packed record bytes."""
+        from strom.formats.predecoded import PredecodedShardSet
+
+        ss = PredecodedShardSet((pdec_shard,), 32)
+        assert ss.num_records == 20
+        assert ss.record_bytes == 32 * 32 * 3
+        np.testing.assert_array_equal(ss.labels(range(20)),
+                                      [i % 7 for i in range(20)])
+        raw = np.fromfile(pdec_shard, dtype=np.uint8)
+        got = np.asarray(memoryview(ctx.pread(ss.extents([3, 4, 11]))))
+        rb = ss.record_bytes
+        np.testing.assert_array_equal(
+            got, np.concatenate([raw[3 * rb: 5 * rb], raw[11 * rb: 12 * rb]]))
+
+    def test_wrong_image_size_rejected(self, pdec_shard):
+        from strom.formats.predecoded import PredecodedShardSet
+
+        with pytest.raises(ValueError, match="image_size"):
+            PredecodedShardSet((pdec_shard,), 64)
+
+    def test_pipeline_batches_and_determinism(self, ctx, mesh, pdec_shard):
+        """Decode-free loader delivers [B,S,S,3] uint8 sharded batches whose
+        bytes equal the packed records, labels aligned, deterministic in
+        seed."""
+        from strom.pipelines import make_predecoded_vision_pipeline
+
+        sharding = NamedSharding(mesh, P("dp", None, None, None))
+        raw = np.fromfile(pdec_shard, dtype=np.uint8).reshape(20, 32, 32, 3)
+        with make_predecoded_vision_pipeline(
+                ctx, [pdec_shard], batch=8, image_size=32, sharding=sharding,
+                shuffle=False) as pipe:
+            imgs, lbls = next(pipe)
+        assert imgs.shape == (8, 32, 32, 3) and imgs.dtype == np.uint8
+        assert imgs.sharding == sharding
+        np.testing.assert_array_equal(np.asarray(imgs), raw[:8])
+        np.testing.assert_array_equal(np.asarray(lbls),
+                                      [i % 7 for i in range(8)])
+        # shuffled: two pipelines with the same seed agree
+        outs = []
+        for _ in range(2):
+            with make_predecoded_vision_pipeline(
+                    ctx, [pdec_shard], batch=8, image_size=32,
+                    sharding=sharding, seed=5) as pipe:
+                outs.append(np.asarray(next(pipe)[0]))
+        np.testing.assert_array_equal(outs[0], outs[1])
+
+    def test_rejects_inner_dim_sharding(self, ctx, pdec_shard):
+        from strom.parallel.mesh import make_mesh
+        from strom.pipelines import make_predecoded_vision_pipeline
+        import jax
+
+        mesh2 = make_mesh({"dp": 2, "mp": 2}, devices=jax.devices()[:4])
+        bad = NamedSharding(mesh2, P("dp", "mp", None, None))
+        with pytest.raises(ValueError, match="batch-dim"):
+            make_predecoded_vision_pipeline(ctx, [pdec_shard], batch=8,
+                                            image_size=32, sharding=bad)
