@@ -61,8 +61,9 @@ def bench_nvme(args: argparse.Namespace) -> dict:
             _mk_testfile(path, args.size)
         created = True
     size = min(os.path.getsize(path), args.size) // args.block * args.block
-    # from_env: STROM_* overrides stay honored so knobs without a dedicated
-    # flag (e.g. STROM_RESIDENCY_HYBRID=0 for the --warm A/B) are testable
+    # from_env: STROM_* overrides stay honored (STROM_RESIDENCY_HYBRID=0 for
+    # the --warm A/B; STROM_ENGINE_RINGS for multi-ring runs — note the ring
+    # fan-out only engages on multi-file gathers, i.e. striped sources)
     cfg = StromConfig.from_env(engine=args.engine, block_size=args.block,
                                queue_depth=args.depth,
                                num_buffers=max(args.depth * 2, 8),

@@ -60,6 +60,14 @@ class StromConfig:
                                        # completion task work at ring entry
                                        # instead of IPI-ing the submitter
                                        # (5.19+; auto-falls back when absent)
+    engine_rings: int = 1              # independent io_uring rings: gathers
+                                       # route per file (RAID0 member i →
+                                       # ring i mod N, the userspace twin of
+                                       # per-device blk-mq queues) and
+                                       # concurrent transfers interleave.
+                                       # >1 wins only where members are
+                                       # distinct NVMe devices; neutral on a
+                                       # one-disk box (BASELINE.md §C)
     sqpoll: bool = False               # IORING_SETUP_SQPOLL: kernel thread
                                        # polls the SQ — zero syscalls per
                                        # submitted batch, at the cost of a
@@ -132,6 +140,8 @@ class StromConfig:
             raise ValueError("buffer_size must be >= block_size")
         if self.queue_depth <= 0:
             raise ValueError("queue_depth must be positive")
+        if self.engine_rings < 1:
+            raise ValueError("engine_rings must be >= 1")
         if self.num_buffers <= 0:
             raise ValueError("num_buffers must be positive")
         if self.engine not in ("auto", "uring", "python"):

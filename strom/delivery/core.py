@@ -271,8 +271,13 @@ class StromContext:
             max_workers=max(2, self.config.delivery_workers),
             thread_name_prefix="strom-groups")
         # engine ops are pipelined internally; serialize whole-transfer use of
-        # the engine so concurrent handles don't interleave queue-depth budgets
-        self._engine_lock = threading.Lock()
+        # the engine so concurrent handles don't interleave queue-depth
+        # budgets. Multi-ring engines serialize internally PER RING instead
+        # (concurrent_gathers) — locking here would re-serialize the very
+        # transfers the rings exist to interleave.
+        self._engine_lock = contextlib.nullcontext() \
+            if getattr(self.engine, "concurrent_gathers", False) \
+            else threading.Lock()
         # process-lifetime unique tags: stale completions from a failed
         # transfer can never alias a later transfer's ops
         self._tag_counter = 0

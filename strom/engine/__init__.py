@@ -14,6 +14,10 @@ def make_engine(config: StromConfig | None = None) -> Engine:
             from strom.engine.uring_engine import UringEngine, uring_available
 
             if config.engine == "uring" or uring_available():
+                if config.engine_rings > 1:
+                    from strom.engine.multi import MultiRingEngine
+
+                    return MultiRingEngine(config)
                 return UringEngine(config)
         except Exception:
             if config.engine == "uring":

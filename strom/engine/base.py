@@ -78,6 +78,10 @@ class Engine(abc.ABC):
     """Owns the staging pool and the submission/completion machinery."""
 
     name: str = "abstract"
+    # True: read_vectored is internally thread-safe (per-ring locking) and
+    # the delivery layer must NOT wrap gathers in its own whole-transfer
+    # lock (see MultiRingEngine). Single-ring engines keep the default.
+    concurrent_gathers: bool = False
 
     def __init__(self, config: StromConfig):
         self.config = config

@@ -1,0 +1,230 @@
+"""Multi-ring engine: one io_uring per NVMe device, transfers interleave.
+
+The reference submits on per-device blk-mq queues concurrently (SURVEY.md
+§2.1 "DMA submit engine", §7.4 #4; reference cite UNVERIFIED — empty mount,
+SURVEY.md §0). A single ring serializes strom-tpu's gathers at two levels:
+the delivery layer's engine lock (one transfer at a time) and the ring's own
+submission queue. This engine owns N independent rings (N child
+:class:`UringEngine` instances, each with its own SQ/CQ, staging pool,
+locks, and counters) and routes work so that:
+
+- a gather touching ONE file runs whole on the next ring round-robin —
+  two concurrent independent transfers land on different rings and
+  interleave end to end;
+- a gather spanning files (RAID0 members, WDS/Parquet multi-shard extents)
+  is partitioned per file (member i → ring i mod N, stable) and the
+  per-ring sub-gathers run in parallel — per-member-device submission, the
+  userspace twin of per-device blk-mq queues.
+
+``concurrent_gathers = True`` tells the delivery layer to SKIP its
+whole-transfer engine lock; serialization happens here, per ring. On this
+one-disk one-core box N > 1 is neutral (members share one virtio queue —
+measured, BASELINE.md §C); the win is structural, on hosts where members
+are distinct NVMe devices. Default stays 1 ring (``StromConfig.engine_rings``).
+"""
+
+from __future__ import annotations
+
+import concurrent.futures
+import itertools
+import threading
+from typing import Sequence
+
+import numpy as np
+
+from strom.config import StromConfig
+from strom.engine.base import Completion, Engine, EngineError, RawRead, ReadRequest
+
+
+class MultiRingEngine(Engine):
+    name = "multi"
+    concurrent_gathers = True  # delivery must not wrap gathers in its own lock
+
+    def __init__(self, config: StromConfig, *, rings: int | None = None,
+                 variant: str = ""):
+        super().__init__(config)
+        from strom.engine.uring_engine import UringEngine
+
+        n = rings if rings is not None else max(config.engine_rings, 1)
+        if n < 1:
+            raise ValueError("need at least one ring")
+        self._children: list[UringEngine] = [
+            UringEngine(config, variant=variant) for _ in range(n)]
+        # my file index -> (path, o_direct); child registrations are lazy
+        # (a file only occupies a ring's fd table once a transfer lands there)
+        self._files: dict[int, tuple[str, bool | None]] = {}
+        self._next_fi = 0
+        self._child_fi: list[dict[int, int]] = [dict() for _ in range(n)]
+        self._reg_lock = threading.Lock()
+        # per-ring transfer locks: child read_vectored is documented
+        # non-concurrent; concurrent MultiRing gathers serialize only where
+        # they land on the same ring
+        self._ring_locks = [threading.Lock() for _ in range(n)]
+        self._rr = itertools.count()
+        self._pool = concurrent.futures.ThreadPoolExecutor(
+            max_workers=n, thread_name_prefix="strom-ring")
+        self._closed = False
+
+    @property
+    def num_rings(self) -> int:
+        return len(self._children)
+
+    # -- files --------------------------------------------------------------
+    def register_file(self, path: str, *, o_direct: bool | None = None) -> int:
+        with self._reg_lock:
+            fi = self._next_fi
+            self._next_fi += 1
+            self._files[fi] = (path, o_direct)
+        # eager on ring 0 so o_direct probing happens once up front and
+        # file_uses_o_direct answers without I/O later
+        self._child_index(0, fi)
+        return fi
+
+    def _child_index(self, ring: int, fi: int) -> int:
+        """Child-engine file index for my index *fi*, registering lazily.
+
+        The whole get-or-register runs under one lock: with
+        concurrent_gathers the delivery layer no longer serializes
+        transfers, and a check-then-act window would let two gathers
+        double-register the file on a ring (leaking the loser's fd pair) or
+        resurrect a registration racing unregister_file. Registration is
+        rare (once per file per ring) — holding the lock across the two
+        open()s is cheap."""
+        import errno as _errno
+
+        with self._reg_lock:
+            m = self._child_fi[ring]
+            ci = m.get(fi)
+            if ci is not None:
+                return ci
+            ent = self._files.get(fi)
+            if ent is None:
+                raise EngineError(_errno.EBADF,
+                                  f"file index {fi} not registered")
+            path, od = ent
+            ci = self._children[ring].register_file(path, o_direct=od)
+            m[fi] = ci
+            return ci
+
+    def unregister_file(self, file_index: int) -> None:
+        with self._reg_lock:
+            self._files.pop(file_index, None)
+            regs = [(r, m.pop(file_index)) for r, m in enumerate(self._child_fi)
+                    if file_index in m]
+        for r, ci in regs:
+            self._children[r].unregister_file(ci)
+
+    def file_uses_o_direct(self, file_index: int) -> bool:
+        return self._children[0].file_uses_o_direct(self._child_index(0, file_index))
+
+    # -- staging pool / per-op paths: ring 0 owns them ----------------------
+    def buffer(self, buf_index: int) -> np.ndarray:
+        return self._children[0].buffer(buf_index)
+
+    def submit(self, requests: Sequence[ReadRequest]) -> int:
+        return self._children[0].submit([
+            ReadRequest(self._child_index(0, r.file_index), r.offset, r.length,
+                        r.buf_index, r.tag, r.buf_offset) for r in requests])
+
+    def submit_raw(self, requests: Sequence[RawRead]) -> int:
+        return self._children[0].submit_raw([
+            RawRead(self._child_index(0, r.file_index), r.offset, r.length,
+                    r.dest, r.tag) for r in requests])
+
+    def wait(self, min_completions: int = 1,
+             timeout_s: float | None = None) -> list[Completion]:
+        return self._children[0].wait(min_completions, timeout_s)
+
+    def in_flight(self) -> int:
+        return sum(c.in_flight() for c in self._children)
+
+    # -- registered dests: every ring gets the slab -------------------------
+    def register_dest(self, arr: np.ndarray) -> int:
+        done = []
+        for c in self._children:
+            if c.register_dest(arr) < 0:
+                # all-or-nothing: returning -1 means the caller installs no
+                # unregister hook, so a partial success would leak pinned
+                # registrations AND leave stale addr→fixed-index mappings
+                # that could route a later gather's DMA into freed pages
+                for d in done:
+                    d.unregister_dest(arr)
+                return -1
+            done.append(c)
+        return 0
+
+    def unregister_dest(self, arr: np.ndarray) -> None:
+        for c in self._children:
+            c.unregister_dest(arr)
+
+    def unregister_dest_addr(self, addr: int) -> None:
+        for c in self._children:
+            c.unregister_dest_addr(addr)
+
+    # -- the vectored hot path: route, fan out, join ------------------------
+    def read_vectored(self, chunks: Sequence[tuple[int, int, int, int]],
+                      dest: np.ndarray, *, retries: int = 1) -> int:
+        if self._closed:
+            raise EngineError(9, "engine closed")
+        files = {c[0] for c in chunks}
+        n = len(self._children)
+        if n == 1 or len(files) == 1:
+            # single file (or single ring): the whole gather rides ONE ring,
+            # chosen round-robin so concurrent independent transfers spread
+            ring = next(self._rr) % n
+            ch = [(self._child_index(ring, fi), fo, do, ln)
+                  for (fi, fo, do, ln) in chunks]
+            with self._ring_locks[ring]:
+                return self._children[ring].read_vectored(ch, dest,
+                                                          retries=retries)
+        # multi-file gather: stable per-file ring (striped member i → ring
+        # i mod N), sub-gathers in parallel. Stability matters: a member's
+        # fd, extent cache and READ_FIXED registrations live on its ring.
+        per_ring: list[list[tuple[int, int, int, int]]] = [[] for _ in range(n)]
+        for (fi, fo, do, ln) in chunks:
+            ring = fi % n
+            per_ring[ring].append((self._child_index(ring, fi), fo, do, ln))
+
+        def run(ring: int) -> int:
+            with self._ring_locks[ring]:
+                return self._children[ring].read_vectored(
+                    per_ring[ring], dest, retries=retries)
+
+        live = [r for r in range(n) if per_ring[r]]
+        if len(live) == 1:
+            return run(live[0])
+        futs = {r: self._pool.submit(run, r) for r in live}
+        # join ALL rings before raising: a caller reacting to an error must
+        # not race sub-gathers still writing into dest
+        concurrent.futures.wait(futs.values())
+        err = next((f.exception() for f in futs.values()
+                    if f.exception() is not None), None)
+        if err is not None:
+            raise err
+        return sum(f.result() for f in futs.values())
+
+    # -- observability ------------------------------------------------------
+    def stats(self) -> dict:
+        per_ring = [c.stats() for c in self._children]
+        out = {"engine": self.name, "rings": len(self._children)}
+        for key in ("ops_submitted", "ops_completed", "ops_errored",
+                    "ops_faulted", "bytes_read", "unaligned_fallback_reads",
+                    "eof_topup_reads", "chunk_retries", "ops_fixed",
+                    "cached_bytes", "media_bytes", "in_flight"):
+            out[key] = sum(int(s.get(key, 0)) for s in per_ring)
+        out["ring_stats"] = per_ring
+        return out
+
+    def buffer_info(self) -> dict:
+        info = self._children[0].buffer_info()
+        info["engine"] = self.name
+        info["rings"] = len(self._children)
+        return info
+
+    def close(self) -> None:
+        if self._closed:
+            return
+        self._closed = True
+        self._pool.shutdown(wait=True)
+        for c in self._children:
+            c.close()

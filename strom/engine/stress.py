@@ -20,17 +20,23 @@ import numpy as np
 
 def run_stress(variant: str = "", *, seconds: float = 3.0,
                readers: int = 3, size: int = 8 * 1024 * 1024,
-               sqpoll: bool = False) -> int:
+               sqpoll: bool = False, rings: int = 1) -> int:
     from strom.config import StromConfig
     from strom.delivery.core import StromContext
     from strom.engine.uring_engine import UringEngine, uring_available
 
-    cfg = StromConfig(queue_depth=16, num_buffers=32, sqpoll=sqpoll)
+    cfg = StromConfig(queue_depth=16, num_buffers=32, sqpoll=sqpoll,
+                      engine_rings=rings)
     if variant:
         if not uring_available():
             print("io_uring unavailable; nothing to stress", file=sys.stderr)
             return 0
-        engine = UringEngine(cfg, variant=variant)
+        if rings > 1:
+            from strom.engine.multi import MultiRingEngine
+
+            engine = MultiRingEngine(cfg, variant=variant)
+        else:
+            engine = UringEngine(cfg, variant=variant)
     else:
         engine = None  # auto
     ctx = StromContext(cfg, engine=engine)
@@ -140,9 +146,14 @@ def main() -> int:
     ap.add_argument("--sqpoll", action="store_true",
                     help="stress an IORING_SETUP_SQPOLL ring (covers the "
                          "need-wakeup fence under the sanitizers)")
+    ap.add_argument("--rings", type=int, default=1,
+                    help="multi-ring engine: concurrent gathers interleave "
+                         "across N rings with NO delivery-layer lock — the "
+                         "per-ring locking is what's under test")
     args = ap.parse_args()
     return run_stress(args.variant, seconds=args.seconds,
-                      readers=args.readers, sqpoll=args.sqpoll)
+                      readers=args.readers, sqpoll=args.sqpoll,
+                      rings=args.rings)
 
 
 if __name__ == "__main__":

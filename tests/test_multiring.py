@@ -1,0 +1,140 @@
+"""Multi-ring engine: per-file ring routing, concurrent-transfer interleave
+(SURVEY.md §2.1 "DMA submit engine" per-device queues; VERDICT.md r2
+missing #3 / next #5)."""
+
+import threading
+
+import numpy as np
+import pytest
+
+from strom.config import StromConfig
+from strom.delivery.core import StromContext, StripedFile
+
+
+def _multi_ctx(rings: int, **cfg_kw) -> StromContext:
+    from strom.engine.uring_engine import uring_available
+
+    if not uring_available():
+        pytest.skip("io_uring unavailable")
+    return StromContext(StromConfig(engine="uring", engine_rings=rings,
+                                    **cfg_kw))
+
+
+def test_make_engine_selects_multi():
+    from strom.engine import make_engine
+    from strom.engine.multi import MultiRingEngine
+    from strom.engine.uring_engine import uring_available
+
+    if not uring_available():
+        pytest.skip("io_uring unavailable")
+    eng = make_engine(StromConfig(engine="uring", engine_rings=3))
+    try:
+        assert isinstance(eng, MultiRingEngine)
+        assert eng.num_rings == 3
+        assert eng.concurrent_gathers
+        s = eng.stats()
+        assert s["rings"] == 3 and len(s["ring_stats"]) == 3
+    finally:
+        eng.close()
+
+
+def test_striped_gather_uses_every_ring(tmp_path, rng):
+    """A RAID0 gather over 4 members on a 2-ring engine must submit on BOTH
+    rings (member i → ring i mod N), with byte-exact results."""
+    from strom.engine.raid0 import stripe_file
+
+    n_mem, chunk = 4, 64 * 1024
+    data = rng.integers(0, 256, size=4 * 1024 * 1024, dtype=np.uint8)
+    src = tmp_path / "src.bin"
+    data.tofile(src)
+    members = [str(tmp_path / f"m{i}.bin") for i in range(n_mem)]
+    stripe_file(str(src), members, chunk)
+    ctx = _multi_ctx(2)
+    try:
+        sf = StripedFile(tuple(members), chunk)
+        got = np.asarray(memoryview(ctx.pread(sf, 0, len(data))))
+        np.testing.assert_array_equal(got, data)
+        per_ring = ctx.engine.stats()["ring_stats"]
+        assert len(per_ring) == 2
+        for rs in per_ring:
+            assert rs["ops_submitted"] > 0, per_ring
+            assert rs["bytes_read"] > 0, per_ring
+    finally:
+        ctx.close()
+
+
+def test_single_file_transfers_round_robin(tmp_path, rng):
+    """Whole-file gathers rotate rings, so back-to-back independent
+    transfers land on different rings."""
+    data = rng.integers(0, 256, size=1 * 1024 * 1024, dtype=np.uint8)
+    p = tmp_path / "f.bin"
+    data.tofile(p)
+    ctx = _multi_ctx(2)
+    try:
+        for _ in range(2):
+            got = np.asarray(memoryview(ctx.pread(str(p))))
+            np.testing.assert_array_equal(got, data)
+        per_ring = ctx.engine.stats()["ring_stats"]
+        assert all(rs["bytes_read"] == len(data) for rs in per_ring), per_ring
+    finally:
+        ctx.close()
+
+
+def test_concurrent_transfers_interleave(tmp_path, rng):
+    """With concurrent_gathers the delivery layer drops its whole-transfer
+    lock: N threads reading concurrently stay byte-exact and every ring
+    carries traffic."""
+    size = 2 * 1024 * 1024
+    datas, paths = [], []
+    for i in range(4):
+        d = rng.integers(0, 256, size=size, dtype=np.uint8)
+        p = tmp_path / f"c{i}.bin"
+        d.tofile(p)
+        datas.append(d)
+        paths.append(str(p))
+    ctx = _multi_ctx(2)
+    try:
+        errors: list[BaseException] = []
+
+        def worker(i: int) -> None:
+            try:
+                for _ in range(3):
+                    got = np.asarray(memoryview(ctx.pread(paths[i])))
+                    np.testing.assert_array_equal(got, datas[i])
+            except BaseException as e:  # noqa: BLE001 - surfaced below
+                errors.append(e)
+
+        threads = [threading.Thread(target=worker, args=(i,)) for i in range(4)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert not errors, errors
+        per_ring = ctx.engine.stats()["ring_stats"]
+        assert all(rs["bytes_read"] > 0 for rs in per_ring), per_ring
+        agg = ctx.engine.stats()
+        assert agg["bytes_read"] == 4 * 3 * size
+    finally:
+        ctx.close()
+
+
+def test_memcpy_and_unregister_roundtrip(tmp_path, rng):
+    """The full delivery path (sharded memcpy_ssd2tpu included) rides the
+    multi-ring engine; unregistering a file drops it from every ring."""
+    data = rng.integers(0, 256, size=512 * 1024, dtype=np.uint8)
+    p = tmp_path / "d.bin"
+    data.tofile(p)
+    ctx = _multi_ctx(2)
+    try:
+        arr = ctx.memcpy_ssd2tpu(str(p), length=len(data))
+        np.testing.assert_array_equal(np.asarray(arr), data)
+        fi = ctx.file_index(str(p))
+        ctx.engine.unregister_file(fi)
+        assert all(fi not in m for m in ctx.engine._child_fi)
+    finally:
+        ctx.close()
+
+
+def test_engine_rings_validation():
+    with pytest.raises(ValueError, match="engine_rings"):
+        StromConfig(engine_rings=0)

@@ -102,3 +102,23 @@ def test_tsan_stress_sqpoll_clean():
     # the probe said SQPOLL engages on this kernel, so a fallback in the
     # stress subprocess means the flag plumbing regressed — fail loudly
     assert "sqpoll=True" in proc.stdout, proc.stdout
+
+
+@pytest.mark.slow
+def test_tsan_multiring_stress_clean():
+    """Concurrent gathers across a 2-ring engine with NO delivery-layer
+    lock (concurrent_gathers): the per-ring locking, lazy cross-ring file
+    registration, and every-ring dest registration under TSAN."""
+    from strom.engine.uring_engine import uring_available
+
+    if not uring_available():
+        pytest.skip("io_uring unavailable")
+    rt = _runtime("libtsan.so")
+    if rt is None:
+        pytest.skip("libtsan runtime not found")
+    proc = _run_stress("tsan", rt, {
+        "TSAN_OPTIONS": "exitcode=66 report_bugs=1 history_size=2",
+    }, "--rings", "2")
+    assert "ThreadSanitizer" not in proc.stderr, proc.stderr[-4000:]
+    assert proc.returncode == 0, (proc.returncode, proc.stderr[-4000:])
+    assert "stress ok" in proc.stdout
