@@ -48,8 +48,17 @@ class MultiRingEngine(Engine):
         n = rings if rings is not None else max(config.engine_rings, 1)
         if n < 1:
             raise ValueError("need at least one ring")
-        self._children: list[UringEngine] = [
-            UringEngine(config, variant=variant) for _ in range(n)]
+        self._children: list[UringEngine] = []
+        try:
+            for _ in range(n):
+                self._children.append(UringEngine(config, variant=variant))
+        except BaseException:
+            # a later ring failing (RLIMIT_MEMLOCK, fd caps) must not leak
+            # the earlier rings' pinned pools and fds — especially under
+            # make_engine's engine="auto" fallback, which swallows the error
+            for c in self._children:
+                c.close()
+            raise
         # my file index -> (path, o_direct); child registrations are lazy
         # (a file only occupies a ring's fd table once a transfer lands there)
         self._files: dict[int, tuple[str, bool | None]] = {}
@@ -212,6 +221,35 @@ class MultiRingEngine(Engine):
                     "eof_topup_reads", "chunk_retries", "ops_fixed",
                     "cached_bytes", "media_bytes", "in_flight"):
             out[key] = sum(int(s.get(key, 0)) for s in per_ring)
+        # feature flags: children share one config, ring 0 speaks for all
+        for key in ("fixed_buffers", "fixed_files", "mlocked", "coop_taskrun",
+                    "sqpoll", "sparse_table"):
+            out[key] = per_ring[0].get(key)
+        # latency: element-wise hist sum so the Prometheus histogram (and its
+        # percentile gauges) survive multi-ring deployments — the dashboards
+        # this engine targets are exactly the ones that would go blank
+        hists = [s.get("read_latency_hist") for s in per_ring]
+        if all(h is not None for h in hists):
+            hist = [sum(h[i] for h in hists) for i in range(len(hists[0]))]
+            total = sum(int(s.get("read_latency_count", 0)) for s in per_ring)
+            mean_num = sum(float(s.get("read_latency_mean_us", 0.0))
+                           * int(s.get("read_latency_count", 0))
+                           for s in per_ring)
+            out["read_latency_hist"] = hist
+            out["read_latency_count"] = total
+            out["read_latency_mean_us"] = mean_num / total if total else 0.0
+            # percentiles from the combined log2 hist — UPPER bucket edge,
+            # the same convention as the single-ring engines
+            for q, name in ((0.5, "read_latency_p50_us"),
+                            (0.99, "read_latency_p99_us")):
+                acc, val = 0, 0.0
+                target = q * total
+                for i, b in enumerate(hist):
+                    acc += b
+                    if total and acc >= target:
+                        val = float(1 << (i + 1))
+                        break
+                out[name] = val
         out["ring_stats"] = per_ring
         return out
 
@@ -219,6 +257,10 @@ class MultiRingEngine(Engine):
         info = self._children[0].buffer_info()
         info["engine"] = self.name
         info["rings"] = len(self._children)
+        # EVERY ring owns a full staging pool: report the real pinned
+        # footprint, with the per-ring size alongside
+        info["per_ring_bytes"] = info["total_bytes"]
+        info["total_bytes"] = info["total_bytes"] * len(self._children)
         return info
 
     def close(self) -> None:

@@ -31,13 +31,66 @@ def scan_units(shards: Sequence[ParquetShard]) -> list[tuple[ParquetShard, int]]
     return [(s, g) for s in shards for g in range(s.num_row_groups)]
 
 
+def _collective_sum(acc: Any) -> Any:
+    """Cross-process aggregate sum as a real XLA collective on a scan mesh.
+
+    One global 1-D mesh over every device in the job; each process
+    contributes its partial on its first local device (zeros elsewhere) as
+    one row of a [n_devices, ...] process-sharded array, and a jitted
+    axis-0 sum with a replicated out_sharding makes XLA emit the all-reduce
+    — ICI within a slice, DCN across (SURVEY.md §2.3). Works at any process
+    count (single-process: a local-mesh reduction). Every process must
+    call this (it is a collective)."""
+    import jax
+
+    devs = np.asarray(jax.devices())
+    mesh = jax.sharding.Mesh(devs, ("scan",))
+    local = jax.local_devices()
+    reducer = _mesh_reducer(mesh)
+
+    def leaf(x: Any) -> np.ndarray:
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        x = np.asarray(x)
+        sh = NamedSharding(mesh, P(*(("scan",) + (None,) * x.ndim)))
+        rows = [jax.device_put(x[None] if i == 0 else np.zeros_like(x)[None],
+                               d)
+                for i, d in enumerate(local)]
+        garr = jax.make_array_from_single_device_arrays(
+            (devs.size,) + x.shape, sh, rows)
+        return np.asarray(reducer(garr))
+
+    return jax.tree.map(leaf, acc)
+
+
+# mesh -> jitted replicated-sum reducer: jit caches on function identity, so
+# a per-call lambda would recompile the all-reduce on every scan; equal
+# meshes hash equal, so repeated scans (and every leaf of one scan) share
+# one executable per array shape
+_reducer_cache: dict = {}
+
+
+def _mesh_reducer(mesh: Any):
+    fn = _reducer_cache.get(mesh)
+    if fn is None:
+        import jax
+        import jax.numpy as jnp
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        fn = jax.jit(lambda a: jnp.sum(a, axis=0),
+                     out_shardings=NamedSharding(mesh, P()))
+        _reducer_cache[mesh] = fn
+    return fn
+
+
 def parquet_scan_aggregate(ctx: StromContext, paths: Sequence[str],
                            columns: Sequence[str], map_fn: MapFn, *,
                            prefetch_depth: int = 2,
                            unit_batch: int = 1,
                            devices: Sequence[Any] | None = None,
                            process_index: int | None = None,
-                           process_count: int | None = None) -> Any:
+                           process_count: int | None = None,
+                           reduce: str = "collective") -> Any:
     """Scan shards' row groups, sum map_fn's partial aggregates, reduce
     globally. Returns the aggregate pytree (host numpy leaves).
 
@@ -45,8 +98,13 @@ def parquet_scan_aggregate(ctx: StromContext, paths: Sequence[str],
     assigned by BYTE SIZE (greedy LPT over the selected columns' compressed
     chunk sizes — deterministic, computed identically on every process with
     no coordination), so skewed row-group sizes don't make one host the
-    pod's critical path. The final cross-process reduction rides XLA
-    collectives via process_allgather.
+    pod's critical path. The final cross-process reduction is selectable:
+    ``reduce="collective"`` (default) is a real XLA all-reduce on a global
+    scan mesh (see :func:`_collective_sum` — the pod-scale path: one
+    fused collective instead of gathering P copies to every host);
+    ``reduce="allgather"`` keeps the ``process_allgather`` + host-sum
+    fallback (useful when a global mesh can't be formed, e.g. heterogeneous
+    local device counts).
 
     unit_batch > 1 concatenates that many row groups' columns on the host
     and dispatches them as ONE device_put + one jitted map_fn call —
@@ -65,6 +123,10 @@ def parquet_scan_aggregate(ctx: StromContext, paths: Sequence[str],
 
     from strom.parallel.multihost import assign_balanced
 
+    if reduce not in ("collective", "allgather"):
+        # fail in microseconds, not after the whole scan has run
+        raise ValueError(f"reduce must be 'collective' or 'allgather', "
+                         f"got {reduce!r}")
     shards = [ParquetShard(p, ctx=ctx) for p in paths]
     units = scan_units(shards)
     if not units:
@@ -112,11 +174,15 @@ def parquet_scan_aggregate(ctx: StromContext, paths: Sequence[str],
         acc = jax.tree.map(jnp.zeros_like, jitted(empty))
     acc = jax.tree.map(np.asarray, acc)
 
-    if jax.process_count() > 1:  # the real count: collectives involve everyone
+    if reduce == "collective":
+        # a collective: every process participates, any process count
+        acc = _collective_sum(acc)
+    elif jax.process_count() > 1:  # "allgather"; collectives involve everyone
         from jax.experimental import multihost_utils
 
         gathered = multihost_utils.process_allgather(acc)
-        acc = jax.tree.map(lambda x: np.sum(np.asarray(x), axis=0), gathered)
+        acc = jax.tree.map(lambda x: np.sum(np.asarray(x), axis=0),
+                           gathered)
     return acc
 
 

@@ -166,3 +166,53 @@ def test_parquet_scan_uses_balanced_assignment(tmp_path):
     big_bin = next(b for b in bins if big_idx in b)
     assert big_bin == [big_idx]
     assert max(loads) == sizes[big_idx]
+
+
+@pytest.mark.slow
+def test_8proc_parquet_scan_fanout(tmp_path):
+    """8 single-device processes scan one Parquet file: LPT unit assignment
+    covers every row group exactly once, and both reductions (the XLA
+    -collective scan-mesh sum and the allgather fallback) agree with the
+    locally-computed truth on every process. Scan-only — no TPU, CPU mesh
+    over localhost DCN (VERDICT.md r2 missing #4 / next #7)."""
+    pa = pytest.importorskip("pyarrow")
+    import pyarrow.parquet as pq
+
+    rng = np.random.default_rng(11)
+    values = rng.standard_normal(40_000)
+    truth = int((values > 0).sum())
+    path = str(tmp_path / "scan.parquet")
+    pq.write_table(pa.table({"value": values}), path,
+                   row_group_size=40_000 // 16)
+
+    nproc = 8
+    port = _free_port()
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = {k: v for k, v in os.environ.items()
+           if k not in ("JAX_PLATFORMS", "XLA_FLAGS")}
+    env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+    procs = [
+        subprocess.Popen(
+            [sys.executable,
+             os.path.join(repo, "tests", "parquet_scan_worker.py"),
+             str(pid), str(nproc), str(port), path],
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+            cwd=repo, env=env)
+        for pid in range(nproc)
+    ]
+    outs = []
+    for p in procs:
+        try:
+            out, _ = p.communicate(timeout=420)
+        except subprocess.TimeoutExpired:
+            for q in procs:
+                q.kill()
+            raise
+        outs.append(out)
+    for pid, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"worker {pid} failed:\n{out[-4000:]}"
+        assert f"worker {pid}: scan[collective] hits={truth}" in out, \
+            out[-2000:]
+        assert f"worker {pid}: scan[allgather] hits={truth}" in out, \
+            out[-2000:]
+        assert f"worker {pid}: scan fanout ok" in out

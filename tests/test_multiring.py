@@ -114,6 +114,11 @@ def test_concurrent_transfers_interleave(tmp_path, rng):
         assert all(rs["bytes_read"] > 0 for rs in per_ring), per_ring
         agg = ctx.engine.stats()
         assert agg["bytes_read"] == 4 * 3 * size
+        # the latency histogram must survive aggregation (Prometheus export
+        # reads these keys; they went blank in an earlier multi-ring draft)
+        assert sum(agg["read_latency_hist"]) == agg["read_latency_count"] > 0
+        assert agg["read_latency_p99_us"] >= agg["read_latency_p50_us"] > 0
+        assert agg["read_latency_mean_us"] > 0
     finally:
         ctx.close()
 

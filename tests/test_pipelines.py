@@ -522,3 +522,37 @@ class TestPredecodedPipeline:
         with pytest.raises(ValueError, match="batch-dim"):
             make_predecoded_vision_pipeline(ctx, [pdec_shard], batch=8,
                                             image_size=32, sharding=bad)
+
+
+class TestScanReduction:
+    def test_reduce_modes_agree(self, ctx, tmp_path):
+        """Both reductions — the XLA-collective scan-mesh sum and the
+        allgather fallback — give the same count on the 8-device CPU mesh
+        (single process: the collective runs as a local-mesh reduction)."""
+        pa = pytest.importorskip("pyarrow")
+        import pyarrow.parquet as pq
+
+        from strom.pipelines import parquet_count_where
+
+        rng = np.random.default_rng(77)
+        vals = rng.standard_normal(6000)
+        p = str(tmp_path / "r.parquet")
+        pq.write_table(pa.table({"value": pa.array(vals)}), p,
+                       row_group_size=1000)
+        truth = int((vals > 0).sum())
+        for reduce in ("collective", "allgather"):
+            got = parquet_count_where(ctx, [p], "value", lambda v: v > 0,
+                                      reduce=reduce)
+            assert got == truth, reduce
+
+    def test_reduce_mode_validated(self, ctx, tmp_path):
+        pa = pytest.importorskip("pyarrow")
+        import pyarrow.parquet as pq
+
+        from strom.pipelines import parquet_count_where
+
+        p = str(tmp_path / "v.parquet")
+        pq.write_table(pa.table({"value": pa.array(np.ones(10))}), p)
+        with pytest.raises(ValueError, match="reduce"):
+            parquet_count_where(ctx, [p], "value", lambda v: v > 0,
+                                reduce="psum")
