@@ -1159,9 +1159,20 @@ int64_t sc_read_vectored(sc_engine *e, const sc_vec_seg *segs, uint64_t n_segs,
     }
     if (si >= n_segs) return false;
     const sc_vec_seg &s = segs[si];
-    uint32_t take = s.length - within < block_size
+    // fully-WARM segments chunk 16x coarser: a buffered read of resident
+    // pages is a memcpy, so per-op overhead (SQE fill, completion, slot
+    // churn) dominates at media-tuned block sizes — fewer, larger ops move
+    // the same bytes with less CPU. Mixed segments keep block_size (the
+    // residency bitmap's granularity); cold segments keep the media tuning.
+    uint32_t eff_block = block_size;
+    if (!seg_state.empty() && seg_state[si] == 1) {
+      uint64_t coarse = (uint64_t)block_size * 16;
+      if (coarse > (64u << 20)) coarse = 64u << 20;  // and never u32 overflow
+      if (coarse > block_size) eff_block = (uint32_t)coarse;
+    }
+    uint32_t take = s.length - within < eff_block
                         ? (uint32_t)(s.length - within)
-                        : block_size;
+                        : eff_block;
     c.offset = s.offset + within;
     c.dest_off = s.dest_offset + within;
     c.want = take;

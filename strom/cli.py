@@ -451,7 +451,10 @@ def bench_resnet(args: argparse.Namespace) -> dict:
             "images_per_s": round(args.steps * args.batch / dt, 1),
             "batch": args.batch, "image_size": args.image_size,
             "steps": args.steps, "devices": n_dev, "data_stall_steps": stalls,
-            "decode_workers": args.decode_workers, "engine": cfg.engine,
+            # the decode-free arm runs no decode pool: reporting the flag's
+            # value there would imply workers that never existed
+            "decode_workers": 0 if predecoded else args.decode_workers,
+            "engine": cfg.engine,
             "predecoded": predecoded,
         }
 
@@ -703,6 +706,10 @@ def bench_all(args: argparse.Namespace) -> dict:
         ("resnet", bench_resnet, dict(batch=32, image_size=176, steps=6,
                                       prefetch=2, decode_workers=8,
                                       train_step=True, model="resnet50")),
+        ("resnet_predecoded", bench_resnet,
+         dict(batch=32, image_size=176, steps=6, prefetch=8,
+              decode_workers=8, train_step=True, model="resnet50",
+              predecoded=True)),
         ("vit", bench_vit, dict(batch=32, image_size=176, steps=6, prefetch=2,
                                 decode_workers=8, raid=4,
                                 raid_chunk=512 * 1024, train_step=True,

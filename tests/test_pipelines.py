@@ -523,6 +523,32 @@ class TestPredecodedPipeline:
             make_predecoded_vision_pipeline(ctx, [pdec_shard], batch=8,
                                             image_size=32, sharding=bad)
 
+    def test_checkpoint_resume_replays_nothing(self, ctx, mesh, pdec_shard):
+        """Mid-epoch resume of the decode-free loader: batches after the
+        cursor match an uninterrupted run exactly (images AND labels)."""
+        from strom.pipelines import make_predecoded_vision_pipeline
+
+        sharding = NamedSharding(mesh, P("dp", None, None, None))
+
+        def make(resume=None):
+            return make_predecoded_vision_pipeline(
+                ctx, [pdec_shard], batch=8, image_size=32, sharding=sharding,
+                seed=9, resume_from=resume)
+
+        with make() as pipe:
+            golden = [next(pipe) for _ in range(4)]
+            golden = [(np.asarray(i), np.asarray(l)) for i, l in golden]
+        with make() as pipe:
+            next(pipe)
+            next(pipe)
+            state = pipe.state()
+            resumed = make(resume=state)
+        with resumed as pipe:
+            for want_i, want_l in golden[2:]:
+                got_i, got_l = next(pipe)
+                np.testing.assert_array_equal(np.asarray(got_i), want_i)
+                np.testing.assert_array_equal(np.asarray(got_l), want_l)
+
 
 class TestScanReduction:
     def test_reduce_modes_agree(self, ctx, tmp_path):
