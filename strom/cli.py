@@ -878,6 +878,7 @@ def main(argv: list[str] | None = None) -> int:
         d = {"path": rep.path, "size": rep.size, "fs": rep.fs_type,
              "tier": rep.tier.value, "supported": rep.supported,
              "dio": vars(rep.dio), "extents": rep.extents,
+             "cached_frac": rep.cached_frac,
              "reasons": list(rep.reasons)}
         print(json.dumps(d, indent=None if args.json else 2))
         return 0

@@ -51,6 +51,10 @@ class FileReport:
     reasons: tuple[str, ...]      # human-readable: why this tier
     fragmented: bool = False      # >1 reliable extent with non-sequential placement
     mean_extent_bytes: int = 0    # mean reliable extent length (0 = map unavailable)
+    # fraction of the file currently page-cache resident (None: unprobeable):
+    # the residency hybrid serves this fraction as memcpys instead of media
+    # reads (strom/probe/residency.py; SURVEY.md §2.1 "Page-cache fallback")
+    cached_frac: float | None = None
 
     @property
     def supported(self) -> bool:
@@ -103,6 +107,28 @@ def check_file(path, *, want_extents: bool = True) -> FileReport:
         except OSError:
             reasons.append("fiemap unavailable on this filesystem")
 
+    cached_frac = None
+    if st.st_size > 0:
+        from strom.probe.residency import cached_pages
+
+        r = None
+        try:
+            fd = os.open(path, os.O_RDONLY)
+        except OSError:
+            pass  # stat-able but unreadable (EACCES): degrade like every
+            # other probe here — check_file reports, it never raises
+        else:
+            try:
+                r = cached_pages(fd, 0, st.st_size)
+            finally:
+                os.close(fd)
+        if r is not None and r[1]:
+            cached_frac = r[0] / r[1]
+            if cached_frac > 0:
+                reasons.append(
+                    f"{cached_frac:.0%} page-cache resident: the residency "
+                    "hybrid serves warm ranges as memcpys")
+
     if not dio.supported:
         tier = PathTier.BUFFERED
         reasons.append(f"O_DIRECT unsupported (source={dio.source}); buffered fallback")
@@ -127,6 +153,7 @@ def check_file(path, *, want_extents: bool = True) -> FileReport:
         reasons=tuple(reasons),
         fragmented=fragmented,
         mean_extent_bytes=mean_extent,
+        cached_frac=cached_frac,
     )
 
 
@@ -149,6 +176,7 @@ def _check_striped(sf, *, want_extents: bool = True) -> FileReport:
             reasons.append(f"member {r.path}: {r.tier.value} ({r.reasons[-1]})")
     mixed_fs = {r.fs_type for r in reports}
     total = sum(r.size for r in reports)
+    probed_bytes = sum(r.size for r in reports if r.cached_frac is not None)
     # count-weighted: the mean over ALL the set's extents, so one heavily-
     # fragmented member isn't averaged away by a large contiguous one
     n_ext = sum(r.extents for r in reports if r.mean_extent_bytes)
@@ -168,6 +196,12 @@ def _check_striped(sf, *, want_extents: bool = True) -> FileReport:
         reasons=tuple(reasons),
         fragmented=any(r.fragmented for r in reports),
         mean_extent_bytes=mean_extent,
+        # byte-weighted over probeable members ONLY (a member whose probe
+        # failed must not dilute the denominator); None when none probed
+        cached_frac=(
+            sum(r.cached_frac * r.size for r in reports
+                if r.cached_frac is not None)
+            / probed_bytes if probed_bytes else None),
     )
 
 

@@ -78,7 +78,12 @@ def cached_pages(fd: int, offset: int, length: int) -> tuple[int, int] | None:
         rc = _libc.mincore(ctypes.c_void_p(addr), ctypes.c_size_t(sz), vec)
         if rc != 0:
             return None
-        return (sum(b & 1 for b in vec), npages)
+        # numpy, not a python loop: whole-file probes on big files walk
+        # millions of vector bytes (one per page)
+        import numpy as np
+
+        resident = int((np.frombuffer(vec, dtype=np.uint8) & 1).sum())
+        return (resident, npages)
     finally:
         _libc.munmap(ctypes.c_void_p(addr), ctypes.c_size_t(sz))
 

@@ -107,3 +107,28 @@ class TestCheckStriped:
             assert rep.tier is rep_plain.tier
         finally:
             strom.close()
+
+
+def test_check_file_reports_residency(tmp_path, rng):
+    """cached_frac: 0 cold, 1.0 warm (the residency hybrid's input signal),
+    None only when no probe exists on the kernel."""
+    from strom.probe.check import check_file
+    from strom.probe.residency import cached_pages, drop_cache
+
+    data = rng.integers(0, 256, 2 * 1024 * 1024, dtype=np.uint8)
+    p = str(tmp_path / "res.bin")
+    data.tofile(p)
+    fd = os.open(p, os.O_RDONLY)
+    try:
+        if cached_pages(fd, 0, 4096) is None:
+            pytest.skip("no residency probe on this kernel")
+    finally:
+        os.close(fd)
+    drop_cache(p)
+    rep = check_file(p, want_extents=False)
+    assert rep.cached_frac == 0.0
+    with open(p, "rb") as f:
+        f.read()
+    rep = check_file(p, want_extents=False)
+    assert rep.cached_frac == 1.0
+    assert any("resident" in r for r in rep.reasons)
