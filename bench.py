@@ -65,6 +65,11 @@ network relay; see BASELINE.md §C):
   vit_images_per_s, vit_train_images_per_s, vit_data_stalls
                   Config #3: ViT-B/16 over WebDataset tar shards on a
                   4-member RAID0 striped set (register_striped aliasing).
+  vit_predecoded_images_per_s, vit_predecoded_train_images_per_s,
+  vit_predecoded_stalls
+                  Config #3's decode-free arm: the decode-once packed shard
+                  is itself striped over the RAID0 members, so the loader
+                  is a pure stripe-decoded engine gather.
   parquet_rows_per_s, parquet_selected_gbps
                   Config #5: PG-Strom-style columnar scan from a RAID0
                   striped set — only selected columns' chunks engine-read,
@@ -219,18 +224,29 @@ def main() -> int:
             engine="auto", tmpdir=args.tmpdir, json=True, batch=64,
             image_size=224, steps=10, prefetch=2, decode_workers=8,
             train_step=True, model="resnet50")
-        rres = attempt("resnet", lambda: bench_resnet(rargs))
-        if rres is not None:
+        def vision_arm(name: str, fn, bargs, prefix: str,
+                       stall_key: str) -> None:
+            """One vision bench arm: run with retry, record the artifact
+            keys, narrate. Single-sourcing the key schema keeps the five
+            arms from drifting apart."""
+            res = attempt(name, lambda: fn(bargs))
+            if res is None:
+                return
             loader_res.update({
-                "resnet_images_per_s": rres["images_per_s"],
-                "resnet_train_images_per_s": rres.get("train_images_per_s"),
-                "resnet_data_stalls": rres.get("train_data_stalls"),
+                f"{prefix}_images_per_s": res["images_per_s"],
+                f"{prefix}_train_images_per_s": res.get("train_images_per_s"),
+                stall_key: res.get("train_data_stalls"),
             })
-            print(f"resnet loader flat-out: {rres['images_per_s']:.0f} img/s; "
-                  f"with {rres.get('train_model')} train step: "
-                  f"{rres.get('train_images_per_s')} img/s, "
-                  f"{rres.get('train_data_stalls')} data-stall steps",
+            raid = getattr(bargs, "raid", 0)
+            print(f"{name} flat-out: {res['images_per_s']:.0f} img/s"
+                  f"{f' (raid{raid})' if raid else ''}; with "
+                  f"{res.get('train_model')} train step: "
+                  f"{res.get('train_images_per_s')} img/s, "
+                  f"{res.get('train_data_stalls')} data-stall steps",
                   file=sys.stderr)
+
+        vision_arm("resnet", bench_resnet, rargs,
+                   "resnet", "resnet_data_stalls")
 
         # config #2, decode-free arm: the JPEG numbers above stall by
         # construction on this 1-core box (decode and the consumer share the
@@ -240,19 +256,8 @@ def main() -> int:
         # -burst reasoning as the llama phase above.
         prargs = argparse.Namespace(**{**vars(rargs), "prefetch": 16,
                                        "predecoded": True})
-        prres = attempt("resnet predecoded", lambda: bench_resnet(prargs))
-        if prres is not None:
-            loader_res.update({
-                "resnet_predecoded_images_per_s": prres["images_per_s"],
-                "resnet_predecoded_train_images_per_s":
-                    prres.get("train_images_per_s"),
-                "resnet_predecoded_stalls": prres.get("train_data_stalls"),
-            })
-            print(f"resnet PREDECODED flat-out: {prres['images_per_s']:.0f} "
-                  f"img/s; with {prres.get('train_model')} train step: "
-                  f"{prres.get('train_images_per_s')} img/s, "
-                  f"{prres.get('train_data_stalls')} data-stall steps",
-                  file=sys.stderr)
+        vision_arm("resnet PREDECODED", bench_resnet, prargs,
+                   "resnet_predecoded", "resnet_predecoded_stalls")
 
         # config #3: ViT-B/16 over WDS tar shards on a 4-member RAID0
         # striped set (BASELINE.json:9) — previously only in BASELINE.md §C
@@ -265,18 +270,15 @@ def main() -> int:
             engine="auto", tmpdir=args.tmpdir, json=True, batch=64,
             image_size=224, steps=10, prefetch=2, decode_workers=8,
             raid=4, raid_chunk=512 * 1024, train_step=True, model="vit_b16")
-        vres = attempt("vit", lambda: bench_vit(vargs))
-        if vres is not None:
-            loader_res.update({
-                "vit_images_per_s": vres["images_per_s"],
-                "vit_train_images_per_s": vres.get("train_images_per_s"),
-                "vit_data_stalls": vres.get("train_data_stalls"),
-            })
-            print(f"vit loader flat-out: {vres['images_per_s']:.0f} img/s "
-                  f"(raid{vargs.raid}); with {vres.get('train_model')} train "
-                  f"step: {vres.get('train_images_per_s')} img/s, "
-                  f"{vres.get('train_data_stalls')} data-stall steps",
-                  file=sys.stderr)
+        vision_arm("vit", bench_vit, vargs, "vit", "vit_data_stalls")
+
+        # config #3 decode-free arm: the packed shard itself striped over
+        # the RAID0 members — pure stripe-decoded engine gather, the
+        # box-feasible 0-stall demonstration for the striped-set config
+        pvargs = argparse.Namespace(**{**vars(vargs), "prefetch": 16,
+                                       "predecoded": True})
+        vision_arm("vit PREDECODED", bench_vit, pvargs,
+                   "vit_predecoded", "vit_predecoded_stalls")
 
         # config #5: PG-Strom-style columnar scan from a RAID0 striped set
         # (BASELINE.json:11) — also artifact-tracked now

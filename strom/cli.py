@@ -505,33 +505,58 @@ def bench_vit(args: argparse.Namespace) -> dict:
     RAID0 striped set. The tar is striped over --raid member files
     (``stripe_file``) and registered as a path alias, so every member gather
     stripe-decodes across the set — the userspace twin of the tar living on
-    a 4xNVMe md-raid0 mount (BASELINE.json:9)."""
+    a 4xNVMe md-raid0 mount (BASELINE.json:9). --predecoded stages the tar
+    decode-once (strom.formats.predecoded) and stripes the PACKED shard
+    instead: the loader is a pure stripe-decoded engine gather, no per-step
+    JPEG decode."""
     import jax
     from jax.sharding import NamedSharding, PartitionSpec as P
 
     from strom.config import StromConfig
     from strom.delivery.core import StromContext
     from strom.parallel.mesh import make_mesh
-    from strom.pipelines import make_vit_wds_pipeline
+    from strom.pipelines import (make_predecoded_vision_pipeline,
+                                 make_vit_wds_pipeline)
 
     plain = args.file or _mk_wds_fixture(args.tmpdir, args.batch,
                                          args.image_size)
-    members, _ = _ensure_striped(plain, args.raid, args.raid_chunk)
     cfg = StromConfig(engine=args.engine, block_size=args.block,
                       queue_depth=args.depth, num_buffers=max(args.depth * 2, 8))
     ctx = StromContext(cfg)
     try:
-        virt = plain + ".raid0"  # never exists on disk: reads resolve via alias
-        ctx.register_striped(virt, members, args.raid_chunk)
+        predecoded = bool(getattr(args, "predecoded", False))
+        if predecoded:
+            from strom.formats.predecoded import stage_striped_predecoded
+
+            pdec = _ensure_predecoded(ctx, plain, args.image_size,
+                                      args.tmpdir)
+            members, _ = _ensure_striped(pdec, args.raid, args.raid_chunk)
+            virt = stage_striped_predecoded(ctx, pdec, members,
+                                            args.raid_chunk, stripe=False)
+        else:
+            members, _ = _ensure_striped(plain, args.raid, args.raid_chunk)
+            virt = plain + ".raid0"  # never on disk: reads resolve via alias
+            ctx.register_striped(virt, members, args.raid_chunk)
         n_dev = _fit_dp_devices(args.batch)
         mesh = make_mesh({"dp": n_dev}, devices=jax.devices()[:n_dev])
         sharding = NamedSharding(mesh, P("dp", None, None, None))
+
+        if predecoded:
+            def pipe_factory():
+                return make_predecoded_vision_pipeline(
+                    ctx, [virt], batch=args.batch,
+                    image_size=args.image_size, sharding=sharding,
+                    prefetch_depth=args.prefetch)
+        else:
+            def pipe_factory():
+                return make_vit_wds_pipeline(
+                    ctx, [virt], batch=args.batch,
+                    image_size=args.image_size, sharding=sharding,
+                    prefetch_depth=args.prefetch,
+                    decode_workers=args.decode_workers)
         for m in members:
             _drop_cache_hint(m)
-        with make_vit_wds_pipeline(
-                ctx, [virt], batch=args.batch, image_size=args.image_size,
-                sharding=sharding, prefetch_depth=args.prefetch,
-                decode_workers=args.decode_workers) as pipe:
+        with pipe_factory() as pipe:
             next(pipe)[0].block_until_ready()
             t0 = time.perf_counter()
             for _ in range(args.steps):
@@ -544,6 +569,7 @@ def bench_vit(args: argparse.Namespace) -> dict:
             "batch": args.batch, "image_size": args.image_size,
             "steps": args.steps, "devices": n_dev, "raid_members": args.raid,
             "data_stall_steps": stalls, "engine": cfg.engine,
+            "predecoded": predecoded,
         }
 
         if getattr(args, "train_step", False):
@@ -575,11 +601,7 @@ def bench_vit(args: argparse.Namespace) -> dict:
             for m in members:
                 _drop_cache_hint(m)
             rate, stalls, loss = _timed_train_phase(
-                lambda: make_vit_wds_pipeline(
-                    ctx, [virt], batch=args.batch, image_size=args.image_size,
-                    sharding=sharding, prefetch_depth=args.prefetch,
-                    decode_workers=args.decode_workers),
-                step, args.steps, args.batch)
+                pipe_factory, step, args.steps, args.batch)
             out["train_images_per_s"] = rate
             out["train_data_stalls"] = stalls
             out["train_model"] = args.model
@@ -832,6 +854,10 @@ def main(argv: list[str] | None = None) -> int:
                        choices=["tiny", "vit_b16"],
                        help="ViT config for --train-step (image_size is "
                             "overridden to --image-size)")
+    p_vit.add_argument("--predecoded", action="store_true",
+                       help="decode-free loader: the tar staged once as a "
+                            "packed uint8 shard, STRIPED over the RAID0 "
+                            "members — pure stripe-decoded engine gather")
     p_vit.set_defaults(fn=bench_vit)
 
     p_pq = sub.add_parser("parquet", help="config #5: PG-Strom-style columnar "

@@ -83,6 +83,30 @@ def predecode_wds(ctx, tar_paths: Sequence[str], out_path: str, *,
     return out_path
 
 
+def stage_striped_predecoded(ctx, pdec: str, members: Sequence[str],
+                             chunk: int, virt: str | None = None, *,
+                             stripe: bool = True) -> str:
+    """Stripe the packed shard *pdec* over *members* RAID0-style (skip with
+    ``stripe=False`` when the members are already fresh — e.g. a
+    fingerprint-cached bench fixture), register the path alias, and place
+    alias-named sidecar copies so :class:`PredecodedShardSet` finds
+    labels/meta — the whole staging protocol in one place (the sidecar copy
+    is easy to forget and only fails at pipeline build). Returns the alias
+    path to load from."""
+    import shutil
+
+    from strom.engine.raid0 import stripe_file
+
+    virt = virt or pdec + ".raid0"
+    if stripe:
+        stripe_file(pdec, list(members), chunk)
+    ctx.register_striped(virt, list(members), chunk,
+                         size=os.path.getsize(pdec))
+    for sfx in (LABELS_SUFFIX, META_SUFFIX):
+        shutil.copyfile(pdec + sfx, virt + sfx)
+    return virt
+
+
 @dataclasses.dataclass(frozen=True)
 class PredecodedShardSet:
     """Pre-decoded image shards addressed as one global record array.
@@ -90,7 +114,13 @@ class PredecodedShardSet:
     Record addressing and gather planning are exactly the packed-token
     layout, so this composes :class:`TokenShardSet` with uint8 pixel
     records; labels live host-side (they are 4 bytes/sample — engine reads
-    are for the 150KiB images)."""
+    are for the 150KiB images).
+
+    *paths* may be striped-set aliases (``StromContext.register_striped``):
+    pass ``shard_sizes`` with the logical sizes (the pipeline resolves them
+    through the context) and keep the ``.labels.npy`` / ``.meta.json``
+    sidecars at the ALIAS names — sidecars are host-read tiny files, only
+    the pixel records ride the engine's stripe decode."""
 
     paths: tuple[str, ...]
     image_size: int

@@ -169,13 +169,18 @@ def make_predecoded_vision_pipeline(ctx: StromContext, paths: Sequence[str], *,
     import jax
     from jax.sharding import NamedSharding, PartitionSpec as P
 
+    from strom.delivery.core import source_size
     from strom.formats.predecoded import PredecodedShardSet
 
     if not isinstance(sharding, NamedSharding):
         raise TypeError("vision pipelines need a NamedSharding (labels derive "
                         "their spec from its batch axis)")
     _validate_batch_only(sharding)
-    shards = PredecodedShardSet(tuple(paths), image_size)
+    # sizes resolved through the ctx so striped-set aliases (paths that need
+    # not exist on disk) work exactly like the llama loader's shards
+    shards = PredecodedShardSet(
+        tuple(paths), image_size,
+        shard_sizes=tuple(source_size(ctx.resolve_source(p)) for p in paths))
     if shards.num_records < batch:
         raise ValueError(f"dataset has {shards.num_records} samples < batch "
                          f"{batch}")

@@ -64,28 +64,35 @@ def cached_pages(fd: int, offset: int, length: int) -> tuple[int, int] | None:
         # EPERM under seccomp profiles that deny unknown syscalls, ...):
         # demote to mincore, which exists everywhere
         _probe_state = 2
-    # mincore fallback on a transient mapping mapped via raw libc (the fd is
+    # mincore fallback on transient mappings via raw libc (the fd is
     # O_RDONLY, so the mapping is PROT_READ and ctypes' from_buffer refuses
-    # it — we need the raw address anyway); mincore never faults pages in
-    sz = end - start
-    _libc.mmap.restype = ctypes.c_void_p
-    addr = _libc.mmap(None, ctypes.c_size_t(sz), mmap.PROT_READ,
-                      mmap.MAP_SHARED, fd, ctypes.c_long(start))
-    if addr is None or addr == ctypes.c_void_p(-1).value:
-        return None
-    try:
-        vec = (ctypes.c_ubyte * npages)()
-        rc = _libc.mincore(ctypes.c_void_p(addr), ctypes.c_size_t(sz), vec)
-        if rc != 0:
-            return None
-        # numpy, not a python loop: whole-file probes on big files walk
-        # millions of vector bytes (one per page)
-        import numpy as np
+    # it — we need the raw address anyway); mincore never faults pages in.
+    # Probed in bounded windows so a whole-file probe of a TB-scale shard
+    # stays O(window) in memory (vector is 1 byte/page), not O(file).
+    import numpy as np
 
-        resident = int((np.frombuffer(vec, dtype=np.uint8) & 1).sum())
-        return (resident, npages)
-    finally:
-        _libc.munmap(ctypes.c_void_p(addr), ctypes.c_size_t(sz))
+    _libc.mmap.restype = ctypes.c_void_p
+    window = 1 << 30
+    resident = 0
+    pos = start
+    while pos < end:
+        sz = min(window, end - pos)
+        wpages = (sz + ps - 1) // ps
+        addr = _libc.mmap(None, ctypes.c_size_t(sz), mmap.PROT_READ,
+                          mmap.MAP_SHARED, fd, ctypes.c_long(pos))
+        if addr is None or addr == ctypes.c_void_p(-1).value:
+            return None
+        try:
+            vec = (ctypes.c_ubyte * wpages)()
+            rc = _libc.mincore(ctypes.c_void_p(addr), ctypes.c_size_t(sz),
+                               vec)
+            if rc != 0:
+                return None
+            resident += int((np.frombuffer(vec, dtype=np.uint8) & 1).sum())
+        finally:
+            _libc.munmap(ctypes.c_void_p(addr), ctypes.c_size_t(sz))
+        pos += sz
+    return (resident, npages)
 
 
 def range_fully_cached(fd: int, offset: int, length: int) -> bool | None:

@@ -2,6 +2,8 @@
 8-device mesh, parquet scan fan-out (SURVEY.md §4.2 'Device delivery' and
 'Overlap/0-stall' rows)."""
 
+import os
+
 import numpy as np
 import pytest
 
@@ -582,3 +584,40 @@ class TestScanReduction:
         with pytest.raises(ValueError, match="reduce"):
             parquet_count_where(ctx, [p], "value", lambda v: v > 0,
                                 reduce="psum")
+
+
+class TestPredecodedStriped:
+    def test_striped_predecoded_pipeline(self, ctx, mesh, tmp_path, rng):
+        """The decode-once shard striped RAID0-style and read through a path
+        alias: batches byte-equal the plain shard's records, labels via the
+        alias-named sidecar (config #3's decode-free arm)."""
+        import cv2
+
+        from strom.formats.predecoded import (predecode_wds,
+                                              stage_striped_predecoded)
+        from strom.pipelines import make_predecoded_vision_pipeline
+        from tests.test_formats import make_wds_shard
+
+        samples = []
+        for i in range(16):
+            img = rng.integers(0, 256, (40, 40, 3), dtype=np.uint8)
+            ok, buf = cv2.imencode(".jpg", img)
+            assert ok
+            samples.append((f"s{i:04d}", {"jpg": buf.tobytes(),
+                                          "cls": str(i % 5).encode()}))
+        tar = str(tmp_path / "src.tar")
+        make_wds_shard(tar, samples)
+        pdec = predecode_wds(ctx, [tar], str(tmp_path / "imgs.pdec"),
+                             image_size=32, decode_workers=2)
+        members = [str(tmp_path / f"pm{i}.bin") for i in range(2)]
+        virt = stage_striped_predecoded(ctx, pdec, members, 64 * 1024)
+
+        raw = np.fromfile(pdec, dtype=np.uint8).reshape(16, 32, 32, 3)
+        sharding = NamedSharding(mesh, P("dp", None, None, None))
+        with make_predecoded_vision_pipeline(
+                ctx, [virt], batch=8, image_size=32, sharding=sharding,
+                shuffle=False) as pipe:
+            imgs, lbls = next(pipe)
+        np.testing.assert_array_equal(np.asarray(imgs), raw[:8])
+        np.testing.assert_array_equal(np.asarray(lbls),
+                                      [i % 5 for i in range(8)])
