@@ -50,7 +50,10 @@ def test_multiprocess_delivery_train_coordination(tmp_path, nproc, ndev):
     outs = []
     for p in procs:
         try:
-            out, _ = p.communicate(timeout=420)
+            # 900s, not 420: the 4-proc case takes ~390s ALONE on this
+            # 1-core box, and suite-internal load (engine rebuilds, jax
+            # compiles in neighboring tests) pushed it past 420 (observed)
+            out, _ = p.communicate(timeout=900)
         except subprocess.TimeoutExpired:
             for q in procs:
                 q.kill()
