@@ -165,6 +165,11 @@ class ParquetShard:
         else:
             self.metadata = pq.read_metadata(path)
         self._footer_bytes: np.ndarray | None = None  # engine-read once, reused
+        # scan decode pools read row groups of one shard concurrently; the
+        # lock keeps "read once" true under that concurrency
+        import threading
+
+        self._footer_lock = threading.Lock()
         self._col_index = {
             self.metadata.schema.column(i).path: i
             for i in range(self.metadata.num_columns)
@@ -228,8 +233,9 @@ class ParquetShard:
 
         chunk_ext = self.column_chunk_extents(row_group, columns)
         footer_ext = self.footer_extent()
-        if self._footer_bytes is None:
-            self._footer_bytes = ctx.pread(footer_ext)  # immutable: read once
+        with self._footer_lock:
+            if self._footer_bytes is None:
+                self._footer_bytes = ctx.pread(footer_ext)  # immutable: once
         buf = ctx.pread(chunk_ext)
         cache = _RangeCache()
         cache.insert(footer_ext.extents[0].offset, self._footer_bytes)

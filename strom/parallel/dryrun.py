@@ -100,6 +100,31 @@ def run_dryrun(n_devices: int) -> None:
         assert np.isfinite(ep_loss), f"non-finite ep loss {ep_loss}"
         print(f"dryrun ok: mesh={ep_axes} (MoE expert parallel), loss={ep_loss:.4f}")
 
+    # MoE × long-context: dp×ep×sp — expert parallelism composed with ring
+    # attention (flash inside the ring) over a sequence-sharded batch; the
+    # expert all-to-alls and the ring's kv ppermutes coexist on one mesh
+    if n_devices >= 8 and n_devices % 4 == 0:
+        from strom.models.moe import MoEConfig
+        from strom.parallel.train import init_moe_train_state, make_moe_train_step
+
+        mix_axes = {"dp": n_devices // 4, "ep": 2, "sp": 2}
+        mix_mesh = make_mesh(mix_axes, devices=devs)
+        mcfg = MoEConfig.tiny(n_experts=4)
+        state = init_moe_train_state(jax.random.PRNGKey(3), mcfg, mix_mesh,
+                                     optimizer)
+        mix_step = make_moe_train_step(mcfg, mix_mesh, optimizer, sp=True,
+                                       attn="flash")
+        B, L = 2 * mix_axes["dp"], 64
+        tokens = jnp.asarray(np.random.default_rng(5).integers(
+            0, mcfg.base.vocab, (B, L), dtype=np.int32))
+        tokens = jax.device_put(tokens,
+                                NamedSharding(mix_mesh, P("dp", "sp")))
+        state, metrics = mix_step(state, tokens)
+        mix_loss = float(metrics["loss"])
+        assert np.isfinite(mix_loss), f"non-finite dp×ep×sp loss {mix_loss}"
+        print(f"dryrun ok: mesh={mix_axes} (dp×ep×sp MoE ring×flash), "
+              f"loss={mix_loss:.4f}")
+
     # Pipeline parallelism: dp×pp — layer stacks pp-sharded, microbatches
     # pumped through the stages via ppermute, fed by the real delivery path
     if n_devices >= 2 and n_devices % 2 == 0 and cfg.n_layers % 2 == 0:

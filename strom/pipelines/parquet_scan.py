@@ -12,6 +12,7 @@ group k+1 overlaps compute of group k via the prefetcher.
 
 from __future__ import annotations
 
+import concurrent.futures
 import itertools
 from functools import partial
 from typing import Any, Callable, Sequence
@@ -90,7 +91,8 @@ def parquet_scan_aggregate(ctx: StromContext, paths: Sequence[str],
                            devices: Sequence[Any] | None = None,
                            process_index: int | None = None,
                            process_count: int | None = None,
-                           reduce: str = "collective") -> Any:
+                           reduce: str = "collective",
+                           decode_workers: int = 4) -> Any:
     """Scan shards' row groups, sum map_fn's partial aggregates, reduce
     globally. Returns the aggregate pytree (host numpy leaves).
 
@@ -117,6 +119,11 @@ def parquet_scan_aggregate(ctx: StromContext, paths: Sequence[str],
     common writer produces) compile twice (body + tail chunk); a heavily
     skewed file can compile once per DISTINCT concatenated length, eating
     the latency win — prefer unit_batch=1 there.
+
+    decode_workers > 1 decodes a unit_batch's row groups on a thread pool
+    (pyarrow releases the GIL in decompression/decode); results are
+    order-identical to serial decode — concatenation keeps the chunk's
+    unit order. Engages only when unit_batch > 1.
     """
     import jax
     import jax.numpy as jnp
@@ -143,14 +150,26 @@ def parquet_scan_aggregate(ctx: StromContext, paths: Sequence[str],
         return {c: np.ascontiguousarray(table[c].to_numpy(zero_copy_only=False))
                 for c in columns}
 
+    if unit_batch < 1:
+        raise ValueError(f"unit_batch must be >= 1, got {unit_batch}")
+    # per-process decode parallelism (VERDICT.md r2 weak #5: pyarrow decode
+    # was single-threaded per process): pyarrow releases the GIL inside
+    # decompression/decode, so a unit_batch's units decode concurrently.
+    # Concatenation order stays the chunk's unit order — results identical.
+    # Only built when it can engage (chunks of >1 unit and >1 worker).
+    decode_pool = concurrent.futures.ThreadPoolExecutor(
+        max_workers=decode_workers, thread_name_prefix="strom-pq-decode") \
+        if decode_workers > 1 and unit_batch > 1 else None
+
     def read_units(chunk: list) -> dict:
-        parts = [read_unit(s, g) for (s, g) in chunk]
+        if decode_pool is not None and len(chunk) > 1:
+            parts = list(decode_pool.map(lambda u: read_unit(*u), chunk))
+        else:
+            parts = [read_unit(s, g) for (s, g) in chunk]
         if len(parts) == 1:
             return parts[0]
         return {c: np.concatenate([p[c] for p in parts]) for c in columns}
 
-    if unit_batch < 1:
-        raise ValueError(f"unit_batch must be >= 1, got {unit_batch}")
     unit_chunks = [local_units[i: i + unit_batch]
                    for i in range(0, len(local_units), unit_batch)]
     # engine read + decode of unit k+1 overlaps device compute of unit k
@@ -159,12 +178,21 @@ def parquet_scan_aggregate(ctx: StromContext, paths: Sequence[str],
 
     acc = None
     dev_cycle = itertools.cycle(devs)
-    for cols in Prefetcher(thunks, depth=prefetch_depth):
-        dev = next(dev_cycle)
-        cols_dev = {c: jax.device_put(v, dev) for c, v in cols.items()}
-        part = jitted(cols_dev)
-        part = jax.tree.map(lambda x: jax.device_put(x, devs[0]), part)
-        acc = part if acc is None else jax.tree.map(jnp.add, acc, part)
+    pf = Prefetcher(thunks, depth=prefetch_depth)
+    try:
+        for cols in pf:
+            dev = next(dev_cycle)
+            cols_dev = {c: jax.device_put(v, dev) for c, v in cols.items()}
+            part = jitted(cols_dev)
+            part = jax.tree.map(lambda x: jax.device_put(x, devs[0]), part)
+            acc = part if acc is None else jax.tree.map(jnp.add, acc, part)
+    finally:
+        # stop feeding BEFORE tearing the decode pool down: an in-flight
+        # prefetch thunk submitting to a shut-down pool would raise into a
+        # never-consumed future
+        pf.close()
+        if decode_pool is not None:
+            decode_pool.shutdown(wait=True)
     if acc is None:
         # this process drew zero units (more processes than units): it must
         # still contribute a zero aggregate, or peers hang in the allgather

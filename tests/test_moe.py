@@ -111,3 +111,23 @@ class TestMoEModel:
         tokens = jax.device_put(tokens, NamedSharding(mesh, P("dp", "sp")))
         state, metrics = step(state, tokens)
         assert np.isfinite(float(metrics["loss"]))
+
+    def test_ep_with_sp_flash_ring(self):
+        """dp×ep×sp with the Pallas flash kernels INSIDE the ring: the
+        expert all-to-alls and the ring's kv ppermutes coexist on one mesh
+        (mirrors the dryrun's 8th config)."""
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from strom.parallel.train import (init_moe_train_state,
+                                          make_moe_train_step, make_optimizer)
+
+        cfg = MoEConfig.tiny(n_experts=4)
+        mesh = make_mesh({"dp": 2, "ep": 2, "sp": 2}, devices=jax.devices()[:8])
+        opt = make_optimizer()
+        state = init_moe_train_state(jax.random.PRNGKey(1), cfg, mesh, opt)
+        step = make_moe_train_step(cfg, mesh, opt, sp=True, attn="flash")
+        tokens = jnp.array(np.random.default_rng(4).integers(
+            0, cfg.base.vocab, (4, 64)), jnp.int32)
+        tokens = jax.device_put(tokens, NamedSharding(mesh, P("dp", "sp")))
+        state, metrics = step(state, tokens)
+        assert np.isfinite(float(metrics["loss"]))
