@@ -221,16 +221,22 @@ def test_sourceio_readahead_windows(ctx, tmp_path, rng):
         f.seek(0, 7)
 
 
-def test_prometheus_engine_histogram(data_file, engine_name):
+@pytest.mark.parametrize("rings", [1, 2])
+def test_prometheus_engine_histogram(data_file, engine_name, rings):
     """strom.prometheus() must expose the ENGINE's counters and a valid
     cumulative read-latency histogram, not just the global counters (the
-    reference exposes exactly these via its /proc node)."""
+    reference exposes exactly these via its /proc node). rings=2: the
+    multi-ring aggregation must keep the exposition intact — dashboards
+    keyed on these series target exactly those deployments."""
     import strom
     from strom.config import StromConfig
 
+    if rings > 1 and engine_name != "uring":
+        pytest.skip("multi-ring is uring-only")
     path, data = data_file
     strom.close()
-    strom.init(StromConfig(engine=engine_name, queue_depth=8, num_buffers=8))
+    strom.init(StromConfig(engine=engine_name, engine_rings=rings,
+                           queue_depth=8, num_buffers=8))
     try:
         strom.memcpy_ssd2tpu(path, length=1 << 20).block_until_ready()
         txt = strom.prometheus()
