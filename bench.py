@@ -14,6 +14,22 @@ denominator with the slow per-op ctypes loop, understating raw bandwidth by
 Extra fields contextualize the ratio on THIS box (single TPU v5 chip behind a
 network relay; see BASELINE.md §C):
   raw_gbps        raw O_DIRECT sequential read -> host RAM (config #1, native)
+  host_delivered_gbps, vs_baseline_host
+                  the framework path up to (NOT including) device_put:
+                  striped-alias resolution, extent-aware planning, residency
+                  routing, engine gather, zero-copy assembly into the final
+                  host array (StromContext.memcpy_ssd2host) — against the
+                  same run's raw denominator. Relay-independent, so this is
+                  the box-feasible form of the binding >=0.90 target: "the
+                  framework adds <=10% on top of raw NVMe". The end-to-end
+                  vs_baseline below remains capped by whatever the relay
+                  link does that day.
+  binding         sub-object collecting the weather-independent fields
+                  ({vs_baseline_host, vs_link, link_busy_frac,
+                  reader_idle_frac, train/bounded/predecoded stall counts})
+                  — THE round-over-round comparison set; absolute GB/s in
+                  "value" is relay weather (swings >50x), kept only for
+                  continuity
   link_gbps       host->HBM device_put ceiling measured alone (no disk I/O)
   vs_link         delivered / min(raw, link): the fraction of the physically
                   achievable pipeline rate the software actually delivers —
@@ -42,6 +58,17 @@ network relay; see BASELINE.md §C):
   stream_read_gbps  engine disk-read throughput DURING the streamed pass
                   (bytes / time the reader spent inside the engine): shows
                   the disk side kept pace while the link was saturated.
+  bounded_train_data_stalls, bounded_steps, bounded_prefetch,
+  bounded_step_delay_s
+                  the NON-degenerate 0-stall arm: 40 train steps at prefetch
+                  depth 4 with an execution-paced consumer (fixed host delay
+                  = the measured per-step wall time after each dispatch).
+                  The headline arm below needs prefetch > steps on this box
+                  (dispatch-burst dynamic, BASELINE.md §C), which cannot
+                  distinguish "overlap works" from "everything was staged
+                  before consumption started"; this arm can, because the
+                  queue is 10x shallower than the step count and the
+                  consumer drains it at execution rate.
   loader_tokens_per_s, train_tokens_per_s, train_data_stalls
                   Llama packed-token pipeline on the real device (config #4
                   shape): flat-out loader rate, then the same loader feeding
@@ -127,24 +154,55 @@ def main() -> int:
                       overlap_chunk_bytes=args.chunk)
 
     # --- denominator: raw O_DIRECT sequential read -> host RAM (config #1),
-    # --- native vectored path (one io_uring_enter per batch of 128KiB blocks)
+    # --- native vectored path (one io_uring_enter per batch of 128KiB
+    # --- blocks) — INTERLEAVED with the framework host-side arm
+    # --- (VERDICT.md r3 next #1): the delivered path stopped at the
+    # --- device_put boundary (striped-alias resolution, extent-aware
+    # --- planning, residency routing, engine gather, zero-copy assembly
+    # --- into the final host array). Relay-independent, so the host ratio
+    # --- is the box-feasible form of the binding >=0.90-of-raw target
+    # --- (BASELINE.json:5): "does the framework add <=10% on top of raw
+    # --- NVMe". The arms alternate raw/host per pass with best-of-3 each
+    # --- because this virtio disk's cold-read rate swings ~1.9-2.9 GB/s
+    # --- pass to pass (BASELINE.md §C): back-to-back blocks would hand one
+    # --- arm the burst and the other the refill, making the ratio weather
+    # --- (a first cut measured host/raw = 1.81 that way). Same size, same
+    # --- READ_FIXED dest treatment on both sides.
     raw_gbps = 0.0
+    host_gbps = 0.0
     dest = alloc_aligned(size)
-    for _ in range(2):
-        _drop_cache_hint(path)
-        eng = make_engine(cfg)
-        fi = eng.register_file(path, o_direct=True)
-        eng.register_dest(dest)  # READ_FIXED when supported (pages pinned
-        # once at registration, not per IO) — the delivered side's pool slabs
-        # register the same way, keeping the ratio best-native-vs-best-native
-        t0 = time.perf_counter()
-        n = eng.read_vectored([(fi, 0, 0, size)], dest)
-        dt = time.perf_counter() - t0
-        eng.close()
-        assert n == size
-        raw_gbps = max(raw_gbps, size / dt / 1e9)
+    hctx = StromContext(cfg)
+    try:
+        hctx.engine.register_dest(dest)
+        for _ in range(3):
+            _drop_cache_hint(path)
+            eng = make_engine(cfg)
+            fi = eng.register_file(path, o_direct=True)
+            eng.register_dest(dest)  # READ_FIXED when supported (pages
+            # pinned once at registration, not per IO) — the host arm's dest
+            # registers the same way, keeping best-native-vs-best-native
+            t0 = time.perf_counter()
+            n = eng.read_vectored([(fi, 0, 0, size)], dest)
+            dt = time.perf_counter() - t0
+            eng.close()
+            assert n == size
+            raw_gbps = max(raw_gbps, size / dt / 1e9)
+            _drop_cache_hint(path)
+            t0 = time.perf_counter()
+            arr = hctx.memcpy_ssd2host(path, length=size, out=dest)
+            dt = time.perf_counter() - t0
+            assert arr.nbytes == size
+            host_gbps = max(host_gbps, size / dt / 1e9)
+            del arr
+    finally:
+        hctx.close()
     del dest
-    print(f"raw O_DIRECT read (native vectored): {raw_gbps:.3f} GB/s", file=sys.stderr)
+    print(f"raw O_DIRECT read (native vectored): {raw_gbps:.3f} GB/s",
+          file=sys.stderr)
+    print(f"host-delivered (framework path up to device_put): "
+          f"{host_gbps:.3f} GB/s = {host_gbps / raw_gbps:.3f} of raw"
+          if raw_gbps else "host-delivered: raw denominator missing",
+          file=sys.stderr)
 
     # --- second north star FIRST: loader throughput + data-stall count on
     # --- the real device (config #4 shape). Runs before the bulk-bandwidth
@@ -171,7 +229,12 @@ def main() -> int:
             file=None, size=size, block=cfg.block_size, depth=32, iters=1,
             engine="auto", tmpdir=args.tmpdir, json=True, batch=8,
             seq_len=2047, steps=12, prefetch=16, train_step=True,
-            model="small", attn="flash")
+            model="small", attn="flash",
+            # bounded-depth arm (VERDICT.md r3 next #2): 40 steps at depth 4
+            # with an execution-paced consumer — the non-degenerate 0-stall
+            # demonstration (the headline arm's prefetch 16 > steps 12 can
+            # buffer the whole run before consumption starts)
+            bounded_steps=40, bounded_prefetch=4)
         # prefetch 16 (> steps+warmup), and here is exactly why (traced
         # on-chip 2026-07-30): through the relay, jitted train steps
         # DISPATCH asynchronously — after the first step's dispatch-queue
@@ -187,6 +250,15 @@ def main() -> int:
         # prefetch >= 2; the counter and its warmup exclusion are
         # untouched. Best-of-3 (min stalls) on top, same methodology as
         # the bandwidth phase's best-of-2; early-out on a 0-stall run.
+        def _stall_key(res: dict) -> tuple[int, int]:
+            # min over (headline stalls, bounded stalls); non-int (absent /
+            # None after a partial phase failure) sorts worst instead of
+            # raising int<None (ADVICE.md r3 #4)
+            s = res.get("train_data_stalls")
+            b = res.get("bounded_train_data_stalls")
+            return (s if isinstance(s, int) else 1 << 30,
+                    b if isinstance(b, int) else 1 << 30)
+
         best = None
         for att in range(3):  # NOT named `attempt`: that's the helper above
             # per-attempt try: a relay flake on attempt 2 must not discard a
@@ -201,11 +273,14 @@ def main() -> int:
                   f"{lres['tokens_per_s']:.0f} tok/s flat-out; "
                   f"with {lres.get('train_model')}+{lres.get('train_attn')}"
                   f" train step: {lres.get('train_tokens_per_s')} tok/s, "
-                  f"{stalls} data-stall steps", file=sys.stderr)
-            if best is None or (stalls is not None
-                                and stalls < best.get("train_data_stalls", 1 << 30)):
+                  f"{stalls} data-stall steps; bounded arm (depth "
+                  f"{lres.get('bounded_prefetch')}, {lres.get('bounded_steps')}"
+                  f" steps, {lres.get('bounded_step_delay_s')}s/step pace): "
+                  f"{lres.get('bounded_train_data_stalls')} stalls",
+                  file=sys.stderr)
+            if best is None or _stall_key(lres) < _stall_key(best):
                 best = lres
-            if stalls == 0:
+            if _stall_key(best) == (0, 0):
                 break
         if best is not None:
             loader_res = {
@@ -213,6 +288,11 @@ def main() -> int:
                 "train_tokens_per_s": best.get("train_tokens_per_s"),
                 "train_data_stalls": best.get("train_data_stalls"),
                 "train_steps": largs.steps,
+                "bounded_train_data_stalls":
+                    best.get("bounded_train_data_stalls"),
+                "bounded_steps": best.get("bounded_steps"),
+                "bounded_prefetch": best.get("bounded_prefetch"),
+                "bounded_step_delay_s": best.get("bounded_step_delay_s"),
             }
 
         # config #2: ResNet-50 images/s (the headline metric's second half)
@@ -386,6 +466,12 @@ def main() -> int:
         "unit": "GB/s",
         "vs_baseline": round(s2t_gbps / raw_gbps, 4) if raw_gbps else 0.0,
         "raw_gbps": round(raw_gbps, 4),
+        # the framework path up to (not including) device_put, against the
+        # same run's raw denominator: the relay-independent restatement of
+        # the binding >=0.90 target — "the framework adds <=10% on top of
+        # raw NVMe" (SURVEY.md §6, BASELINE.json:5)
+        "host_delivered_gbps": round(host_gbps, 4),
+        "vs_baseline_host": round(host_gbps / raw_gbps, 4) if raw_gbps else 0.0,
         # null (not 0.0) when the transfer didn't take the streamed path
         # (size < overlap_min_bytes): 0.0 would read as "link idle the whole
         # transfer", the opposite of "not measured"
@@ -408,6 +494,22 @@ def main() -> int:
         "delivered_bytes": size,
     }
     out.update(loader_res)
+    # The metric of record for round-over-round comparison (VERDICT.md r3
+    # next #8): "value"/"vs_baseline" stay for continuity, but they measure
+    # the relay's token-bucket state (absolute GB/s swings >50x run-to-run —
+    # BASELINE.md §C). These fields are weather-independent: ratios of
+    # same-run timers, busy/idle fractions, and stall counts. Judges and
+    # dashboards should diff THIS object across BENCH_r*.json.
+    out["binding"] = {
+        "vs_baseline_host": out.get("vs_baseline_host"),
+        "vs_link": out.get("vs_link"),
+        "link_busy_frac": out.get("link_busy_frac"),
+        "reader_idle_frac": out.get("reader_idle_frac"),
+        "train_data_stalls": out.get("train_data_stalls"),
+        "bounded_train_data_stalls": out.get("bounded_train_data_stalls"),
+        "resnet_predecoded_stalls": out.get("resnet_predecoded_stalls"),
+        "vit_predecoded_stalls": out.get("vit_predecoded_stalls"),
+    }
 
     print(json.dumps(out))
     return 0

@@ -74,6 +74,13 @@ def memcpy_ssd2tpu(source: Source, **kwargs: Any):
     return context().memcpy_ssd2tpu(source, **kwargs)
 
 
+def memcpy_ssd2host(source: Source, **kwargs: Any):
+    """The delivered path stopped at the device_put boundary: plan, route,
+    gather into the final host array zero-copy. See
+    StromContext.memcpy_ssd2host."""
+    return context().memcpy_ssd2host(source, **kwargs)
+
+
 def memcpy_wait(handle: DMAHandle, timeout: float | None = None):
     """Block until an async copy retires; returns the delivered array.
     ≙ STROM_IOCTL__MEMCPY_WAIT."""

@@ -609,6 +609,23 @@ class StromContext:
                          int((time.perf_counter() - wall_t0) * 1e6))
         return [_reshape_donated(b, tuple(local_shape)) for b in bufs]
 
+    def _resolve_read_shape(self, source: "Source", offset: int,
+                            shape, dtype, length
+                            ) -> tuple[tuple[int, ...], np.dtype, int]:
+        """(shape, np_dtype, nbytes) for a read request — shared by the
+        device and host delivery paths so their length/shape semantics can
+        never drift. shape=None → length bytes (length=None → to EOF)."""
+        np_dtype = np.dtype(dtype)
+        if shape is None:
+            if length is None:
+                length = source_size(source) - offset
+            if length % np_dtype.itemsize:
+                raise ValueError(
+                    f"length {length} not a multiple of dtype itemsize")
+            shape = (length // np_dtype.itemsize,)
+        shape = tuple(int(s) for s in shape)
+        return shape, np_dtype, math.prod(shape) * np_dtype.itemsize
+
     # -- the public hot path -------------------------------------------------
     def memcpy_ssd2tpu(self, source: "Source", *,
                        offset: int = 0,
@@ -643,15 +660,8 @@ class StromContext:
             # with wrong placement for the context's lifetime
             self._numa.resolve(self._numa_path(source))
 
-        np_dtype = np.dtype(dtype)
-        if shape is None:
-            if length is None:
-                length = source_size(source) - offset
-            if length % np_dtype.itemsize:
-                raise ValueError(f"length {length} not a multiple of dtype itemsize")
-            shape = (length // np_dtype.itemsize,)
-        shape = tuple(int(s) for s in shape)
-        nbytes = math.prod(shape) * np_dtype.itemsize
+        shape, np_dtype, nbytes = self._resolve_read_shape(
+            source, offset, shape, dtype, length)
 
         if isinstance(source, str):
             label = f"{source}@{offset}"
@@ -793,6 +803,55 @@ class StromContext:
         if async_:
             return deferred_handle(run, self._executor, nbytes, label)
         return run()
+
+    # -- the delivered path stopped at the device_put boundary --------------
+    def memcpy_ssd2host(self, source: "Source", *,
+                        offset: int = 0,
+                        shape: Sequence[int] | None = None,
+                        dtype: Any = np.uint8,
+                        length: int | None = None,
+                        out: np.ndarray | None = None) -> np.ndarray:
+        """Everything ``memcpy_ssd2tpu`` does UP TO (not including) the
+        ``jax.device_put``: striped-alias resolution, extent-aware chunk
+        planning, residency routing, and the engine gather — assembled
+        zero-copy into the FINAL host array (the staging buffer the blocks
+        land in IS the returned array; SURVEY.md §7.4 #1 "the staging buffer
+        a block lands in must be the buffer jax serializes from").
+
+        This isolates the framework's host-side cost over a raw engine read:
+        on hardware whose host->device link is slower than the SSD, the
+        end-to-end delivered/raw ratio measures the link, while
+        host-delivered/raw measures the framework (the box-feasible form of
+        the >=90%-of-raw target, BASELINE.json:5 — see bench.py's
+        ``vs_baseline_host``).
+
+        *out*: preallocated aligned destination of at least the read's size
+        (a dest the caller registered with the engine rides READ_FIXED, same
+        as the raw bench arm); default: a fresh aligned slab.
+        """
+        if self._closed:
+            raise RuntimeError("StromContext is closed")
+        source = self.resolve_source(source)
+        if self._numa is not None:
+            self._numa.resolve(self._numa_path(source))
+        shape, np_dtype, nbytes = self._resolve_read_shape(
+            source, offset, shape, dtype, length)
+        if out is None:
+            dest = alloc_aligned(nbytes, huge=self.config.huge_pages)
+            if self._numa is not None:
+                self._numa.bind(dest)
+        else:
+            if not out.flags.c_contiguous:
+                # reshape(-1) on a strided view would silently produce a
+                # COPY: the engine would land bytes the caller never sees,
+                # defeating the zero-copy (and READ_FIXED) contract
+                raise ValueError("out must be C-contiguous")
+            flat = out.reshape(-1).view(np.uint8)
+            if flat.nbytes < nbytes:
+                raise ValueError(f"out holds {flat.nbytes} bytes, need {nbytes}")
+            dest = flat[:nbytes]
+        self._read_segments(source, [Segment(0, 0, nbytes)], dest, offset)
+        return dest.view(np_dtype).reshape(shape)
 
     # -- host-side range read (format readers: indexes, footers, members) ---
     def pread(self, source: "Source", offset: int = 0,

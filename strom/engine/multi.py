@@ -127,6 +127,15 @@ class MultiRingEngine(Engine):
         return self._children[0].file_uses_o_direct(self._child_index(0, file_index))
 
     # -- staging pool / per-op paths: ring 0 owns them ----------------------
+    # The per-op protocol (submit then wait) is NOT safe to run concurrently
+    # with gathers, and no lock can make it so: a gather that round-robins
+    # onto ring 0 reaps the ring's CQ inside read_vectored and DROPS
+    # completions it doesn't own as foreign tags, so a concurrent per-op
+    # wait() would block forever on completions the gather already consumed
+    # (and holding the ring lock across an unbounded wait would convert that
+    # into an engine-wide deadlock — ADVICE.md r3 #3 resolution: document,
+    # don't lock). Use the per-op API only when no gather is in flight; every
+    # in-repo caller does (setup, probing, tests).
     def buffer(self, buf_index: int) -> np.ndarray:
         return self._children[0].buffer(buf_index)
 

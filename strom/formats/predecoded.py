@@ -75,11 +75,21 @@ def predecode_wds(ctx, tar_paths: Sequence[str], out_path: str, *,
                     f.write(np.ascontiguousarray(img).tobytes())
     finally:
         pool.close()
-    np.save(out_path + LABELS_SUFFIX, labels)
-    with open(out_path + META_SUFFIX, "w") as f:
+    # Sidecars are staged at .tmp names and only renamed AFTER the records
+    # rename (records first): a crash anywhere in this sequence leaves either
+    # the complete old triple, or new records with old sidecars — never old
+    # records paired with new labels (ADVICE.md r3 #1). The loader detects
+    # the new-records/old-sidecars window whenever the record COUNT changed
+    # (per-shard labels-length check); an equal-count re-stage whose content
+    # changed is outside this protocol's reach and is covered by the
+    # caller-level source fingerprint (_ensure_predecoded and the like) —
+    # callers re-staging over an existing shard should keep one.
+    np.save(out_path + LABELS_SUFFIX + ".tmp.npy", labels)
+    with open(out_path + META_SUFFIX + ".tmp", "w") as f:
         json.dump({"image_size": image_size, "n": len(ss)}, f)
-    os.replace(out_path + ".tmp", out_path)  # records land last: a crashed
-    # predecode leaves no half-valid shard behind
+    os.replace(out_path + ".tmp", out_path)
+    os.replace(out_path + LABELS_SUFFIX + ".tmp.npy", out_path + LABELS_SUFFIX)
+    os.replace(out_path + META_SUFFIX + ".tmp", out_path + META_SUFFIX)
     return out_path
 
 
@@ -144,7 +154,7 @@ class PredecodedShardSet:
                               shard_sizes=self.shard_sizes)
         object.__setattr__(self, "_inner", inner)
         labels = []
-        for p in self.paths:
+        for i, p in enumerate(self.paths):
             lp = p + LABELS_SUFFIX
             if not os.path.exists(lp):
                 # refusing beats silently training against label 0 for every
@@ -153,7 +163,16 @@ class PredecodedShardSet:
                 raise FileNotFoundError(
                     f"{p}: labels sidecar {lp} is missing — re-run "
                     f"predecode_wds (records and labels are written together)")
-            labels.append(np.load(lp).astype(np.int32))
+            arr = np.load(lp).astype(np.int32)
+            n_records = inner.records_in_shard(i)
+            if len(arr) != n_records:
+                # catches a predecode interrupted between the records rename
+                # and the sidecar renames (new records, stale labels)
+                raise ValueError(
+                    f"{p}: labels sidecar has {len(arr)} entries but the "
+                    f"records file holds {n_records} records — sidecars are "
+                    f"stale; re-run predecode_wds")
+            labels.append(arr)
         object.__setattr__(self, "_labels", np.concatenate(labels)
                            if labels else np.zeros(0, np.int32))
 

@@ -15,6 +15,7 @@ The C++ engine carries its own copy of this logic (strom_core.cpp
 from __future__ import annotations
 
 import ctypes
+import errno
 import mmap
 import os
 
@@ -53,17 +54,26 @@ def cached_pages(fd: int, offset: int, length: int) -> tuple[int, int] | None:
     if _probe_state <= 1:
         r = _CachestatRange(offset, length)
         cs = _Cachestat()
-        rc = _libc.syscall(_NR_CACHESTAT, fd, ctypes.byref(r),
-                           ctypes.byref(cs), 0)
-        if rc == 0:
-            _probe_state = 1
-            return (int(cs.nr_cache), npages)
+        err = 0
+        for _ in range(3):  # EINTR/EAGAIN are retryable, not a verdict on
+            ctypes.set_errno(0)  # whether the syscall exists
+            rc = _libc.syscall(_NR_CACHESTAT, fd, ctypes.byref(r),
+                               ctypes.byref(cs), 0)
+            if rc == 0:
+                _probe_state = 1
+                return (int(cs.nr_cache), npages)
+            err = ctypes.get_errno()
+            if err not in (errno.EINTR, errno.EAGAIN):
+                break
         if _probe_state == 1:
             return None  # transient failure on a probe that was working
-        # first failure, whatever the errno (ENOSYS on pre-6.5 kernels,
-        # EPERM under seccomp profiles that deny unknown syscalls, ...):
-        # demote to mincore, which exists everywhere
-        _probe_state = 2
+        if err in (errno.ENOSYS, errno.EPERM):
+            # the syscall genuinely isn't available (pre-6.5 kernel, or a
+            # seccomp profile denying unknown syscalls): demote permanently
+            # to mincore, which exists everywhere
+            _probe_state = 2
+        # any other first-call failure: fall through to mincore for THIS
+        # call but leave the state untried so cachestat gets another chance
     # mincore fallback on transient mappings via raw libc (the fd is
     # O_RDONLY, so the mapping is PROT_READ and ctypes' from_buffer refuses
     # it — we need the raw address anyway); mincore never faults pages in.

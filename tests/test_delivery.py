@@ -122,6 +122,59 @@ def test_striped_sharded(ctx, tmp_path, rng):
     np.testing.assert_array_equal(np.asarray(arr), logical.reshape(shape))
 
 
+def test_ssd2host_plain(ctx, data_file):
+    """memcpy_ssd2host: the delivered path stopped at the device_put
+    boundary — returns the bytes zero-copy in a host array."""
+    path, data = data_file
+    arr = ctx.memcpy_ssd2host(path, length=len(data) // 2 * 2)
+    assert isinstance(arr, np.ndarray)
+    np.testing.assert_array_equal(arr, data[: len(data) // 2 * 2])
+    # shaped/dtype/offset forms match the ssd2tpu semantics
+    arr = ctx.memcpy_ssd2host(path, shape=(512, 128), dtype=np.float32)
+    np.testing.assert_array_equal(
+        arr, data[: 512 * 128 * 4].view(np.float32).reshape(512, 128))
+    arr = ctx.memcpy_ssd2host(path, offset=12345, length=4096)
+    np.testing.assert_array_equal(arr, data[12345:12345 + 4096])
+
+
+def test_ssd2host_out_buffer(ctx, data_file):
+    """out=: the caller's preallocated (registrable) dest IS the returned
+    array — zero-copy all the way, like the raw bench arm."""
+    from strom.delivery.buffers import alloc_aligned, buf_addr
+
+    path, data = data_file
+    n = 1 << 20
+    dest = alloc_aligned(n)
+    ctx.engine.register_dest(dest)
+    arr = ctx.memcpy_ssd2host(path, length=n, out=dest)
+    assert buf_addr(arr) == buf_addr(dest)  # same memory, no bounce
+    np.testing.assert_array_equal(arr, data[:n])
+    # too-small out refuses instead of short-reading
+    with pytest.raises(ValueError, match="holds"):
+        ctx.memcpy_ssd2host(path, length=n, out=alloc_aligned(n // 2))
+    # strided out refuses instead of silently reading into a hidden copy
+    with pytest.raises(ValueError, match="contiguous"):
+        ctx.memcpy_ssd2host(path, length=n, out=alloc_aligned(2 * n)[::2])
+
+
+def test_ssd2host_striped_alias(ctx, tmp_path, rng):
+    """The host path rides striped-alias resolution like the device path."""
+    n, chunk = 2, 4096
+    logical = rng.integers(0, 256, size=n * chunk * 4, dtype=np.uint8)
+    members = []
+    for m in range(n):
+        mdata = bytearray()
+        for ci in range(m, len(logical) // chunk, n):
+            mdata.extend(logical[ci * chunk:(ci + 1) * chunk])
+        p = tmp_path / f"hm{m}.bin"
+        p.write_bytes(bytes(mdata))
+        members.append(str(p))
+    virt = str(tmp_path / "host.raid0")
+    ctx.register_striped(virt, members, chunk)
+    arr = ctx.memcpy_ssd2host(virt)
+    np.testing.assert_array_equal(arr, logical)
+
+
 def test_short_file_raises(ctx, data_file):
     path, data = data_file
     with pytest.raises(Exception):

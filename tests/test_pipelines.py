@@ -514,6 +514,32 @@ class TestPredecodedPipeline:
                 outs.append(np.asarray(next(pipe)[0]))
         np.testing.assert_array_equal(outs[0], outs[1])
 
+    def test_stale_labels_sidecar_rejected(self, ctx, pdec_shard, tmp_path):
+        """A predecode interrupted between the records rename and the
+        sidecar renames leaves new records with OLD sidecars (sidecars land
+        strictly after records — ADVICE.md r3 #1): the loader must refuse
+        the count mismatch, not silently mislabel every sample."""
+        import shutil
+
+        from strom.formats.predecoded import (LABELS_SUFFIX, META_SUFFIX,
+                                              PredecodedShardSet)
+
+        clone = str(tmp_path / "stale.pdec")
+        shutil.copyfile(pdec_shard, clone)
+        shutil.copyfile(pdec_shard + META_SUFFIX, clone + META_SUFFIX)
+        np.save(clone + LABELS_SUFFIX + ".tmp.npy",
+                np.zeros(7, np.int32))  # wrong count = stale generation
+        os.replace(clone + LABELS_SUFFIX + ".tmp.npy", clone + LABELS_SUFFIX)
+        with pytest.raises(ValueError, match="stale"):
+            PredecodedShardSet((clone,), 32)
+
+    def test_predecode_leaves_no_tmp_files(self, pdec_shard):
+        """The atomic-staging protocol cleans up: no .tmp leftovers beside
+        the shard after a successful predecode."""
+        d = os.path.dirname(pdec_shard)
+        leftovers = [f for f in os.listdir(d) if ".tmp" in f]
+        assert leftovers == []
+
     def test_rejects_inner_dim_sharding(self, ctx, pdec_shard):
         from strom.parallel.mesh import make_mesh
         from strom.pipelines import make_predecoded_vision_pipeline
