@@ -144,9 +144,19 @@ struct sc_stats {
   uint32_t sqpoll_wakeup_errno;  // last fatal SQ_WAKEUP errno (0 = none)
   // residency-hybrid accounting for the vectored gather path: bytes served
   // through the page cache because the range was RESIDENT (cached_bytes) vs
-  // bytes read from media O_DIRECT (media_bytes)
+  // bytes read from media O_DIRECT (media_bytes). ADVISORY under memory
+  // pressure (ADVICE.md r3 #5): residency is snapshotted upfront per gather
+  // (anti-readahead-cascade), so pages evicted between the probe and the
+  // buffered read still count as cached_bytes — the counters describe the
+  // ROUTE chosen, not a guarantee of where the bytes were ultimately
+  // served from. Data integrity is unaffected either way.
   uint64_t cached_bytes;
   uint64_t media_bytes;
+  // resident_pages() probe syscalls issued (cachestat/mincore): watches for
+  // the pathological mixed-segment case where per-chunk bitmap probing
+  // would otherwise be invisible (VERDICT.md r3 weak #5; bounded to <=
+  // kMaxResidencyProbes groups per segment)
+  uint64_t residency_probes;
 };
 
 struct sc_engine {
@@ -237,6 +247,7 @@ struct sc_engine {
   // cache) instead of re-reading them from media O_DIRECT
   bool residency_hybrid = false;
   std::atomic<uint64_t> cached_bytes{0}, media_bytes{0};
+  std::atomic<uint64_t> residency_probes{0};
 };
 
 // ---- page-cache residency probe (hybrid read path) -------------------------
@@ -274,14 +285,25 @@ static int64_t resident_pages(int fd, uint64_t off, uint64_t len,
     sc_cachestat_range r{off, len};
     sc_cachestat cs;
     memset(&cs, 0, sizeof(cs));
-    if (syscall(__NR_cachestat, fd, &r, &cs, 0) == 0) {
-      if (probe == 0) g_residency_probe.store(1, std::memory_order_relaxed);
-      return (int64_t)cs.nr_cache;
+    int err = 0;
+    for (int attempt = 0; attempt < 3; ++attempt) {
+      // EINTR/EAGAIN are retryable, not a verdict on whether the syscall
+      // exists (ADVICE.md r3 #2, mirrored in probe/residency.py)
+      if (syscall(__NR_cachestat, fd, &r, &cs, 0) == 0) {
+        if (probe == 0) g_residency_probe.store(1, std::memory_order_relaxed);
+        return (int64_t)cs.nr_cache;
+      }
+      err = errno;
+      if (err != EINTR && err != EAGAIN) break;
     }
     if (probe == 1) return -1;  // transient failure on a working probe
-    // first failure, whatever the errno (ENOSYS pre-6.5, EPERM under
-    // syscall-denying seccomp profiles): demote to mincore permanently
-    g_residency_probe.store(2, std::memory_order_relaxed);
+    if (err == ENOSYS || err == EPERM) {
+      // the syscall genuinely isn't available (pre-6.5 kernel, or a
+      // syscall-denying seccomp profile): demote to mincore permanently
+      g_residency_probe.store(2, std::memory_order_relaxed);
+    }
+    // any other first-call failure: fall through to mincore for THIS call
+    // but leave the state untried so cachestat gets another chance
   }
   void *m = mmap(nullptr, (size_t)(end - start), PROT_READ, MAP_SHARED, fd,
                  (off_t)start);
@@ -1130,24 +1152,39 @@ int64_t sc_read_vectored(sc_engine *e, const sc_vec_seg *segs, uint64_t n_segs,
       seg_oa[i] = oa ? oa : 1;
       seg_ma[i] = ma ? ma : 1;
       if (!e->residency_hybrid || !od || fdb < 0 || s.length == 0) continue;
+      uint64_t probes = 1;
       uint64_t tot = 0;
       int64_t res = resident_pages(fdb, s.offset, s.length, &tot);
-      if (res <= 0) continue;  // cold or unprobeable: direct
-      if ((uint64_t)res >= tot) {
-        seg_state[i] = 1;
+      if (res <= 0 || (uint64_t)res >= tot) {
+        e->residency_probes.fetch_add(probes, std::memory_order_relaxed);
+        if (res > 0) seg_state[i] = 1;  // fully warm; else cold/unprobeable
         continue;
       }
+      // Mixed segment: per-chunk warm bitmap, probed in GROUPS so the probe
+      // count stays bounded regardless of segment size (VERDICT.md r3 weak
+      // #5: per-block_size probing of a multi-GiB half-warm segment is ~8k
+      // syscalls/GiB — and mmap/munmap pairs in mincore mode). At most
+      // kMaxResidencyProbes groups per segment; a group is routed warm only
+      // when FULLY resident, so coarser probing can only send warm bytes to
+      // media (correct either way), never cold bytes to the cache path.
+      constexpr uint64_t kMaxResidencyProbes = 256;
       uint64_t nch = (s.length + block_size - 1) / block_size;
+      uint64_t group = (nch + kMaxResidencyProbes - 1) / kMaxResidencyProbes;
       std::vector<uint8_t> &bm = seg_chunk_warm[i];
       bm.assign(nch, 0);
-      for (uint64_t ci = 0; ci < nch; ++ci) {
-        uint64_t coff = s.offset + ci * block_size;
-        uint64_t remain = s.length - ci * block_size;
-        uint32_t take = remain < block_size ? (uint32_t)remain : block_size;
+      for (uint64_t g0 = 0; g0 < nch; g0 += group) {
+        uint64_t coff = s.offset + g0 * block_size;
+        uint64_t remain = s.length - g0 * block_size;
+        uint64_t glen = group * block_size;
+        if (glen > remain) glen = remain;
         uint64_t t2 = 0;
-        int64_t r2 = resident_pages(fdb, coff, take, &t2);
-        bm[ci] = (r2 >= 0 && (uint64_t)r2 >= t2) ? 1 : 0;
+        ++probes;
+        int64_t r2 = resident_pages(fdb, coff, glen, &t2);
+        uint8_t warm = (r2 >= 0 && (uint64_t)r2 >= t2) ? 1 : 0;
+        uint64_t gend = g0 + group < nch ? g0 + group : nch;
+        for (uint64_t ci = g0; ci < gend; ++ci) bm[ci] = warm;
       }
+      e->residency_probes.fetch_add(probes, std::memory_order_relaxed);
       seg_state[i] = 2;
     }
   }
@@ -1419,6 +1456,7 @@ void sc_get_stats(sc_engine *e, sc_stats *s) {
       e->sqpoll_wakeup_errno.load(std::memory_order_relaxed);
   s->cached_bytes = e->cached_bytes.load(std::memory_order_relaxed);
   s->media_bytes = e->media_bytes.load(std::memory_order_relaxed);
+  s->residency_probes = e->residency_probes.load(std::memory_order_relaxed);
 }
 
 }  // extern "C"

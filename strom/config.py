@@ -115,8 +115,13 @@ class StromConfig:
     # (cachestat(2), else mincore) and serve WARM ranges through the buffered
     # fd — a memcpy from the cache — instead of re-reading them from media
     # O_DIRECT (SURVEY.md §0.5 mechanism #5, §2.1 "Page-cache fallback").
-    # Cold ranges are unchanged: one probe syscall per gather segment.
-    # Observable via the cached_bytes / media_bytes engine counters.
+    # Cold ranges are unchanged: one probe syscall per gather segment; mixed
+    # segments probe in groups bounded at 256 per segment (the
+    # residency_probes counter watches the probe volume). Observable via the
+    # cached_bytes / media_bytes engine counters — ADVISORY under memory
+    # pressure: residency is snapshotted upfront per gather, so pages
+    # evicted between probe and read still count as cached_bytes (the route
+    # chosen, not where bytes were ultimately served; integrity unaffected).
     residency_hybrid: bool = True
 
     # RAID0 (software striped reader over N member files/devices)

@@ -228,7 +228,8 @@ class MultiRingEngine(Engine):
         for key in ("ops_submitted", "ops_completed", "ops_errored",
                     "ops_faulted", "bytes_read", "unaligned_fallback_reads",
                     "eof_topup_reads", "chunk_retries", "ops_fixed",
-                    "cached_bytes", "media_bytes", "in_flight"):
+                    "cached_bytes", "media_bytes", "residency_probes",
+                    "in_flight"):
             out[key] = sum(int(s.get(key, 0)) for s in per_ring)
         # feature flags: children share one config, ring 0 speaks for all
         for key in ("fixed_buffers", "fixed_files", "mlocked", "coop_taskrun",

@@ -195,10 +195,17 @@ class PythonEngine(Engine):
         # referenced by self to avoid invalidating them until GC.
 
     # -- vectored gather: snapshot residency upfront ------------------------
+    # bound on residency probes per MIXED chunk of a gather: per-block_size
+    # probing of a multi-GiB half-warm range is ~8k syscalls/GiB (VERDICT.md
+    # r3 weak #5). Pieces are probed in groups of ceil(n/256); a group is
+    # warm only when FULLY resident, so coarser probing can only route warm
+    # bytes to media (correct either way), never cold bytes to the cache.
+    MAX_RESIDENCY_PROBES = 256
+
     def _snapshot_residency(self, chunks) -> dict[tuple[int, int], bool] | None:
         """{(file_index, block_offset): warm} for every block_size piece the
         gather will submit, probed BEFORE any read runs. One probe per
-        fully-warm/fully-cold chunk; per-piece probes only for mixed ones."""
+        fully-warm/fully-cold chunk; bounded group probes for mixed ones."""
         if not self.config.residency_hybrid:
             return None
         block = self.config.block_size
@@ -207,17 +214,29 @@ class PythonEngine(Engine):
             f = self._files.get(fi)
             if f is None or not f.o_direct or ln <= 0:
                 continue
+            self._stats.add("residency_probes")
             r = cached_pages(f.fd_buffered, fo, ln)
             if r is None:
                 continue  # unprobeable: worker falls back to a lazy probe
             res, tot = r
-            # explicit False for cold pieces too — an absent key would make
-            # the worker probe lazily, after readahead may have warmed it
-            state = True if res >= tot else (False if res == 0 else None)
-            for p in range(0, ln, block):
-                m[(fi, fo + p)] = state if state is not None else \
-                    range_fully_cached(f.fd_buffered, fo + p,
-                                       min(block, ln - p)) is True
+            if res >= tot or res == 0:
+                # explicit False for cold pieces too — an absent key would
+                # make the worker probe lazily, after readahead may have
+                # warmed it
+                state = res >= tot
+                for p in range(0, ln, block):
+                    m[(fi, fo + p)] = state
+                continue
+            npieces = (ln + block - 1) // block
+            group = (npieces + self.MAX_RESIDENCY_PROBES - 1) \
+                // self.MAX_RESIDENCY_PROBES
+            for g0 in range(0, npieces, group):
+                goff = fo + g0 * block
+                glen = min(group * block, ln - g0 * block)
+                self._stats.add("residency_probes")
+                warm = range_fully_cached(f.fd_buffered, goff, glen) is True
+                for ci in range(g0, min(g0 + group, npieces)):
+                    m[(fi, fo + ci * block)] = warm
         return m
 
     def read_vectored(self, chunks, dest, *, retries: int = 1) -> int:
@@ -272,9 +291,11 @@ class PythonEngine(Engine):
                 wm = self._warm_map
                 hint = None if wm is None else \
                     wm.get((req.file_index, req.offset))
-                warm = hint if hint is not None else \
-                    range_fully_cached(f.fd_buffered, req.offset,
-                                       req.length) is True
+                if hint is None:
+                    self._stats.add("residency_probes")
+                    hint = range_fully_cached(f.fd_buffered, req.offset,
+                                              req.length) is True
+                warm = hint
             direct = f.o_direct and aligned and not warm
             fd = f.fd if direct else f.fd_buffered
             if f.o_direct and not aligned:

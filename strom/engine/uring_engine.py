@@ -53,6 +53,7 @@ class _ScStats(ctypes.Structure):
         ("sqpoll_wakeup_errno", ctypes.c_uint32),
         ("cached_bytes", ctypes.c_uint64),
         ("media_bytes", ctypes.c_uint64),
+        ("residency_probes", ctypes.c_uint64),
     ]
 
 
@@ -427,8 +428,13 @@ class UringEngine(Engine):
             "coop_taskrun": bool(s.coop_taskrun),
             "sqpoll": bool(s.sqpoll),
             "sqpoll_wakeup_errno": int(s.sqpoll_wakeup_errno),
+            # cached/media are ADVISORY under memory pressure: residency is
+            # snapshotted upfront per gather, so pages evicted before the
+            # buffered read still count as cached_bytes (route chosen, not
+            # where bytes were ultimately served — ADVICE.md r3 #5)
             "cached_bytes": int(s.cached_bytes),
             "media_bytes": int(s.media_bytes),
+            "residency_probes": int(s.residency_probes),
             "sparse_table": bool(s.sparse_table),
             "ext_buffers": int(s.ext_buffers),
             "ops_fixed": int(s.ops_fixed),

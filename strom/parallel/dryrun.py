@@ -9,6 +9,14 @@ import tempfile
 import numpy as np
 
 
+def _engine_desc(ctx) -> str:
+    """Engine identity line for the dryrun tail (VERDICT.md r3 next #3: the
+    virtual-mesh matrix must say which engine each config exercised)."""
+    eng = ctx.engine
+    rings = getattr(eng, "num_rings", None)
+    return f"{eng.name}(rings={rings})" if rings is not None else eng.name
+
+
 def run_dryrun(n_devices: int) -> None:
     import jax
 
@@ -44,8 +52,13 @@ def run_dryrun(n_devices: int) -> None:
     with tempfile.TemporaryDirectory() as td:
         path = os.path.join(td, "tokens.bin")
         tokens_host.tofile(path)
-        ctx = StromContext(StromConfig(engine="python", queue_depth=8, num_buffers=8))
+        # flagship config rides the PRODUCTION engine (engine="auto": the
+        # C++ io_uring engine when it initializes, else the Python fallback
+        # — VERDICT.md r3 next #3): the virtual-mesh correctness matrix must
+        # exercise the same data path the benches run
+        ctx = StromContext(StromConfig(engine="auto", queue_depth=8, num_buffers=8))
         try:
+            eng_desc = _engine_desc(ctx)
             batch = ctx.memcpy_ssd2tpu(
                 path, shape=(B, S + 1), dtype=np.int32,
                 sharding=NamedSharding(mesh, P("dp", None)))
@@ -55,7 +68,8 @@ def run_dryrun(n_devices: int) -> None:
             ctx.close()
     assert np.isfinite(loss), f"non-finite loss {loss}"
     assert int(state.step) == 1
-    print(f"dryrun ok: mesh={axes}, devices={n_devices}, loss={loss:.4f}")
+    print(f"dryrun ok: mesh={axes}, devices={n_devices}, loss={loss:.4f}, "
+          f"engine={eng_desc}")
 
     # Long-context path: dp×sp mesh, sequence-sharded batch, ring attention
     if n_devices >= 2 and n_devices % 2 == 0:
@@ -140,19 +154,36 @@ def run_dryrun(n_devices: int) -> None:
         with tempfile.TemporaryDirectory() as td:
             path = os.path.join(td, "pp_tokens.bin")
             tokens_host.tofile(path)
-            ctx = StromContext(StromConfig(engine="python", queue_depth=8,
-                                           num_buffers=8))
+            # this config rides the MULTI-RING production path (VERDICT.md
+            # r3 next #3): engine="auto" + engine_rings=2, tokens striped
+            # RAID0 over two members so the per-file ring fan-out actually
+            # engages (member i → ring i mod N) under sharded delivery
+            from strom.engine.raid0 import stripe_file
+
+            members = [os.path.join(td, f"pp_m{i}.bin") for i in range(2)]
+            stripe_file(path, members, 1024)
+            ctx = StromContext(StromConfig(engine="auto", engine_rings=2,
+                                           queue_depth=8, num_buffers=8))
             try:
+                eng_desc = _engine_desc(ctx)
+                virt = path + ".raid0"
+                ctx.register_striped(virt, members, 1024,
+                                     size=os.path.getsize(path))
                 batch = ctx.memcpy_ssd2tpu(
-                    path, shape=(B, 65), dtype=np.int32,
+                    virt, shape=(B, 65), dtype=np.int32,
                     sharding=NamedSharding(pp_mesh, P("dp", None)))
                 state, metrics = pp_step(state, batch)
                 pp_loss = float(metrics["loss"])
+                ring_stats = ctx.engine.stats().get("ring_stats")
+                if ring_stats is not None:
+                    traffic = [int(r.get("bytes_read", 0)) for r in ring_stats]
+                    assert all(t > 0 for t in traffic), \
+                        f"a ring carried no bytes: {traffic}"
             finally:
                 ctx.close()
         assert np.isfinite(pp_loss), f"non-finite pp loss {pp_loss}"
         print(f"dryrun ok: mesh={pp_axes} (pipeline parallelism), "
-              f"loss={pp_loss:.4f}")
+              f"loss={pp_loss:.4f}, engine={eng_desc}")
 
     # Full 3-axis composition with the pipe: dp×tp×pp — manual-collective
     # Megatron blocks inside each stage, microbatches over ppermute
@@ -175,6 +206,7 @@ def run_dryrun(n_devices: int) -> None:
             ctx = StromContext(StromConfig(engine="python", queue_depth=8,
                                            num_buffers=8))
             try:
+                eng_desc = _engine_desc(ctx)
                 tokens = ctx.memcpy_ssd2tpu(
                     path, shape=(B, 64), dtype=np.int32,
                     sharding=NamedSharding(mesh_tpp, P("dp", None)))
@@ -184,7 +216,7 @@ def run_dryrun(n_devices: int) -> None:
         tpp_loss = float(metrics["loss"])
         assert np.isfinite(tpp_loss), f"non-finite dp×tp×pp loss {tpp_loss}"
         print(f"dryrun ok: mesh={axes_tpp} (dp×tp×pp pipeline), "
-              f"loss={tpp_loss:.4f}")
+              f"loss={tpp_loss:.4f}, engine={eng_desc}")
 
     # Deepest composition: tp×sp×pp in ONE step — manual-tp Megatron blocks,
     # ring×flash attention over sp inside every pipeline stage
@@ -207,6 +239,7 @@ def run_dryrun(n_devices: int) -> None:
                 ctx = StromContext(StromConfig(engine="python",
                                                queue_depth=8, num_buffers=8))
                 try:
+                    eng_desc = _engine_desc(ctx)
                     tokens = ctx.memcpy_ssd2tpu(
                         path, shape=(4, 64), dtype=np.int32,
                         sharding=NamedSharding(mesh4, P(None, "sp")))
@@ -216,7 +249,7 @@ def run_dryrun(n_devices: int) -> None:
             loss4 = float(metrics["loss"])
             assert np.isfinite(loss4), f"non-finite tp×sp×pp loss {loss4}"
             print(f"dryrun ok: mesh={axes4} (tp×sp×pp, flash ring in-pipe), "
-                  f"loss={loss4:.4f}")
+                  f"loss={loss4:.4f}, engine={eng_desc}")
 
     # Composed 3-axis mesh: dp×tp×sp — ring×flash attention over sp with
     # tp-sharded heads (n_kv_heads divides tp) and dp-sharded batch, all in
@@ -234,3 +267,45 @@ def run_dryrun(n_devices: int) -> None:
         loss3 = float(metrics["loss"])
         assert np.isfinite(loss3), f"non-finite 3-axis loss {loss3}"
         print(f"dryrun ok: mesh={axes3} (dp×tp×sp ring×flash), loss={loss3:.4f}")
+
+    # Llama-3-8B at its REAL shape (BASELINE.json:10 names Llama-3-8B; every
+    # executed config above runs tiny shapes — VERDICT.md r3 next #7): lower
+    # the full sharded train step on the virtual mesh. Lowering only — no
+    # execution, no 16GiB of parameters materialized: the state is abstract
+    # ShapeDtypeStructs carrying the real dp×tp×sp shardings.
+    if n_devices >= 8 and n_devices % 4 == 0:
+        from functools import partial
+
+        from strom.models.llama import init_params
+        from strom.parallel.sharding import param_shardings
+        from strom.parallel.train import TrainState
+
+        cfg8 = LlamaConfig.llama3_8b()
+        n_params = cfg8.param_count()
+        assert n_params == 8_030_261_248, n_params  # the 8B family size
+        mesh8 = make_mesh({"dp": n_devices // 4, "tp": 2, "sp": 2},
+                          devices=devs)
+        shapes = jax.eval_shape(partial(init_params, cfg=cfg8),
+                                jax.random.key(0))
+        shardings8 = param_shardings(shapes, mesh8)
+        # spot-check the Megatron column/row pairs landed on tp at 8B shapes
+        wq_spec = shardings8["layers"]["wq"].spec
+        wo_spec = shardings8["layers"]["wo"].spec
+        assert "tp" in wq_spec and "tp" in wo_spec, (wq_spec, wo_spec)
+        assert wq_spec.index("tp") == 2 and wo_spec.index("tp") == 1, \
+            "column-parallel wq must split its output dim, row-parallel wo its input dim"
+        params_s = jax.tree.map(
+            lambda s, sh: jax.ShapeDtypeStruct(s.shape, s.dtype, sharding=sh),
+            shapes, shardings8)
+        opt_s = jax.eval_shape(optimizer.init, params_s)
+        state_s = TrainState(params=params_s, opt_state=opt_s,
+                             step=jax.ShapeDtypeStruct((), jnp.int32))
+        step8 = make_train_step(cfg8, mesh8, optimizer, attn="flash", sp=True)
+        toks_s = jax.ShapeDtypeStruct(
+            (2 * (n_devices // 4), 4096), jnp.int32,
+            sharding=NamedSharding(mesh8, P("dp", "sp")))
+        lowered = step8.lower(state_s, toks_s)
+        assert lowered.as_text()  # the HLO exists; compilation is the pods' job
+        print(f"dryrun ok: Llama-3-8B real shape lowered on "
+              f"{dict(dp=n_devices // 4, tp=2, sp=2)} "
+              f"(params={n_params:,}, seq=4096, ring×flash, lowering only)")

@@ -49,15 +49,22 @@ def assign_balanced(sizes: Sequence[int], n_bins: int) -> list[list[int]]:
     Returns n_bins lists of unit indices; each list preserves ascending index
     order (deterministic iteration within a host).
     """
+    import heapq
+
     if n_bins <= 0:
         raise ValueError("n_bins must be positive")
     order = sorted(range(len(sizes)), key=lambda i: (-sizes[i], i))
-    loads = [0] * n_bins
     bins: list[list[int]] = [[] for _ in range(n_bins)]
+    # (load, bin) heap: O(n log b) instead of the naive O(n*b) lightest-bin
+    # scan — at pod shape (256 bins, 10k+ units, VERDICT.md r3 next #5) the
+    # naive scan is ~2.6M comparisons on the coordinator-free hot path every
+    # process runs at every scan. Tie-break on bin index, identical to the
+    # sequential scan's ordering, so assignments are unchanged.
+    heap = [(0, j) for j in range(n_bins)]  # already a valid heap
     for i in order:
-        b = min(range(n_bins), key=lambda j: (loads[j], j))
+        load, b = heapq.heappop(heap)
         bins[b].append(i)
-        loads[b] += sizes[i]
+        heapq.heappush(heap, (load + sizes[i], b))
     for b in bins:
         b.sort()
     return bins

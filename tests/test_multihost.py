@@ -109,6 +109,102 @@ def test_assign_balanced_rejects_bad_bins():
         assign_balanced([1, 2], 0)
 
 
+def test_assign_balanced_pod_scale():
+    """Pod shape (VERDICT.md r3 next #5): 256 bins (v5p-256 hosts), 10,000
+    skewed units. The heap-based LPT must stay fast enough to run on every
+    process at every scan with no coordination, and the makespan must be
+    near-ideal — the balance claim at the scale BASELINE.json:11 names, not
+    just at the 8-process integration size."""
+    import time
+
+    rng = np.random.default_rng(7)
+    # log-normal: heavy-tailed like real compressed column-chunk sizes
+    sizes = (np.exp(rng.normal(0, 1.0, 10_000)) * 1e6).astype(np.int64)
+    t0 = time.perf_counter()
+    bins = assign_balanced([int(s) for s in sizes], 256)
+    dt = time.perf_counter() - t0
+    assert sorted(i for b in bins for i in b) == list(range(10_000))
+    loads = np.array([sum(int(sizes[i]) for i in b) for b in bins])
+    ideal = sizes.sum() / 256
+    # LPT guarantees 4/3 OPT; with 10k units over 256 bins it is far tighter
+    assert loads.max() / ideal < 1.01, loads.max() / ideal
+    # runtime bound: a second on a 1-core CI box, milliseconds on real hosts
+    # (measured 25ms here; the pre-heap O(n*b) scan measured 466ms)
+    assert dt < 1.0, f"assign_balanced took {dt:.2f}s at pod scale"
+
+
+def test_assign_balanced_heap_matches_naive():
+    """The heap LPT (O(n log b)) must produce EXACTLY the assignment of the
+    reference lightest-bin scan it replaced — determinism across processes
+    is load-bearing (every process computes its own copy)."""
+    rng = np.random.default_rng(3)
+    sizes = [int(s) for s in rng.integers(1, 10_000, 500)]
+    n_bins = 13
+
+    def naive(sizes, n_bins):
+        order = sorted(range(len(sizes)), key=lambda i: (-sizes[i], i))
+        loads = [0] * n_bins
+        bins = [[] for _ in range(n_bins)]
+        for i in order:
+            b = min(range(n_bins), key=lambda j: (loads[j], j))
+            bins[b].append(i)
+            loads[b] += sizes[i]
+        return [sorted(b) for b in bins]
+
+    assert assign_balanced(sizes, n_bins) == naive(sizes, n_bins)
+
+
+def test_mesh_reducer_cache_reused_across_scans():
+    """Repeated scans share ONE jitted all-reduce per mesh: equal meshes
+    must hit the reducer cache (a per-scan recompile at v5p-256 would put
+    an XLA compile on every scan's critical path — VERDICT.md r3 next #5)."""
+    import jax
+
+    from strom.pipelines.parquet_scan import _mesh_reducer, _reducer_cache
+
+    devs = np.asarray(jax.devices())
+    m1 = jax.sharding.Mesh(devs, ("scan",))
+    m2 = jax.sharding.Mesh(devs, ("scan",))  # fresh but equal object
+    assert m1 == m2 and hash(m1) == hash(m2)
+    before = len(_reducer_cache)
+    f1 = _mesh_reducer(m1)
+    f2 = _mesh_reducer(m2)
+    assert f1 is f2
+    assert len(_reducer_cache) <= before + 1
+    # and the cached reducer is actually correct
+    out = np.asarray(f1(np.arange(8, dtype=np.int32)[:, None]))
+    assert out.ravel().tolist() == [28]
+
+
+def test_repeated_scans_share_reducer(tmp_path):
+    """Two parquet_count_where calls over the same devices add at most one
+    reducer-cache entry total (the second scan reuses the first's)."""
+    pa = pytest.importorskip("pyarrow")
+    import pyarrow.parquet as pq
+
+    from strom.config import StromConfig
+    from strom.delivery.core import StromContext
+    from strom.pipelines.parquet_scan import _reducer_cache, parquet_count_where
+
+    values = np.random.default_rng(5).standard_normal(2_000)
+    path = str(tmp_path / "cache.parquet")
+    pq.write_table(pa.table({"value": values}), path, row_group_size=500)
+    ctx = StromContext(StromConfig(engine="python", queue_depth=8,
+                                   num_buffers=8))
+    try:
+        truth = int((values > 0).sum())
+        n0 = len(_reducer_cache)
+        assert parquet_count_where(ctx, [path], "value",
+                                   lambda v: v > 0) == truth
+        n1 = len(_reducer_cache)
+        assert parquet_count_where(ctx, [path], "value",
+                                   lambda v: v > 0) == truth
+        assert len(_reducer_cache) == n1  # second scan added nothing
+        assert n1 <= n0 + 1
+    finally:
+        ctx.close()
+
+
 def test_straggler_monitor_single_process():
     m = StragglerMonitor()
     for t in (0.01, 0.02, 0.03):
@@ -172,12 +268,14 @@ def test_parquet_scan_uses_balanced_assignment(tmp_path):
 
 
 @pytest.mark.slow
-def test_8proc_parquet_scan_fanout(tmp_path):
-    """8 single-device processes scan one Parquet file: LPT unit assignment
-    covers every row group exactly once, and both reductions (the XLA
-    -collective scan-mesh sum and the allgather fallback) agree with the
-    locally-computed truth on every process. Scan-only — no TPU, CPU mesh
-    over localhost DCN (VERDICT.md r2 missing #4 / next #7)."""
+@pytest.mark.parametrize("nproc", [8, 16], ids=["8proc", "16proc"])
+def test_multiproc_parquet_scan_fanout(tmp_path, nproc):
+    """8 and 16 single-device processes scan one Parquet file: LPT unit
+    assignment covers every row group exactly once, and both reductions
+    (the XLA-collective scan-mesh sum and the allgather fallback) agree
+    with the locally-computed truth on every process. Scan-only — no TPU,
+    CPU mesh over localhost DCN (VERDICT.md r2 missing #4; the 16-process
+    arm is r3 next #5's scale step past the 8-process ceiling)."""
     pa = pytest.importorskip("pyarrow")
     import pyarrow.parquet as pq
 
@@ -185,10 +283,10 @@ def test_8proc_parquet_scan_fanout(tmp_path):
     values = rng.standard_normal(40_000)
     truth = int((values > 0).sum())
     path = str(tmp_path / "scan.parquet")
+    # 2 row groups per process so LPT has something to balance everywhere
     pq.write_table(pa.table({"value": values}), path,
-                   row_group_size=40_000 // 16)
+                   row_group_size=40_000 // (2 * nproc))
 
-    nproc = 8
     port = _free_port()
     repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     env = {k: v for k, v in os.environ.items()
@@ -206,7 +304,9 @@ def test_8proc_parquet_scan_fanout(tmp_path):
     outs = []
     for p in procs:
         try:
-            out, _ = p.communicate(timeout=420)
+            # 16 interpreters time-slice one core on this box: scale the
+            # budget with the process count (8-proc measured well under 420)
+            out, _ = p.communicate(timeout=420 if nproc <= 8 else 840)
         except subprocess.TimeoutExpired:
             for q in procs:
                 q.kill()
