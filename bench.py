@@ -368,7 +368,7 @@ def main() -> int:
             file=None, size=size, block=cfg.block_size, depth=32, iters=1,
             engine="auto", tmpdir=args.tmpdir, json=True, rows=2_000_000,
             row_groups=32, prefetch=2, unit_batch=4, raid=4,
-            raid_chunk=512 * 1024)
+            raid_chunk=512 * 1024, columns=1)
         pres = attempt("parquet", lambda: bench_parquet(pargs))
         if pres is not None:
             loader_res.update({
@@ -378,6 +378,31 @@ def main() -> int:
             print(f"parquet scan (raid{pargs.raid}, unit_batch "
                   f"{pargs.unit_batch}): {pres['rows_per_s']:.0f} rows/s, "
                   f"selected columns {pres['selected_gbps']:.3f} GB/s",
+                  file=sys.stderr)
+
+        # config #5, WIDE projection arm (VERDICT.md r3 weak #6: the
+        # narrow scan's 8B/row selection is too small for selected_gbps to
+        # mean anything): 16 float64 columns selected = 128B/row, the
+        # PG-Strom feature-vector shape — selected-column GB/s here IS scan
+        # bandwidth. cpu_device: through this box's relay the wide arm's
+        # device traffic rides the token bucket and would measure the
+        # throttle again (observed 0.026 GB/s = refill rate); the host
+        # backend keeps it on the scan machinery. Fewer rows keep the
+        # fixture and runtime modest.
+        pwargs = argparse.Namespace(**{**vars(pargs), "rows": 500_000,
+                                       "columns": 16, "raid": 0,
+                                       "cpu_device": True})
+        pwres = attempt("parquet WIDE", lambda: bench_parquet(pwargs))
+        if pwres is not None:
+            loader_res.update({
+                "parquet_wide_rows_per_s": pwres["rows_per_s"],
+                "parquet_wide_selected_gbps": pwres["selected_gbps"],
+                "parquet_wide_columns": pwres["selected_columns"],
+            })
+            print(f"parquet WIDE scan ({pwres['selected_columns']} cols, "
+                  f"{pwres['selected_bytes'] >> 20} MiB selected): "
+                  f"{pwres['rows_per_s']:.0f} rows/s, "
+                  f"{pwres['selected_gbps']:.3f} GB/s selected",
                   file=sys.stderr)
 
     # --- numerator: one streamed memcpy_ssd2tpu ----------------------------

@@ -687,22 +687,29 @@ def bench_parquet(args: argparse.Namespace) -> dict:
     from strom.delivery.core import StromContext
     from strom.pipelines.parquet_scan import parquet_count_where
 
+    n_cols = max(int(getattr(args, "columns", 1) or 1), 1)
     path = args.file
     if path is None:
         rows = args.rows
-        # keyed by BOTH knobs so a changed --row-groups regenerates it
-        path = os.path.join(
-            args.tmpdir, f"strom_bench_scan_{rows}_{args.row_groups}.parquet")
+        # keyed by EVERY generation knob so a changed flag regenerates it
+        key = f"{rows}_{args.row_groups}" + (f"_c{n_cols}" if n_cols > 1 else "")
+        path = os.path.join(args.tmpdir, f"strom_bench_scan_{key}.parquet")
         if not os.path.exists(path):
             rng = np.random.default_rng(0)
             # several columns so column pruning is actually exercised: the
-            # scan touches `value` only, the rest is dead weight on disk
-            table = pa.table({
+            # narrow scan touches `value` only, the rest is dead weight on
+            # disk. --columns N adds f0..f{N-2} float64 feature columns for
+            # the WIDE-projection arm (the PG-Strom shape that projects a
+            # feature vector per row), where selected bytes/row is large
+            # enough for selected_gbps to mean scan bandwidth
+            cols = {
                 "value": rng.standard_normal(rows),
                 "key": rng.integers(0, 1 << 30, rows, dtype=np.int64),
                 "payload": rng.integers(0, 256, rows, dtype=np.int64),
-            })
-            pq.write_table(table, path,
+            }
+            for i in range(n_cols - 1):
+                cols[f"f{i}"] = rng.standard_normal(rows)
+            pq.write_table(pa.table(cols), path,
                            row_group_size=max(rows // args.row_groups, 1),
                            compression="snappy")
             os.sync()
@@ -734,23 +741,69 @@ def bench_parquet(args: argparse.Namespace) -> dict:
         # bench reads through the same path the library scan does
         meta = ParquetShard(path, ctx=ctx).metadata
         n_rows = meta.num_rows
+        sel_cols = ["value"] + [f"f{i}" for i in range(n_cols - 1)]
+        present = {meta.row_group(0).column(i).path_in_schema
+                   for i in range(meta.num_columns)}
+        missing = [c for c in sel_cols if c not in present]
+        if missing:
+            # fail up front with the real cause: --columns names the
+            # generated fixture's schema (value, f0..fN-2) — a user --file
+            # without those columns would otherwise die mid-scan on an
+            # opaque pyarrow missing-column error after sel_bytes silently
+            # undercounted
+            raise SystemExit(
+                f"strom-bench parquet: --columns {n_cols} selects {sel_cols} "
+                f"but {path} lacks {missing}; --columns > 1 expects the "
+                f"generated fixture schema (omit --file or regenerate)")
         sel_bytes = sum(
             meta.row_group(g).column(i).total_compressed_size
             for g in range(meta.num_row_groups)
             for i in range(meta.num_columns)
-            if meta.row_group(g).column(i).path_in_schema == "value")
+            if meta.row_group(g).column(i).path_in_schema in sel_cols)
+
+        # --cpu-device: run the jitted aggregate on the host backend. On
+        # relay-throttled boxes the WIDE arm's device_put traffic (selected
+        # bytes × columns) rides the throttle and selected_gbps measures the
+        # relay, not the scan (BASELINE.md §C); the host backend keeps the
+        # measurement on the scan machinery itself — engine read, snappy
+        # decode, aggregate. The device leg is the bandwidth phase's job.
+        devs = None
+        if getattr(args, "cpu_device", False):
+            import jax
+
+            devs = jax.devices("cpu")
+        if n_cols == 1:
+            def scan() -> int:
+                return parquet_count_where(ctx, [path], "value",
+                                           lambda v: v > 0,
+                                           prefetch_depth=args.prefetch,
+                                           unit_batch=args.unit_batch,
+                                           devices=devs)
+        else:
+            # wide projection: every selected column moves disk -> device;
+            # the aggregate consumes them all so nothing is dead weight
+            from strom.pipelines.parquet_scan import parquet_scan_aggregate
+
+            def map_fn(d: dict):
+                import jax.numpy as jnp
+
+                return {"hits": jnp.sum((d["value"] > 0).astype(jnp.int32)),
+                        "fsum": sum(jnp.sum(d[c]) for c in sel_cols[1:])}
+
+            def scan() -> int:
+                res = parquet_scan_aggregate(ctx, [path], sel_cols, map_fn,
+                                             prefetch_depth=args.prefetch,
+                                             unit_batch=args.unit_batch,
+                                             devices=devs)
+                return int(res["hits"])
         # warmup pass: XLA compiles (body + tail shapes) outside the timed
         # region — house pattern of every bench here; matters doubly for the
         # --unit-batch A/B, which would otherwise partly measure compile count
-        parquet_count_where(ctx, [path], "value", lambda v: v > 0,
-                            prefetch_depth=args.prefetch,
-                            unit_batch=args.unit_batch)
+        scan()
         for p in (members if raid else [path]):
             _drop_cache_hint(p)
         t0 = time.perf_counter()
-        hits = parquet_count_where(ctx, [path], "value", lambda v: v > 0,
-                                   prefetch_depth=args.prefetch,
-                                   unit_batch=args.unit_batch)
+        hits = scan()
         dt = time.perf_counter() - t0
     finally:
         ctx.close()
@@ -760,6 +813,7 @@ def bench_parquet(args: argparse.Namespace) -> dict:
         "selected_gbps": round(sel_bytes / dt / 1e9, 4),
         "rows": n_rows, "row_groups": meta.num_row_groups,
         "selected_bytes": sel_bytes, "hits": int(hits),
+        "selected_columns": len(sel_cols),
         # logical bytes either way, so raid and plain runs of the same
         # file agree
         "total_bytes": logical_bytes if raid else os.path.getsize(path),
@@ -950,6 +1004,15 @@ def main(argv: list[str] | None = None) -> int:
                            "flagship md-raid0-of-NVMe deployment shape")
     p_pq.add_argument("--raid-chunk", type=int, default=512 * 1024,
                       dest="raid_chunk", help="RAID0 chunk size")
+    p_pq.add_argument("--columns", type=int, default=1,
+                      help="select this many columns (value + N-1 float64 "
+                           "feature columns): the WIDE-projection arm, "
+                           "where selected bytes/row is large enough for "
+                           "selected_gbps to mean scan bandwidth")
+    p_pq.add_argument("--cpu-device", action="store_true", dest="cpu_device",
+                      help="run the jitted aggregate on the host backend: "
+                           "keeps WIDE-arm selected_gbps measuring the scan "
+                           "machinery instead of a throttled device link")
     p_pq.set_defaults(fn=bench_parquet)
 
     p_all = sub.add_parser("all", help="every BASELINE config, quick shapes, "

@@ -32,21 +32,25 @@ def scan_units(shards: Sequence[ParquetShard]) -> list[tuple[ParquetShard, int]]
     return [(s, g) for s in shards for g in range(s.num_row_groups)]
 
 
-def _collective_sum(acc: Any) -> Any:
+def _collective_sum(acc: Any, devices: Sequence[Any] | None = None) -> Any:
     """Cross-process aggregate sum as a real XLA collective on a scan mesh.
 
-    One global 1-D mesh over every device in the job; each process
-    contributes its partial on its first local device (zeros elsewhere) as
-    one row of a [n_devices, ...] process-sharded array, and a jitted
-    axis-0 sum with a replicated out_sharding makes XLA emit the all-reduce
-    — ICI within a slice, DCN across (SURVEY.md §2.3). Works at any process
-    count (single-process: a local-mesh reduction). Every process must
-    call this (it is a collective)."""
+    One global 1-D mesh over every device in the job (or over *devices*
+    when the caller pinned the scan to specific ones — e.g. the host
+    backend; the reduction must ride the same backend as the map stage, or
+    a host-pinned scan would still round-trip the default devices here);
+    each process contributes its partial on its first local device (zeros
+    elsewhere) as one row of a [n_devices, ...] process-sharded array, and
+    a jitted axis-0 sum with a replicated out_sharding makes XLA emit the
+    all-reduce — ICI within a slice, DCN across (SURVEY.md §2.3). Works at
+    any process count (single-process: a local-mesh reduction). Every
+    process must call this (it is a collective)."""
     import jax
 
-    devs = np.asarray(jax.devices())
+    devs = np.asarray(jax.devices() if devices is None else list(devices))
     mesh = jax.sharding.Mesh(devs, ("scan",))
-    local = jax.local_devices()
+    pidx = jax.process_index()
+    local = [d for d in devs.ravel() if d.process_index == pidx]
     reducer = _mesh_reducer(mesh)
 
     def leaf(x: Any) -> np.ndarray:
@@ -204,7 +208,7 @@ def parquet_scan_aggregate(ctx: StromContext, paths: Sequence[str],
 
     if reduce == "collective":
         # a collective: every process participates, any process count
-        acc = _collective_sum(acc)
+        acc = _collective_sum(acc, devices=devices)
     elif jax.process_count() > 1:  # "allgather"; collectives involve everyone
         from jax.experimental import multihost_utils
 
