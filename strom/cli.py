@@ -267,6 +267,58 @@ def _timed_train_phase(pipe_factory, step, steps: int,
                 pipe.data_stall_steps - base_stalls, round(train_loss, 4))
 
 
+def _bounded_train_phase(pipe_factory_at_depth, step, rate: float,
+                         items_per_step: int, bsteps: int, bdepth: int
+                         ) -> tuple[float, int, float]:
+    """The NON-degenerate 0-stall arm (VERDICT.md r3 next #2), shared by the
+    llama and predecoded-vision benches: the headline phases need
+    prefetch > steps on this box because relay-backed train steps DISPATCH
+    in a burst — the consumer drains any shallower queue before execution
+    starts (BASELINE.md §C) — which cannot distinguish "overlap works" from
+    "everything was staged before consumption started". This arm defeats
+    the burst by pacing the consumer at EXECUTION rate: a fixed host-side
+    delay of ~the measured per-step wall time after each step's dispatch,
+    matching what a real device imposes. Depth <= 4, steps >= 40: 0 stalls
+    here is the SURVEY.md §3.5 double-buffer contract shown non-degenerately.
+    Counter and warmup exclusion untouched (_timed_train_phase).
+
+    *pipe_factory_at_depth(depth)* builds the pipeline at a given prefetch
+    depth; *rate* is the measured headline items/s the pace derives from.
+    Returns (items_per_s, data_stall_steps, delay_s)."""
+    delay = items_per_step / rate if rate else 0.05
+    delay = min(max(delay, 0.01), 1.0)
+
+    def paced(batch):
+        loss = step(batch)
+        time.sleep(delay)
+        return loss
+
+    r, stalls, _ = _timed_train_phase(lambda: pipe_factory_at_depth(bdepth),
+                                      paced, bsteps, items_per_step)
+    return r, stalls, round(delay, 4)
+
+
+def _run_bounded_arm(args: argparse.Namespace, out: dict, pipe_factory, step,
+                     rate: float, items_per_step: int, rate_key: str,
+                     drop_paths) -> None:
+    """Run the bounded arm when --bounded-steps asks for it and record the
+    shared key schema — single-sourced so the llama/resnet/vit benches
+    cannot drift apart on the protocol."""
+    bsteps = int(getattr(args, "bounded_steps", 0) or 0)
+    if not bsteps:
+        return
+    bdepth = int(getattr(args, "bounded_prefetch", 4) or 4)
+    for p in drop_paths:
+        _drop_cache_hint(p)
+    brate, bstalls, delay = _bounded_train_phase(
+        pipe_factory, step, rate, items_per_step, bsteps, bdepth)
+    out["bounded_train_data_stalls"] = bstalls
+    out["bounded_steps"] = bsteps
+    out["bounded_prefetch"] = bdepth
+    out["bounded_step_delay_s"] = delay
+    out[rate_key] = brate
+
+
 def bench_llama(args: argparse.Namespace) -> dict:
     """Config #4 loader shape: packed-token pipeline throughput (tokens/s)
     + the 0-data-stall counter, feeding a dp mesh on the local device(s).
@@ -351,43 +403,14 @@ def bench_llama(args: argparse.Namespace) -> dict:
                 out["train_attn"] = args.attn
                 out["train_loss"] = loss
 
-                bsteps = int(getattr(args, "bounded_steps", 0) or 0)
-                if bsteps:
-                    # Bounded-depth 0-stall arm (VERDICT.md r3 next #2): the
-                    # headline phase needs prefetch > steps on this box
-                    # because relay-backed train steps DISPATCH in a burst
-                    # (the consumer drains any shallower queue before
-                    # execution starts — BASELINE.md §C), which cannot
-                    # distinguish "overlap works" from "we staged everything
-                    # first". This arm defeats the burst by pacing the
-                    # consumer at EXECUTION rate: a fixed host-side delay of
-                    # ~the measured per-step wall time after each step's
-                    # dispatch, so consumption matches what a real device
-                    # imposes. Depth <= 4, steps >= 40: 0 stalls here is the
-                    # non-degenerate double-buffer demonstration (SURVEY.md
-                    # §3.5). Counter and warmup exclusion untouched.
-                    bdepth = int(getattr(args, "bounded_prefetch", 4) or 4)
-                    items = args.batch * (args.seq_len + 1)
-                    delay = items / rate if rate else 0.05
-                    delay = min(max(delay, 0.01), 1.0)
-
-                    def paced_step(toks):
-                        nonlocal state
-                        state, m = step_fn(state, toks % mcfg.vocab)
-                        time.sleep(delay)
-                        return m["loss"]
-
-                    brate, bstalls, _ = _timed_train_phase(
-                        lambda: make_llama_pipeline(
-                            ctx, [path], batch=args.batch,
-                            seq_len=args.seq_len, sharding=sharding,
-                            prefetch_depth=bdepth),
-                        paced_step, bsteps, items)
-                    out["bounded_train_data_stalls"] = bstalls
-                    out["bounded_steps"] = bsteps
-                    out["bounded_prefetch"] = bdepth
-                    out["bounded_step_delay_s"] = round(delay, 4)
-                    out["bounded_train_tokens_per_s"] = brate
+                # the non-degenerate 0-stall arm — see _bounded_train_phase
+                _run_bounded_arm(
+                    args, out,
+                    lambda depth: make_llama_pipeline(
+                        ctx, [path], batch=args.batch, seq_len=args.seq_len,
+                        sharding=sharding, prefetch_depth=depth),
+                    step, rate, args.batch * (args.seq_len + 1),
+                    "bounded_train_tokens_per_s", [path])
     finally:
         ctx.close()
     return out
@@ -478,19 +501,19 @@ def bench_resnet(args: argparse.Namespace) -> dict:
             pdec = _ensure_predecoded(ctx, path, args.image_size, args.tmpdir)
             data_paths = [pdec]
 
-            def pipe_factory():
+            def pipe_factory(depth=args.prefetch):
                 return make_predecoded_vision_pipeline(
                     ctx, [pdec], batch=args.batch,
                     image_size=args.image_size, sharding=sharding,
-                    prefetch_depth=args.prefetch)
+                    prefetch_depth=depth)
         else:
             data_paths = [path]
 
-            def pipe_factory():
+            def pipe_factory(depth=args.prefetch):
                 return make_imagenet_resnet_pipeline(
                     ctx, [path], batch=args.batch,
                     image_size=args.image_size, sharding=sharding,
-                    prefetch_depth=args.prefetch,
+                    prefetch_depth=depth,
                     decode_workers=args.decode_workers)
         for p in data_paths:
             _drop_cache_hint(p)
@@ -555,6 +578,10 @@ def bench_resnet(args: argparse.Namespace) -> dict:
             out["train_data_stalls"] = stalls
             out["train_model"] = args.model
             out["train_loss"] = loss
+
+            # the non-degenerate 0-stall arm — see _bounded_train_phase
+            _run_bounded_arm(args, out, pipe_factory, step, rate, args.batch,
+                             "bounded_train_images_per_s", data_paths)
     finally:
         ctx.close()
     return out
@@ -602,17 +629,17 @@ def bench_vit(args: argparse.Namespace) -> dict:
         sharding = NamedSharding(mesh, P("dp", None, None, None))
 
         if predecoded:
-            def pipe_factory():
+            def pipe_factory(depth=args.prefetch):
                 return make_predecoded_vision_pipeline(
                     ctx, [virt], batch=args.batch,
                     image_size=args.image_size, sharding=sharding,
-                    prefetch_depth=args.prefetch)
+                    prefetch_depth=depth)
         else:
-            def pipe_factory():
+            def pipe_factory(depth=args.prefetch):
                 return make_vit_wds_pipeline(
                     ctx, [virt], batch=args.batch,
                     image_size=args.image_size, sharding=sharding,
-                    prefetch_depth=args.prefetch,
+                    prefetch_depth=depth,
                     decode_workers=args.decode_workers)
         for m in members:
             _drop_cache_hint(m)
@@ -670,6 +697,10 @@ def bench_vit(args: argparse.Namespace) -> dict:
             out["train_data_stalls"] = stalls
             out["train_model"] = args.model
             out["train_loss"] = loss
+
+            # the non-degenerate 0-stall arm — see _bounded_train_phase
+            _run_bounded_arm(args, out, pipe_factory, step, rate, args.batch,
+                             "bounded_train_images_per_s", members)
     finally:
         ctx.close()
     return out
@@ -742,8 +773,9 @@ def bench_parquet(args: argparse.Namespace) -> dict:
         meta = ParquetShard(path, ctx=ctx).metadata
         n_rows = meta.num_rows
         sel_cols = ["value"] + [f"f{i}" for i in range(n_cols - 1)]
-        present = {meta.row_group(0).column(i).path_in_schema
-                   for i in range(meta.num_columns)}
+        # probe the SCHEMA, not row_group(0): a valid file with zero row
+        # groups must still reach the scan's clear "no row groups" error
+        present = set(meta.schema.names)
         missing = [c for c in sel_cols if c not in present]
         if missing:
             # fail up front with the real cause: --columns names the
@@ -861,6 +893,10 @@ def bench_all(args: argparse.Namespace) -> dict:
                                               prefetch=2, unit_batch=4,
                                               raid=4,
                                               raid_chunk=512 * 1024)),
+        ("parquet_wide", bench_parquet, dict(rows=200_000, row_groups=8,
+                                             prefetch=2, unit_batch=4,
+                                             raid=0, raid_chunk=512 * 1024,
+                                             columns=16, cpu_device=True)),
     ]
     out: dict = {"bench": "all", "failed": []}
     for name, fn, extra in phases:
@@ -961,6 +997,15 @@ def main(argv: list[str] | None = None) -> int:
                       help="decode-free loader over a decode-once staged "
                            "shard (strom.formats.predecoded): pure engine "
                            "gather + device_put, no per-step JPEG decode")
+    p_rn.add_argument("--bounded-steps", type=int, default=0,
+                      dest="bounded_steps",
+                      help="with --train-step: extra phase of this many "
+                           "steps with an execution-paced consumer at "
+                           "--bounded-prefetch depth (non-degenerate "
+                           "0-stall demonstration; 0 = off)")
+    p_rn.add_argument("--bounded-prefetch", type=int, default=4,
+                      dest="bounded_prefetch",
+                      help="prefetch depth for the bounded 0-stall phase")
     p_rn.set_defaults(fn=bench_resnet)
 
     p_vit = sub.add_parser("vit", help="config #3: WDS .tar -> ViT loader "
@@ -986,6 +1031,15 @@ def main(argv: list[str] | None = None) -> int:
                        help="decode-free loader: the tar staged once as a "
                             "packed uint8 shard, STRIPED over the RAID0 "
                             "members — pure stripe-decoded engine gather")
+    p_vit.add_argument("--bounded-steps", type=int, default=0,
+                       dest="bounded_steps",
+                       help="with --train-step: extra phase of this many "
+                            "steps with an execution-paced consumer at "
+                            "--bounded-prefetch depth (non-degenerate "
+                            "0-stall demonstration; 0 = off)")
+    p_vit.add_argument("--bounded-prefetch", type=int, default=4,
+                       dest="bounded_prefetch",
+                       help="prefetch depth for the bounded 0-stall phase")
     p_vit.set_defaults(fn=bench_vit)
 
     p_pq = sub.add_parser("parquet", help="config #5: PG-Strom-style columnar "
