@@ -168,7 +168,7 @@ def main() -> int:
     # --- into the final host array). Relay-independent, so the host ratio
     # --- is the box-feasible form of the binding >=0.90-of-raw target
     # --- (BASELINE.json:5): "does the framework add <=10% on top of raw
-    # --- NVMe". The arms alternate raw/host per pass with best-of-3 each
+    # --- NVMe". The arms alternate raw/host per pass with best-of-4 each
     # --- because this virtio disk's cold-read rate swings ~1.9-2.9 GB/s
     # --- pass to pass (BASELINE.md §C): back-to-back blocks would hand one
     # --- arm the burst and the other the refill, making the ratio weather
@@ -180,8 +180,9 @@ def main() -> int:
     hctx = StromContext(cfg)
     try:
         hctx.engine.register_dest(dest)
-        for _ in range(3):
-            _drop_cache_hint(path)
+
+        def run_raw() -> None:
+            nonlocal raw_gbps
             eng = make_engine(cfg)
             fi = eng.register_file(path, o_direct=True)
             eng.register_dest(dest)  # READ_FIXED when supported (pages
@@ -193,13 +194,24 @@ def main() -> int:
             eng.close()
             assert n == size
             raw_gbps = max(raw_gbps, size / dt / 1e9)
-            _drop_cache_hint(path)
+
+        def run_host() -> None:
+            nonlocal host_gbps
             t0 = time.perf_counter()
             arr = hctx.memcpy_ssd2host(path, length=size, out=dest)
             dt = time.perf_counter() - t0
             assert arr.nbytes == size
             host_gbps = max(host_gbps, size / dt / 1e9)
-            del arr
+
+        for i in range(4):
+            # alternate which arm goes first: the disk often runs faster as
+            # a pass sequence warms its burst state, and a fixed raw-then-
+            # host order hands that drift to one arm (a run with host always
+            # second read host/raw = 1.03 — position bias, not software)
+            for run in ((run_raw, run_host) if i % 2 == 0
+                        else (run_host, run_raw)):
+                _drop_cache_hint(path)
+                run()
     finally:
         hctx.close()
     del dest
@@ -254,8 +266,8 @@ def main() -> int:
         # hardware the device itself throttles consumption to execution
         # rate and depth 2-6 suffices. The spec's north star allows
         # prefetch >= 2; the counter and its warmup exclusion are
-        # untouched. Best-of-3 (min stalls) on top, same methodology as
-        # the bandwidth phase's best-of-2; early-out on a 0-stall run.
+        # untouched. Best-of-3 (min stalls) on top, the house best-of-N
+        # methodology; early-out on a 0-stall run.
         def _stall_key(res: dict) -> tuple[int, int]:
             # min over (headline stalls, bounded stalls); non-int (absent /
             # None after a partial phase failure) sorts worst instead of
@@ -360,17 +372,29 @@ def main() -> int:
                 **vars(base), "batch": 16, "image_size": 112, "steps": 4,
                 "prefetch": 16, "predecoded": True,
                 "bounded_steps": 40, "bounded_prefetch": 4})
-            res = attempt(name, lambda: fn(bargs))
-            if res is None:
+            # best-of-2 (min stalls), the same methodology as the llama
+            # phase's best-of-3: one relay latency spike over a 40-step run
+            # is jitter, not a property of the overlap machinery
+            best_s = None
+            for _ in range(2):
+                res = attempt(name, lambda: fn(bargs))
+                if res is None:
+                    continue
+                s = res.get("bounded_train_data_stalls")
+                if isinstance(s, int) and (best_s is None or s < best_s):
+                    best_s = s
+                print(f"{name} bounded arm (16x112, depth "
+                      f"{res.get('bounded_prefetch')}, "
+                      f"{res.get('bounded_steps')} steps, "
+                      f"{res.get('bounded_step_delay_s')}s/step pace): "
+                      f"{s} stalls", file=sys.stderr)
+                if s == 0:
+                    break
+            if best_s is None:
                 return
-            loader_res[stall_key] = res.get("bounded_train_data_stalls")
+            loader_res[stall_key] = best_s
             loader_res["bounded_vision_shape"] = \
                 f"{bargs.batch}x{bargs.image_size}"
-            print(f"{name} bounded arm (16x112, depth "
-                  f"{res.get('bounded_prefetch')}, {res.get('bounded_steps')}"
-                  f" steps, {res.get('bounded_step_delay_s')}s/step pace): "
-                  f"{res.get('bounded_train_data_stalls')} stalls",
-                  file=sys.stderr)
 
         bounded_vision("resnet PREDECODED", bench_resnet, rargs,
                        "resnet_predecoded_stalls_bounded")

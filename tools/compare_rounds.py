@@ -42,9 +42,12 @@ CONTEXT_KEYS = [
 
 
 def unwrap(d: dict) -> dict:
-    """The driver records {'cmd', 'rc', 'tail', ...} with bench.py's one
-    JSON line embedded in 'tail'; accept both that wrapper and a bare
-    bench.py line."""
+    """The driver records {'cmd', 'rc', 'parsed', 'tail', ...}; prefer the
+    pre-parsed inner dict (immune to tail-window truncation), then fall
+    back to scraping the JSON line out of 'tail', then to a bare bench.py
+    line."""
+    if isinstance(d.get("parsed"), dict) and "metric" in d["parsed"]:
+        return d["parsed"]
     if "metric" in d or "tail" not in d:
         return d
     for line in reversed(str(d.get("tail", "")).splitlines()):
