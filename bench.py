@@ -143,9 +143,7 @@ def main() -> int:
 
     from strom.cli import _drop_cache_hint, _mk_testfile
     from strom.config import StromConfig
-    from strom.delivery.buffers import alloc_aligned
     from strom.delivery.core import StromContext
-    from strom.engine import make_engine
 
     path = os.path.join(args.tmpdir, "strom_bench_nvme.bin")
     if not os.path.exists(path) or os.path.getsize(path) < args.size:
@@ -174,47 +172,13 @@ def main() -> int:
     # --- arm the burst and the other the refill, making the ratio weather
     # --- (a first cut measured host/raw = 1.81 that way). Same size, same
     # --- READ_FIXED dest treatment on both sides.
-    raw_gbps = 0.0
-    host_gbps = 0.0
-    dest = alloc_aligned(size)
-    hctx = StromContext(cfg)
-    try:
-        hctx.engine.register_dest(dest)
+    from strom.cli import bench_ssd2host
 
-        def run_raw() -> None:
-            nonlocal raw_gbps
-            eng = make_engine(cfg)
-            fi = eng.register_file(path, o_direct=True)
-            eng.register_dest(dest)  # READ_FIXED when supported (pages
-            # pinned once at registration, not per IO) — the host arm's dest
-            # registers the same way, keeping best-native-vs-best-native
-            t0 = time.perf_counter()
-            n = eng.read_vectored([(fi, 0, 0, size)], dest)
-            dt = time.perf_counter() - t0
-            eng.close()
-            assert n == size
-            raw_gbps = max(raw_gbps, size / dt / 1e9)
-
-        def run_host() -> None:
-            nonlocal host_gbps
-            t0 = time.perf_counter()
-            arr = hctx.memcpy_ssd2host(path, length=size, out=dest)
-            dt = time.perf_counter() - t0
-            assert arr.nbytes == size
-            host_gbps = max(host_gbps, size / dt / 1e9)
-
-        for i in range(4):
-            # alternate which arm goes first: the disk often runs faster as
-            # a pass sequence warms its burst state, and a fixed raw-then-
-            # host order hands that drift to one arm (a run with host always
-            # second read host/raw = 1.03 — position bias, not software)
-            for run in ((run_raw, run_host) if i % 2 == 0
-                        else (run_host, run_raw)):
-                _drop_cache_hint(path)
-                run()
-    finally:
-        hctx.close()
-    del dest
+    hres = bench_ssd2host(argparse.Namespace(
+        file=path, size=size, block=cfg.block_size, depth=cfg.queue_depth,
+        iters=4, engine=cfg.engine, tmpdir=args.tmpdir, json=True))
+    raw_gbps = hres["raw_gbps"]
+    host_gbps = hres["host_gbps"]
     print(f"raw O_DIRECT read (native vectored): {raw_gbps:.3f} GB/s",
           file=sys.stderr)
     print(f"host-delivered (framework path up to device_put): "
