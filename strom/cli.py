@@ -182,7 +182,16 @@ def bench_ssd2host(args: argparse.Namespace) -> dict:
             assert arr.nbytes == size
             host_gbps = max(host_gbps, size / dt / 1e9)
 
-        for i in range(max(args.iters, 1)):
+        # even pass count only: an odd count gives one arm more first-
+        # position runs, reintroducing the very order bias the alternation
+        # exists to remove
+        passes = max(args.iters, 1)
+        if passes % 2:
+            passes += 1
+            print(f"ssd2host: rounding --iters up to {passes} "
+                  f"(alternating arm order needs an even pass count)",
+                  file=sys.stderr)
+        for i in range(passes):
             for run in ((run_raw, run_host) if i % 2 == 0
                         else (run_host, run_raw)):
                 _drop_cache_hint(path)
@@ -198,7 +207,7 @@ def bench_ssd2host(args: argparse.Namespace) -> dict:
         "host_gbps": round(host_gbps, 4),
         "vs_raw": round(host_gbps / raw_gbps, 4) if raw_gbps else 0.0,
         "bytes": size, "block": args.block, "depth": args.depth,
-        "passes": max(args.iters, 1), "engine": cfg.engine,
+        "passes": passes, "engine": cfg.engine,
     }
 
 

@@ -157,6 +157,25 @@ def test_ssd2host_out_buffer(ctx, data_file):
         ctx.memcpy_ssd2host(path, length=n, out=alloc_aligned(2 * n)[::2])
 
 
+def test_bench_ssd2host_smoke(tmp_path, rng, engine_name):
+    """The strom-bench ssd2host subcommand's phase function: both arms run,
+    the ratio is finite, and the fields bench.py consumes are present."""
+    import argparse
+
+    from strom.cli import bench_ssd2host
+
+    n = 4 << 20
+    data = rng.integers(0, 256, n, dtype=np.uint8)
+    p = tmp_path / "ratio.bin"
+    data.tofile(p)
+    res = bench_ssd2host(argparse.Namespace(
+        file=str(p), size=n, block=128 * 1024, depth=8, iters=2,
+        engine=engine_name, tmpdir=str(tmp_path), json=True))
+    assert res["bench"] == "ssd2host" and res["bytes"] == n
+    assert res["raw_gbps"] > 0 and res["host_gbps"] > 0
+    assert res["vs_raw"] > 0 and res["passes"] == 2
+
+
 def test_ssd2host_striped_alias(ctx, tmp_path, rng):
     """The host path rides striped-alias resolution like the device path."""
     n, chunk = 2, 4096
