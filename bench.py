@@ -431,6 +431,46 @@ def main() -> int:
                   f"{pwres['selected_gbps']:.3f} GB/s selected",
                   file=sys.stderr)
 
+        # config #5, PLAIN-encoded arm (VERDICT.md r4 next #1): the wide
+        # snappy arm's selected-GB/s is single-core-codec-bound (0.287 vs a
+        # same-run 1.67 disk side in BENCH_r04). This arm removes the codec:
+        # uncompressed PLAIN chunks decode as frombuffer page views over
+        # the engine slab plus one join copy per chunk
+        # (formats/parquet.decode_plain_pages — the plain_decoded_bytes
+        # counter proves the path), float32 so the
+        # device dispatch aliases instead of downcasting, and --disk-rate
+        # interleaves a BARE-engine gather of the identical extents as the
+        # same-run I/O yardstick (alternating arms, best-of-2 each — the
+        # ssd2host debiasing). vs_disk is the binding, weather-independent
+        # form: the scan machinery's cost over raw I/O on the same bytes.
+        plargs = argparse.Namespace(**{**vars(pargs), "rows": 2_000_000,
+                                       "row_groups": 8, "columns": 16,
+                                       "raid": 0, "cpu_device": True,
+                                       "compression": "none",
+                                       "dtype": "float32",
+                                       "disk_rate": True, "prefetch": 8,
+                                       "unit_batch": 1})
+        plres = attempt("parquet PLAIN", lambda: bench_parquet(plargs))
+        if plres is not None:
+            loader_res.update({
+                "parquet_plain_rows_per_s": plres["rows_per_s"],
+                "parquet_plain_selected_gbps": plres["selected_gbps"],
+                "parquet_plain_disk_gbps": plres["disk_read_gbps"],
+                "parquet_plain_vs_disk": plres["vs_disk"],
+                "parquet_plain_selected_gbps_passes":
+                    plres["selected_gbps_passes"],
+                "parquet_plain_disk_gbps_passes": plres["disk_gbps_passes"],
+                "parquet_plain_decoded_bytes": plres["plain_decoded_bytes"],
+                "parquet_plain_pyarrow_bytes": plres["pyarrow_decoded_bytes"],
+            })
+            print(f"parquet PLAIN scan ({plres['selected_columns']} cols, "
+                  f"{plres['selected_bytes'] >> 20} MiB selected, direct "
+                  f"decode): {plres['rows_per_s']:.0f} rows/s, "
+                  f"{plres['selected_gbps']:.3f} GB/s selected vs "
+                  f"{plres['disk_read_gbps']:.3f} GB/s bare gather of the "
+                  f"same extents = vs_disk {plres['vs_disk']}",
+                  file=sys.stderr)
+
     # --- numerator: one streamed memcpy_ssd2tpu ----------------------------
     # (engine reads piece k+1 while piece k streams host->HBM)
     # Capped at 512MiB: the relay link's token bucket holds ~0.5-1 GiB of
@@ -564,6 +604,9 @@ def main() -> int:
         "vit_predecoded_stalls": out.get("vit_predecoded_stalls"),
         "vit_predecoded_stalls_bounded":
             out.get("vit_predecoded_stalls_bounded"),
+        # same-run interleaved ratio: plain-encoded scan vs a bare engine
+        # gather of the identical extents (VERDICT.md r4 next #1)
+        "parquet_plain_vs_disk": out.get("parquet_plain_vs_disk"),
     }
 
     print(json.dumps(out))

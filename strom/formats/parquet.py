@@ -145,6 +145,219 @@ class RangeCachedFile:
                 os.close(self._fd)
 
 
+# --- direct PLAIN-page decode (the I/O-bound scan path) ---------------------
+#
+# For uncompressed, PLAIN-encoded numeric column chunks the bytes on disk ARE
+# the values (modulo small thrift page headers and an all-ones definition-
+# level run), so decode can be np.frombuffer over the engine's slab — zero
+# copies — instead of the pyarrow PythonFile round trip (range-cache stitch,
+# arrow buffer copy, to_numpy). This is what makes config #5's selected-GB/s
+# an I/O measurement rather than a codec one (VERDICT.md r4 next #1; the
+# reference's scans stream straight from NVMe — SURVEY.md §0.5, UNVERIFIED).
+# Anything the fast path can't prove safe (compression, dictionary pages,
+# nulls, non-numeric types, v2 pages, encodings != PLAIN) falls back to the
+# pyarrow path; tests cross-check both against each other.
+
+_PHYSICAL_NP = {
+    "INT32": np.dtype("<i4"),
+    "INT64": np.dtype("<i8"),
+    "FLOAT": np.dtype("<f4"),
+    "DOUBLE": np.dtype("<f8"),
+}
+
+
+class _PlainDecodeUnsupported(Exception):
+    """Chunk needs the pyarrow fallback (not an error)."""
+
+
+def _uvarint(buf, pos: int) -> tuple[int, int]:
+    out = 0
+    shift = 0
+    while True:
+        b = buf[pos]
+        pos += 1
+        out |= (b & 0x7F) << shift
+        if not b & 0x80:
+            return out, pos
+        shift += 7
+        if shift > 63:
+            raise _PlainDecodeUnsupported("varint overflow")
+
+
+def _zigzag(v: int) -> int:
+    return (v >> 1) ^ -(v & 1)
+
+
+def _thrift_skip(buf, pos: int, ftype: int) -> int:
+    """Skip one thrift compact value of *ftype*; returns new pos."""
+    if ftype in (1, 2):  # BOOLEAN_TRUE / BOOLEAN_FALSE: value is in the type
+        return pos
+    if ftype == 3:  # byte
+        return pos + 1
+    if ftype in (4, 5, 6):  # i16/i32/i64: zigzag varint
+        _, pos = _uvarint(buf, pos)
+        return pos
+    if ftype == 7:  # double
+        return pos + 8
+    if ftype == 8:  # binary/string
+        n, pos = _uvarint(buf, pos)
+        return pos + n
+    if ftype in (9, 10):  # list/set
+        head = buf[pos]
+        pos += 1
+        size = head >> 4
+        etype = head & 0x0F
+        if size == 15:
+            size, pos = _uvarint(buf, pos)
+        for _ in range(size):
+            pos = _thrift_skip(buf, pos, etype)
+        return pos
+    if ftype == 12:  # struct
+        while True:
+            fb = buf[pos]
+            pos += 1
+            if fb == 0:
+                return pos
+            if fb >> 4 == 0:  # long-form field id: zigzag varint follows
+                _, pos = _uvarint(buf, pos)
+            pos = _thrift_skip(buf, pos, fb & 0x0F)
+    raise _PlainDecodeUnsupported(f"thrift type {ftype}")
+
+
+def _thrift_struct(buf, pos: int) -> tuple[dict, int]:
+    """Parse a thrift compact struct into {field_id: value}; nested structs
+    recurse, everything else is skipped or decoded as a zigzag int. Only the
+    field shapes PageHeader uses are decoded."""
+    out: dict = {}
+    fid = 0
+    while True:
+        fb = buf[pos]
+        pos += 1
+        if fb == 0:
+            return out, pos
+        delta = fb >> 4
+        ftype = fb & 0x0F
+        if delta:
+            fid += delta
+        else:
+            sv, pos = _uvarint(buf, pos)
+            fid = _zigzag(sv)
+        if ftype in (1, 2):
+            out[fid] = ftype == 1
+        elif ftype in (4, 5, 6):
+            sv, pos = _uvarint(buf, pos)
+            out[fid] = _zigzag(sv)
+        elif ftype == 12:
+            out[fid], pos = _thrift_struct(buf, pos)
+        else:
+            pos = _thrift_skip(buf, pos, ftype)
+            out[fid] = None
+    # unreachable
+
+
+def _defs_all_present(buf, num_values: int) -> bool:
+    """True iff an RLE/bit-packed (bit width 1) definition-level block is all
+    ones — i.e. no nulls. *buf* is the block AFTER its 4-byte length prefix."""
+    pos = 0
+    seen = 0
+    while seen < num_values and pos < len(buf):
+        header, pos = _uvarint(buf, pos)
+        if header & 1:  # bit-packed run: (header>>1) groups of 8 values
+            n_groups = header >> 1
+            n_bytes = n_groups  # bit width 1: one byte per 8 values
+            take = min(n_groups * 8, num_values - seen)
+            full, rem = divmod(take, 8)
+            block = buf[pos: pos + n_bytes]
+            if any(b != 0xFF for b in block[:full]):
+                return False
+            if rem and (block[full] & ((1 << rem) - 1)) != (1 << rem) - 1:
+                return False
+            pos += n_bytes
+            seen += take
+        else:  # RLE run: value repeated (header>>1) times, 1 byte at width 1
+            count = header >> 1
+            if count == 0:
+                return False  # malformed; be conservative
+            if buf[pos] != 1:
+                return False
+            pos += 1
+            seen += min(count, num_values - seen)
+    return seen >= num_values
+
+
+def decode_plain_pages(col_meta, col_schema, buf: np.ndarray
+                       ) -> list[np.ndarray]:
+    """Decode one uncompressed PLAIN numeric column chunk into per-page
+    numpy VIEWS over its raw bytes (zero copies; the page list is the
+    chunk's row order).
+
+    *col_meta*: pyarrow ColumnChunkMetaData; *col_schema*: the matching
+    ParquetColumnSchema (for max def/rep levels); *buf*: the chunk's bytes
+    (np.uint8, offset 0 = the chunk's first page header).
+    Raises _PlainDecodeUnsupported when any page needs the pyarrow path.
+    """
+    if col_meta.compression != "UNCOMPRESSED":
+        raise _PlainDecodeUnsupported(col_meta.compression)
+    if col_meta.dictionary_page_offset is not None:
+        raise _PlainDecodeUnsupported("dictionary-encoded")
+    np_dtype = _PHYSICAL_NP.get(col_meta.physical_type)
+    if np_dtype is None:
+        raise _PlainDecodeUnsupported(col_meta.physical_type)
+    if col_schema.max_repetition_level:
+        raise _PlainDecodeUnsupported("nested (repetition levels)")
+    max_def = col_schema.max_definition_level
+    stats = col_meta.statistics
+    nulls_known_zero = stats is not None and stats.has_null_count \
+        and stats.null_count == 0
+    mv = buf if isinstance(buf, (bytes, memoryview)) else memoryview(buf)
+
+    total = col_meta.num_values
+    parts: list[np.ndarray] = []
+    pos = 0
+    decoded = 0
+    while decoded < total:
+        header, pos = _thrift_struct(mv, pos)
+        page_type = header.get(1)
+        comp_size = header.get(3)
+        if comp_size is None:
+            raise _PlainDecodeUnsupported("no page size")
+        page_end = pos + comp_size
+        if page_type != 0:  # 0 = DATA_PAGE (v1); v2/dict/index -> fallback
+            raise _PlainDecodeUnsupported(f"page type {page_type}")
+        dph = header.get(5)
+        if not isinstance(dph, dict):
+            raise _PlainDecodeUnsupported("no data page header")
+        num_values = dph.get(1)
+        encoding = dph.get(2)
+        def_enc = dph.get(3)
+        if encoding != 0:  # PLAIN
+            raise _PlainDecodeUnsupported(f"encoding {encoding}")
+        vpos = pos
+        if max_def:
+            if def_enc != 3:  # RLE
+                raise _PlainDecodeUnsupported(f"def-level encoding {def_enc}")
+            dlen = int.from_bytes(mv[vpos: vpos + 4], "little")
+            if not nulls_known_zero and not _defs_all_present(
+                    mv[vpos + 4: vpos + 4 + dlen], num_values):
+                raise _PlainDecodeUnsupported("nulls present")
+            vpos += 4 + dlen
+        want = num_values * np_dtype.itemsize
+        if vpos + want > page_end:
+            raise _PlainDecodeUnsupported("page shorter than its values")
+        parts.append(np.frombuffer(mv, np_dtype, count=num_values,
+                                   offset=vpos))
+        decoded += num_values
+        pos = page_end
+    return parts
+
+
+def decode_plain_chunk(col_meta, col_schema, buf: np.ndarray) -> np.ndarray:
+    """:func:`decode_plain_pages` joined to one array (a view when the chunk
+    is a single page, else one concatenation)."""
+    parts = decode_plain_pages(col_meta, col_schema, buf)
+    return parts[0] if len(parts) == 1 else np.concatenate(parts)
+
+
 class ParquetShard:
     """One Parquet file: metadata once, column chunks as ExtentLists."""
 
@@ -255,3 +468,53 @@ class ParquetShard:
 
             global_stats.add("parquet_cache_miss_bytes", cache.miss_bytes)
         return table
+
+    def read_row_group_arrays(self, ctx: "StromContext", row_group: int,
+                              columns: Sequence[str]) -> dict:
+        """Selected columns of one row group as host numpy arrays — the scan
+        pipeline's read unit.
+
+        Uncompressed PLAIN numeric chunks take the direct-decode path: ONE
+        engine gather of the selected chunks, then ``decode_plain_pages``
+        returns frombuffer views into that slab — no pyarrow round trip, no
+        stitching copies, so the scan's cost is the I/O (VERDICT.md r4 next
+        #1). Any column the fast path can't prove safe routes the whole
+        group through :meth:`read_row_group` (results identical; tests
+        cross-check). The ``parquet_plain_bytes`` / ``parquet_decode_bytes``
+        stats counters record which path bytes took.
+        """
+        from strom.utils.stats import global_stats
+
+        rg = self.metadata.row_group(row_group)
+        cis = self._col_indices(columns)
+        eligible = True
+        for ci in cis:
+            col = rg.column(ci)
+            if (col.compression != "UNCOMPRESSED"
+                    or col.dictionary_page_offset is not None
+                    or col.physical_type not in _PHYSICAL_NP
+                    or self.metadata.schema.column(ci).max_repetition_level):
+                eligible = False
+                break
+        if eligible:
+            chunk_ext = self.column_chunk_extents(row_group, columns)
+            buf = ctx.pread(chunk_ext)
+            out = {}
+            pos = 0
+            try:
+                for name, ci, ext in zip(columns, cis, chunk_ext.extents):
+                    out[name] = decode_plain_chunk(
+                        rg.column(ci), self.metadata.schema.column(ci),
+                        buf[pos: pos + ext.length])
+                    pos += ext.length
+            except _PlainDecodeUnsupported:
+                eligible = False  # data-level surprise: fall through
+            else:
+                global_stats.add("parquet_plain_bytes", int(buf.nbytes))
+                return out
+        table = self.read_row_group(ctx, row_group, columns=columns)
+        out = {c: np.ascontiguousarray(table[c].to_numpy(zero_copy_only=False))
+               for c in columns}
+        global_stats.add("parquet_decode_bytes",
+                         int(sum(a.nbytes for a in out.values())))
+        return out

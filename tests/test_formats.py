@@ -314,6 +314,123 @@ class TestParquet:
         assert pa.concat_tables(parts).equals(table)
 
 
+class TestPlainDecode:
+    """Direct PLAIN-page decode (formats/parquet.decode_plain_pages): the
+    I/O-bound scan path — frombuffer views instead of the pyarrow round
+    trip, falling back whenever the bytes can't be proven reinterpretable
+    (VERDICT.md r4 next #1). Every case cross-checks against pyarrow."""
+
+    COLS = ("a64", "a32", "i64", "i32")
+
+    def _write(self, tmp_path, rng, name="plain.parquet", **kw):
+        import pyarrow as pa
+        import pyarrow.parquet as pq
+
+        n = 50_000
+        table = pa.table({
+            "a64": pa.array(rng.normal(size=n)),
+            "a32": pa.array(rng.normal(size=n).astype(np.float32)),
+            "i64": pa.array(rng.integers(0, 1 << 40, n, dtype=np.int64)),
+            "i32": pa.array(rng.integers(0, 1 << 20, n, dtype=np.int32)),
+        })
+        p = str(tmp_path / name)
+        kw.setdefault("row_group_size", 30_000)  # 2 pages/chunk (20k-row cap)
+        kw.setdefault("compression", "NONE")
+        kw.setdefault("use_dictionary", False)
+        pq.write_table(table, p, **kw)
+        return p, table
+
+    def _counters(self):
+        from strom.utils.stats import global_stats
+
+        snap = global_stats.snapshot()
+        return (snap.get("parquet_plain_bytes", 0),
+                snap.get("parquet_decode_bytes", 0))
+
+    def _check(self, ctx, p, table, expect_plain: bool):
+        from strom.formats.parquet import ParquetShard
+
+        shard = ParquetShard(p, ctx=ctx)
+        plain0, fall0 = self._counters()
+        off = 0
+        for g in range(shard.num_row_groups):
+            got = shard.read_row_group_arrays(ctx, g, list(self.COLS))
+            n = len(got[self.COLS[0]])
+            for c in self.COLS:
+                want = table.slice(off, n)[c].to_numpy()
+                np.testing.assert_array_equal(got[c], want)
+            off += n
+        assert off == table.num_rows
+        plain1, fall1 = self._counters()
+        if expect_plain:
+            assert plain1 > plain0 and fall1 == fall0
+        else:
+            assert plain1 == plain0 and fall1 > fall0
+
+    def test_plain_multi_dtype_multi_page(self, ctx, tmp_path, rng):
+        p, table = self._write(tmp_path, rng)
+        self._check(ctx, p, table, expect_plain=True)
+
+    def test_no_statistics_def_levels_parsed(self, ctx, tmp_path, rng):
+        """Without chunk statistics the decoder must PARSE the RLE/bit-packed
+        definition levels to prove no nulls, not assume."""
+        p, table = self._write(tmp_path, rng, write_statistics=False)
+        self._check(ctx, p, table, expect_plain=True)
+
+    def test_snappy_falls_back(self, ctx, tmp_path, rng):
+        p, table = self._write(tmp_path, rng, compression="snappy")
+        self._check(ctx, p, table, expect_plain=False)
+
+    def test_dictionary_falls_back(self, ctx, tmp_path, rng):
+        p, table = self._write(tmp_path, rng, use_dictionary=True)
+        self._check(ctx, p, table, expect_plain=False)
+
+    def test_nulls_fall_back(self, ctx, tmp_path, rng):
+        """A nullable column with REAL nulls: the def levels are not all
+        ones, so reinterpreting the value bytes would mis-align rows — the
+        decoder must detect this from the page itself and fall back."""
+        import pyarrow as pa
+        import pyarrow.parquet as pq
+
+        from strom.formats.parquet import ParquetShard
+
+        vals = [1.0, None, 3.0] * 1000
+        p = str(tmp_path / "nulls.parquet")
+        pq.write_table(pa.table({"n": pa.array(vals)}), p,
+                       compression="NONE", use_dictionary=False,
+                       write_statistics=False)
+        shard = ParquetShard(p, ctx=ctx)
+        plain0, fall0 = self._counters()
+        got = shard.read_row_group_arrays(ctx, 0, ["n"])["n"]
+        want = shard.read_row_group(ctx, 0, columns=["n"])["n"] \
+            .to_numpy(zero_copy_only=False)
+        np.testing.assert_array_equal(np.isnan(got), np.isnan(want))
+        np.testing.assert_array_equal(got[~np.isnan(got)],
+                                      want[~np.isnan(want)])
+        plain1, fall1 = self._counters()
+        assert plain1 == plain0 and fall1 > fall0
+
+    def test_single_page_is_view(self, ctx, tmp_path, rng):
+        """A single-page chunk decodes to a VIEW over the engine slab (no
+        copy) — the property the fast path exists for."""
+        import pyarrow.parquet as pq
+
+        from strom.formats.parquet import (ParquetShard, decode_plain_pages)
+
+        p, table = self._write(tmp_path, rng, row_group_size=10_000)
+        shard = ParquetShard(p, ctx=ctx)
+        rg = shard.metadata.row_group(0)
+        ext = shard.column_chunk_extents(0, ["a64"])
+        buf = ctx.pread(ext)
+        ci = shard._col_indices(["a64"])[0]
+        pages = decode_plain_pages(rg.column(ci),
+                                   shard.metadata.schema.column(ci), buf)
+        assert len(pages) == 1
+        assert pages[0].base is not None  # a view, not an owning copy
+        np.testing.assert_array_equal(
+            pages[0], table.slice(0, 10_000)["a64"].to_numpy())
+
+
 class TestWdsStriped:
     """WDS shards on a RAID0 striped set (BASELINE config #3's '4×NVMe
     RAID0'): index through SourceIO, payload gathers stripe-decode in the
