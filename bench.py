@@ -186,6 +186,25 @@ def main() -> int:
           if raw_gbps else "host-delivered: raw denominator missing",
           file=sys.stderr)
 
+    # the same ratio on the reference's flagship deployment shape (4xNVMe
+    # md-raid0, BASELINE.json:9; VERDICT.md r4 next #2): framework arm
+    # stripe-decodes through the alias, raw arm reads the members
+    # contiguously through a bare engine — so vs_baseline_host_raid prices
+    # exactly the striped path's software. Members live on the same virtio
+    # disk; the software path is what's being measured (BASELINE.md §C
+    # establishes this for the ViT striped rows already).
+    raid_res: dict | None = None
+    try:
+        raid_res = bench_ssd2host(argparse.Namespace(
+            file=path, size=size, block=cfg.block_size,
+            depth=cfg.queue_depth, iters=4, engine=cfg.engine,
+            tmpdir=args.tmpdir, json=True, raid=4, raid_chunk=512 * 1024))
+        print(f"host-delivered RAID0 (4 members, striped alias): "
+              f"{raid_res['host_gbps']:.3f} GB/s = {raid_res['vs_raw']:.3f} "
+              f"of the bare-engine member read", file=sys.stderr)
+    except Exception as e:
+        print(f"ssd2host raid arm failed: {e!r}", file=sys.stderr)
+
     # --- second north star FIRST: loader throughput + data-stall count on
     # --- the real device (config #4 shape). Runs before the bulk-bandwidth
     # --- phase: the stall measurement moves ~2 MB of batches, but 2 GiB of
@@ -242,6 +261,7 @@ def main() -> int:
                     b if isinstance(b, int) else 1 << 30)
 
         best = None
+        llama_attempts: list[list] = []  # [headline stalls, bounded stalls]
         for att in range(3):  # NOT named `attempt`: that's the helper above
             # per-attempt try: a relay flake on attempt 2 must not discard a
             # successful attempt's result (nor sink the bandwidth phase)
@@ -249,8 +269,14 @@ def main() -> int:
                 lres = bench_llama(largs)
             except Exception as e:
                 print(f"llama attempt {att} failed: {e!r}", file=sys.stderr)
+                # failed attempts must stay visible in the audit arrays —
+                # hiding them is exactly the invisible-discard problem the
+                # arrays exist to fix
+                llama_attempts.append([None, None])
                 continue
             stalls = lres.get("train_data_stalls")
+            llama_attempts.append([stalls,
+                                   lres.get("bounded_train_data_stalls")])
             print(f"llama attempt {att}: "
                   f"{lres['tokens_per_s']:.0f} tok/s flat-out; "
                   f"with {lres.get('train_model')}+{lres.get('train_attn')}"
@@ -275,6 +301,12 @@ def main() -> int:
                 "bounded_steps": best.get("bounded_steps"),
                 "bounded_prefetch": best.get("bounded_prefetch"),
                 "bounded_step_delay_s": best.get("bounded_step_delay_s"),
+                # per-attempt audit (VERDICT.md r4 next #3): what the
+                # best-of-3 min-stalls selection saw and discarded
+                "train_data_stalls_attempts":
+                    [a[0] for a in llama_attempts],
+                "bounded_train_data_stalls_attempts":
+                    [a[1] for a in llama_attempts],
             }
 
         # config #2: ResNet-50 images/s (the headline metric's second half)
@@ -321,47 +353,105 @@ def main() -> int:
         vision_arm("resnet PREDECODED", bench_resnet, prargs,
                    "resnet_predecoded", "resnet_predecoded_stalls")
 
-        def bounded_vision(name: str, fn, base, stall_key: str) -> None:
-            """Bounded-depth companion at relay-feasible step bytes: the
-            non-degenerate 0-stall arm for vision (same execution-paced
-            protocol as the llama bounded arm), run at batch 16 x 112^2 =
-            602KB/step. At the headline 64 x 224^2 shape a step moves 9.6MB
-            through the relay, which at the throttle's worst observed state
-            (0.003 GB/s) needs ~3.2s against the ~1s consumer pace — the
-            arm then measures relay bandwidth, not overlap (36/40 stalls
-            observed), exactly the weather-hostage number the binding set
-            exists to exclude. 602KB/step stays inside the burst bucket at
-            every throttle state observed on this box (BASELINE.md §C)."""
+        def bounded_vision_arm(name: str, fn, base, *, batch: int,
+                               image_size: int
+                               ) -> tuple[int | None, list[int]]:
+            """One bounded-depth vision arm at the given shape (execution-
+            paced consumer, depth 4, 40 steps — the llama bounded
+            protocol), best-of-2 on min stalls with the per-attempt list
+            returned for the audit trail (VERDICT.md r4 next #3)."""
             bargs = argparse.Namespace(**{
-                **vars(base), "batch": 16, "image_size": 112, "steps": 4,
-                "prefetch": 16, "predecoded": True,
+                **vars(base), "batch": batch, "image_size": image_size,
+                "steps": 4, "prefetch": 16, "predecoded": True,
                 "bounded_steps": 40, "bounded_prefetch": 4})
             # best-of-2 (min stalls), the same methodology as the llama
             # phase's best-of-3: one relay latency spike over a 40-step run
             # is jitter, not a property of the overlap machinery
             best_s = None
+            attempts: list[int] = []
             for _ in range(2):
                 res = attempt(name, lambda: fn(bargs))
                 if res is None:
                     continue
                 s = res.get("bounded_train_data_stalls")
-                if isinstance(s, int) and (best_s is None or s < best_s):
-                    best_s = s
-                print(f"{name} bounded arm (16x112, depth "
+                if isinstance(s, int):
+                    attempts.append(s)
+                    if best_s is None or s < best_s:
+                        best_s = s
+                print(f"{name} bounded arm ({batch}x{image_size}, depth "
                       f"{res.get('bounded_prefetch')}, "
                       f"{res.get('bounded_steps')} steps, "
                       f"{res.get('bounded_step_delay_s')}s/step pace): "
                       f"{s} stalls", file=sys.stderr)
                 if s == 0:
                     break
+            return best_s, attempts
+
+        def bounded_vision(name: str, fn, base, stall_key: str) -> None:
+            """The binding bounded arm at relay-feasible step bytes: batch
+            16 x 112^2 = 602KB/step. At the headline 64 x 224^2 shape a
+            step moves 9.6MB through the relay, which at the throttle's
+            worst observed state (0.003 GB/s) needs ~3.2s against the ~1s
+            consumer pace — the arm then measures relay bandwidth, not
+            overlap (36/40 stalls observed), exactly the weather-hostage
+            number the binding set exists to exclude. 602KB/step stays
+            inside the burst bucket at every throttle state observed on
+            this box (BASELINE.md §C). The headline shape is attempted
+            separately, gated on a link probe (see bounded_headline)."""
+            best_s, attempts = bounded_vision_arm(name, fn, base, batch=16,
+                                                  image_size=112)
             if best_s is None:
                 return
             loader_res[stall_key] = best_s
-            loader_res["bounded_vision_shape"] = \
-                f"{bargs.batch}x{bargs.image_size}"
+            loader_res[stall_key + "_attempts"] = attempts
+            loader_res["bounded_vision_shape"] = "16x112"
+
+        def probe_link_gbps(nbytes: int = 32 * 1024 * 1024) -> float:
+            """Timed device_put+fetch of fresh random bytes (the relay
+            content-caches repeats, BASELINE.md §C) — a burst-state sample
+            of the host->HBM link, for gating the headline-shape arm."""
+            import jax
+
+            a = np.random.default_rng(os.getpid() + int(time.time())) \
+                .integers(0, 256, nbytes, dtype=np.uint8)
+            dev = jax.devices()[0]
+            t0 = time.perf_counter()
+            x = jax.device_put(a, dev)
+            x.block_until_ready()
+            np.asarray(x[:1])  # arrival-forced (block_ready acks dispatch)
+            return nbytes / (time.perf_counter() - t0) / 1e9
+
+        def bounded_headline(name: str, fn, base) -> None:
+            """VERDICT.md r4 next #6: attempt the HEADLINE-shape (64x224^2,
+            9.6MB/step) bounded arm opportunistically instead of silently
+            running only the reduced shape. A link probe decides: the arm
+            needs 9.6MB inside the ~1s pace with margin, so require a
+            probed burst rate >= 0.05 GB/s (~5x). The decision, the probed
+            rate, and the stalls (when attempted) all land in the artifact
+            — a good-weather round upgrades the claim automatically."""
+            headline = {"shape": "64x224", "step_bytes": 64 * 224 * 224 * 3,
+                        "attempted": False, "link_probe_gbps": None,
+                        "stalls": None, "stalls_attempts": []}
+            probe = attempt("headline link probe", probe_link_gbps, tries=1)
+            if probe is not None:
+                headline["link_probe_gbps"] = round(probe, 4)
+                if probe >= 0.05:
+                    headline["attempted"] = True
+                    best_s, attempts = bounded_vision_arm(
+                        name + " HEADLINE", fn, base, batch=64,
+                        image_size=224)
+                    headline["stalls"] = best_s
+                    headline["stalls_attempts"] = attempts
+                else:
+                    print(f"headline bounded arm skipped: probed link "
+                          f"{probe:.4f} GB/s < 0.05 GB/s budget "
+                          f"(9.6MB/step would measure the throttle)",
+                          file=sys.stderr)
+            loader_res["bounded_vision_headline"] = headline
 
         bounded_vision("resnet PREDECODED", bench_resnet, rargs,
                        "resnet_predecoded_stalls_bounded")
+        bounded_headline("resnet PREDECODED", bench_resnet, rargs)
 
         # config #3: ViT-B/16 over WDS tar shards on a 4-member RAID0
         # striped set (BASELINE.json:9) — previously only in BASELINE.md §C
@@ -563,6 +653,19 @@ def main() -> int:
         # raw NVMe" (SURVEY.md §6, BASELINE.json:5)
         "host_delivered_gbps": round(host_gbps, 4),
         "vs_baseline_host": round(host_gbps / raw_gbps, 4) if raw_gbps else 0.0,
+        # per-pass audit trail for the best-of selection (VERDICT.md r4
+        # next #3)
+        "raw_gbps_passes": hres.get("raw_gbps_passes"),
+        "host_gbps_passes": hres.get("host_gbps_passes"),
+        # the striped-path ratio (VERDICT.md r4 next #2): same methodology,
+        # reference deployment shape (4-member RAID0 alias)
+        "raw_raid_gbps": raid_res["raw_gbps"] if raid_res else None,
+        "host_raid_gbps": raid_res["host_gbps"] if raid_res else None,
+        "vs_baseline_host_raid": raid_res["vs_raw"] if raid_res else None,
+        "raw_raid_gbps_passes":
+            raid_res["raw_gbps_passes"] if raid_res else None,
+        "host_raid_gbps_passes":
+            raid_res["host_gbps_passes"] if raid_res else None,
         # null (not 0.0) when the transfer didn't take the streamed path
         # (size < overlap_min_bytes): 0.0 would read as "link idle the whole
         # transfer", the opposite of "not measured"
@@ -593,6 +696,7 @@ def main() -> int:
     # dashboards should diff THIS object across BENCH_r*.json.
     out["binding"] = {
         "vs_baseline_host": out.get("vs_baseline_host"),
+        "vs_baseline_host_raid": out.get("vs_baseline_host_raid"),
         "vs_link": out.get("vs_link"),
         "link_busy_frac": out.get("link_busy_frac"),
         "reader_idle_frac": out.get("reader_idle_frac"),
@@ -608,6 +712,36 @@ def main() -> int:
         # gather of the identical extents (VERDICT.md r4 next #1)
         "parquet_plain_vs_disk": out.get("parquet_plain_vs_disk"),
     }
+    # Everything NOT in the binding set is context: absolute rates and
+    # fixture-bound numbers that move with relay/disk weather (>50x swings,
+    # BASELINE.md §C) and must not be compared round-over-round. Built as
+    # the complement so the JSON is self-describing and no tool needs a
+    # hand-maintained field list (VERDICT.md r4 next #8). Top-level copies
+    # stay for artifact continuity with rounds 1-4.
+    out["context"] = {k: v for k, v in out.items()
+                      if k not in out["binding"]
+                      and k not in ("metric", "unit", "binding")}
+    # The deferred-evidence ledger (VERDICT.md r4 next #7): what this
+    # sandbox structurally cannot demonstrate and what to run on real
+    # hardware. Mirrors README.md "Proven here vs deferred to hardware".
+    out["needs_real_hardware"] = [
+        "composed e2e >=0.90-of-raw into HBM (vs_baseline): the relay link "
+        "caps it; box-feasible form = vs_baseline_host x vs_link/"
+        "link_busy_frac (both in binding)",
+        "raw-JPEG vision 0-stall (resnet/vit_data_stalls): JPEG decode and "
+        "the tunnel RPC share this box's single core; the predecoded arms "
+        "carry the binding claim",
+        "device-path scan bandwidth: the parquet wide/plain arms are "
+        "host-pinned here (device traffic would measure the relay token "
+        "bucket, 12x observed)",
+        "224^2-shape bounded vision 0-stall: attempted only when the link "
+        "probe clears the 9.6MB/step budget (bounded_vision_headline "
+        "records the decision)",
+        "kernel-vs-XLA compute timing: the relay acks dispatch and "
+        "memoizes repeats; kernel parity is tested exactly instead",
+        "real multi-chip execution: one chip here; sharding is validated "
+        "on virtual meshes (MULTICHIP_r*.json) and 16/32-device lowering",
+    ]
 
     print(json.dumps(out))
     return 0

@@ -17,6 +17,32 @@ def _engine_desc(ctx) -> str:
     return f"{eng.name}(rings={rings})" if rings is not None else eng.name
 
 
+def _deliver_tokens(tokens_host: np.ndarray, mesh, spec,
+                    engine: str) -> tuple:
+    """Deliver a token batch through the REAL data path: write it to disk,
+    then memcpy_ssd2tpu it onto *mesh* with the given PartitionSpec.
+    Returns (sharded tokens, engine description) — the shared shape of
+    every non-striped delivery-fed matrix config (VERDICT.md r4 next #4)."""
+    from jax.sharding import NamedSharding
+
+    from strom.config import StromConfig
+    from strom.delivery.core import StromContext
+
+    with tempfile.TemporaryDirectory() as td:
+        path = os.path.join(td, "tokens.bin")
+        tokens_host.tofile(path)
+        ctx = StromContext(StromConfig(engine=engine, queue_depth=8,
+                                       num_buffers=8))
+        try:
+            desc = _engine_desc(ctx)
+            tokens = ctx.memcpy_ssd2tpu(
+                path, shape=tokens_host.shape, dtype=tokens_host.dtype,
+                sharding=NamedSharding(mesh, spec))
+        finally:
+            ctx.close()
+    return tokens, desc
+
+
 def run_dryrun(n_devices: int) -> None:
     import jax
 
@@ -46,32 +72,27 @@ def run_dryrun(n_devices: int) -> None:
 
     # Deliver the token batch through the real data path: packed-token .bin on
     # disk -> memcpy_ssd2tpu -> jax.Array sharded P("dp") over the mesh.
+    # Flagship config rides the PRODUCTION engine (engine="auto": the C++
+    # io_uring engine when it initializes, else the Python fallback —
+    # VERDICT.md r3 next #3): the virtual-mesh correctness matrix must
+    # exercise the same data path the benches run.
     B, S = 2 * axes["dp"], 64
     rng = np.random.default_rng(0)
     tokens_host = rng.integers(0, cfg.vocab, size=(B, S + 1), dtype=np.int32)
-    with tempfile.TemporaryDirectory() as td:
-        path = os.path.join(td, "tokens.bin")
-        tokens_host.tofile(path)
-        # flagship config rides the PRODUCTION engine (engine="auto": the
-        # C++ io_uring engine when it initializes, else the Python fallback
-        # — VERDICT.md r3 next #3): the virtual-mesh correctness matrix must
-        # exercise the same data path the benches run
-        ctx = StromContext(StromConfig(engine="auto", queue_depth=8, num_buffers=8))
-        try:
-            eng_desc = _engine_desc(ctx)
-            batch = ctx.memcpy_ssd2tpu(
-                path, shape=(B, S + 1), dtype=np.int32,
-                sharding=NamedSharding(mesh, P("dp", None)))
-            state, metrics = step(state, batch)
-            loss = float(metrics["loss"])
-        finally:
-            ctx.close()
+    batch, eng_desc = _deliver_tokens(tokens_host, mesh, P("dp", None),
+                                      "auto")
+    state, metrics = step(state, batch)
+    loss = float(metrics["loss"])
     assert np.isfinite(loss), f"non-finite loss {loss}"
     assert int(state.step) == 1
     print(f"dryrun ok: mesh={axes}, devices={n_devices}, loss={loss:.4f}, "
           f"engine={eng_desc}")
 
-    # Long-context path: dp×sp mesh, sequence-sharded batch, ring attention
+    # Long-context path: dp×sp mesh, SEQUENCE-SHARDED delivery — the batch
+    # arrives P("dp", "sp") through the real data path, so the shard
+    # planner runs on a non-batch axis inside the matrix (each device's
+    # byte ranges are row FRAGMENTS of the packed records, not whole rows —
+    # VERDICT.md r4 next #4), then ring attention consumes it.
     if n_devices >= 2 and n_devices % 2 == 0:
         # keep dp ≥ 2 when possible so both axes are exercised
         sp = 2
@@ -85,13 +106,15 @@ def run_dryrun(n_devices: int) -> None:
         sp_step = make_train_step(cfg, sp_mesh, optimizer, sp=True,
                                   attn="flash")
         B, L = 2 * sp_axes["dp"], 64  # record length divisible by sp
-        tokens = jnp.asarray(
-            np.random.default_rng(1).integers(0, cfg.vocab, (B, L), dtype=np.int32))
-        tokens = jax.device_put(tokens, NamedSharding(sp_mesh, P("dp", "sp")))
+        tokens_host = np.random.default_rng(1).integers(
+            0, cfg.vocab, (B, L), dtype=np.int32)
+        tokens, eng_desc = _deliver_tokens(tokens_host, sp_mesh,
+                                           P("dp", "sp"), "auto")
         state, metrics = sp_step(state, tokens)
         sp_loss = float(metrics["loss"])
         assert np.isfinite(sp_loss), f"non-finite sp loss {sp_loss}"
-        print(f"dryrun ok: mesh={sp_axes} (ring attention), loss={sp_loss:.4f}")
+        print(f"dryrun ok: mesh={sp_axes} (ring attention), loss={sp_loss:.4f}, "
+              f"engine={eng_desc}, delivery=P('dp','sp') sequence-sharded")
 
     # Expert-parallel path: dp×ep mesh, MoE model, ep-sharded expert stacks
     if n_devices >= 2 and n_devices % 2 == 0:
@@ -107,12 +130,18 @@ def run_dryrun(n_devices: int) -> None:
         state = init_moe_train_state(jax.random.PRNGKey(0), mcfg, ep_mesh, optimizer)
         ep_step = make_moe_train_step(mcfg, ep_mesh, optimizer)
         B = 2 * ep_axes["dp"]
-        tokens = jnp.asarray(np.random.default_rng(2).integers(
-            0, mcfg.base.vocab, (B, 64), dtype=np.int32))
+        tokens_host = np.random.default_rng(2).integers(
+            0, mcfg.base.vocab, (B, 64), dtype=np.int32)
+        # delivery-fed (VERDICT.md r4 next #4): dp-sharded batch through the
+        # real data path on the Python engine (engine diversity across the
+        # matrix; the flagship/sp configs ride uring)
+        tokens, eng_desc = _deliver_tokens(tokens_host, ep_mesh,
+                                           P("dp", None), "python")
         state, metrics = ep_step(state, tokens)
         ep_loss = float(metrics["loss"])
         assert np.isfinite(ep_loss), f"non-finite ep loss {ep_loss}"
-        print(f"dryrun ok: mesh={ep_axes} (MoE expert parallel), loss={ep_loss:.4f}")
+        print(f"dryrun ok: mesh={ep_axes} (MoE expert parallel), "
+              f"loss={ep_loss:.4f}, engine={eng_desc}")
 
     # MoE × long-context: dp×ep×sp — expert parallelism composed with ring
     # attention (flash inside the ring) over a sequence-sharded batch; the
@@ -129,15 +158,18 @@ def run_dryrun(n_devices: int) -> None:
         mix_step = make_moe_train_step(mcfg, mix_mesh, optimizer, sp=True,
                                        attn="flash")
         B, L = 2 * mix_axes["dp"], 64
-        tokens = jnp.asarray(np.random.default_rng(5).integers(
-            0, mcfg.base.vocab, (B, L), dtype=np.int32))
-        tokens = jax.device_put(tokens,
-                                NamedSharding(mix_mesh, P("dp", "sp")))
+        tokens_host = np.random.default_rng(5).integers(
+            0, mcfg.base.vocab, (B, L), dtype=np.int32)
+        # delivery-fed, sequence-sharded on a THREE-axis mesh: the planner
+        # splits rows over dp and row fragments over sp while ep stays
+        # replicated for the batch (VERDICT.md r4 next #4)
+        tokens, eng_desc = _deliver_tokens(tokens_host, mix_mesh,
+                                           P("dp", "sp"), "python")
         state, metrics = mix_step(state, tokens)
         mix_loss = float(metrics["loss"])
         assert np.isfinite(mix_loss), f"non-finite dp×ep×sp loss {mix_loss}"
         print(f"dryrun ok: mesh={mix_axes} (dp×ep×sp MoE ring×flash), "
-              f"loss={mix_loss:.4f}")
+              f"loss={mix_loss:.4f}, engine={eng_desc}")
 
     # Pipeline parallelism: dp×pp — layer stacks pp-sharded, microbatches
     # pumped through the stages via ppermute, fed by the real delivery path
@@ -200,19 +232,9 @@ def run_dryrun(n_devices: int) -> None:
         tokens_host = np.random.default_rng(5).integers(
             0, cfg.vocab, size=(B, 64), dtype=np.int32)
         # through the real delivery path, like the other pipeline case
-        with tempfile.TemporaryDirectory() as td:
-            path = os.path.join(td, "tpp_tokens.bin")
-            tokens_host.tofile(path)
-            ctx = StromContext(StromConfig(engine="python", queue_depth=8,
-                                           num_buffers=8))
-            try:
-                eng_desc = _engine_desc(ctx)
-                tokens = ctx.memcpy_ssd2tpu(
-                    path, shape=(B, 64), dtype=np.int32,
-                    sharding=NamedSharding(mesh_tpp, P("dp", None)))
-                state, metrics = step_tpp(state, tokens)
-            finally:
-                ctx.close()
+        tokens, eng_desc = _deliver_tokens(tokens_host, mesh_tpp,
+                                           P("dp", None), "python")
+        state, metrics = step_tpp(state, tokens)
         tpp_loss = float(metrics["loss"])
         assert np.isfinite(tpp_loss), f"non-finite dp×tp×pp loss {tpp_loss}"
         print(f"dryrun ok: mesh={axes_tpp} (dp×tp×pp pipeline), "
@@ -233,19 +255,9 @@ def run_dryrun(n_devices: int) -> None:
             tokens_host = np.random.default_rng(6).integers(
                 0, cfg.vocab, size=(4, 64), dtype=np.int32)
             # sequence-sharded delivery through the real data path
-            with tempfile.TemporaryDirectory() as td:
-                path = os.path.join(td, "tspp_tokens.bin")
-                tokens_host.tofile(path)
-                ctx = StromContext(StromConfig(engine="python",
-                                               queue_depth=8, num_buffers=8))
-                try:
-                    eng_desc = _engine_desc(ctx)
-                    tokens = ctx.memcpy_ssd2tpu(
-                        path, shape=(4, 64), dtype=np.int32,
-                        sharding=NamedSharding(mesh4, P(None, "sp")))
-                    state, metrics = step4(state, tokens)
-                finally:
-                    ctx.close()
+            tokens, eng_desc = _deliver_tokens(tokens_host, mesh4,
+                                               P(None, "sp"), "python")
+            state, metrics = step4(state, tokens)
             loss4 = float(metrics["loss"])
             assert np.isfinite(loss4), f"non-finite tp×sp×pp loss {loss4}"
             print(f"dryrun ok: mesh={axes4} (tp×sp×pp, flash ring in-pipe), "
@@ -260,13 +272,18 @@ def run_dryrun(n_devices: int) -> None:
         state = init_train_state(jax.random.PRNGKey(0), cfg, mesh3, optimizer)
         step3 = make_train_step(cfg, mesh3, optimizer, sp=True, attn="flash")
         B = 2 * axes3["dp"]
-        tokens = jnp.asarray(np.random.default_rng(3).integers(
-            0, cfg.vocab, (B, 64), dtype=np.int32))
-        tokens = jax.device_put(tokens, NamedSharding(mesh3, P("dp", "sp")))
+        tokens_host = np.random.default_rng(3).integers(
+            0, cfg.vocab, (B, 64), dtype=np.int32)
+        # delivery-fed, sequence-sharded, production engine (VERDICT.md r4
+        # next #4): the full dp×tp×sp composition eats a planner-delivered
+        # P("dp","sp") batch off the C++ engine
+        tokens, eng_desc = _deliver_tokens(tokens_host, mesh3,
+                                           P("dp", "sp"), "auto")
         state, metrics = step3(state, tokens)
         loss3 = float(metrics["loss"])
         assert np.isfinite(loss3), f"non-finite 3-axis loss {loss3}"
-        print(f"dryrun ok: mesh={axes3} (dp×tp×sp ring×flash), loss={loss3:.4f}")
+        print(f"dryrun ok: mesh={axes3} (dp×tp×sp ring×flash), "
+              f"loss={loss3:.4f}, engine={eng_desc}")
 
     # Llama-3-8B at its REAL shape (BASELINE.json:10 names Llama-3-8B; every
     # executed config above runs tiny shapes — VERDICT.md r3 next #7): lower
@@ -309,3 +326,124 @@ def run_dryrun(n_devices: int) -> None:
         print(f"dryrun ok: Llama-3-8B real shape lowered on "
               f"{dict(dp=n_devices // 4, tp=2, sp=2)} "
               f"(params={n_params:,}, seq=4096, ring×flash, lowering only)")
+
+    # 16/32-device lowering (VERDICT.md r4 next #5): this process's backend
+    # is pinned at n_devices, so the bigger virtual meshes run in a
+    # subprocess that forces its own device count. Lowering-only — catches
+    # axis-factorization and sharding-spec bugs the 8-device shape can't
+    # express (e.g. dp×tp×sp×pp all ≥2 at once). STROM_DRYRUN_AT_SCALE=0
+    # opts out: the pytest suite reaches run_dryrun(8) through the driver
+    # entry and must not pay a second jax cold-start + an 8B pp lowering
+    # on the 1-core box (conftest sets it; the driver leaves it on).
+    if n_devices >= 8 and os.environ.get("STROM_DRYRUN_AT_SCALE", "1") != "0":
+        import subprocess
+        import sys
+
+        repo_root = os.path.dirname(os.path.dirname(
+            os.path.dirname(os.path.abspath(__file__))))
+        env = dict(os.environ)
+        env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=32"
+        env["JAX_PLATFORMS"] = "cpu"
+        res = subprocess.run(
+            [sys.executable, "-m", "strom.parallel.dryrun",
+             "--lower-at-scale"],
+            env=env, capture_output=True, text=True, timeout=900,
+            cwd=repo_root)
+        sys.stdout.write(res.stdout)
+        if res.returncode != 0:
+            raise RuntimeError(
+                f"--lower-at-scale subprocess failed (rc={res.returncode}):\n"
+                f"{res.stderr[-2000:]}")
+
+
+def lower_at_scale() -> None:
+    """Lowering-only validation past the executed matrix's 8 devices
+    (VERDICT.md r4 next #5): the Llama-3-8B training step on a 16-device
+    dp×tp×sp×pp mesh (every axis ≥ 2 simultaneously — the composition an
+    8-device mesh cannot factor) and the scan-mesh all-reduce on 32
+    devices. No execution, no parameters materialized: abstract state with
+    the real shardings, exactly like run_dryrun's 8B section."""
+    import math
+    from functools import partial
+
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from strom.models.llama import LlamaConfig, init_params
+    from strom.parallel.mesh import make_mesh
+    from strom.parallel.pipeline import make_pp_train_step
+    from strom.parallel.sharding import param_shardings
+    from strom.parallel.train import TrainState, make_optimizer
+
+    devs = jax.devices()
+    if len(devs) < 32:
+        raise RuntimeError(f"lower_at_scale needs 32 virtual devices, "
+                           f"have {len(devs)}")
+
+    # Llama-3-8B pipelined step on dp×tp×sp×pp at 16 devices
+    cfg8 = LlamaConfig.llama3_8b()
+    assert cfg8.param_count() == 8_030_261_248
+    axes16 = {"dp": 2, "tp": 2, "sp": 2, "pp": 2}
+    assert math.prod(axes16.values()) == 16  # axis factorization
+    mesh16 = make_mesh(axes16, devices=devs[:16])
+    optimizer = make_optimizer()
+    shapes = jax.eval_shape(partial(init_params, cfg=cfg8),
+                            jax.random.key(0))
+    shardings16 = param_shardings(shapes, mesh16)
+    # the Megatron pairs AND the pipeline stage split must all land: wq
+    # column-parallel (tp on its output dim) with pp on the stacked-layer
+    # dim; wo row-parallel (tp on its input dim)
+    wq_spec = shardings16["layers"]["wq"].spec
+    wo_spec = shardings16["layers"]["wo"].spec
+    assert wq_spec.index("pp") == 0 and wq_spec.index("tp") == 2, wq_spec
+    assert wo_spec.index("pp") == 0 and wo_spec.index("tp") == 1, wo_spec
+    params_s = jax.tree.map(
+        lambda s, sh: jax.ShapeDtypeStruct(s.shape, s.dtype, sharding=sh),
+        shapes, shardings16)
+    opt_s = jax.eval_shape(optimizer.init, params_s)
+    state_s = TrainState(params=params_s, opt_state=opt_s,
+                         step=jax.ShapeDtypeStruct((), jnp.int32))
+    step16 = make_pp_train_step(cfg8, mesh16, optimizer, microbatches=2,
+                                attn="flash")
+    toks_s = jax.ShapeDtypeStruct(
+        (4, 4096), jnp.int32,
+        sharding=NamedSharding(mesh16, P("dp", "sp")))
+    lowered = step16.lower(state_s, toks_s)
+    assert lowered.as_text()
+    print(f"dryrun ok: Llama-3-8B lowered on {axes16} (16 devices, "
+          f"pp pipeline + ring×flash over sp, lowering only)")
+
+    # scan-mesh collective reducer at 32 devices (the parquet fan-out's
+    # cross-pod all-reduce, pipelines/parquet_scan._mesh_reducer)
+    from strom.pipelines.parquet_scan import _mesh_reducer
+
+    mesh32 = jax.sharding.Mesh(np.asarray(devs[:32]), ("scan",))
+    reducer = _mesh_reducer(mesh32)
+    part_s = jax.ShapeDtypeStruct(
+        (32, 8), jnp.float32,
+        sharding=NamedSharding(mesh32, P("scan", None)))
+    lowered = reducer.lower(part_s)
+    assert lowered.as_text()
+    print("dryrun ok: scan-mesh all-reduce lowered on 32 devices "
+          "(replicated out_sharding, lowering only)")
+
+
+if __name__ == "__main__":
+    import sys
+
+    if "--lower-at-scale" in sys.argv:
+        # standalone-safe: force the 32-device CPU backend ourselves. The
+        # env alone is NOT enough — the sandbox re-pins JAX_PLATFORMS=axon
+        # at interpreter startup, so the config update (before any backend
+        # touch; this module imports no jax at module level) must win.
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "--xla_force_host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = \
+                (flags + " --xla_force_host_platform_device_count=32").strip()
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+        lower_at_scale()
+    else:
+        run_dryrun(int(sys.argv[1]) if len(sys.argv) > 1 else 8)

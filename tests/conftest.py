@@ -17,6 +17,11 @@ jax.config.update("jax_platforms", "cpu")
 import numpy as np
 import pytest
 
+# the dryrun's 16/32-device lowering runs in a subprocess (own jax
+# cold-start + an 8B pp lowering) — driver-artifact work, not suite work
+# on a 1-core box; the dryrun test covers the executed 8-device matrix
+os.environ.setdefault("STROM_DRYRUN_AT_SCALE", "0")
+
 
 @pytest.fixture(scope="session")
 def rng():

@@ -174,6 +174,49 @@ def test_bench_ssd2host_smoke(tmp_path, rng, engine_name):
     assert res["bench"] == "ssd2host" and res["bytes"] == n
     assert res["raw_gbps"] > 0 and res["host_gbps"] > 0
     assert res["vs_raw"] > 0 and res["passes"] == 2
+    # per-pass audit arrays: one entry per pass, best == max (VERDICT.md
+    # r4 next #3)
+    assert len(res["raw_gbps_passes"]) == 2
+    assert len(res["host_gbps_passes"]) == 2
+    assert res["raw_gbps"] == max(res["raw_gbps_passes"])
+    assert res["host_gbps"] == max(res["host_gbps_passes"])
+
+
+def test_bench_ssd2host_raid_smoke(tmp_path, rng, engine_name):
+    """--raid: the framework arm reads the whole logical file through the
+    striped alias byte-exactly (checked via memcpy_ssd2host against the
+    source), and the phase reports the striped-shape fields."""
+    import argparse
+
+    from strom.cli import bench_ssd2host
+
+    n = 4 << 20
+    data = rng.integers(0, 256, n, dtype=np.uint8)
+    p = tmp_path / "ratio_raid.bin"
+    data.tofile(p)
+    chunk = 64 * 1024
+    res = bench_ssd2host(argparse.Namespace(
+        file=str(p), size=n, block=128 * 1024, depth=8, iters=2,
+        engine=engine_name, tmpdir=str(tmp_path), json=True,
+        raid=4, raid_chunk=chunk))
+    assert res["raid_members"] == 4
+    assert res["bytes"] == n  # 4MiB is a multiple of the 256KiB stripe
+    assert res["raw_gbps"] > 0 and res["host_gbps"] > 0 and res["vs_raw"] > 0
+    # integrity: the striped-alias host path must return the source bytes
+    # (the bench arms only time; this is the correctness side)
+    from strom.config import StromConfig
+    from strom.delivery.core import StromContext
+
+    ctx = StromContext(StromConfig(engine=engine_name, queue_depth=8,
+                                   num_buffers=8))
+    try:
+        members = [f"{p}.r{i}of4.c{chunk}" for i in range(4)]
+        virt = str(tmp_path / "ratio.raid0")
+        ctx.register_striped(virt, members, chunk, size=n)
+        got = ctx.memcpy_ssd2host(virt, length=n)
+        np.testing.assert_array_equal(got, data)
+    finally:
+        ctx.close()
 
 
 def test_ssd2host_striped_alias(ctx, tmp_path, rng):

@@ -19,10 +19,14 @@ import json
 import os
 import sys
 
-# binding fields first (the metric of record), then context rows that help
-# interpret them; older artifacts predate some keys and print "-"
-BINDING_KEYS = [
+# Binding rows come from the artifacts' own "binding" objects (r5+ JSONs
+# are self-describing — VERDICT.md r4 next #8); this list only fixes the
+# display ORDER for known keys, with unknown binding keys appended. Context
+# rows stay a short curated set: the full "context" object is the
+# complement of binding and too wide to tabulate.
+BINDING_ORDER = [
     "vs_baseline_host",
+    "vs_baseline_host_raid",
     "vs_link",
     "link_busy_frac",
     "reader_idle_frac",
@@ -32,13 +36,18 @@ BINDING_KEYS = [
     "resnet_predecoded_stalls_bounded",
     "vit_predecoded_stalls",
     "vit_predecoded_stalls_bounded",
+    "parquet_plain_vs_disk",
 ]
 CONTEXT_KEYS = [
     "raw_gbps",            # denominator (disk weather, NOT comparable)
     "value",               # delivered GB/s (relay weather, NOT comparable)
     "parquet_rows_per_s",
     "parquet_wide_selected_gbps",
+    "parquet_plain_selected_gbps",
 ]
+# per-attempt / per-pass audit arrays (VERDICT.md r4 next #3): printed so
+# the best-of selection's discards are visible in the comparison too
+AUDIT_SUFFIXES = ("_attempts", "_passes")
 
 
 def unwrap(d: dict) -> dict:
@@ -86,18 +95,50 @@ def main(argv: list[str]) -> int:
             print(f"skipping {p}: {e}", file=sys.stderr)
     if not rounds:
         return 1
-    name_w = max(len(k) for k in BINDING_KEYS + CONTEXT_KEYS) + 2
-    col_w = max(max(len(n) for n, _ in rounds) + 2, 12)
+    binding_keys = list(BINDING_ORDER)
+    for _, d in rounds:  # self-described keys this tool predates
+        for k in (d.get("binding") or {}):
+            if k not in binding_keys:
+                binding_keys.append(k)
+    audit_keys = sorted({k for _, d in rounds for k in d
+                         if k.endswith(AUDIT_SUFFIXES)
+                         and isinstance(d[k], list)})
+
+    def audit_cell(v) -> str:
+        """Compact list rendering that fits a table column: int lists (stall
+        attempts) join verbatim, float lists (GB/s passes) compress to a
+        min..max xN range."""
+        if not isinstance(v, list):
+            return "-"
+        if not v:
+            return "[]"
+        if all(isinstance(x, int) for x in v):
+            return ",".join(str(x) for x in v)
+        if all(isinstance(x, (int, float)) for x in v):
+            return f"{min(v):.2f}..{max(v):.2f}x{len(v)}"
+        return ",".join("?" if x is None else str(x) for x in v)
+
+    audit_cells = {k: [audit_cell(d.get(k)) for _, d in rounds]
+                   for k in audit_keys}
+    name_w = max(len(k) for k in binding_keys + CONTEXT_KEYS + audit_keys) + 2
+    col_w = max(max(len(n) for n, _ in rounds) + 2, 12,
+                *(len(c) + 2 for cs in audit_cells.values() for c in cs),
+                2)
     header = " " * name_w + "".join(n.rjust(col_w) for n, _ in rounds)
     print(header)
     print("binding (comparable round-over-round):")
-    for k in BINDING_KEYS:
+    for k in binding_keys:
         print(k.ljust(name_w)
               + "".join(cell(d, k).rjust(col_w) for _, d in rounds))
     print("context (weather / fixture-bound — NOT comparable):")
     for k in CONTEXT_KEYS:
         print(k.ljust(name_w)
               + "".join(cell(d, k).rjust(col_w) for _, d in rounds))
+    if audit_keys:
+        print("audit (per-attempt/per-pass lists behind each best-of):")
+        for k in audit_keys:
+            print(k.ljust(name_w)
+                  + "".join(c.rjust(col_w) for c in audit_cells[k]))
     return 0
 
 
