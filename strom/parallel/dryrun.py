@@ -436,11 +436,13 @@ if __name__ == "__main__":
         # standalone-safe: force the 32-device CPU backend ourselves. The
         # env alone is NOT enough — the sandbox re-pins JAX_PLATFORMS=axon
         # at interpreter startup, so the config update (before any backend
-        # touch; this module imports no jax at module level) must win.
-        flags = os.environ.get("XLA_FLAGS", "")
-        if "--xla_force_host_platform_device_count" not in flags:
-            os.environ["XLA_FLAGS"] = \
-                (flags + " --xla_force_host_platform_device_count=32").strip()
+        # touch; this module imports no jax at module level) must win. An
+        # INHERITED device-count flag (e.g. pytest's 8) is replaced, not
+        # kept — this entry point means 32.
+        flags = [f for f in os.environ.get("XLA_FLAGS", "").split()
+                 if not f.startswith("--xla_force_host_platform_device_count")]
+        flags.append("--xla_force_host_platform_device_count=32")
+        os.environ["XLA_FLAGS"] = " ".join(flags)
         import jax
 
         jax.config.update("jax_platforms", "cpu")
