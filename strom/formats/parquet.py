@@ -310,8 +310,23 @@ def decode_plain_pages(col_meta, col_schema, buf: np.ndarray
     nulls_known_zero = stats is not None and stats.has_null_count \
         and stats.null_count == 0
     mv = buf if isinstance(buf, (bytes, memoryview)) else memoryview(buf)
+    try:
+        return _walk_plain_pages(mv, col_meta.num_values, np_dtype, max_def,
+                                 nulls_known_zero)
+    except (IndexError, ValueError, TypeError, RecursionError) as e:
+        # truncated/corrupt chunk bytes (header walk past the buffer,
+        # frombuffer over a short page, malformed def-level block, a
+        # missing header field arithmetic'd as None, or bytes that nest
+        # thrift structs past the recursion limit — 0x1C repeated recurses
+        # once per byte) are a "can't prove safe" case like any other —
+        # fall back to pyarrow (whose own decode then produces the
+        # authoritative error) instead of leaking a bare error out of
+        # library code
+        raise _PlainDecodeUnsupported(f"malformed chunk: {e!r}") from None
 
-    total = col_meta.num_values
+
+def _walk_plain_pages(mv, total: int, np_dtype, max_def: int,
+                      nulls_known_zero: bool) -> list[np.ndarray]:
     parts: list[np.ndarray] = []
     pos = 0
     decoded = 0

@@ -410,6 +410,33 @@ class TestPlainDecode:
         plain1, fall1 = self._counters()
         assert plain1 == plain0 and fall1 > fall0
 
+    def test_malformed_chunk_bytes_fall_back_not_crash(self, ctx, tmp_path,
+                                                       rng):
+        """Truncated/garbage chunk bytes must surface as the controlled
+        fallback signal (_PlainDecodeUnsupported), never a bare
+        IndexError/ValueError out of the page walk."""
+        import pyarrow.parquet as pq  # noqa: F401 (fixture dependency)
+
+        from strom.formats.parquet import (ParquetShard,
+                                           _PlainDecodeUnsupported,
+                                           decode_plain_pages)
+
+        p, _ = self._write(tmp_path, rng)
+        shard = ParquetShard(p, ctx=ctx)
+        rg = shard.metadata.row_group(0)
+        ci = shard._col_indices(["a64"])[0]
+        ext = shard.column_chunk_extents(0, ["a64"])
+        good = ctx.pread(ext)
+        schema_col = shard.metadata.schema.column(ci)
+        for bad in (good[:7],                      # truncated mid-header
+                    good[:len(good) // 2],         # truncated mid-values
+                    np.frombuffer(rng.bytes(256), np.uint8),   # garbage
+                    # 0x1C = (field delta 1, type struct): each byte opens
+                    # a nested thrift struct — recursion-limit bomb
+                    np.full(5000, 0x1C, dtype=np.uint8)):
+            with pytest.raises(_PlainDecodeUnsupported):
+                decode_plain_pages(rg.column(ci), schema_col, bad)
+
     def test_single_page_is_view(self, ctx, tmp_path, rng):
         """A single-page chunk decodes to a VIEW over the engine slab (no
         copy) — the property the fast path exists for."""
