@@ -334,8 +334,12 @@ def _walk_plain_pages(mv, total: int, np_dtype, max_def: int,
         header, pos = _thrift_struct(mv, pos)
         page_type = header.get(1)
         comp_size = header.get(3)
-        if comp_size is None:
-            raise _PlainDecodeUnsupported("no page size")
+        # negative sizes/counts are crafted-input territory: comp_size < 0
+        # walks the cursor BACKWARD onto the same header and num_values <= 0
+        # never advances `decoded` (frombuffer treats any negative count as
+        # "all") — an infinite loop, not an exception, so guard explicitly
+        if not isinstance(comp_size, int) or comp_size < 0:
+            raise _PlainDecodeUnsupported(f"bad page size {comp_size}")
         page_end = pos + comp_size
         if page_type != 0:  # 0 = DATA_PAGE (v1); v2/dict/index -> fallback
             raise _PlainDecodeUnsupported(f"page type {page_type}")
@@ -345,6 +349,8 @@ def _walk_plain_pages(mv, total: int, np_dtype, max_def: int,
         num_values = dph.get(1)
         encoding = dph.get(2)
         def_enc = dph.get(3)
+        if not isinstance(num_values, int) or num_values <= 0:
+            raise _PlainDecodeUnsupported(f"bad num_values {num_values}")
         if encoding != 0:  # PLAIN
             raise _PlainDecodeUnsupported(f"encoding {encoding}")
         vpos = pos

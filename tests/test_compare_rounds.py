@@ -1,0 +1,93 @@
+"""tools/compare_rounds.py — the judge-facing round comparison table. It
+reads driver-recorded BENCH_r*.json artifacts of THREE vintages (raw bench
+line, driver-wrapped {'parsed': ...}, tail-scrape fallback) and must keep
+rendering all of them as the artifact schema grows."""
+
+import importlib.util
+import json
+import os
+import sys
+
+import pytest
+
+_SPEC = importlib.util.spec_from_file_location(
+    "compare_rounds",
+    os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+                 "tools", "compare_rounds.py"))
+compare_rounds = importlib.util.module_from_spec(_SPEC)
+_SPEC.loader.exec_module(compare_rounds)
+
+
+OLD_ROUND = {  # r2-era shape: no binding object, top-level fields only
+    "metric": "ssd2hbm_bandwidth", "value": 0.0076, "unit": "GB/s",
+    "vs_link": 0.9901, "link_busy_frac": 0.9933, "train_data_stalls": 1,
+    "raw_gbps": 3.0055,
+}
+NEW_ROUND = {  # r5-era shape: binding + context + audit arrays + headline
+    "metric": "ssd2hbm_bandwidth", "value": 0.019, "unit": "GB/s",
+    "raw_gbps": 3.49,
+    "raw_gbps_passes": [0.78, 3.14, 3.49, 2.96],
+    "train_data_stalls_attempts": [0],
+    "bounded_vision_headline": {"shape": "64x224", "attempted": False,
+                                "link_probe_gbps": 0.0175, "stalls": None},
+    "binding": {"vs_baseline_host": 1.0315, "vs_baseline_host_raid": 0.9708,
+                "train_data_stalls": 0, "some_future_key": 0.5},
+    "context": {"raw_gbps": 3.49},
+}
+DRIVER_WRAPPED = {  # how the driver records it: cmd/rc/tail + parsed
+    "n": 4, "cmd": "python bench.py", "rc": 0,
+    "tail": "device: TPU\n" + json.dumps(OLD_ROUND) + "\n",
+    "parsed": OLD_ROUND,
+}
+
+
+@pytest.fixture()
+def artifacts(tmp_path):
+    paths = []
+    for name, doc in (("BENCH_r02.json", DRIVER_WRAPPED),
+                      ("BENCH_r05.json", NEW_ROUND)):
+        p = tmp_path / name
+        p.write_text(json.dumps(doc))
+        paths.append(str(p))
+    return paths
+
+
+def test_table_renders_all_vintages(artifacts, capsys):
+    assert compare_rounds.main(artifacts) == 0
+    out = capsys.readouterr().out
+    # binding rows: known keys plus self-described ones the tool predates
+    assert "vs_baseline_host" in out
+    assert "some_future_key" in out
+    # old round resolved through the driver wrapper's parsed dict
+    assert "0.9901" in out
+    # audit arrays render compactly (no raw list repr blowing the column)
+    assert "0.78..3.49x4" in out
+    assert "[0.78" not in out
+    # the headline gating decision is visible as a decision, not a blank
+    assert "skip@0.0175" in out
+
+
+def test_tail_scrape_fallback(tmp_path, capsys):
+    """A wrapper with no usable 'parsed' falls back to scraping the JSON
+    line out of 'tail'."""
+    doc = {"cmd": "python bench.py", "rc": 0,
+           "tail": "noise\n" + json.dumps(OLD_ROUND) + "\n"}
+    p = tmp_path / "BENCH_r01.json"
+    p.write_text(json.dumps(doc))
+    assert compare_rounds.main([str(p)]) == 0
+    assert "0.9901" in capsys.readouterr().out
+
+
+def test_unreadable_artifact_skipped(tmp_path, capsys):
+    good = tmp_path / "BENCH_r05.json"
+    good.write_text(json.dumps(NEW_ROUND))
+    bad = tmp_path / "BENCH_r04.json"
+    bad.write_text("{not json")
+    assert compare_rounds.main([str(bad), str(good)]) == 0
+    captured = capsys.readouterr()
+    assert "skipping" in captured.err
+    assert "vs_baseline_host" in captured.out
+
+
+def test_no_artifacts_errors(tmp_path, capsys):
+    assert compare_rounds.main([str(tmp_path / "missing.json")]) == 1

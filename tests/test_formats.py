@@ -433,7 +433,14 @@ class TestPlainDecode:
                     np.frombuffer(rng.bytes(256), np.uint8),   # garbage
                     # 0x1C = (field delta 1, type struct): each byte opens
                     # a nested thrift struct — recursion-limit bomb
-                    np.full(5000, 0x1C, dtype=np.uint8)):
+                    np.full(5000, 0x1C, dtype=np.uint8),
+                    # crafted header with NEGATIVE comp_size (-11) and
+                    # num_values (-2): without explicit guards this loops
+                    # forever (cursor walks backward onto the same header,
+                    # decoded never reaches total)
+                    np.frombuffer(bytes([0x15, 0x00, 0x25, 0x15, 0x2C,
+                                         0x15, 0x03, 0x15, 0x00, 0x00,
+                                         0x00]) + b"\0" * 64, np.uint8)):
             with pytest.raises(_PlainDecodeUnsupported):
                 decode_plain_pages(rg.column(ci), schema_col, bad)
 

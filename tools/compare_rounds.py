@@ -120,9 +120,27 @@ def main(argv: list[str]) -> int:
 
     audit_cells = {k: [audit_cell(d.get(k)) for _, d in rounds]
                    for k in audit_keys}
+
+    def headline_cell(d: dict) -> str:
+        # the headline-shape vision arm's gating decision (r5+): a skipped
+        # arm is a decision, not a missing measurement, so show it
+        h = d.get("bounded_vision_headline")
+        if not isinstance(h, dict):
+            return "-"
+        probe = h.get("link_probe_gbps")
+        probe_s = f"@{probe:.4f}" if isinstance(probe, (int, float)) else "@?"
+        if h.get("attempted"):
+            stalls = h.get("stalls")
+            return f"ran{probe_s}:{'?' if stalls is None else stalls}st"
+        return f"skip{probe_s}"
+
+    headline_cells = [headline_cell(d) for _, d in rounds]
+    have_headline = any(c != "-" for c in headline_cells)
     name_w = max(len(k) for k in binding_keys + CONTEXT_KEYS + audit_keys) + 2
+    # every rendered cell folds into ONE column width, or rows misalign
     col_w = max(max(len(n) for n, _ in rounds) + 2, 12,
                 *(len(c) + 2 for cs in audit_cells.values() for c in cs),
+                *(len(c) + 2 for c in headline_cells),
                 2)
     header = " " * name_w + "".join(n.rjust(col_w) for n, _ in rounds)
     print(header)
@@ -139,6 +157,10 @@ def main(argv: list[str]) -> int:
         for k in audit_keys:
             print(k.ljust(name_w)
                   + "".join(c.rjust(col_w) for c in audit_cells[k]))
+    if have_headline:
+        print("headline vision arm (ran@probe_gbps:stalls | skip@probe):")
+        print("bounded_vision_headline".ljust(name_w)
+              + "".join(c.rjust(col_w) for c in headline_cells))
     return 0
 
 
