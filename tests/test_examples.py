@@ -1,0 +1,26 @@
+"""The examples/ scripts are user-facing documentation — they must stay
+runnable. Each runs as a real subprocess on the CPU backend (--cpu: the
+scripts pin the backend via jax.config before first touch, because this
+sandbox re-pins JAX_PLATFORMS at interpreter startup)."""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+EXAMPLES = os.path.join(REPO, "examples")
+
+
+@pytest.mark.parametrize("script,expect", [
+    ("ssd_to_tpu.py", "integrity: delivered bytes == file bytes"),
+    ("train_llama_tiny.py", "step 4:"),
+    ("parquet_scan.py", "dot(value, weight):"),
+])
+def test_example_runs(script, expect):
+    res = subprocess.run(
+        [sys.executable, os.path.join(EXAMPLES, script), "--cpu"],
+        capture_output=True, text=True, timeout=600, cwd=REPO)
+    assert res.returncode == 0, res.stderr[-2000:]
+    assert expect in res.stdout, res.stdout

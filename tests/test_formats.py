@@ -440,7 +440,21 @@ class TestPlainDecode:
                     # decoded never reaches total)
                     np.frombuffer(bytes([0x15, 0x00, 0x25, 0x15, 0x2C,
                                          0x15, 0x03, 0x15, 0x00, 0x00,
-                                         0x00]) + b"\0" * 64, np.uint8)):
+                                         0x00]) + b"\0" * 64, np.uint8),
+                    # crafted header with a VALID comp_size (64) but
+                    # num_values = -2: reaches the num_values guard
+                    # specifically (the buffer above trips on comp_size
+                    # first); without it, decoded += -2 never reaches
+                    # total and frombuffer(count=-2) reads "all"
+                    np.frombuffer(bytes([0x15, 0x00,              # type 0
+                                         0x15, 0x80, 0x01,       # uncomp 64
+                                         0x15, 0x80, 0x01,       # comp 64
+                                         0x2C,                   # dph struct
+                                         0x15, 0x03,             # n_vals -2
+                                         0x15, 0x00,             # enc PLAIN
+                                         0x15, 0x06,             # def RLE
+                                         0x00, 0x00])            # stops
+                                  + b"\0" * 80, np.uint8)):
             with pytest.raises(_PlainDecodeUnsupported):
                 decode_plain_pages(rg.column(ci), schema_col, bad)
 
