@@ -49,7 +49,7 @@ def main() -> int:
         pq.write_table(
             pa.table({"value": value, "weight": weight,
                       "payload": rng.integers(0, 1 << 30, args.rows)}),
-            path, row_group_size=args.rows // 8, compression="NONE",
+            path, row_group_size=max(args.rows // 8, 1), compression="NONE",
             use_dictionary=False)
 
         ctx = StromContext(StromConfig(queue_depth=8, num_buffers=16))

@@ -493,6 +493,52 @@ class TestParquetScan:
             max(out["selected_gbps_passes"]) / out["disk_read_gbps"],
             rel=1e-2)
 
+    def test_bench_parquet_raid_disk_rate_smoke(self, tmp_path):
+        """--raid + --disk-rate: the bare-gather yardstick expands logical
+        extents to member ops (the bench does the stripe math, the engine
+        reads member ranges) — the striped scan gets a vs_disk too, with
+        the scan's own hit count proving the data path."""
+        import argparse
+
+        from strom.cli import bench_parquet
+
+        out = bench_parquet(argparse.Namespace(
+            file=None, size=0, block=4096, depth=8, iters=1,
+            engine="python", tmpdir=str(tmp_path), json=True,
+            rows=20_000, row_groups=4, prefetch=2, unit_batch=2,
+            raid=2, raid_chunk=64 * 1024, columns=4,
+            compression="none", dtype="float32", disk_rate=True,
+            cpu_device=True))
+        assert out["raid_members"] == 2
+        assert out["vs_disk"] is not None and out["vs_disk"] > 0
+        assert len(out["disk_gbps_passes"]) == 2
+        assert out["plain_decoded_bytes"] > 0  # striped + direct decode
+
+    def test_decode_path_counters_in_prometheus(self, ctx, tmp_path):
+        """The decode-path counters are observability surface (≙ the
+        reference's /proc counters): after a scan they must appear in the
+        Prometheus exposition, not only in the bench JSON."""
+        pa = pytest.importorskip("pyarrow")
+        import pyarrow.parquet as pq
+
+        from strom.pipelines import parquet_count_where
+        from strom.utils.stats import global_stats
+
+        vals = np.random.default_rng(7).standard_normal(8_000) \
+            .astype(np.float32)
+        path = str(tmp_path / "prom.parquet")
+        pq.write_table(pa.table({"value": vals}), path,
+                       compression="NONE", use_dictionary=False)
+        before = global_stats.snapshot().get("parquet_plain_bytes", 0)
+        parquet_count_where(ctx, [path], "value", lambda v: v > 0)
+        after = global_stats.snapshot().get("parquet_plain_bytes", 0)
+        # THIS scan advanced the counter (key presence alone would pass
+        # vacuously: global_stats is process-global and earlier tests have
+        # already created the key)
+        assert after > before
+        assert f"strom_parquet_plain_bytes {after}" in \
+            global_stats.prometheus()
+
 
 class TestLlamaStriped:
     def test_striped_token_shards_golden(self, ctx, tmp_path):
