@@ -458,6 +458,67 @@ class TestPlainDecode:
             with pytest.raises(_PlainDecodeUnsupported):
                 decode_plain_pages(rg.column(ci), schema_col, bad)
 
+    def test_defs_all_present_run_shapes(self):
+        """_defs_all_present against hand-built bit-width-1 blocks: RLE
+        runs, bit-packed groups (incl. the partial last byte), and every
+        way a zero bit can hide."""
+        from strom.formats.parquet import _defs_all_present
+
+        def uvarint(n: int) -> bytes:
+            out = bytearray()
+            while True:
+                b = n & 0x7F
+                n >>= 7
+                out.append(b | (0x80 if n else 0))
+                if not n:
+                    return bytes(out)
+
+        # RLE run of 100 ones: header = count<<1, value byte 1
+        assert _defs_all_present(uvarint(100 << 1) + b"\x01", 100)
+        # RLE run of zeros -> nulls
+        assert not _defs_all_present(uvarint(100 << 1) + b"\x00", 100)
+        # bit-packed: 2 groups of 8, all ones (header = n_groups<<1 | 1)
+        assert _defs_all_present(uvarint(2 << 1 | 1) + b"\xff\xff", 16)
+        # bit-packed with one zero bit in a FULL byte
+        assert not _defs_all_present(uvarint(2 << 1 | 1) + b"\xff\xfe", 16)
+        # bit-packed partial tail: 12 values over 2 groups; the high 4 bits
+        # of byte 2 are PADDING and must be ignored...
+        assert _defs_all_present(uvarint(2 << 1 | 1) + b"\xff\x0f", 12)
+        # ...but a zero inside the VALID low bits must be caught
+        assert not _defs_all_present(uvarint(2 << 1 | 1) + b"\xff\x07", 12)
+        # mixed: RLE 8 ones then bit-packed group of 8 ones
+        assert _defs_all_present(
+            uvarint(8 << 1) + b"\x01" + uvarint(1 << 1 | 1) + b"\xff", 16)
+        # truncated block (runs cover fewer values than num_values)
+        assert not _defs_all_present(uvarint(8 << 1) + b"\x01", 16)
+
+    def test_thrift_skip_field_types(self):
+        """_thrift_struct must skip over every compact field type that can
+        appear in a PageHeader (bools, doubles, binaries, lists, nested
+        structs, long-form field ids) and still land on later fields."""
+        from strom.formats.parquet import _thrift_struct
+
+        buf = bytes([
+            0x11,              # field 1: BOOLEAN_TRUE (value in type)
+            0x17,              # field 2: double
+            *([0x40] * 8),     # 8 payload bytes
+            0x18, 0x03,        # field 3: binary, len 3
+            0x61, 0x62, 0x63,
+            0x19, 0x25,        # field 4: list of 2 i32 elements
+            0x02, 0x04,        # zigzag 1, 2
+            0x1C,              # field 5: nested struct
+            0x15, 0x06,        # nested field 1: i32 zigzag(6)=3
+            0x00,              # nested stop
+            0x05, 0x0E,        # long-form id: delta 0, type i32, id=7
+            0x2A,              # zigzag -> 21
+            0x00,              # stop
+        ])
+        out, pos = _thrift_struct(memoryview(buf), 0)
+        assert out[1] is True
+        assert out[5] == {1: 3}
+        assert out[7] == 21
+        assert pos == len(buf)
+
     def test_single_page_is_view(self, ctx, tmp_path, rng):
         """A single-page chunk decodes to a VIEW over the engine slab (no
         copy) — the property the fast path exists for."""
