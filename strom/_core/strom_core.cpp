@@ -20,6 +20,7 @@
 //   sc_get_stats                         — counters + latency histogram (≙ /proc/nvme-strom)
 //   sc_set_fault_every                   — fault injection for tests
 
+#include <algorithm>
 #include <atomic>
 #include <cerrno>
 #include <cstdint>
@@ -54,6 +55,44 @@ int sys_io_uring_register(int fd, unsigned opcode, const void *arg,
   return (int)syscall(__NR_io_uring_register, fd, opcode, arg, nr_args);
 }
 
+// struct statx grew stx_dio_mem_align/stx_dio_offset_align in kernel 6.1;
+// build hosts with older uapi headers lack the fields but the syscall ABI is
+// fixed (the kernel fills a 256-byte buffer at unchanging offsets) — a local
+// mirror of the modern layout builds anywhere and runs identically: on a
+// pre-6.1 kernel the dio fields simply stay zero and STATX_DIOALIGN never
+// lands in stx_mask, which the caller already handles as "unknown".
+struct sc_statx_timestamp {
+  int64_t tv_sec;
+  uint32_t tv_nsec;
+  int32_t pad;
+};
+struct sc_statx {
+  uint32_t stx_mask, stx_blksize;
+  uint64_t stx_attributes;
+  uint32_t stx_nlink, stx_uid, stx_gid;
+  uint16_t stx_mode, spare0;
+  uint64_t stx_ino, stx_size, stx_blocks, stx_attributes_mask;
+  sc_statx_timestamp stx_atime, stx_btime, stx_ctime, stx_mtime;
+  uint32_t stx_rdev_major, stx_rdev_minor, stx_dev_major, stx_dev_minor;
+  uint64_t stx_mnt_id;
+  uint32_t stx_dio_mem_align, stx_dio_offset_align;
+  uint64_t spare3[12];
+};
+static_assert(sizeof(sc_statx) == 256, "statx ABI is a fixed 256 bytes");
+
+// syscall numbers are per-architecture: only fill the gap on arches whose
+// number we know; elsewhere (headers old AND arch unknown) skip the statx
+// probe entirely — alignment falls back to the 4096 guess, same as a
+// pre-4.11 kernel at runtime
+#ifndef __NR_statx
+#if defined(__x86_64__)
+#define __NR_statx 332
+#elif defined(__aarch64__)
+#define __NR_statx 291
+#else
+#define SC_NO_STATX 1
+#endif
+#endif
 #ifndef STATX_DIOALIGN
 #define STATX_DIOALIGN 0x00002000U
 #endif
@@ -529,8 +568,9 @@ int sc_register_file(sc_engine *e, const char *path, int o_direct) {
 
   uint32_t mem_align = 4096, offset_align = 4096;
   bool dio_known = false, dio_ok = true;
+#ifndef SC_NO_STATX
   {
-    struct statx stx;
+    struct sc_statx stx;
     memset(&stx, 0, sizeof(stx));
     if (syscall(__NR_statx, AT_FDCWD, path, 0, STATX_DIOALIGN, &stx) == 0 &&
         (stx.stx_mask & STATX_DIOALIGN)) {
@@ -543,6 +583,7 @@ int sc_register_file(sc_engine *e, const char *path, int o_direct) {
       }
     }
   }
+#endif
 
   int fd = -1;
   bool use_direct = false;
@@ -1129,6 +1170,7 @@ int64_t sc_read_vectored(sc_engine *e, const sc_vec_seg *segs, uint64_t n_segs,
   std::vector<uint8_t> seg_odirect(n_segs, 0);
   std::vector<uint32_t> seg_oa(n_segs, 1), seg_ma(n_segs, 1);
   {
+    std::vector<int> seg_fdb(n_segs, -1);
     int last_fi = -2, fdb = -1;
     bool od = false;
     uint32_t oa = 1, ma = 1;
@@ -1151,22 +1193,25 @@ int64_t sc_read_vectored(sc_engine *e, const sc_vec_seg *segs, uint64_t n_segs,
       seg_odirect[i] = od ? 1 : 0;
       seg_oa[i] = oa ? oa : 1;
       seg_ma[i] = ma ? ma : 1;
-      if (!e->residency_hybrid || !od || fdb < 0 || s.length == 0) continue;
+      seg_fdb[i] = (e->residency_hybrid && od && s.length > 0) ? fdb : -1;
+    }
+    // Per-seg probe with mixed-range bitmap, probed in GROUPS so the probe
+    // count stays bounded regardless of segment size (VERDICT.md r3 weak
+    // #5: per-block_size probing of a multi-GiB half-warm segment is ~8k
+    // syscalls/GiB — and mmap/munmap pairs in mincore mode). At most
+    // kMaxResidencyProbes groups per segment; a group is routed warm only
+    // when FULLY resident, so coarser probing can only send warm bytes to
+    // media (correct either way), never cold bytes to the cache path.
+    auto probe_seg = [&](uint64_t i) {
+      const sc_vec_seg &s = segs[i];
       uint64_t probes = 1;
       uint64_t tot = 0;
-      int64_t res = resident_pages(fdb, s.offset, s.length, &tot);
+      int64_t res = resident_pages(seg_fdb[i], s.offset, s.length, &tot);
       if (res <= 0 || (uint64_t)res >= tot) {
         e->residency_probes.fetch_add(probes, std::memory_order_relaxed);
         if (res > 0) seg_state[i] = 1;  // fully warm; else cold/unprobeable
-        continue;
+        return;
       }
-      // Mixed segment: per-chunk warm bitmap, probed in GROUPS so the probe
-      // count stays bounded regardless of segment size (VERDICT.md r3 weak
-      // #5: per-block_size probing of a multi-GiB half-warm segment is ~8k
-      // syscalls/GiB — and mmap/munmap pairs in mincore mode). At most
-      // kMaxResidencyProbes groups per segment; a group is routed warm only
-      // when FULLY resident, so coarser probing can only send warm bytes to
-      // media (correct either way), never cold bytes to the cache path.
       constexpr uint64_t kMaxResidencyProbes = 256;
       uint64_t nch = (s.length + block_size - 1) / block_size;
       uint64_t group = (nch + kMaxResidencyProbes - 1) / kMaxResidencyProbes;
@@ -1179,13 +1224,59 @@ int64_t sc_read_vectored(sc_engine *e, const sc_vec_seg *segs, uint64_t n_segs,
         if (glen > remain) glen = remain;
         uint64_t t2 = 0;
         ++probes;
-        int64_t r2 = resident_pages(fdb, coff, glen, &t2);
+        int64_t r2 = resident_pages(seg_fdb[i], coff, glen, &t2);
         uint8_t warm = (r2 >= 0 && (uint64_t)r2 >= t2) ? 1 : 0;
         uint64_t gend = g0 + group < nch ? g0 + group : nch;
         for (uint64_t ci = g0; ci < gend; ++ci) bm[ci] = warm;
       }
       e->residency_probes.fetch_add(probes, std::memory_order_relaxed);
       seg_state[i] = 2;
+    };
+    // Probe coalescing: segs that are file-contiguous (a striped gather's
+    // member chunks — member offsets run contiguously whatever the
+    // submission order — or a coalesced extent list's split pieces) share
+    // ONE probe over the whole run: a fully-warm or fully-cold verdict
+    // applies to every seg in it, and only a mixed run pays per-seg probes.
+    // Runs are found over a (file, offset)-sorted view so the striped
+    // overlap-window submission order doesn't fragment them: a 4-member
+    // striped gather drops from one probe per raid_chunk (~2k mmap+mincore
+    // pairs per GiB) to one per member — the same probe shape as the raw
+    // member read it is benchmarked against.
+    std::vector<uint64_t> by_off;
+    by_off.reserve(n_segs);
+    for (uint64_t i = 0; i < n_segs; ++i)
+      if (seg_fdb[i] >= 0) by_off.push_back(i);
+    std::sort(by_off.begin(), by_off.end(), [&](uint64_t a, uint64_t b) {
+      if (segs[a].file_index != segs[b].file_index)
+        return segs[a].file_index < segs[b].file_index;
+      return segs[a].offset < segs[b].offset;
+    });
+    for (size_t i = 0; i < by_off.size();) {
+      size_t j = i + 1;
+      uint64_t run_end = segs[by_off[i]].offset + segs[by_off[i]].length;
+      while (j < by_off.size() &&
+             segs[by_off[j]].file_index == segs[by_off[i]].file_index &&
+             segs[by_off[j]].offset == run_end) {
+        run_end += segs[by_off[j]].length;
+        ++j;
+      }
+      if (j == i + 1) {
+        probe_seg(by_off[i]);
+        i = j;
+        continue;
+      }
+      uint64_t tot = 0;
+      int64_t res = resident_pages(seg_fdb[by_off[i]], segs[by_off[i]].offset,
+                                   run_end - segs[by_off[i]].offset, &tot);
+      e->residency_probes.fetch_add(1, std::memory_order_relaxed);
+      if (res > 0 && (uint64_t)res >= tot) {
+        for (size_t k = i; k < j; ++k) seg_state[by_off[k]] = 1;  // all warm
+      } else if (res > 0) {
+        // mixed run: fall back to per-seg probing (bounded groups within)
+        for (size_t k = i; k < j; ++k) probe_seg(by_off[k]);
+      }  // res <= 0: cold or unprobeable — every seg stays on the
+         // O_DIRECT path, exactly what per-seg probing would conclude
+      i = j;
     }
   }
 

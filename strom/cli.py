@@ -179,6 +179,13 @@ def bench_ssd2host(args: argparse.Namespace) -> dict:
     host_passes: list[float] = []
     dest = alloc_aligned(size)
     ctx = StromContext(cfg)
+    from strom.utils.stats import global_stats as _gs
+
+    # delta-snapshot the process-global window counter (same reasoning as
+    # bench_parquet: other phases share the singleton in one process); the
+    # *_last gauges need no snapshot — the raw arm's bare engine never
+    # touches them, so they hold exactly the last HOST transfer's values
+    _win0 = _gs.counter("stripe_windows").value
     try:
         ctx.engine.register_dest(dest)
         source: str | object = path
@@ -235,6 +242,9 @@ def bench_ssd2host(args: argparse.Namespace) -> dict:
                 print(f"  pass {i}: raw {max(raw_passes):.3f} / host "
                       f"{max(host_passes):.3f} GB/s (best so far)",
                       file=sys.stderr)
+        # delivery-scheduler observability (coalescing + striped overlap
+        # window), read before close() so engine stats are still live
+        sched = ctx.stats()["context"]
     finally:
         ctx.close()
     raw_gbps = max(raw_passes, default=0.0)
@@ -251,6 +261,13 @@ def bench_ssd2host(args: argparse.Namespace) -> dict:
         "bytes": size, "block": args.block, "depth": args.depth,
         "passes": passes, "engine": cfg.engine,
         "raid_members": raid,
+        # ops before/after coalescing (last host transfer) and the striped
+        # overlap window the host arm submitted under (windows summed over
+        # THIS call's host passes only — delta vs the _win0 snapshot)
+        "coalesce_ops_in": sched["coalesce_ops_in_last"],
+        "coalesce_ops_out": sched["coalesce_ops_out_last"],
+        "stripe_overlap_window_bytes": sched["stripe_overlap_window_bytes"],
+        "stripe_windows": sched["stripe_windows"] - _win0,
     }
 
 
@@ -367,7 +384,8 @@ def _fit_dp_devices(batch: int) -> int:
 
 
 def _timed_train_phase(pipe_factory, step, steps: int,
-                       items_per_step: int) -> tuple[float, int, float]:
+                       items_per_step: int
+                       ) -> tuple[float, int, float, dict]:
     """Shared harness for the --train-step north-star phases (llama, resnet,
     vit): one warmup step (compile + drain) outside the timed region, a
     stall-counter baseline, *steps* timed steps, then a HOST FETCH of the
@@ -377,7 +395,9 @@ def _timed_train_phase(pipe_factory, step, steps: int,
     to drain inside the timed region.
 
     *step(batch) -> loss* threads model state via closure. Returns
-    (items_per_s, data_stall_steps, final_loss)."""
+    (items_per_s, data_stall_steps, final_loss, depth_info) — depth_info
+    carries the prefetch controller's final depth and (step, depth) trace
+    so auto-tuned arms are auditable in the artifact."""
     with pipe_factory() as pipe:
         loss = step(next(pipe))  # warmup; also the reported loss at steps=0
         float(loss)
@@ -387,8 +407,13 @@ def _timed_train_phase(pipe_factory, step, steps: int,
             loss = step(next(pipe))
         train_loss = float(loss)
         dt = time.perf_counter() - t0
+        depth_info = {
+            "prefetch_depth_final": pipe.prefetch_depth,
+            "prefetch_depth_trace": pipe.prefetch_depth_trace,
+        }
         return (round(steps * items_per_step / dt, 1),
-                pipe.data_stall_steps - base_stalls, round(train_loss, 4))
+                pipe.data_stall_steps - base_stalls, round(train_loss, 4),
+                depth_info)
 
 
 def _bounded_train_phase(pipe_factory_at_depth, step, rate: float,
@@ -417,8 +442,8 @@ def _bounded_train_phase(pipe_factory_at_depth, step, rate: float,
         time.sleep(delay)
         return loss
 
-    r, stalls, _ = _timed_train_phase(lambda: pipe_factory_at_depth(bdepth),
-                                      paced, bsteps, items_per_step)
+    r, stalls, _, _ = _timed_train_phase(lambda: pipe_factory_at_depth(bdepth),
+                                         paced, bsteps, items_per_step)
     return r, stalls, round(delay, 4)
 
 
@@ -515,17 +540,21 @@ def bench_llama(args: argparse.Namespace) -> dict:
                     state, m = step_fn(state, toks % mcfg.vocab)
                     return m["loss"]
 
-                rate, stalls, loss = _timed_train_phase(
+                auto = bool(getattr(args, "auto_prefetch", False))
+                rate, stalls, loss, dinfo = _timed_train_phase(
                     lambda: make_llama_pipeline(ctx, [path], batch=args.batch,
                                                 seq_len=args.seq_len,
                                                 sharding=sharding,
-                                                prefetch_depth=args.prefetch),
+                                                prefetch_depth=args.prefetch,
+                                                auto_prefetch=auto),
                     step, args.steps, args.batch * (args.seq_len + 1))
                 out["train_tokens_per_s"] = rate
                 out["train_data_stalls"] = stalls
                 out["train_model"] = args.model
                 out["train_attn"] = args.attn
                 out["train_loss"] = loss
+                out["prefetch_auto"] = auto
+                out.update(dinfo)
 
                 # the non-degenerate 0-stall arm — see _bounded_train_phase
                 _run_bounded_arm(
@@ -621,23 +650,27 @@ def bench_resnet(args: argparse.Namespace) -> dict:
         mesh = make_mesh({"dp": n_dev}, devices=jax.devices()[:n_dev])
         sharding = NamedSharding(mesh, P("dp", None, None, None))
         predecoded = bool(getattr(args, "predecoded", False))
+        # auto depth applies to the headline train phase only: the bounded
+        # arm is BY PROTOCOL a fixed shallow depth, and the flat-out phase
+        # has no compute to overlap with (its stalls measure loader rate)
+        auto_pf = bool(getattr(args, "auto_prefetch", False))
         if predecoded:
             pdec = _ensure_predecoded(ctx, path, args.image_size, args.tmpdir)
             data_paths = [pdec]
 
-            def pipe_factory(depth=args.prefetch):
+            def pipe_factory(depth=args.prefetch, auto=False):
                 return make_predecoded_vision_pipeline(
                     ctx, [pdec], batch=args.batch,
                     image_size=args.image_size, sharding=sharding,
-                    prefetch_depth=depth)
+                    prefetch_depth=depth, auto_prefetch=auto)
         else:
             data_paths = [path]
 
-            def pipe_factory(depth=args.prefetch):
+            def pipe_factory(depth=args.prefetch, auto=False):
                 return make_imagenet_resnet_pipeline(
                     ctx, [path], batch=args.batch,
                     image_size=args.image_size, sharding=sharding,
-                    prefetch_depth=depth,
+                    prefetch_depth=depth, auto_prefetch=auto,
                     decode_workers=args.decode_workers)
         for p in data_paths:
             _drop_cache_hint(p)
@@ -696,14 +729,18 @@ def bench_resnet(args: argparse.Namespace) -> dict:
 
             for p in data_paths:
                 _drop_cache_hint(p)
-            rate, stalls, loss = _timed_train_phase(
-                pipe_factory, step, args.steps, args.batch)
+            rate, stalls, loss, dinfo = _timed_train_phase(
+                lambda: pipe_factory(args.prefetch, auto_pf), step,
+                args.steps, args.batch)
             out["train_images_per_s"] = rate
             out["train_data_stalls"] = stalls
             out["train_model"] = args.model
             out["train_loss"] = loss
+            out["prefetch_auto"] = auto_pf
+            out.update(dinfo)
 
             # the non-degenerate 0-stall arm — see _bounded_train_phase
+            # (fixed depth by protocol: pipe_factory's auto default is False)
             _run_bounded_arm(args, out, pipe_factory, step, rate, args.batch,
                              "bounded_train_images_per_s", data_paths)
     finally:
@@ -752,18 +789,19 @@ def bench_vit(args: argparse.Namespace) -> dict:
         mesh = make_mesh({"dp": n_dev}, devices=jax.devices()[:n_dev])
         sharding = NamedSharding(mesh, P("dp", None, None, None))
 
+        auto_pf = bool(getattr(args, "auto_prefetch", False))
         if predecoded:
-            def pipe_factory(depth=args.prefetch):
+            def pipe_factory(depth=args.prefetch, auto=False):
                 return make_predecoded_vision_pipeline(
                     ctx, [virt], batch=args.batch,
                     image_size=args.image_size, sharding=sharding,
-                    prefetch_depth=depth)
+                    prefetch_depth=depth, auto_prefetch=auto)
         else:
-            def pipe_factory(depth=args.prefetch):
+            def pipe_factory(depth=args.prefetch, auto=False):
                 return make_vit_wds_pipeline(
                     ctx, [virt], batch=args.batch,
                     image_size=args.image_size, sharding=sharding,
-                    prefetch_depth=depth,
+                    prefetch_depth=depth, auto_prefetch=auto,
                     decode_workers=args.decode_workers)
         for m in members:
             _drop_cache_hint(m)
@@ -815,14 +853,18 @@ def bench_vit(args: argparse.Namespace) -> dict:
 
             for m in members:
                 _drop_cache_hint(m)
-            rate, stalls, loss = _timed_train_phase(
-                pipe_factory, step, args.steps, args.batch)
+            rate, stalls, loss, dinfo = _timed_train_phase(
+                lambda: pipe_factory(args.prefetch, auto_pf), step,
+                args.steps, args.batch)
             out["train_images_per_s"] = rate
             out["train_data_stalls"] = stalls
             out["train_model"] = args.model
             out["train_loss"] = loss
+            out["prefetch_auto"] = auto_pf
+            out.update(dinfo)
 
             # the non-degenerate 0-stall arm — see _bounded_train_phase
+            # (fixed depth by protocol: pipe_factory's auto default is False)
             _run_bounded_arm(args, out, pipe_factory, step, rate, args.batch,
                              "bounded_train_images_per_s", members)
     finally:
@@ -903,6 +945,14 @@ def bench_parquet(args: argparse.Namespace) -> dict:
     cfg = StromConfig(engine=args.engine, block_size=args.block,
                       queue_depth=args.depth, num_buffers=max(args.depth * 2, 8))
     ctx = StromContext(cfg)
+    from strom.utils.stats import global_stats as _gs
+
+    # snapshot the process-global scheduler counters NOW: other bench
+    # phases (ssd2host, vision arms) share the singleton in one process,
+    # and reporting their ops as this scan's would corrupt the artifact
+    _sched0 = {k: _gs.counter(k).value
+               for k in ("coalesce_ops_in", "coalesce_ops_out",
+                         "stripe_windows")}
     try:
         from strom.formats.parquet import ParquetShard
 
@@ -1078,6 +1128,7 @@ def bench_parquet(args: argparse.Namespace) -> dict:
         plain_bytes //= len(scan_dts)
         pyarrow_bytes //= len(scan_dts)
         disk_gbps = round(max(raw_gbps_list), 4) if raw_gbps_list else None
+        sched = {k: _gs.counter(k).value - v0 for k, v0 in _sched0.items()}
     finally:
         ctx.close()
     return {
@@ -1106,6 +1157,12 @@ def bench_parquet(args: argparse.Namespace) -> dict:
         "disk_gbps_passes": [round(g, 4) for g in raw_gbps_list],
         "plain_decoded_bytes": int(plain_bytes),
         "pyarrow_decoded_bytes": int(pyarrow_bytes),
+        # delivery-scheduler observability: per-column-chunk extents that
+        # landed adjacent merged into fewer engine ops (cumulative over the
+        # scan passes); the stripe window engages with --raid
+        "coalesce_ops_in": sched["coalesce_ops_in"],
+        "coalesce_ops_out": sched["coalesce_ops_out"],
+        "stripe_windows": sched["stripe_windows"],
     }
 
 
@@ -1263,6 +1320,12 @@ def main(argv: list[str] | None = None) -> int:
     p_llama.add_argument("--bounded-prefetch", type=int, default=4,
                          dest="bounded_prefetch",
                          help="prefetch depth for the bounded 0-stall phase")
+    p_llama.add_argument("--auto-prefetch", action="store_true",
+                         dest="auto_prefetch",
+                         help="auto-tune prefetch depth in the --train-step "
+                              "phase: grow on stalls, shrink when lead time "
+                              "is ample, bounded by the slab pool "
+                              "(--prefetch is the starting depth)")
     p_llama.set_defaults(fn=bench_llama)
 
     p_rn = sub.add_parser("resnet", help="config #2: JPEG loader images/s")
@@ -1291,6 +1354,11 @@ def main(argv: list[str] | None = None) -> int:
     p_rn.add_argument("--bounded-prefetch", type=int, default=4,
                       dest="bounded_prefetch",
                       help="prefetch depth for the bounded 0-stall phase")
+    p_rn.add_argument("--auto-prefetch", action="store_true",
+                      dest="auto_prefetch",
+                      help="auto-tune prefetch depth in the --train-step "
+                           "phase (grow on stalls, shrink on ample lead; "
+                           "--prefetch is the starting depth)")
     p_rn.set_defaults(fn=bench_resnet)
 
     p_vit = sub.add_parser("vit", help="config #3: WDS .tar -> ViT loader "
@@ -1325,6 +1393,11 @@ def main(argv: list[str] | None = None) -> int:
     p_vit.add_argument("--bounded-prefetch", type=int, default=4,
                        dest="bounded_prefetch",
                        help="prefetch depth for the bounded 0-stall phase")
+    p_vit.add_argument("--auto-prefetch", action="store_true",
+                       dest="auto_prefetch",
+                       help="auto-tune prefetch depth in the --train-step "
+                            "phase (grow on stalls, shrink on ample lead; "
+                            "--prefetch is the starting depth)")
     p_vit.set_defaults(fn=bench_vit)
 
     p_pq = sub.add_parser("parquet", help="config #5: PG-Strom-style columnar "

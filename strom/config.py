@@ -78,7 +78,25 @@ class StromConfig:
 
     # delivery
     prefetch_depth: int = 2            # batches dispatched ahead of consumption
+    prefetch_auto: bool = False        # auto-tune prefetch depth: grow on
+                                       # data stalls, shrink when the queue
+                                       # runs fully ready (lead time ample);
+                                       # prefetch_depth is the STARTING depth
+    prefetch_max_depth: int = 16       # auto-tune ceiling (further bounded by
+                                       # slab-pool capacity per batch)
     delivery_workers: int = 2          # threads pushing host->HBM
+    # segment coalescing: merge caller fragments (tar members, record runs,
+    # shard-plan segments) that are contiguous in both file and dest space
+    # into fewer, larger engine ops before submission; merged ops split at
+    # this cap so a coalesced run still pipelines and still stripes across
+    # RAID0 members. 0 disables coalescing entirely.
+    coalesce_max_bytes: int = 32 * MiB
+    # striped-read overlap window: member ops are submitted as per-member
+    # sequential runs within windows of this many bytes (segments for window
+    # N+1 enter the queue while window N's completions drain). -1 = auto
+    # (queue_depth * block_size: the in-flight budget, so every member stays
+    # busy within one window); 0 = keep chunk-granular logical order.
+    stripe_window_bytes: int = -1
     slab_pool_bytes: int = 512 * MiB   # recycled host slabs (0 = off); only
                                        # used on backends where device_put
                                        # copies (i.e. not the jax CPU backend)
@@ -154,6 +172,21 @@ class StromConfig:
         if self.overlap_chunk_bytes and self.overlap_chunk_bytes % 4096:
             raise ValueError("overlap_chunk_bytes must be a multiple of 4096 "
                              "(O_DIRECT alignment and dtype itemsize)")
+        if self.coalesce_max_bytes < 0:
+            raise ValueError("coalesce_max_bytes must be >= 0 (0 = off)")
+        if self.stripe_window_bytes < -1:
+            raise ValueError("stripe_window_bytes must be >= 0 (0 = off) "
+                             "or exactly -1 (auto)")
+        if self.prefetch_max_depth < 1:
+            raise ValueError("prefetch_max_depth must be >= 1")
+
+    @property
+    def resolved_stripe_window_bytes(self) -> int:
+        """The effective striped-overlap window: -1 resolves to the engine's
+        in-flight budget (queue_depth × block_size)."""
+        if self.stripe_window_bytes >= 0:
+            return self.stripe_window_bytes
+        return self.queue_depth * self.block_size
 
     @classmethod
     def from_env(cls, **overrides: Any) -> "StromConfig":

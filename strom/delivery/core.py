@@ -29,12 +29,14 @@ import numpy as np
 
 from strom.config import StromConfig
 from strom.delivery.buffers import SlabPool, alloc_aligned
+from strom.delivery.coalesce import coalesce_chunks, coalesce_segments
 from strom.delivery.extents import ExtentList
 from strom.delivery.handle import DMAHandle, deferred_handle
 from strom.delivery.shard import DevicePlan, Segment, dedupe_plans, plan_sharded_read
 from strom.engine import make_engine
 from strom.engine.base import Engine, EngineError
-from strom.engine.raid0 import plan_stripe_reads
+from strom.engine.raid0 import (count_stripe_windows, plan_stripe_reads,
+                                plan_stripe_windows)
 from strom.utils.stats import global_stats
 
 
@@ -412,6 +414,12 @@ class StromContext:
             # device's home node; once per thread, resolved from the source
             self._numa.ensure_thread(self._numa_path(source))
 
+        if cfg.coalesce_max_bytes and len(segments) > 1:
+            # merge caller fragments that are file+dest contiguous BEFORE
+            # expansion: a merged logical run stripes/extent-splits as one
+            # piece instead of per fragment
+            segments = coalesce_segments(segments, cfg.coalesce_max_bytes)
+
         # member fds resolved once per transfer, not once per extent run (a
         # WDS batch produces one run per sample component)
         member_cache: dict[StripedFile, list[int]] = {}
@@ -428,8 +436,21 @@ class StromContext:
             if member_idx is None:
                 member_idx = [findex(m) for m in sf.members]
                 member_cache[sf] = member_idx
-            for s in plan_stripe_reads(file_off, length, len(sf.members),
-                                       sf.chunk):
+            segs = plan_stripe_reads(file_off, length, len(sf.members),
+                                     sf.chunk)
+            wb = cfg.resolved_stripe_window_bytes
+            if wb > 0 and len(sf.members) > 1 and length > wb:
+                # striped-read overlap: per-member sequential runs inside
+                # windows of the in-flight budget — ops for window N+1 enter
+                # the queue while window N's completions drain, instead of
+                # a chunk-granular round-robin hopping members every
+                # raid_chunk bytes (see plan_stripe_windows)
+                global_stats.add("stripe_windows",
+                                 count_stripe_windows(segs, len(sf.members),
+                                                      wb))
+                segs = plan_stripe_windows(segs, len(sf.members), wb)
+                global_stats.set_gauge("stripe_overlap_window_bytes", wb)
+            for s in segs:
                 chunks.append((member_idx[s.member], s.member_offset,
                                dest_off + (s.logical_offset - file_off),
                                s.length))
@@ -441,6 +462,12 @@ class StromContext:
                 stripe_chunks(source, base_offset + seg.file_offset,
                               seg.dest_offset, seg.length)
         elif isinstance(source, ExtentList):
+            # striped-alias runs buffer per StripedFile and coalesce BEFORE
+            # stripe expansion: adjacent extents over one alias (consecutive
+            # column chunks, back-to-back tar members) become one logical
+            # run, which then stripes — and windows — as a whole instead of
+            # per fragment. Plain-path runs merge later at the op level.
+            striped_runs: dict[StripedFile, list[Segment]] = {}
             for seg in segments:
                 for r in source.locate(base_offset + seg.file_offset, seg.length,
                                        seg.dest_offset):
@@ -448,13 +475,38 @@ class StromContext:
                     if sf is not None:
                         # extent planned against an aliased path: stripe-decode
                         # it here, exactly where a plain path resolves to an fd
-                        stripe_chunks(sf, r.offset, r.dest_offset, r.length)
+                        striped_runs.setdefault(sf, []).append(
+                            Segment(r.offset, r.dest_offset, r.length))
                     else:
                         chunks.append((findex(r.path), r.offset,
                                        r.dest_offset, r.length))
+            for sf, runs in striped_runs.items():
+                if cfg.coalesce_max_bytes and len(runs) > 1:
+                    n_in = len(runs)
+                    runs = coalesce_segments(runs, cfg.coalesce_max_bytes)
+                    global_stats.add("coalesce_ops_in", n_in)
+                    global_stats.add("coalesce_ops_out", len(runs))
+                    global_stats.set_gauge("coalesce_ops_in_last", n_in)
+                    global_stats.set_gauge("coalesce_ops_out_last", len(runs))
+                for s in runs:
+                    stripe_chunks(sf, s.file_offset, s.dest_offset, s.length)
         else:
             chunks = [(findex(source), base_offset + s.file_offset,
                        s.dest_offset, s.length) for s in segments]
+
+        if cfg.coalesce_max_bytes and len(chunks) > 1 and not member_cache:
+            # op-level coalescing: per-extent-run fragments (tar members,
+            # column chunks, record runs) that landed adjacent in both file
+            # and dest space become one engine op. Striped gathers are
+            # exempt (member ops interleave by design; merging would need
+            # non-contiguous dests) — their fragment merging happened at the
+            # segment level above, before stripe expansion.
+            n_in = len(chunks)
+            chunks = coalesce_chunks(chunks, cfg.coalesce_max_bytes)
+            global_stats.add("coalesce_ops_in", n_in)
+            global_stats.add("coalesce_ops_out", len(chunks))
+            global_stats.set_gauge("coalesce_ops_in_last", n_in)
+            global_stats.set_gauge("coalesce_ops_out_last", len(chunks))
 
         if cfg.extent_aware and chunks and not member_cache:
             # extent-aware planning for plain-file gathers of every source
@@ -881,6 +933,18 @@ class StromContext:
         out = {"context": {
             "registered_files": len(self._files),
             "ssd2tpu_bytes": global_stats.counter("ssd2tpu_bytes").value,
+            # delivery-scheduler observability: op counts before/after
+            # coalescing (cumulative + last transfer) and the striped-read
+            # overlap window (bytes per window, windows planned)
+            "coalesce_ops_in": global_stats.counter("coalesce_ops_in").value,
+            "coalesce_ops_out": global_stats.counter("coalesce_ops_out").value,
+            "coalesce_ops_in_last":
+                global_stats.gauge("coalesce_ops_in_last").value,
+            "coalesce_ops_out_last":
+                global_stats.gauge("coalesce_ops_out_last").value,
+            "stripe_overlap_window_bytes":
+                global_stats.gauge("stripe_overlap_window_bytes").value,
+            "stripe_windows": global_stats.counter("stripe_windows").value,
         }}
         if self._slab_pool is not None:
             out["slab_pool"] = self._slab_pool.stats()

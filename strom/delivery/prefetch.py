@@ -6,6 +6,15 @@ the next buffer while the kernel consumes the previous one; reference cite
 UNVERIFIED — empty mount, SURVEY.md §0).  The "0 data-stall steps" north-star
 counter lives here (BASELINE.json:5): a stall is recorded whenever ``next()``
 has to block because the head-of-line batch isn't ready.
+
+Depth can be hand-picked (``depth=``) or auto-tuned (``auto_depth=True``): a
+feedback controller tracks per-batch lead time (how long the head batch sat
+ready before consumption) and the stall counter, GROWS depth multiplicatively
+on a stall (a stall means the dispatch-ahead window was too shallow for the
+observed jitter) and SHRINKS it by one step once the queue has run fully
+ready for a patience window (lead time ample: the extra in-flight batches
+only pin slab-pool memory). Depth stays inside [min_depth, max_depth];
+callers bound max_depth by slab-pool capacity (:func:`bound_depth`).
 """
 
 from __future__ import annotations
@@ -21,6 +30,24 @@ from strom.utils.stats import StatsRegistry
 
 T = TypeVar("T")
 
+# auto-tune shape: grow is multiplicative (a stall under-estimates the needed
+# window by an unknown factor; doubling finds it in log steps — the resnet
+# JPEG arm went 6 stalls at fixed depth 2), shrink is one step per patience
+# window of fully-ready pops (lead time ample), the classic AIMD asymmetry so
+# depth converges from above without oscillating into stalls.
+_SHRINK_PATIENCE = 8
+_TRACE_CAP = 512
+
+
+def bound_depth(pool_bytes: int, batch_bytes: int, *, floor: int = 2,
+                cap: int = 32) -> int:
+    """Max prefetch depth a slab pool of *pool_bytes* can stage when each
+    in-flight batch owns ~*batch_bytes* of slabs until its device_put
+    retires. Unknown sizes (<=0) fall back to *cap*."""
+    if pool_bytes <= 0 or batch_bytes <= 0:
+        return cap
+    return max(floor, min(cap, pool_bytes // batch_bytes))
+
 
 class Prefetcher(Generic[T]):
     """Wraps an iterable of thunks (callables producing a batch) and runs up to
@@ -29,23 +56,49 @@ class Prefetcher(Generic[T]):
     Thunks typically end in a `jax.device_put` dispatch, so "ready" here means
     the host-side work is done and the HBM transfer is enqueued — the classic
     dispatch-ahead overlap jax wants.
+
+    With ``auto_depth=True``, *depth* is the starting point and the
+    controller moves it inside [min_depth, max_depth] (see module
+    docstring). ``depth_trace`` records every change as (step, new_depth).
     """
 
     def __init__(self, thunks: Iterable[Callable[[], T]], *, depth: int = 2,
                  executor: concurrent.futures.Executor | None = None,
-                 stats: StatsRegistry | None = None):
+                 stats: StatsRegistry | None = None,
+                 auto_depth: bool = False,
+                 min_depth: int = 1,
+                 max_depth: int | None = None):
         if depth < 1:
             raise ValueError("depth must be >= 1")
+        if min_depth < 1:
+            raise ValueError("min_depth must be >= 1")
+        self._auto = auto_depth
+        if max_depth is None:
+            max_depth = max(depth, 16) if auto_depth else depth
+        if max_depth < min_depth:
+            raise ValueError(f"max_depth {max_depth} < min_depth {min_depth}")
+        self._min_depth = min_depth
+        self._max_depth = max_depth
+        self._depth = min(max(depth, min_depth), max_depth)
         self._thunks = iter(thunks)
-        self._depth = depth
         self._own_executor = executor is None
+        # auto mode sizes its own pool at the ceiling so a grown depth has
+        # workers to actually run the extra thunks in parallel
         self._executor = executor or concurrent.futures.ThreadPoolExecutor(
-            max_workers=depth, thread_name_prefix="strom-prefetch")
+            max_workers=max_depth if auto_depth else depth,
+            thread_name_prefix="strom-prefetch")
         self._queue: deque[concurrent.futures.Future] = deque()
         self._lock = threading.Lock()
         self.stats = stats or StatsRegistry("prefetch")
+        self.stats.set_gauge("prefetch_depth", self._depth)
+        self.depth_trace: list[tuple[int, int]] = [(0, self._depth)]
+        self._ready_streak = 0
         self._exhausted = False
         self._fill()
+
+    @property
+    def depth(self) -> int:
+        return self._depth
 
     def _fill(self) -> None:
         # next(thunks) runs OUTSIDE the lock: thunk generators may block
@@ -66,7 +119,21 @@ class Prefetcher(Generic[T]):
             with self._lock:
                 if self._exhausted:  # close() raced the pull: drop, don't submit
                     return
-                self._queue.append(self._executor.submit(thunk))
+                fut = self._executor.submit(thunk)
+                fut.add_done_callback(_stamp_done)
+                self._queue.append(fut)
+
+    def _set_depth(self, depth: int, kind: str) -> None:
+        """Record a controller move (caller holds no lock; _depth writes are
+        single-consumer like the rest of the class)."""
+        if depth == self._depth:
+            return
+        self._depth = depth
+        self.stats.add("depth_grow" if kind == "grow" else "depth_shrink")
+        self.stats.set_gauge("prefetch_depth", depth)
+        if len(self.depth_trace) < _TRACE_CAP:
+            self.depth_trace.append(
+                (self.stats.counter("steps").value, depth))
 
     def __iter__(self) -> Iterator[T]:
         return self
@@ -93,8 +160,31 @@ class Prefetcher(Generic[T]):
             t0 = time.monotonic()
             result = fut.result()
             self.stats.observe_us("stall_wait", (time.monotonic() - t0) * 1e6)
+            if self._auto:
+                # a stall: the window was too shallow for the observed jitter
+                self._ready_streak = 0
+                self._set_depth(min(self._depth * 2, self._max_depth), "grow")
         else:
             result = fut.result()
+            done_at = getattr(fut, "_strom_done_at", None)
+            if done_at is not None:
+                # lead time: how long the head batch sat ready before the
+                # consumer came for it — the controller's "ample" signal,
+                # and the observable overlap margin per batch
+                self.stats.observe_us(
+                    "lead", max(time.monotonic() - done_at, 0.0) * 1e6)
+            if self._auto:
+                with self._lock:
+                    full_ready = (len(self._queue) + 1 >= self._depth
+                                  and all(f.done() for f in self._queue))
+                if full_ready:
+                    self._ready_streak += 1
+                    if (self._ready_streak >= _SHRINK_PATIENCE
+                            and self._depth > self._min_depth):
+                        self._set_depth(self._depth - 1, "shrink")
+                        self._ready_streak = 0
+                else:
+                    self._ready_streak = 0
         self.stats.add("steps")
         self._fill()
         return result
@@ -118,3 +208,7 @@ class Prefetcher(Generic[T]):
             self._queue.clear()
             self._exhausted = True
         self._shutdown()
+
+
+def _stamp_done(fut: concurrent.futures.Future) -> None:
+    fut._strom_done_at = time.monotonic()  # type: ignore[attr-defined]

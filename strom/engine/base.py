@@ -194,6 +194,7 @@ class Engine(abc.ABC):
               for p in range(0, ln, block))
         exhausted = False
         total = 0
+        inflight_peak = 0
         err: EngineError | None = None
         try:
             while not exhausted or pending:
@@ -207,6 +208,8 @@ class Engine(abc.ABC):
                     self._vec_tag += 1
                     self.submit_raw([RawRead(fi, fo, ln, d8[do: do + ln], tag)])
                     pending[tag] = (fi, fo, do, ln, 0)
+                if len(pending) > inflight_peak:
+                    inflight_peak = len(pending)
                 if not pending:
                     break
                 for c in self.wait(min_completions=1):
@@ -250,6 +253,15 @@ class Engine(abc.ABC):
             raise
         if err is not None:
             raise err
+        if inflight_peak:
+            # overlap observability: how deep the submit-while-draining
+            # pipeline actually ran — a peak pinned at queue_depth means the
+            # gather kept the queue full across op boundaries (the overlap
+            # claim); a shallow peak means the op stream, not the engine,
+            # was the limit
+            from strom.utils.stats import global_stats
+
+            global_stats.gauge("gather_inflight_peak").max(inflight_peak)
         return total
 
     # -- convenience: synchronous read of an arbitrary range ----------------

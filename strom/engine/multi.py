@@ -211,6 +211,13 @@ class MultiRingEngine(Engine):
         live = [r for r in range(n) if per_ring[r]]
         if len(live) == 1:
             return run(live[0])
+        from strom.utils.stats import global_stats
+
+        # overlap observability: gathers whose member sub-gathers ran on
+        # independent rings concurrently (the per-device blk-mq twin), and
+        # how wide the fan-out went
+        global_stats.add("multi_ring_fanout_gathers")
+        global_stats.gauge("multi_ring_fanout_width").max(len(live))
         futs = {r: self._pool.submit(run, r) for r in live}
         # join ALL rings before raising: a caller reacting to an error must
         # not race sub-gathers still writing into dest

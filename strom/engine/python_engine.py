@@ -204,20 +204,39 @@ class PythonEngine(Engine):
 
     def _snapshot_residency(self, chunks) -> dict[tuple[int, int], bool] | None:
         """{(file_index, block_offset): warm} for every block_size piece the
-        gather will submit, probed BEFORE any read runs. One probe per
-        fully-warm/fully-cold chunk; bounded group probes for mixed ones."""
+        gather will submit, probed BEFORE any read runs.
+
+        Probes are coalesced over file-contiguous chunk runs (a striped
+        gather's member chunks are member-contiguous whatever the submission
+        order; coalesced extent lists split at the op cap): ONE probe decides
+        a fully-warm or fully-cold run, and only mixed runs fall back to
+        per-chunk probing (bounded group probes within a mixed chunk). Same
+        probe shape as the native engine's run coalescing."""
         if not self.config.residency_hybrid:
             return None
         block = self.config.block_size
         m: dict[tuple[int, int], bool] = {}
+        elig = []
         for fi, fo, _do, ln in chunks:
             f = self._files.get(fi)
             if f is None or not f.o_direct or ln <= 0:
                 continue
+            elig.append((fi, fo, ln, f))
+        elig.sort(key=lambda t: (t[0], t[1]))
+        # (fi, run_start, run_end, file, [(chunk_off, chunk_len), ...])
+        runs: list[list] = []
+        for fi, fo, ln, f in elig:
+            if runs and runs[-1][0] == fi and runs[-1][2] == fo:
+                runs[-1][2] = fo + ln
+                runs[-1][4].append((fo, ln))
+            else:
+                runs.append([fi, fo, fo + ln, f, [(fo, ln)]])
+
+        def probe_chunk(fi: int, fo: int, ln: int, f) -> None:
             self._stats.add("residency_probes")
             r = cached_pages(f.fd_buffered, fo, ln)
             if r is None:
-                continue  # unprobeable: worker falls back to a lazy probe
+                return  # unprobeable: worker falls back to a lazy probe
             res, tot = r
             if res >= tot or res == 0:
                 # explicit False for cold pieces too — an absent key would
@@ -226,7 +245,7 @@ class PythonEngine(Engine):
                 state = res >= tot
                 for p in range(0, ln, block):
                     m[(fi, fo + p)] = state
-                continue
+                return
             npieces = (ln + block - 1) // block
             group = (npieces + self.MAX_RESIDENCY_PROBES - 1) \
                 // self.MAX_RESIDENCY_PROBES
@@ -237,6 +256,24 @@ class PythonEngine(Engine):
                 warm = range_fully_cached(f.fd_buffered, goff, glen) is True
                 for ci in range(g0, min(g0 + group, npieces)):
                     m[(fi, fo + ci * block)] = warm
+
+        for fi, start, end, f, members in runs:
+            if len(members) == 1:
+                probe_chunk(fi, start, end - start, f)
+                continue
+            self._stats.add("residency_probes")
+            r = cached_pages(f.fd_buffered, start, end - start)
+            if r is None:
+                continue
+            res, tot = r
+            if res >= tot or res == 0:
+                state = res >= tot
+                for fo, ln in members:
+                    for p in range(0, ln, block):
+                        m[(fi, fo + p)] = state
+                continue
+            for fo, ln in members:  # mixed run: per-chunk fallback
+                probe_chunk(fi, fo, ln, f)
         return m
 
     def read_vectored(self, chunks, dest, *, retries: int = 1) -> int:

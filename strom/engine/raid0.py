@@ -67,6 +67,66 @@ def coalesce(segs: list[StripeSegment]) -> list[StripeSegment]:
     return out
 
 
+def plan_stripe_windows(segs: Sequence[StripeSegment], n_members: int,
+                        window_bytes: int) -> list[StripeSegment]:
+    """Reorder logical-order stripe segments into overlap windows: within
+    each window of ~*window_bytes* total, segments are grouped into
+    per-member runs (member-offset order preserved, so each run is a
+    sequential read on its member).
+
+    The engine keeps its queue-depth pipeline full ACROSS the list, so a
+    window sized to the in-flight budget (queue_depth × block_size) means
+    member ops for window N+1 are entering the submission queue while window
+    N's completions drain — continuous per-member streams instead of a
+    chunk-granular round-robin hopping files every raid_chunk bytes. Every
+    byte mapping is unchanged (dest offsets are explicit); only submission
+    order moves. window_bytes <= 0 keeps logical order. Consecutive windows
+    continue each member's run at the exact next member offset, so
+    downstream run detection (the native engine's residency-probe
+    coalescing) still sees long member-contiguous streaks."""
+    if window_bytes <= 0 or n_members <= 1:
+        return list(segs)
+    out: list[StripeSegment] = []
+    win: list[StripeSegment] = []
+    acc = 0
+
+    def flush() -> None:
+        by_member: dict[int, list[StripeSegment]] = {}
+        for s in win:
+            by_member.setdefault(s.member, []).append(s)
+        for m in sorted(by_member):
+            out.extend(by_member[m])
+
+    for s in segs:
+        win.append(s)
+        acc += s.length
+        if acc >= window_bytes:
+            flush()
+            win = []
+            acc = 0
+    if win:
+        flush()
+    return out
+
+
+def count_stripe_windows(segs: Sequence[StripeSegment], n_members: int,
+                         window_bytes: int) -> int:
+    """Exactly how many windows :func:`plan_stripe_windows` flushes for the
+    same inputs (same accumulation rule: a flush can consume MORE than
+    window_bytes when segment lengths don't divide it, so ceil(total/wb)
+    would overcount) — kept adjacent so the two can't drift."""
+    if window_bytes <= 0 or n_members <= 1:
+        return 0
+    windows = 0
+    acc = 0
+    for s in segs:
+        acc += s.length
+        if acc >= window_bytes:
+            windows += 1
+            acc = 0
+    return windows + (1 if acc else 0)
+
+
 SIZE_SIDECAR_SUFFIX = ".stromsz"
 
 

@@ -170,6 +170,33 @@ class _PlainDecodeUnsupported(Exception):
     """Chunk needs the pyarrow fallback (not an error)."""
 
 
+def _plain_logical_ok(col_schema, physical_type: str) -> bool:
+    """True iff the column's logical/converted annotation is absent or
+    exactly the physical numpy meaning, so frombuffer over the raw bytes
+    returns what pyarrow would. A uint32 column is physically INT32: raw
+    decode would silently reinterpret 2147483653 as -2147483643; date32/
+    timestamp would return raw ints where pyarrow returns datetime64
+    (ADVICE.md r5 high). Only NONE and a signed INT annotation of exactly
+    the physical width are provably equivalent."""
+    lt = getattr(col_schema, "logical_type", None)
+    kind = (getattr(lt, "type", None) or "NONE").upper()
+    conv = (getattr(col_schema, "converted_type", None) or "NONE").upper()
+    if kind in ("NONE", "UNDEFINED"):
+        # legacy files may carry only a converted_type (e.g. UINT_32)
+        return conv == "NONE"
+    if kind == "INT":
+        import json
+
+        try:
+            d = json.loads(lt.to_json())
+        except (TypeError, ValueError, AttributeError):
+            return False
+        width = {"INT32": 32, "INT64": 64}.get(physical_type)
+        return (width is not None and d.get("bitWidth") == width
+                and d.get("isSigned") is True)
+    return False
+
+
 def _uvarint(buf, pos: int) -> tuple[int, int]:
     out = 0
     shift = 0
@@ -209,6 +236,12 @@ def _thrift_skip(buf, pos: int, ftype: int) -> int:
         etype = head & 0x0F
         if size == 15:
             size, pos = _uvarint(buf, pos)
+        if etype in (1, 2):
+            # bool ELEMENTS are one byte each (0x01/0x02) — unlike bool
+            # struct FIELDS, whose value rides the field-type nibble; the
+            # ftype 1/2 early-out above is the field case and must not be
+            # reused here or the walk desynchronizes
+            return pos + size
         for _ in range(size):
             pos = _thrift_skip(buf, pos, etype)
         return pos
@@ -303,12 +336,21 @@ def decode_plain_pages(col_meta, col_schema, buf: np.ndarray
     np_dtype = _PHYSICAL_NP.get(col_meta.physical_type)
     if np_dtype is None:
         raise _PlainDecodeUnsupported(col_meta.physical_type)
+    if not _plain_logical_ok(col_schema, col_meta.physical_type):
+        raise _PlainDecodeUnsupported(
+            f"logical type {col_schema.logical_type} != physical "
+            f"{col_meta.physical_type}")
     if col_schema.max_repetition_level:
         raise _PlainDecodeUnsupported("nested (repetition levels)")
     max_def = col_schema.max_definition_level
     stats = col_meta.statistics
     nulls_known_zero = stats is not None and stats.has_null_count \
         and stats.null_count == 0
+    if max_def > 1 and not nulls_known_zero:
+        # _defs_all_present parses bit-width-1 blocks only; a wider def
+        # level (optional leaf inside an optional group) would be misparsed
+        # — conservatism by coincidence, not by construction (ADVICE.md r5)
+        raise _PlainDecodeUnsupported("max_definition_level > 1")
     mv = buf if isinstance(buf, (bytes, memoryview)) else memoryview(buf)
     try:
         return _walk_plain_pages(mv, col_meta.num_values, np_dtype, max_def,
@@ -511,10 +553,12 @@ class ParquetShard:
         eligible = True
         for ci in cis:
             col = rg.column(ci)
+            cs = self.metadata.schema.column(ci)
             if (col.compression != "UNCOMPRESSED"
                     or col.dictionary_page_offset is not None
                     or col.physical_type not in _PHYSICAL_NP
-                    or self.metadata.schema.column(ci).max_repetition_level):
+                    or not _plain_logical_ok(cs, col.physical_type)
+                    or cs.max_repetition_level):
                 eligible = False
                 break
         if eligible:

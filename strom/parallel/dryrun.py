@@ -342,7 +342,13 @@ def run_dryrun(n_devices: int) -> None:
         repo_root = os.path.dirname(os.path.dirname(
             os.path.dirname(os.path.abspath(__file__))))
         env = dict(os.environ)
-        env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=32"
+        # mirror the __main__ entry: strip only the device-count flag and
+        # keep any other inherited XLA_FLAGS (a wholesale overwrite would
+        # drop e.g. a caller's memory/debug flags — ADVICE.md r5)
+        flags = [f for f in env.get("XLA_FLAGS", "").split()
+                 if not f.startswith("--xla_force_host_platform_device_count")]
+        flags.append("--xla_force_host_platform_device_count=32")
+        env["XLA_FLAGS"] = " ".join(flags)
         env["JAX_PLATFORMS"] = "cpu"
         res = subprocess.run(
             [sys.executable, "-m", "strom.parallel.dryrun",

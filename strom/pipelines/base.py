@@ -29,6 +29,8 @@ class Pipeline:
     def __init__(self, sampler: EpochShuffleSampler,
                  make_batch: Callable[[np.ndarray, int], Any], *,
                  depth: int = 2,
+                 auto_depth: bool = False,
+                 max_depth: int | None = None,
                  fingerprint: dict | None = None,
                  executor: concurrent.futures.Executor | None = None,
                  on_close: Callable[[], None] | None = None,
@@ -69,6 +71,8 @@ class Pipeline:
                 serial += 1
 
         self._prefetcher: Prefetcher = Prefetcher(thunks(), depth=depth,
+                                                  auto_depth=auto_depth,
+                                                  max_depth=max_depth,
                                                   executor=executor)
 
     def __iter__(self) -> "Pipeline":
@@ -109,6 +113,16 @@ class Pipeline:
     def steps_delivered(self) -> int:
         return self._prefetcher.steps
 
+    @property
+    def prefetch_depth(self) -> int:
+        """Current prefetch depth (moves when auto_depth is on)."""
+        return self._prefetcher.depth
+
+    @property
+    def prefetch_depth_trace(self) -> list[tuple[int, int]]:
+        """(step, depth) at every controller move, starting depth included."""
+        return list(self._prefetcher.depth_trace)
+
     def straggler_report(self, threshold: float = 1.25):
         """Cross-host step-time skew (collective: every process must call)."""
         return self.monitor.report(threshold)
@@ -123,6 +137,21 @@ class Pipeline:
 
     def __exit__(self, *exc) -> None:
         self.close()
+
+
+def _auto_depth_bounds(ctx, auto_prefetch: bool | None,
+                       batch_bytes: int) -> tuple[bool, int | None]:
+    """(auto_depth, max_depth) for a pipeline: *auto_prefetch* None defers to
+    ``ctx.config.prefetch_auto``; when auto, the ceiling is the config's
+    prefetch_max_depth further bounded by what the slab pool can stage at
+    *batch_bytes* per in-flight batch (strom.delivery.prefetch.bound_depth)."""
+    from strom.delivery.prefetch import bound_depth
+
+    auto = ctx.config.prefetch_auto if auto_prefetch is None else auto_prefetch
+    if not auto:
+        return False, None
+    return True, bound_depth(ctx.config.slab_pool_bytes, batch_bytes,
+                             cap=ctx.config.prefetch_max_depth)
 
 
 def resolve_state(paths: tuple[str, ...], *, seed: int,

@@ -17,7 +17,7 @@ import numpy as np
 
 from strom.delivery.core import StromContext
 from strom.formats.rawbin import TokenShardSet
-from strom.pipelines.base import Pipeline, resolve_state
+from strom.pipelines.base import Pipeline, _auto_depth_bounds, resolve_state
 from strom.pipelines.sampler import EpochShuffleSampler, SamplerState
 
 
@@ -28,6 +28,7 @@ def make_llama_pipeline(ctx: StromContext, paths: Sequence[str], *,
                         seed: int = 0,
                         shuffle: bool = True,
                         prefetch_depth: int | None = None,
+                        auto_prefetch: bool | None = None,
                         resume_from: str | SamplerState | None = None,
                         epoch_sync: bool = False
                         ) -> Pipeline:
@@ -57,5 +58,9 @@ def make_llama_pipeline(ctx: StromContext, paths: Sequence[str], *,
                                   sharding=sharding)
 
     depth = prefetch_depth if prefetch_depth is not None else ctx.config.prefetch_depth
-    return Pipeline(sampler, make_batch, depth=depth, fingerprint=fp,
+    auto, max_depth = _auto_depth_bounds(
+        ctx, auto_prefetch,
+        batch * (seq_len + 1) * np.dtype(dtype).itemsize)
+    return Pipeline(sampler, make_batch, depth=depth, auto_depth=auto,
+                    max_depth=max_depth, fingerprint=fp,
                     epoch_sync=epoch_sync)

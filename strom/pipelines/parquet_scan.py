@@ -91,6 +91,7 @@ def _mesh_reducer(mesh: Any):
 def parquet_scan_aggregate(ctx: StromContext, paths: Sequence[str],
                            columns: Sequence[str], map_fn: MapFn, *,
                            prefetch_depth: int = 2,
+                           auto_prefetch: bool | None = None,
                            unit_batch: int = 1,
                            devices: Sequence[Any] | None = None,
                            process_index: int | None = None,
@@ -191,7 +192,21 @@ def parquet_scan_aggregate(ctx: StromContext, paths: Sequence[str],
 
     acc = None
     dev_cycle = itertools.cycle(devs)
-    pf = Prefetcher(thunks, depth=prefetch_depth)
+    # auto depth: bound by what the slab pool can stage per in-flight unit
+    # chunk (selected bytes of the LARGEST chunk — LPT assignment makes
+    # sizes near-uniform, so the max is a safe per-unit estimate)
+    auto = ctx.config.prefetch_auto if auto_prefetch is None else auto_prefetch
+    max_depth = None
+    if auto:
+        from strom.delivery.prefetch import bound_depth
+
+        unit_bytes = max((sum(s.column_chunk_extents(g, columns).size
+                              for (s, g) in ch) for ch in unit_chunks),
+                         default=0)
+        max_depth = bound_depth(ctx.config.slab_pool_bytes, unit_bytes,
+                                cap=ctx.config.prefetch_max_depth)
+    pf = Prefetcher(thunks, depth=prefetch_depth, auto_depth=auto,
+                    max_depth=max_depth)
     try:
         for cols in pf:
             dev = next(dev_cycle)

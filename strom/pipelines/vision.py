@@ -18,7 +18,7 @@ import numpy as np
 from strom.delivery.core import StromContext
 from strom.formats.jpeg import DecodePool, decode_jpeg, random_resized_crop
 from strom.formats.wds import WdsShardSet
-from strom.pipelines.base import Pipeline, resolve_state
+from strom.pipelines.base import Pipeline, _auto_depth_bounds, resolve_state
 from strom.pipelines.sampler import EpochShuffleSampler, SamplerState
 
 # transform(jpeg_bytes, rng) -> HWC uint8
@@ -76,6 +76,7 @@ def make_wds_vision_pipeline(ctx: StromContext, paths: Sequence[str], *,
                              seed: int = 0,
                              shuffle: bool = True,
                              prefetch_depth: int | None = None,
+                             auto_prefetch: bool | None = None,
                              resume_from: str | SamplerState | None = None
                              ) -> Pipeline:
     """Infinite stream of (images [B,S,S,3] uint8, labels [B] int32) jax.Array
@@ -145,7 +146,10 @@ def make_wds_vision_pipeline(ctx: StromContext, paths: Sequence[str], *,
         return imgs, lbls
 
     depth = prefetch_depth if prefetch_depth is not None else ctx.config.prefetch_depth
-    return Pipeline(sampler, make_batch, depth=depth, fingerprint=fp,
+    auto, max_depth = _auto_depth_bounds(
+        ctx, auto_prefetch, len(local_rows) * image_size * image_size * 3)
+    return Pipeline(sampler, make_batch, depth=depth, auto_depth=auto,
+                    max_depth=max_depth, fingerprint=fp,
                     on_close=pool.close)
 
 
@@ -156,6 +160,7 @@ def make_predecoded_vision_pipeline(ctx: StromContext, paths: Sequence[str], *,
                                     seed: int = 0,
                                     shuffle: bool = True,
                                     prefetch_depth: int | None = None,
+                                    auto_prefetch: bool | None = None,
                                     resume_from: str | SamplerState | None = None
                                     ) -> Pipeline:
     """Decode-free vision loader over pre-decoded shards (see
@@ -201,7 +206,10 @@ def make_predecoded_vision_pipeline(ctx: StromContext, paths: Sequence[str], *,
         return imgs, lbls
 
     depth = prefetch_depth if prefetch_depth is not None else ctx.config.prefetch_depth
-    return Pipeline(sampler, make_batch, depth=depth, fingerprint=fp)
+    auto, max_depth = _auto_depth_bounds(
+        ctx, auto_prefetch, batch * image_size * image_size * 3)
+    return Pipeline(sampler, make_batch, depth=depth, auto_depth=auto,
+                    max_depth=max_depth, fingerprint=fp)
 
 
 def make_imagenet_resnet_pipeline(ctx: StromContext, paths: Sequence[str], *,

@@ -27,6 +27,27 @@ class _Counter:
             self.value += n
 
 
+class _Gauge:
+    """Last-set value (vs a _Counter's monotonic sum): the right shape for
+    "current depth" / "ops after coalesce this transfer" style observability
+    where the latest state, not the lifetime total, is the signal."""
+
+    __slots__ = ("value", "_lock")
+
+    def __init__(self) -> None:
+        self.value = 0
+        self._lock = threading.Lock()
+
+    def set(self, v: float) -> None:
+        with self._lock:
+            self.value = v
+
+    def max(self, v: float) -> None:
+        with self._lock:
+            if v > self.value:
+                self.value = v
+
+
 class _Histogram:
     """Fixed-bucket latency histogram (microseconds, log2 buckets)."""
 
@@ -74,6 +95,7 @@ class StatsRegistry:
         self.name = name
         self._counters: dict[str, _Counter] = {}
         self._hists: dict[str, _Histogram] = {}
+        self._gauges: dict[str, _Gauge] = {}
         self._lock = threading.Lock()
         self.created_at = time.time()
 
@@ -83,6 +105,16 @@ class StatsRegistry:
             if c is None:
                 c = self._counters[name] = _Counter()
             return c
+
+    def gauge(self, name: str) -> _Gauge:
+        with self._lock:
+            g = self._gauges.get(name)
+            if g is None:
+                g = self._gauges[name] = _Gauge()
+            return g
+
+    def set_gauge(self, name: str, value: float) -> None:
+        self.gauge(name).set(value)
 
     def histogram(self, name: str) -> _Histogram:
         with self._lock:
@@ -102,8 +134,11 @@ class StatsRegistry:
         with self._lock:
             counters = dict(self._counters)
             hists = dict(self._hists)
+            gauges = dict(self._gauges)
         for k, c in counters.items():
             out[k] = c.value
+        for k, g in gauges.items():
+            out[k] = g.value
         for k, h in hists.items():
             out[k + "_p50_us"] = h.percentile(0.50)
             out[k + "_p99_us"] = h.percentile(0.99)

@@ -1,0 +1,255 @@
+"""Delivery-scheduler unit tests: segment/op coalescing (adjacency, overlap,
+split threshold, RAID0 boundaries) and the striped overlap-window submission
+order (byte-mapping invariance, per-member grouping, error propagation)."""
+
+import os
+
+import numpy as np
+import pytest
+
+from strom.config import StromConfig
+from strom.delivery.coalesce import coalesce_chunks, coalesce_segments
+from strom.delivery.core import StromContext
+from strom.delivery.shard import Segment
+from strom.engine.base import EngineError
+from strom.engine.raid0 import (plan_stripe_reads, plan_stripe_windows,
+                                stripe_file)
+
+
+def cover_map(segs):
+    """{dest_byte: file_byte} a segment list describes — the invariant every
+    scheduler transform must preserve."""
+    m = {}
+    for s in segs:
+        for i in range(s.length):
+            m[s.dest_offset + i] = s.file_offset + i
+    return m
+
+
+def chunk_cover_map(chunks):
+    m = {}
+    for fi, fo, do, ln in chunks:
+        for i in range(ln):
+            m[do + i] = (fi, fo + i)
+    return m
+
+
+class TestCoalesceSegments:
+    def test_adjacent_merge(self):
+        segs = [Segment(0, 0, 100), Segment(100, 100, 50),
+                Segment(150, 150, 50)]
+        out = coalesce_segments(segs)
+        assert out == [Segment(0, 0, 200)]
+
+    def test_gap_not_merged(self):
+        segs = [Segment(0, 0, 100), Segment(200, 100, 50)]
+        assert coalesce_segments(segs) == segs
+
+    def test_adjacent_file_but_not_dest(self):
+        # file-contiguous but dest-disjoint (different deltas): two copies
+        segs = [Segment(0, 0, 100), Segment(100, 500, 100)]
+        assert sorted(coalesce_segments(segs),
+                      key=lambda s: s.dest_offset) == segs
+
+    def test_overlap_same_delta_dedupes_to_union(self):
+        segs = [Segment(0, 0, 100), Segment(50, 50, 100)]
+        out = coalesce_segments(segs)
+        assert out == [Segment(0, 0, 150)]
+        assert cover_map(out) == cover_map(segs)
+
+    def test_out_of_order_input(self):
+        segs = [Segment(150, 150, 50), Segment(0, 0, 100),
+                Segment(100, 100, 50)]
+        assert coalesce_segments(segs) == [Segment(0, 0, 200)]
+
+    def test_split_threshold(self):
+        segs = [Segment(0, 0, 100), Segment(100, 100, 100)]
+        out = coalesce_segments(segs, max_bytes=64)
+        assert all(s.length <= 64 for s in out)
+        assert cover_map(out) == cover_map(segs)
+
+    def test_cover_map_preserved(self):
+        rng = np.random.default_rng(7)
+        segs = []
+        dest = 0
+        fo = 0
+        for _ in range(40):
+            ln = int(rng.integers(1, 2000))
+            fo += int(rng.integers(0, 2)) * int(rng.integers(0, 500))
+            segs.append(Segment(fo, dest, ln))
+            fo += ln
+            dest += ln
+        out = coalesce_segments(segs, max_bytes=4096)
+        assert cover_map(out) == cover_map(segs)
+        assert len(out) <= len(segs) + sum(s.length for s in segs) // 4096 + 1
+
+
+class TestCoalesceChunks:
+    def test_merge_within_file_only(self):
+        ch = [(1, 0, 0, 100), (1, 100, 100, 100), (2, 200, 200, 100),
+              (2, 300, 300, 100)]
+        out = coalesce_chunks(ch)
+        assert out == [(1, 0, 0, 200), (2, 200, 200, 200)]
+
+    def test_interleaved_files_regroup(self):
+        # a WDS-style interleave: per-sample fragments alternating files
+        ch = [(1, 0, 0, 10), (2, 0, 10, 10), (1, 10, 20, 10), (2, 10, 30, 10)]
+        out = coalesce_chunks(ch)
+        # nothing merges (file runs are dest-discontiguous) but the mapping
+        # survives and files keep first-appearance order
+        assert chunk_cover_map(out) == chunk_cover_map(ch)
+        assert [c[0] for c in out] == [1, 1, 2, 2]
+
+    def test_split_threshold(self):
+        ch = [(1, 0, 0, 1000), (1, 1000, 1000, 1000)]
+        out = coalesce_chunks(ch, max_bytes=512)
+        assert all(c[3] <= 512 for c in out)
+        assert chunk_cover_map(out) == chunk_cover_map(ch)
+
+    def test_raid0_member_chunks_never_cross_members(self):
+        """Chunks expanded from a stripe plan: member ops stay per-member
+        and (dest-discontiguous by construction) never merge across chunk
+        boundaries — coalescing must not corrupt the stripe decode."""
+        segs = plan_stripe_reads(0, 4 << 20, 4, 512 * 1024)
+        ch = [(s.member, s.member_offset, s.logical_offset, s.length)
+              for s in segs]
+        out = coalesce_chunks(ch)
+        assert chunk_cover_map(out) == chunk_cover_map(ch)
+        # every output op maps entirely inside one member
+        assert {c[0] for c in out} == {0, 1, 2, 3}
+
+    def test_single_member_stripe_merges_fully(self):
+        # n=1 "striping" is plain contiguity: one op after coalescing
+        segs = plan_stripe_reads(0, 1 << 20, 1, 128 * 1024)
+        ch = [(0, s.member_offset, s.logical_offset, s.length) for s in segs]
+        assert coalesce_chunks(ch) == [(0, 0, 0, 1 << 20)]
+
+
+class TestStripeWindows:
+    def test_same_byte_mapping(self):
+        segs = plan_stripe_reads(12345, 9 << 20, 4, 512 * 1024)
+        out = plan_stripe_windows(segs, 4, 4 << 20)
+        key = lambda s: (s.member, s.member_offset, s.logical_offset, s.length)
+        assert sorted(map(key, out)) == sorted(map(key, segs))
+
+    def test_groups_per_member_within_window(self):
+        segs = plan_stripe_reads(0, 8 << 20, 4, 512 * 1024)
+        out = plan_stripe_windows(segs, 4, 4 << 20)
+        # first window = 8 segs: members grouped 0,0,1,1,2,2,3,3
+        first = [s.member for s in out[:8]]
+        assert first == [0, 0, 1, 1, 2, 2, 3, 3]
+        # within a member's run, member offsets are sequential
+        runs = [out[0:2], out[2:4], out[4:6], out[6:8]]
+        for run in runs:
+            assert run[1].member_offset == run[0].member_offset + run[0].length
+
+    def test_window_zero_keeps_logical_order(self):
+        segs = plan_stripe_reads(0, 4 << 20, 4, 512 * 1024)
+        assert plan_stripe_windows(segs, 4, 0) == list(segs)
+
+    def test_tail_window_flushes(self):
+        segs = plan_stripe_reads(0, (4 << 20) + (3 * 512 * 1024), 4,
+                                 512 * 1024)
+        out = plan_stripe_windows(segs, 4, 4 << 20)
+        assert len(out) == len(segs)
+
+    def test_count_matches_flushes(self):
+        from strom.engine.raid0 import count_stripe_windows
+
+        # lengths that don't divide the window: a flush consumes MORE than
+        # window_bytes, so ceil(total/wb) would overcount — the counter
+        # must match the actual flush rule
+        for total, chunk, wb in ((10 << 20, 3 << 20, 4 << 20),
+                                 ((4 << 20) + (3 * 512 * 1024), 512 * 1024,
+                                  4 << 20),
+                                 (9 << 20, 512 * 1024, 4 << 20)):
+            segs = plan_stripe_reads(0, total, 4, chunk)
+            n = count_stripe_windows(segs, 4, wb)
+            # replicate by instrumenting: group boundaries in the planned
+            # output are where the member id resets to the minimum member
+            # of a fresh window — instead, just recompute flushes directly
+            acc, flushes = 0, 0
+            for s in segs:
+                acc += s.length
+                if acc >= wb:
+                    flushes += 1
+                    acc = 0
+            assert n == flushes + (1 if acc else 0)
+        assert count_stripe_windows(segs, 1, 4 << 20) == 0  # n=1: no-op
+        assert count_stripe_windows(segs, 4, 0) == 0        # off: no-op
+
+
+@pytest.fixture()
+def striped_set(tmp_path, rng):
+    data = rng.integers(0, 256, 6 * 1024 * 1024 + 333, dtype=np.uint8)
+    src = tmp_path / "src.bin"
+    data.tofile(src)
+    members = [str(tmp_path / f"m{i}") for i in range(4)]
+    stripe_file(str(src), members, 256 * 1024)
+    return members, data
+
+
+class TestStripedDelivery:
+    """The windowed submission order through the real delivery path: bytes
+    identical to logical order, completions order-independent, errors
+    propagate."""
+
+    def _ctx(self, **kw):
+        return StromContext(StromConfig(engine="python", **kw))
+
+    def test_windowed_read_matches_data(self, tmp_path, striped_set):
+        members, data = striped_set
+        ctx = self._ctx()
+        try:
+            ctx.register_striped(str(tmp_path / "virt"), members, 256 * 1024)
+            out = ctx.memcpy_ssd2host(str(tmp_path / "virt"),
+                                      length=len(data))
+            np.testing.assert_array_equal(out.reshape(-1), data)
+            snap = ctx.stats()["context"]
+            assert snap["stripe_windows"] > 0
+            assert snap["stripe_overlap_window_bytes"] > 0
+        finally:
+            ctx.close()
+
+    def test_window_off_matches_window_on(self, tmp_path, striped_set):
+        members, data = striped_set
+        for wb in (0, 1 << 20, 16 << 20):
+            ctx = self._ctx(stripe_window_bytes=wb)
+            try:
+                ctx.register_striped(str(tmp_path / "virt"), members,
+                                     256 * 1024)
+                out = ctx.memcpy_ssd2host(str(tmp_path / "virt"),
+                                          length=len(data))
+                np.testing.assert_array_equal(out.reshape(-1), data)
+            finally:
+                ctx.close()
+
+    def test_offset_reads_identical(self, tmp_path, striped_set):
+        members, data = striped_set
+        ctx = self._ctx()
+        try:
+            ctx.register_striped(str(tmp_path / "virt"), members, 256 * 1024)
+            for off, ln in ((0, 700_000), (513 * 1024, 2 << 20),
+                            (1_000_001, 999_999)):
+                out = ctx.pread(str(tmp_path / "virt"), off, ln)
+                np.testing.assert_array_equal(out, data[off: off + ln])
+        finally:
+            ctx.close()
+
+    def test_error_mid_pipeline_propagates(self, tmp_path, striped_set):
+        """A member truncated mid-set: the windowed gather must surface
+        EngineError (short read), not return silently-zeroed bytes."""
+        members, data = striped_set
+        # remove the size sidecar so StripedFile.size reports full stripe
+        # capacity, then truncate one member mid-file
+        os.unlink(members[0] + ".stromsz")
+        with open(members[2], "r+b") as f:
+            f.truncate(os.path.getsize(members[2]) // 2)
+        ctx = self._ctx()
+        try:
+            ctx.register_striped(str(tmp_path / "virt"), members, 256 * 1024,
+                                 size=len(data))
+            with pytest.raises(EngineError):
+                ctx.memcpy_ssd2host(str(tmp_path / "virt"), length=len(data))
+        finally:
+            ctx.close()

@@ -539,6 +539,114 @@ class TestPlainDecode:
         np.testing.assert_array_equal(
             pages[0], table.slice(0, 10_000)["a64"].to_numpy())
 
+    def test_logical_types_fall_back_and_agree(self, ctx, tmp_path, rng):
+        """uint32 (physical INT32 + unsigned annotation), date32 and
+        timestamp columns must NOT ride the raw-reinterpret fast path: a
+        uint32 value past 2^31 would silently come back negative and
+        date/timestamp would come back as raw ints (ADVICE.md r5 high).
+        Cross-check: the routed result equals the pyarrow fallback exactly,
+        values AND dtype."""
+        import pyarrow as pa
+        import pyarrow.parquet as pq
+
+        from strom.formats.parquet import ParquetShard
+
+        n = 5000
+        u32 = rng.integers(0, 1 << 32, n, dtype=np.uint64).astype(np.uint32)
+        u32[0] = 2147483653  # > 2^31: the silent-reinterpretation witness
+        table = pa.table({
+            "u32": pa.array(u32),
+            "d32": pa.array(rng.integers(0, 30000, n).astype(np.int32),
+                            type=pa.date32()),
+            "ts": pa.array(rng.integers(0, 1 << 48, n, dtype=np.int64),
+                           type=pa.timestamp("us")),
+        })
+        p = str(tmp_path / "logical.parquet")
+        pq.write_table(table, p, compression="NONE", use_dictionary=False)
+        shard = ParquetShard(p, ctx=ctx)
+        plain0, fall0 = self._counters()
+        got = shard.read_row_group_arrays(ctx, 0, ["u32", "d32", "ts"])
+        plain1, fall1 = self._counters()
+        assert plain1 == plain0 and fall1 > fall0  # rode the pyarrow path
+        want = shard.read_row_group(ctx, 0, columns=["u32", "d32", "ts"])
+        for c in ("u32", "d32", "ts"):
+            ref = np.ascontiguousarray(
+                want[c].to_numpy(zero_copy_only=False))
+            assert got[c].dtype == ref.dtype
+            np.testing.assert_array_equal(got[c], ref)
+        assert got["u32"][0] == 2147483653  # not -2147483643
+        assert got["d32"].dtype.kind == "M"  # datetime64, not raw int32
+
+    def test_signed_int_annotation_stays_fast(self, ctx, tmp_path, rng):
+        """An explicit INT(32, signed)/INT(64, signed) annotation is exactly
+        the physical meaning: must stay eligible (no over-conservative
+        fallback for what common writers emit)."""
+        import pyarrow as pa
+        import pyarrow.parquet as pq
+
+        from strom.formats.parquet import ParquetShard
+
+        n = 5000
+        table = pa.table({
+            "i32": pa.array(rng.integers(-(1 << 20), 1 << 20, n,
+                                         dtype=np.int32)),
+            "i64": pa.array(rng.integers(-(1 << 40), 1 << 40, n,
+                                         dtype=np.int64)),
+        })
+        p = str(tmp_path / "signed.parquet")
+        pq.write_table(table, p, compression="NONE", use_dictionary=False)
+        shard = ParquetShard(p, ctx=ctx)
+        plain0, fall0 = self._counters()
+        got = shard.read_row_group_arrays(ctx, 0, ["i32", "i64"])
+        plain1, fall1 = self._counters()
+        assert plain1 > plain0 and fall1 == fall0
+        for c in ("i32", "i64"):
+            np.testing.assert_array_equal(got[c], table[c].to_numpy())
+
+    def test_wide_def_levels_fall_back(self, ctx, tmp_path, rng):
+        """max_definition_level > 1 (optional leaf in an optional group):
+        _defs_all_present only parses bit-width-1 blocks, so the decoder
+        must refuse BEFORE parsing instead of staying conservative by
+        coincidence (ADVICE.md r5 low)."""
+        import pyarrow as pa
+        import pyarrow.parquet as pq
+
+        from strom.formats.parquet import (ParquetShard,
+                                           _PlainDecodeUnsupported,
+                                           decode_plain_pages)
+
+        n = 2000
+        table = pa.table({"s": pa.array(
+            [{"v": float(i)} for i in range(n)],
+            type=pa.struct([("v", pa.float64())]))})
+        p = str(tmp_path / "nested_def.parquet")
+        pq.write_table(table, p, compression="NONE", use_dictionary=False,
+                       write_statistics=False)
+        shard = ParquetShard(p, ctx=ctx)
+        ci = shard._col_indices(["s.v"])[0]
+        cs = shard.metadata.schema.column(ci)
+        assert cs.max_definition_level > 1  # the case under test
+        buf = ctx.pread(shard.column_chunk_extents(0, ["s.v"]))
+        with pytest.raises(_PlainDecodeUnsupported):
+            decode_plain_pages(shard.metadata.row_group(0).column(ci), cs,
+                               buf)
+
+    def test_thrift_skip_bool_list_elements(self):
+        """list<bool> elements are ONE BYTE each in thrift compact (unlike
+        bool struct fields, whose value rides the type nibble): the skip
+        walk must advance size bytes or it desynchronizes (ADVICE.md r5)."""
+        from strom.formats.parquet import _thrift_struct
+
+        buf = bytes([
+            0x19, 0x31,        # field 1: list, 3 bool elements
+            0x01, 0x02, 0x01,  # one byte per element
+            0x25, 0x2A,        # field 3: i32 zigzag -> 21
+            0x00,              # stop
+        ])
+        out, pos = _thrift_struct(memoryview(buf), 0)
+        assert out[3] == 21  # landed on the field AFTER the list
+        assert pos == len(buf)
+
 
 class TestWdsStriped:
     """WDS shards on a RAID0 striped set (BASELINE config #3's '4×NVMe

@@ -63,3 +63,91 @@ def test_close_cancels():
     pf = Prefetcher(make_thunks(100, 0.01), depth=2)
     next(pf)
     pf.close()
+
+
+class TestAutoDepth:
+    """The feedback controller: grow on stalls, shrink when the queue runs
+    fully ready, stay inside [min_depth, max_depth] (the slab-pool bound)."""
+
+    def test_grows_under_stalls(self):
+        # reader 15ms/batch, consumer 0ms, start depth 1: every step stalls
+        # until depth covers the jitter — the controller must climb
+        pf = Prefetcher(make_thunks(30, 0.015), depth=1, auto_depth=True,
+                        max_depth=8)
+        out = list(pf)
+        assert out == list(range(30))
+        assert pf.depth > 1
+        assert pf.stats.snapshot()["depth_grow"] >= 1
+        # every move is on the audit trace
+        assert pf.depth_trace[0] == (0, 1)
+        assert pf.depth_trace[-1][1] == pf.depth
+
+    def test_respects_max_depth_bound(self):
+        pf = Prefetcher(make_thunks(40, 0.01), depth=1, auto_depth=True,
+                        max_depth=3)
+        for _ in pf:
+            pass
+        assert pf.depth <= 3
+        assert max(d for _, d in pf.depth_trace) <= 3
+
+    def test_shrinks_when_lead_ample(self):
+        # reader instant, consumer 5ms/step, start depth 8: the queue runs
+        # fully ready every pop — depth must come back down
+        pf = Prefetcher(make_thunks(60, 0.0), depth=8, auto_depth=True,
+                        min_depth=2, max_depth=8)
+        for _ in pf:
+            time.sleep(0.005)
+        assert pf.depth < 8
+        assert pf.depth >= 2
+        assert pf.stats.snapshot()["depth_shrink"] >= 1
+
+    def test_min_depth_floor(self):
+        pf = Prefetcher(make_thunks(80, 0.0), depth=4, auto_depth=True,
+                        min_depth=3, max_depth=8)
+        for _ in pf:
+            time.sleep(0.003)
+        assert pf.depth >= 3
+
+    def test_lead_time_recorded(self):
+        pf = Prefetcher(make_thunks(10, 0.0), depth=2, auto_depth=True)
+        for _ in pf:
+            time.sleep(0.004)
+        snap = pf.stats.snapshot()
+        assert snap.get("lead_count", 0) >= 1
+        assert snap["prefetch_depth"] == pf.depth
+
+    def test_fixed_depth_never_moves(self):
+        pf = Prefetcher(make_thunks(20, 0.01), depth=2)  # auto off
+        for _ in pf:
+            pass
+        assert pf.depth == 2
+        snap = pf.stats.snapshot()
+        assert snap.get("depth_grow", 0) == 0
+        assert snap.get("depth_shrink", 0) == 0
+
+    def test_order_preserved_while_depth_moves(self):
+        # jittery reader + pacing consumer: depth moves both ways, order
+        # and completeness must not
+        def thunk(i):
+            def run():
+                time.sleep(0.03 if i % 7 == 3 else 0.001)
+                return i
+            return run
+
+        pf = Prefetcher([thunk(i) for i in range(50)], depth=2,
+                        auto_depth=True, max_depth=6)
+        out = []
+        for x in pf:
+            time.sleep(0.004)
+            out.append(x)
+        assert out == list(range(50))
+
+
+def test_bound_depth_by_slab_pool():
+    from strom.delivery.prefetch import bound_depth
+
+    assert bound_depth(512 << 20, 64 << 20) == 8
+    assert bound_depth(512 << 20, 1 << 20, cap=16) == 16   # capped
+    assert bound_depth(16 << 20, 64 << 20) == 2            # floored
+    assert bound_depth(0, 64 << 20) == 32                  # pool off -> cap
+    assert bound_depth(512 << 20, 0) == 32                 # unknown batch
