@@ -137,11 +137,13 @@ def main() -> int:
     ap.add_argument("--tmpdir", default=os.environ.get("STROM_BENCH_DIR", "/tmp"))
     ap.add_argument("--skip-loader", action="store_true")
     ap.add_argument("--budget", type=int,
-                    default=int(os.environ.get("STROM_BENCH_BUDGET_S", "840")),
+                    default=int(os.environ.get("STROM_BENCH_BUDGET_S", "780")),
                     help="wall-clock budget in seconds: phases that no "
                          "longer fit are SKIPPED (recorded in "
                          "skipped_phases) so the run always finishes rc=0 "
-                         "with valid JSON instead of dying rc=124 mid-phase")
+                         "with valid JSON instead of dying rc=124 mid-phase. "
+                         "Default 780s: comfortably under the driver's kill "
+                         "timeout, so the final JSON always gets emitted")
     args = ap.parse_args()
 
     # --- per-phase wall-clock budgeting (BENCH_r05 died rc=124 mid-run:
@@ -154,6 +156,33 @@ def main() -> int:
     t_start = time.monotonic()
     skipped_phases: list[str] = []
     RESERVE_S = 150.0  # numerator bandwidth phase + JSON emit
+
+    # --- incremental artifact: atomically rewrite a partial JSON object
+    # --- after every completed phase. Belt to the budget's suspenders: even
+    # --- if a driver-side kill lands mid-phase (BENCH_r05: rc=124,
+    # --- parsed:null, the whole round's structured evidence gone), every
+    # --- phase that FINISHED is already on disk at STROM_BENCH_PARTIAL
+    # --- (default <tmpdir>/strom_bench_partial.json).
+    partial_path = os.environ.get(
+        "STROM_BENCH_PARTIAL",
+        os.path.join(args.tmpdir, "strom_bench_partial.json"))
+    partial_state: dict = {"metric": "ssd2hbm_bandwidth", "unit": "GB/s"}
+
+    def write_artifact(doc: dict) -> None:
+        tmp = partial_path + ".tmp"
+        try:
+            with open(tmp, "w") as f:
+                json.dump(doc, f)
+            os.replace(tmp, partial_path)
+        except OSError:
+            pass  # an unwritable tmpdir must not sink the bench itself
+
+    def flush_partial(**fields) -> None:
+        partial_state.update(fields)
+        write_artifact({**partial_state, "partial": True,
+                        "budget_s": args.budget,
+                        "elapsed_s": round(time.monotonic() - t_start, 1),
+                        "skipped_phases": list(skipped_phases)})
 
     def remaining() -> float:
         return args.budget - (time.monotonic() - t_start)
@@ -213,6 +242,11 @@ def main() -> int:
           f"{host_gbps:.3f} GB/s = {host_gbps / raw_gbps:.3f} of raw"
           if raw_gbps else "host-delivered: raw denominator missing",
           file=sys.stderr)
+    flush_partial(
+        raw_gbps=round(raw_gbps, 4), host_delivered_gbps=round(host_gbps, 4),
+        vs_baseline_host=round(host_gbps / raw_gbps, 4) if raw_gbps else 0.0,
+        raw_gbps_passes=hres.get("raw_gbps_passes"),
+        host_gbps_passes=hres.get("host_gbps_passes"))
 
     # the same ratio on the reference's flagship deployment shape (4xNVMe
     # md-raid0, BASELINE.json:9; VERDICT.md r4 next #2): framework arm
@@ -234,6 +268,9 @@ def main() -> int:
                   f"{raid_res.get('stripe_overlap_window_bytes')}B, "
                   f"{raid_res.get('stripe_windows')} windows)",
                   file=sys.stderr)
+            flush_partial(raw_raid_gbps=raid_res["raw_gbps"],
+                          host_raid_gbps=raid_res["host_gbps"],
+                          vs_baseline_host_raid=raid_res["vs_raw"])
         except Exception as e:
             print(f"ssd2host raid arm failed: {e!r}", file=sys.stderr)
 
@@ -346,6 +383,7 @@ def main() -> int:
                 "bounded_train_data_stalls_attempts":
                     [a[1] for a in llama_attempts],
             }
+            flush_partial(**loader_res)
 
         # config #2: ResNet-50 images/s (the headline metric's second half)
         # — still before the bulk phase, same relay-congestion reasoning
@@ -384,6 +422,16 @@ def main() -> int:
                     res.get("prefetch_depth_final")
                 loader_res[f"{prefix}_prefetch_depth_trace"] = \
                     res.get("prefetch_depth_trace")
+            # decode-path counters (JPEG arms only — the predecoded arms
+            # never touch the decode pool): the tentpole's evidence that
+            # reduced-scale / slot / overlapped-put actually engaged
+            for k in ("decode_reduced_hits_2", "decode_reduced_hits_4",
+                      "decode_reduced_hits_8", "decode_slot_bytes",
+                      "decode_errors", "decode_put_overlap_ms",
+                      "decode_batch_p50_us", "decode_batch_mean_us"):
+                if k in res:
+                    loader_res[f"{prefix}_{k}"] = res[k]
+            flush_partial(**loader_res)
             raid = getattr(bargs, "raid", 0)
             print(f"{name} flat-out: {res['images_per_s']:.0f} img/s"
                   f"{f' (raid{raid})' if raid else ''}; with "
@@ -465,6 +513,7 @@ def main() -> int:
             loader_res[stall_key] = best_s
             loader_res[stall_key + "_attempts"] = attempts
             loader_res["bounded_vision_shape"] = "16x112"
+            flush_partial(**loader_res)
 
         def probe_link_gbps(nbytes: int = 32 * 1024 * 1024) -> float:
             """Timed device_put+fetch of fresh random bytes (the relay
@@ -511,6 +560,7 @@ def main() -> int:
                           f"(9.6MB/step would measure the throttle)",
                           file=sys.stderr)
             loader_res["bounded_vision_headline"] = headline
+            flush_partial(bounded_vision_headline=headline)
 
         bounded_vision("resnet PREDECODED", bench_resnet, rargs,
                        "resnet_predecoded_stalls_bounded")
@@ -561,6 +611,7 @@ def main() -> int:
                   f"{pargs.unit_batch}): {pres['rows_per_s']:.0f} rows/s, "
                   f"selected columns {pres['selected_gbps']:.3f} GB/s",
                   file=sys.stderr)
+            flush_partial(**loader_res)
 
         # config #5, WIDE projection arm (VERDICT.md r3 weak #6: the
         # narrow scan's 8B/row selection is too small for selected_gbps to
@@ -628,6 +679,7 @@ def main() -> int:
                   f"{plres['disk_read_gbps']:.3f} GB/s bare gather of the "
                   f"same extents = vs_disk {plres['vs_disk']}",
                   file=sys.stderr)
+        flush_partial(**loader_res)
 
     # --- numerator: one streamed memcpy_ssd2tpu ----------------------------
     # (engine reads piece k+1 while piece k streams host->HBM)
@@ -705,6 +757,8 @@ def main() -> int:
             stream_read_gbps = size / r_read / 1e9 if r_read else None
         del arr
     ctx.close()
+    flush_partial(value=round(s2t_gbps, 4),
+                  link_busy_frac=round(busy_frac, 4) if busy_frac else None)
     print(f"ssd2tpu delivered: {s2t_gbps:.3f} GB/s (host->HBM link busy "
           f"{busy_frac:.1%} of the transfer, effective link "
           f"{link_gbps:.3f} GB/s; stream reader idle "
@@ -828,6 +882,10 @@ def main() -> int:
         "on virtual meshes (MULTICHIP_r*.json) and 16/32-device lowering",
     ]
 
+    # the completed artifact replaces the incremental partial file too
+    # (partial=False marks it final), so a post-print driver kill still
+    # finds the full object on disk
+    write_artifact({**out, "partial": False})
     print(json.dumps(out))
     return 0
 

@@ -623,6 +623,47 @@ def _ensure_predecoded(ctx, tar_path: str, image_size: int, tmpdir: str) -> str:
     return out
 
 
+# decode-path counters reported by the JPEG vision arms (ISSUE 2):
+# reduced-scale decode hits per denominator, bytes decoded straight into
+# batch slots, zero-image substitutions, decode/put overlap window
+_DECODE_COUNTERS = ("decode_reduced_hits_2", "decode_reduced_hits_4",
+                    "decode_reduced_hits_8", "decode_slot_bytes",
+                    "decode_errors", "decode_put_overlap_ms")
+
+
+def _decode_stats_delta(snap0: dict) -> dict:
+    """Decode-path counter AND histogram deltas since *snap0* (the process
+    -global registry is shared across bench phases in one process — same
+    delta discipline as bench_parquet's scheduler counters; a cumulative
+    p50 would bill the resnet arm's batches to the vit arm's column)."""
+    from strom.utils.stats import global_stats, percentile_from_buckets
+
+    snap1 = global_stats.snapshot()
+    out = {k: int(snap1.get(k, 0) - snap0.get(k, 0))
+           for k in _DECODE_COUNTERS}
+    b0 = snap0.get("decode_batch_hist") or []
+    b1 = snap1.get("decode_batch_hist") or []
+    db = [a - b for a, b in zip(b1, b0)] if b0 else list(b1)
+    n = sum(db)
+    tot = (snap1.get("decode_batch_mean_us", 0.0)
+           * snap1.get("decode_batch_count", 0)
+           - snap0.get("decode_batch_mean_us", 0.0)
+           * snap0.get("decode_batch_count", 0))
+    out["decode_batch_p50_us"] = percentile_from_buckets(db, 0.50)
+    out["decode_batch_mean_us"] = round(tot / n, 1) if n else 0.0
+    return out
+
+
+def _decode_config_kw(args: argparse.Namespace) -> dict:
+    """StromConfig decode-knob overrides from the A/B flags (absent in
+    driver-built Namespaces → config defaults, i.e. all on)."""
+    return {
+        "decode_reduced_scale": not getattr(args, "full_decode", False),
+        "decode_to_slot": not getattr(args, "no_slot_decode", False),
+        "decode_overlap_put": not getattr(args, "no_overlap_put", False),
+    }
+
+
 def bench_resnet(args: argparse.Namespace) -> dict:
     """Config #2 shape: JPEG WebDataset -> decode -> device, images/s
     (IO-bound: a throttled fake 'train step' just blocks on delivery).
@@ -643,8 +684,12 @@ def bench_resnet(args: argparse.Namespace) -> dict:
     if path is None:
         path = _mk_wds_fixture(args.tmpdir, args.batch, args.image_size)
     cfg = StromConfig(engine=args.engine, block_size=args.block,
-                      queue_depth=args.depth, num_buffers=max(args.depth * 2, 8))
+                      queue_depth=args.depth, num_buffers=max(args.depth * 2, 8),
+                      **_decode_config_kw(args))
     ctx = StromContext(cfg)
+    from strom.utils.stats import global_stats as _gs
+
+    _dec0 = _gs.snapshot()
     try:
         n_dev = _fit_dp_devices(args.batch)
         mesh = make_mesh({"dp": n_dev}, devices=jax.devices()[:n_dev])
@@ -697,6 +742,10 @@ def bench_resnet(args: argparse.Namespace) -> dict:
             "engine": cfg.engine,
             "predecoded": predecoded,
         }
+        if not predecoded:
+            out.update({"decode_reduced_scale": cfg.decode_reduced_scale,
+                        "decode_to_slot": cfg.decode_to_slot,
+                        "decode_overlap_put": cfg.decode_overlap_put})
 
         if getattr(args, "train_step", False):
             # north-star phase (BASELINE.json:5 "ResNet-50 input pipeline fully
@@ -743,6 +792,8 @@ def bench_resnet(args: argparse.Namespace) -> dict:
             # (fixed depth by protocol: pipe_factory's auto default is False)
             _run_bounded_arm(args, out, pipe_factory, step, rate, args.batch,
                              "bounded_train_images_per_s", data_paths)
+        if not predecoded:
+            out.update(_decode_stats_delta(_dec0))
     finally:
         ctx.close()
     return out
@@ -769,8 +820,12 @@ def bench_vit(args: argparse.Namespace) -> dict:
     plain = args.file or _mk_wds_fixture(args.tmpdir, args.batch,
                                          args.image_size)
     cfg = StromConfig(engine=args.engine, block_size=args.block,
-                      queue_depth=args.depth, num_buffers=max(args.depth * 2, 8))
+                      queue_depth=args.depth, num_buffers=max(args.depth * 2, 8),
+                      **_decode_config_kw(args))
     ctx = StromContext(cfg)
+    from strom.utils.stats import global_stats as _gs
+
+    _dec0 = _gs.snapshot()
     try:
         predecoded = bool(getattr(args, "predecoded", False))
         if predecoded:
@@ -824,6 +879,10 @@ def bench_vit(args: argparse.Namespace) -> dict:
             "data_stall_steps": stalls, "engine": cfg.engine,
             "predecoded": predecoded,
         }
+        if not predecoded:
+            out.update({"decode_reduced_scale": cfg.decode_reduced_scale,
+                        "decode_to_slot": cfg.decode_to_slot,
+                        "decode_overlap_put": cfg.decode_overlap_put})
 
         if getattr(args, "train_step", False):
             # north-star phase: a REAL jitted ViT train step consumes the batches
@@ -867,6 +926,8 @@ def bench_vit(args: argparse.Namespace) -> dict:
             # (fixed depth by protocol: pipe_factory's auto default is False)
             _run_bounded_arm(args, out, pipe_factory, step, rate, args.batch,
                              "bounded_train_images_per_s", members)
+        if not predecoded:
+            out.update(_decode_stats_delta(_dec0))
     finally:
         ctx.close()
     return out
@@ -1238,6 +1299,22 @@ def bench_all(args: argparse.Namespace) -> dict:
     return out
 
 
+def _add_decode_flags(p: argparse.ArgumentParser) -> None:
+    """Decode-path A/B flags shared by the JPEG vision arms (defaults: all
+    three optimizations ON, per StromConfig)."""
+    p.add_argument("--full-decode", action="store_true", dest="full_decode",
+                   help="disable reduced-scale JPEG decode (A/B the "
+                        "SOF-header 1/2 / 1/4 / 1/8 IDCT fast path)")
+    p.add_argument("--no-slot-decode", action="store_true",
+                   dest="no_slot_decode",
+                   help="disable direct-to-slot decode: workers return rows "
+                        "and the batch is np.stack'd (the legacy copy path)")
+    p.add_argument("--no-overlap-put", action="store_true",
+                   dest="no_overlap_put",
+                   help="disable overlapped shard delivery: decode the whole "
+                        "batch, then device_put each device group serially")
+
+
 def main(argv: list[str] | None = None) -> int:
     ap = argparse.ArgumentParser(prog="strom-bench")
     sub = ap.add_subparsers(dest="cmd", required=True)
@@ -1359,6 +1436,7 @@ def main(argv: list[str] | None = None) -> int:
                       help="auto-tune prefetch depth in the --train-step "
                            "phase (grow on stalls, shrink on ample lead; "
                            "--prefetch is the starting depth)")
+    _add_decode_flags(p_rn)
     p_rn.set_defaults(fn=bench_resnet)
 
     p_vit = sub.add_parser("vit", help="config #3: WDS .tar -> ViT loader "
@@ -1398,6 +1476,7 @@ def main(argv: list[str] | None = None) -> int:
                        help="auto-tune prefetch depth in the --train-step "
                             "phase (grow on stalls, shrink on ample lead; "
                             "--prefetch is the starting depth)")
+    _add_decode_flags(p_vit)
     p_vit.set_defaults(fn=bench_vit)
 
     p_pq = sub.add_parser("parquet", help="config #5: PG-Strom-style columnar "

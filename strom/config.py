@@ -117,6 +117,26 @@ class StromConfig:
     # serialized stream still saturates the DMA engine)
     serialize_device_put: bool = True
 
+    # host JPEG decode path (vision pipelines — strom/pipelines/vision.py):
+    # decode_reduced_scale: when the SAMPLED crop at 1/d scale still covers
+    # the target (min(crop_h, crop_w) >= size*d; d in 2/4/8; encoded dims
+    # read from the SOF header without decoding), decode at 1/d via
+    # IMREAD_REDUCED_COLOR_* — libjpeg skips the corresponding IDCT work
+    # (up to 64x at 1/8). Crop geometry is sampled in full-res coordinates
+    # BEFORE the denominator is chosen, so the augmentation RNG stream is
+    # identical either way, and a crop that would need upscaling at 1/d
+    # rides a smaller denominator or the full path (quality-neutral).
+    decode_reduced_scale: bool = True
+    # decode_to_slot: decode workers write their final size^2 x 3 rows
+    # straight into a preallocated batch array (transforms take out=),
+    # eliminating the np.stack full-batch copy and per-row temporaries.
+    decode_to_slot: bool = True
+    # decode_overlap_put: device_put each device's row group as soon as its
+    # rows finish decoding (completion-ordered), overlapping host->HBM
+    # transfer with the remaining decode instead of decoding the whole
+    # union then transferring serially. Implies decode_to_slot mechanics.
+    decode_overlap_put: bool = True
+
     # NUMA affinity (multi-socket hosts): pin submitting threads to the NVMe's
     # home node, mbind staging slabs there, optionally steer the device IRQs
     # (needs root). Off by default; no-op on UMA boxes (strom/utils/numa.py).

@@ -385,6 +385,21 @@ class StromContext:
             return source.extents[0].path
         return None
 
+    def device_put(self, arr: np.ndarray, device: Any) -> Any:
+        """One host->device dispatch under the context's put policy: the
+        `serialize_device_put` lock (concurrent puts interleave poorly on a
+        shared host link) and the trace annotation. Pipelines route their
+        per-device shard puts here — including the decode path's overlapped
+        per-group puts — so every host->HBM byte obeys one policy."""
+        import jax
+
+        from strom.utils.tracing import trace_span
+
+        with self._put_lock, \
+                trace_span("strom.device_put",
+                           enabled=self.config.trace_annotations):
+            return jax.device_put(arr, device)
+
     def extent_map(self, path: str) -> list | None:
         """Cached FIEMAP extent map for *path* (None: unavailable)."""
         with self._files_lock:
@@ -946,6 +961,28 @@ class StromContext:
                 global_stats.gauge("stripe_overlap_window_bytes").value,
             "stripe_windows": global_stats.counter("stripe_windows").value,
         }}
+        # decode-path observability (vision pipelines; ISSUE 2 tentpole):
+        # reduced-scale hit counts per denominator, bytes decoded straight
+        # into batch slots, per-sample decode failures absorbed by the
+        # zero-image policy, and the decode/put overlap window
+        dh = global_stats.histogram("decode_batch")
+        out["decode"] = {
+            "decode_reduced_hits_2":
+                global_stats.counter("decode_reduced_hits_2").value,
+            "decode_reduced_hits_4":
+                global_stats.counter("decode_reduced_hits_4").value,
+            "decode_reduced_hits_8":
+                global_stats.counter("decode_reduced_hits_8").value,
+            "decode_slot_bytes":
+                global_stats.counter("decode_slot_bytes").value,
+            "decode_errors": global_stats.counter("decode_errors").value,
+            "decode_put_overlap_ms":
+                global_stats.counter("decode_put_overlap_ms").value,
+            "decode_batch_p50_us": dh.percentile(0.50),
+            "decode_batch_mean_us": dh.mean_us,
+            "decode_batch_count": dh.count,
+            "decode_batch_hist": list(dh.buckets),
+        }
         if self._slab_pool is not None:
             out["slab_pool"] = self._slab_pool.stats()
         out["engine"] = self.engine.stats()

@@ -10,5 +10,6 @@ RAID0 striping, sharded reads and async handles.
 from strom.formats.rawbin import TokenShardSet  # noqa: F401
 from strom.formats.wds import TarIndex, TarMember, WdsSample, WdsShardSet  # noqa: F401
 from strom.formats.jpeg import (  # noqa: F401
-    DecodePool, center_crop_resize, decode_jpeg, random_resized_crop)
+    DecodePool, center_crop_resize, decode_jpeg, make_train_transform,
+    parse_jpeg_dims, random_resized_crop, reduced_denom)
 from strom.formats.parquet import ParquetShard  # noqa: F401

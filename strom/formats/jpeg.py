@@ -8,14 +8,40 @@ division of labor in the reference's consumer (PG-Strom decompresses on GPU —
 strom-tpu instead keeps the TPU's MXU for the model and spends host cores on
 decode; the "0 data-stall" overlap hides both).  Consumer: the ResNet-50
 pipeline (BASELINE config #2, BASELINE.json:8).
+
+Decode-path scheduling (ISSUE 2 tentpole):
+
+- **Reduced-scale decode**: when the SAMPLED crop at 1/d scale still covers
+  the target (d in 2/4/8; encoded dims read from the SOF header by
+  :func:`parse_jpeg_dims` without decoding), decode via cv2's
+  ``IMREAD_REDUCED_COLOR_{2,4,8}`` — libjpeg skips the corresponding IDCT
+  work, up to 64x less at 1/8. The crop geometry is sampled in FULL-res
+  coordinates BEFORE the denominator is chosen (RNG stream identical either
+  way) and rescaled onto the reduced image; a crop that would need
+  upscaling at 1/d rides a smaller d or the full path, so the knob is
+  quality-neutral.  Counters: ``decode_reduced_hits_{2,4,8}``.
+- **Direct-to-slot decode**: every transform takes an optional ``out=`` row
+  (the final size x size x 3 destination inside a preallocated batch array)
+  so the resize lands its pixels straight into the batch slot — no
+  ``np.stack`` pass over the batch, no per-row output temporaries.
+  :meth:`DecodePool.map_into` drives it; ``decode_slot_bytes`` counts the
+  bytes delivered this way.
+- **Per-sample failure policy** (slot path): a ``ValueError`` decode failure
+  zeroes the row and bumps ``decode_errors`` instead of aborting the whole
+  batch — one truncated JPEG in a million-sample epoch is data loss of one
+  sample, not of the run.
 """
 
 from __future__ import annotations
 
 import concurrent.futures
+import os
+import threading
 from typing import Callable, Iterable, Sequence
 
 import numpy as np
+
+from strom.utils.stats import global_stats
 
 try:
     import cv2
@@ -33,12 +59,81 @@ except Exception:  # pragma: no cover
     _HAVE_PIL = False
 
 
-def decode_jpeg(data: bytes | np.ndarray) -> np.ndarray:
-    """Decode JPEG/PNG bytes → HWC uint8 RGB array."""
+# -- SOF header parsing (no decode) -----------------------------------------
+
+# SOF0..SOF15 carry frame dimensions, except DHT (C4), JPG (C8), DAC (CC)
+_SOF_MARKERS = frozenset(range(0xC0, 0xD0)) - {0xC4, 0xC8, 0xCC}
+
+
+def parse_jpeg_dims(data: bytes | np.ndarray) -> tuple[int, int] | None:
+    """(height, width) from a JPEG's SOF header, walking marker segments
+    only — no entropy decode, no IDCT. Returns None for anything that is
+    not parseable JPEG (PNG members, truncated headers): callers fall back
+    to the full-scale decode path, which raises its own clear error."""
+    if isinstance(data, np.ndarray):
+        b = data.view(np.uint8).reshape(-1)
+    else:
+        b = np.frombuffer(data, dtype=np.uint8)
+    n = b.shape[0]
+    if n < 4 or b[0] != 0xFF or b[1] != 0xD8:
+        return None
+    i = 2
+    while i + 3 < n:
+        if b[i] != 0xFF:
+            return None  # desynced: not walking marker segments anymore
+        marker = int(b[i + 1])
+        if marker == 0xFF:  # fill byte before a marker
+            i += 1
+            continue
+        if marker == 0x01 or 0xD0 <= marker <= 0xD7:  # standalone TEM/RSTn
+            i += 2
+            continue
+        if marker in (0xD9, 0xDA):  # EOI / SOS before any SOF: give up
+            return None
+        seg_len = (int(b[i + 2]) << 8) | int(b[i + 3])
+        if seg_len < 2:
+            return None
+        if marker in _SOF_MARKERS:
+            if i + 9 > n:
+                return None
+            h = (int(b[i + 5]) << 8) | int(b[i + 6])
+            w = (int(b[i + 7]) << 8) | int(b[i + 8])
+            return (h, w) if h > 0 and w > 0 else None
+        i += 2 + seg_len
+    return None
+
+
+def reduced_denom(h: int, w: int, size: int) -> int:
+    """Largest decode denominator d in (8, 4, 2) at which an (h, w) crop
+    still covers the size×size target: min(h, w) >= size * d. Callers pass
+    the CROP rectangle's dimensions, not the encoded image's — a reduced
+    decode whose crop region lands below the target size would be bilinearly
+    UPSCALED where the full path downsamples real pixels, a silent training
+    -quality regression. 1 = decode full scale."""
+    if size <= 0:
+        return 1
+    shorter = min(h, w)
+    for d in (8, 4, 2):
+        if shorter >= size * d:
+            return d
+    return 1
+
+
+def decode_jpeg(data: bytes | np.ndarray, *, reduced: int = 1) -> np.ndarray:
+    """Decode JPEG/PNG bytes → HWC uint8 RGB array.
+
+    *reduced* in (2, 4, 8) decodes JPEGs at 1/reduced scale (libjpeg
+    skips the corresponding IDCT work); the caller owns rescaling any
+    crop geometry onto the reduced image (:func:`make_train_transform`).
+    """
     if _HAVE_CV2:
+        flag = {1: cv2.IMREAD_COLOR,
+                2: cv2.IMREAD_REDUCED_COLOR_2,
+                4: cv2.IMREAD_REDUCED_COLOR_4,
+                8: cv2.IMREAD_REDUCED_COLOR_8}[reduced]
         buf = np.frombuffer(data, dtype=np.uint8) if isinstance(data, (bytes, memoryview)) \
             else data.view(np.uint8).reshape(-1)
-        img = cv2.imdecode(buf, cv2.IMREAD_COLOR)
+        img = cv2.imdecode(buf, flag)
         if img is None:
             raise ValueError("not a decodable image")
         return cv2.cvtColor(img, cv2.COLOR_BGR2RGB)
@@ -46,6 +141,10 @@ def decode_jpeg(data: bytes | np.ndarray) -> np.ndarray:
         raw = data.tobytes() if isinstance(data, np.ndarray) else bytes(data)
         try:
             with Image.open(io.BytesIO(raw)) as im:
+                if reduced > 1:
+                    # draft mode: JPEG power-of-2 reduced decode, same trick
+                    im.draft("RGB", (max(1, im.width // reduced),
+                                     max(1, im.height // reduced)))
                 return np.asarray(im.convert("RGB"))
         except Exception as e:  # UnidentifiedImageError etc. → one contract
             raise ValueError("not a decodable image") from e
@@ -56,6 +155,33 @@ def _resize(img: np.ndarray, h: int, w: int) -> np.ndarray:
     if _HAVE_CV2:
         return cv2.resize(img, (w, h), interpolation=cv2.INTER_LINEAR)
     return np.asarray(Image.fromarray(img).resize((w, h), Image.BILINEAR))
+
+
+def _resize_into(img: np.ndarray, size: int,
+                 out: np.ndarray | None) -> np.ndarray:
+    """Bilinear resize to size x size, into *out* when given (cv2 writes the
+    pixels straight into the destination row — the zero-copy half of the
+    slot-decode story)."""
+    if out is None:
+        return _resize(img, size, size)
+    if _HAVE_CV2:
+        cv2.resize(img, (size, size), dst=out,
+                   interpolation=cv2.INTER_LINEAR)
+    else:
+        out[:] = _resize(img, size, size)
+    return out
+
+
+def _flip_h(dst: np.ndarray, out: np.ndarray | None) -> np.ndarray:
+    """Horizontal flip; in place (cv2.flip supports src==dst) on the slot
+    path, a fresh contiguous mirror otherwise — values identical."""
+    if out is None:
+        return np.ascontiguousarray(dst[:, ::-1])
+    if _HAVE_CV2:
+        cv2.flip(dst, 1, dst=dst)
+    else:
+        dst[:] = dst[:, ::-1].copy()
+    return dst
 
 
 def center_crop_resize(img: np.ndarray, size: int,
@@ -70,12 +196,15 @@ def center_crop_resize(img: np.ndarray, size: int,
     return img[top: top + size, left: left + size]
 
 
-def random_resized_crop(img: np.ndarray, size: int, rng: np.random.Generator,
+def sample_rrc_geometry(h: int, w: int, rng: np.random.Generator,
                         *, scale: tuple[float, float] = (0.08, 1.0),
-                        ratio: tuple[float, float] = (3 / 4, 4 / 3)) -> np.ndarray:
-    """Train transform: Inception-style random area/aspect crop → size×size,
-    plus a horizontal flip coin."""
-    h, w = img.shape[:2]
+                        ratio: tuple[float, float] = (3 / 4, 4 / 3)
+                        ) -> tuple[int, int, int, int]:
+    """(top, left, crop_h, crop_w) of an Inception-style random area/aspect
+    crop in (h, w) coordinates; falls back to the center square. Pure RNG +
+    arithmetic — the full-scale and reduced-scale decode paths both sample
+    here in FULL-resolution coordinates, so their random streams (and
+    therefore checkpoint-resume determinism) are identical."""
     area = h * w
     for _ in range(10):
         target = area * rng.uniform(*scale)
@@ -86,32 +215,155 @@ def random_resized_crop(img: np.ndarray, size: int, rng: np.random.Generator,
         if 0 < cw <= w and 0 < ch <= h:
             top = int(rng.integers(0, h - ch + 1))
             left = int(rng.integers(0, w - cw + 1))
-            img = img[top: top + ch, left: left + cw]
-            break
-    else:
-        img = center_crop_resize(img, min(h, w), resize_shorter=min(h, w))
-    out = _resize(img, size, size)
+            return top, left, ch, cw
+    side = min(h, w)
+    return (h - side) // 2, (w - side) // 2, side, side
+
+
+def random_resized_crop(img: np.ndarray, size: int, rng: np.random.Generator,
+                        *, scale: tuple[float, float] = (0.08, 1.0),
+                        ratio: tuple[float, float] = (3 / 4, 4 / 3),
+                        out: np.ndarray | None = None) -> np.ndarray:
+    """Train transform: Inception-style random area/aspect crop → size×size,
+    plus a horizontal flip coin. With *out*, the result lands in the given
+    row (bit-identical values to the allocating path)."""
+    h, w = img.shape[:2]
+    top, left, ch, cw = sample_rrc_geometry(h, w, rng, scale=scale,
+                                            ratio=ratio)
+    dst = _resize_into(img[top: top + ch, left: left + cw], size, out)
     if rng.random() < 0.5:
-        out = out[:, ::-1]
-    return np.ascontiguousarray(out)
+        return _flip_h(dst, out)
+    return np.ascontiguousarray(dst) if out is None else dst
+
+
+def _scale_crop(top: int, left: int, ch: int, cw: int,
+                fh: int, fw: int, rh: int, rw: int
+                ) -> tuple[int, int, int, int]:
+    """Map a full-resolution crop rectangle onto a reduced decode of actual
+    shape (rh, rw) (libjpeg reduced sizes are ceil(dim/d), so the exact
+    ratio comes from the decoded image, not the nominal denominator).
+    Clamped non-empty."""
+    sy, sx = rh / fh, rw / fw
+    r0 = min(int(round(top * sy)), rh - 1)
+    c0 = min(int(round(left * sx)), rw - 1)
+    r1 = max(r0 + 1, min(int(round((top + ch) * sy)), rh))
+    c1 = max(c0 + 1, min(int(round((left + cw) * sx)), rw))
+    return r0, c0, r1 - r0, c1 - c0
+
+
+def make_train_transform(size: int, *, reduced_scale: bool = True,
+                         scale: tuple[float, float] = (0.08, 1.0),
+                         ratio: tuple[float, float] = (3 / 4, 4 / 3)
+                         ) -> Callable[..., np.ndarray]:
+    """Transform(jpeg_bytes, rng, out=None) -> size×size×3 uint8.
+
+    With *reduced_scale*, the crop rectangle is sampled FIRST (in full-res
+    coordinates from the SOF header's dimensions — identical RNG stream to
+    the full path), then the largest decode denominator at which that crop
+    still covers the size×size target is chosen (:func:`reduced_denom` on
+    the CROP dims: a crop that would land below the target at 1/d must not
+    be upscaled from a reduced decode) and the rectangle is rescaled onto
+    the reduced image. Non-JPEG members (no SOF) ride the full path."""
+
+    def tf(data, rng: np.random.Generator,
+           out: np.ndarray | None = None) -> np.ndarray:
+        dims = parse_jpeg_dims(data) if reduced_scale else None
+        if dims is None:
+            return random_resized_crop(decode_jpeg(data), size, rng,
+                                       scale=scale, ratio=ratio, out=out)
+        fh, fw = dims
+        top, left, ch, cw = sample_rrc_geometry(fh, fw, rng, scale=scale,
+                                                ratio=ratio)
+        denom = reduced_denom(ch, cw, size)
+        if denom == 1:
+            img = decode_jpeg(data)
+            r0, c0, rch, rcw = top, left, ch, cw
+        else:
+            img = decode_jpeg(data, reduced=denom)
+            global_stats.add(f"decode_reduced_hits_{denom}")
+            r0, c0, rch, rcw = _scale_crop(top, left, ch, cw, fh, fw,
+                                           img.shape[0], img.shape[1])
+        dst = _resize_into(img[r0: r0 + rch, c0: c0 + rcw], size, out)
+        if rng.random() < 0.5:
+            return _flip_h(dst, out)
+        return np.ascontiguousarray(dst) if out is None else dst
+
+    return tf
 
 
 class DecodePool:
-    """Thread pool mapping decode+transform over batches of member payloads."""
+    """Thread pool mapping decode+transform over batches of member payloads.
+
+    Worker count is clamped to the host's core count (decode has no I/O
+    waits to hide; extra threads only add GIL churn and context switches).
+
+    cv2's internal threading is disabled while a pool lives (parallelism
+    comes from this pool, not from within one image); the prior thread count
+    is snapshotted at construction and restored in :meth:`close` so library
+    users embedding a pipeline don't inherit a globally-mutated cv2.
+    (Overlapping pool lifetimes restore whatever the LAST close sees —
+    cv2 keeps one global setting, there is nothing finer to restore.)
+    """
 
     def __init__(self, workers: int = 8):
+        self._cv2_threads_prev: int | None = None
         if _HAVE_CV2:
-            # parallelism comes from this pool, not from within one image
+            self._cv2_threads_prev = cv2.getNumThreads()
             cv2.setNumThreads(0)
+        # decode is pure CPU (no I/O waits to hide), so workers beyond the
+        # core count only thrash: measured 177ms vs 126ms per 64-image batch
+        # at 8 vs 2 workers on a 2-core host — oversubscription cost ate
+        # more than the reduced-scale decode win. Clamp, don't trust the
+        # caller's guess about this host.
+        workers = max(1, min(workers, os.cpu_count() or workers))
+        self.workers = workers
         self._pool = concurrent.futures.ThreadPoolExecutor(
             max_workers=workers, thread_name_prefix="strom-decode")
+        self.decode_errors = 0
+        self._err_lock = threading.Lock()
+        self._closed = False
 
     def map(self, fn: Callable[..., np.ndarray],
             items: Iterable, *extra: Sequence) -> list[np.ndarray]:
         return list(self._pool.map(fn, items, *extra))
 
+    # -- direct-to-slot mapping --------------------------------------------
+    def _one_into(self, fn: Callable[..., np.ndarray], item,
+                  rng, row: np.ndarray) -> None:
+        try:
+            fn(item, rng, out=row)
+        except ValueError:
+            # per-sample failure policy: a truncated/corrupt member costs
+            # one zero image and a counter bump, not the whole batch
+            row[...] = 0
+            with self._err_lock:
+                self.decode_errors += 1
+            global_stats.add("decode_errors")
+
+    def submit_into(self, fn: Callable[..., np.ndarray], item, rng,
+                    row: np.ndarray) -> concurrent.futures.Future:
+        """One decode+transform job writing its result into *row* (the
+        failure policy applied) — the unit the overlapped per-device
+        delivery completes on."""
+        return self._pool.submit(self._one_into, fn, item, rng, row)
+
+    def map_into(self, fn: Callable[..., np.ndarray], items: Sequence,
+                 rngs: Sequence, out: np.ndarray) -> np.ndarray:
+        """Map fn(item, rng, out=out[i]) over the batch, every worker
+        writing straight into its slot row. Returns *out*."""
+        futs = [self.submit_into(fn, item, rng, out[i])
+                for i, (item, rng) in enumerate(zip(items, rngs))]
+        for f in futs:
+            f.result()
+        return out
+
     def close(self) -> None:
+        if self._closed:
+            return
+        self._closed = True
         self._pool.shutdown(wait=True)
+        if _HAVE_CV2 and self._cv2_threads_prev is not None:
+            cv2.setNumThreads(self._cv2_threads_prev)
 
     def __enter__(self) -> "DecodePool":
         return self

@@ -34,10 +34,14 @@ class Pipeline:
                  fingerprint: dict | None = None,
                  executor: concurrent.futures.Executor | None = None,
                  on_close: Callable[[], None] | None = None,
+                 decode_pool: Any | None = None,
                  epoch_sync: bool = False):
         self.sampler = sampler
         self.fingerprint = fingerprint or {}
         self._on_close = on_close
+        # the DecodePool feeding make_batch, when one exists (vision
+        # pipelines): surfaces the per-sample decode-failure counter
+        self._decode_pool = decode_pool
         # epoch_sync: barrier every process at epoch boundaries so no host
         # issues next-epoch reads while a straggler is still dispatching the
         # previous epoch's (SURVEY.md §2.3). The barrier sits in the thunk
@@ -117,6 +121,13 @@ class Pipeline:
     def prefetch_depth(self) -> int:
         """Current prefetch depth (moves when auto_depth is on)."""
         return self._prefetcher.depth
+
+    @property
+    def decode_errors(self) -> int:
+        """Samples substituted with a zero image by the per-sample decode
+        failure policy (0 for pipelines without a decode pool)."""
+        return self._decode_pool.decode_errors \
+            if self._decode_pool is not None else 0
 
     @property
     def prefetch_depth_trace(self) -> list[tuple[int, int]]:

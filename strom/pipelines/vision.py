@@ -7,27 +7,46 @@ decode+augment on the host worker pool (cv2 releases the GIL), device_put
 each device's rows, assemble the global sharded array — each host only ever
 reads and decodes the rows its own devices consume (SURVEY.md §2.3
 "Mesh-sharded delivery").
+
+Decode-path scheduling (ISSUE 2 tentpole; knobs `decode_reduced_scale`,
+`decode_to_slot`, `decode_overlap_put` in StromConfig): bytes flow
+slab → preallocated batch slot → device with no intermediate full-batch
+copies — workers decode (reduced-scale when the SOF header allows) straight
+into their slot row, and each device's row group is `device_put` the moment
+its rows finish decoding (completion-ordered, the per-group analogue of the
+streamed delivery in strom/delivery/core.py:_deliver_streamed) instead of
+decoding the whole union then transferring serially.
 """
 
 from __future__ import annotations
 
+import concurrent.futures
+import inspect
+import time
 from typing import Any, Callable, Sequence
 
 import numpy as np
 
 from strom.delivery.core import StromContext
-from strom.formats.jpeg import DecodePool, decode_jpeg, random_resized_crop
+from strom.formats.jpeg import (DecodePool, decode_jpeg,
+                                make_train_transform, random_resized_crop)
 from strom.formats.wds import WdsShardSet
 from strom.pipelines.base import Pipeline, _auto_depth_bounds, resolve_state
 from strom.pipelines.sampler import EpochShuffleSampler, SamplerState
+from strom.utils.stats import global_stats
 
-# transform(jpeg_bytes, rng) -> HWC uint8
-Transform = Callable[[bytes, np.random.Generator], np.ndarray]
+# transform(jpeg_bytes, rng[, out=row]) -> HWC uint8; transforms accepting
+# an `out=` keyword get direct-to-slot decode (see make_train_transform)
+Transform = Callable[..., np.ndarray]
 
 
 def default_train_transform(size: int) -> Transform:
-    def tf(data: bytes, rng: np.random.Generator) -> np.ndarray:
-        return random_resized_crop(decode_jpeg(data), size, rng)
+    """Full-scale decode + RandomResizedCrop (the pre-reduced-scale
+    behavior, kept for callers that pinned it); pipelines default to
+    :func:`strom.formats.jpeg.make_train_transform` instead."""
+    def tf(data: bytes, rng: np.random.Generator,
+           out: np.ndarray | None = None) -> np.ndarray:
+        return random_resized_crop(decode_jpeg(data), size, rng, out=out)
 
     return tf
 
@@ -65,6 +84,53 @@ def _local_batch_rows(sharding: Any, batch: int) -> dict:
     return out
 
 
+def _decode_put_overlapped(ctx: StromContext, pool: DecodePool, tf: Transform,
+                           blobs: Sequence, rngs: Sequence,
+                           images: np.ndarray, dev_items: Sequence,
+                           row_pos: dict) -> list:
+    """Decode every row into its slot and `device_put` each device's row
+    group the moment its rows finish (completion-ordered — the per-group
+    analogue of `_deliver_streamed`'s read/transfer overlap: early groups
+    ride the host->HBM link while late rows are still on the decode pool).
+
+    Returns one put shard per entry of *dev_items*, in order. Observability:
+    `decode_batch` histogram (per-batch decode wall), `decode_put_overlap_ms`
+    (the window during which puts overlapped in-flight decode)."""
+    n = images.shape[0]
+    pos_devs: list[list[int]] = [[] for _ in range(n)]
+    pending: list[int] = []
+    shards: list = [None] * len(dev_items)
+    for di, (device, (lo, hi)) in enumerate(dev_items):
+        for r in range(lo, hi):
+            pos_devs[row_pos[r]].append(di)
+        pending.append(hi - lo)
+        if hi <= lo:  # empty row range: nothing to wait for
+            shards[di] = ctx.device_put(images[0:0], device)
+    futs = {pool.submit_into(tf, blobs[i], rngs[i], images[i]): i
+            for i in range(n)}
+    t0 = time.perf_counter()
+    t_first_put = None
+    t_last_decode = t0
+    for f in concurrent.futures.as_completed(futs):
+        f.result()  # decode ValueErrors are absorbed per-row by the pool;
+        # anything else (a transform bug) must still abort the batch
+        t_last_decode = time.perf_counter()
+        for di in pos_devs[futs[f]]:
+            pending[di] -= 1
+            if pending[di] == 0:
+                device, (lo, hi) = dev_items[di]
+                base = row_pos[lo]
+                if t_first_put is None:
+                    t_first_put = time.perf_counter()
+                shards[di] = ctx.device_put(images[base: base + hi - lo],
+                                            device)
+    global_stats.observe_us("decode_batch", (t_last_decode - t0) * 1e6)
+    if t_first_put is not None and t_last_decode > t_first_put:
+        global_stats.add("decode_put_overlap_ms",
+                         int((t_last_decode - t_first_put) * 1000))
+    return shards
+
+
 def make_wds_vision_pipeline(ctx: StromContext, paths: Sequence[str], *,
                              batch: int,
                              image_size: int,
@@ -77,6 +143,9 @@ def make_wds_vision_pipeline(ctx: StromContext, paths: Sequence[str], *,
                              shuffle: bool = True,
                              prefetch_depth: int | None = None,
                              auto_prefetch: bool | None = None,
+                             decode_reduced_scale: bool | None = None,
+                             decode_to_slot: bool | None = None,
+                             decode_overlap_put: bool | None = None,
                              resume_from: str | SamplerState | None = None
                              ) -> Pipeline:
     """Infinite stream of (images [B,S,S,3] uint8, labels [B] int32) jax.Array
@@ -102,7 +171,20 @@ def make_wds_vision_pipeline(ctx: StromContext, paths: Sequence[str], *,
                               ctx=ctx)
     sampler = EpochShuffleSampler(len(ss), batch, seed=seed, shuffle=shuffle,
                                   state=state)
-    tf = transform or default_train_transform(image_size)
+    cfg = ctx.config
+    reduced = cfg.decode_reduced_scale if decode_reduced_scale is None \
+        else decode_reduced_scale
+    to_slot = cfg.decode_to_slot if decode_to_slot is None else decode_to_slot
+    overlap_put = cfg.decode_overlap_put if decode_overlap_put is None \
+        else decode_overlap_put
+    tf = transform or make_train_transform(image_size, reduced_scale=reduced)
+    try:
+        tf_out_ok = "out" in inspect.signature(tf).parameters
+    except (TypeError, ValueError):  # pragma: no cover - exotic callables
+        tf_out_ok = False
+    # custom transforms without an out= keyword keep the stack path
+    to_slot = to_slot and tf_out_ok
+    overlap_put = overlap_put and to_slot
     pool = DecodePool(decode_workers)
     label_sharding = NamedSharding(
         sharding.mesh,
@@ -113,6 +195,15 @@ def make_wds_vision_pipeline(ctx: StromContext, paths: Sequence[str], *,
     local_rows = sorted({r for lo, hi in rows_by_device.values()
                          for r in range(lo, hi)})
     row_pos = {r: i for i, r in enumerate(local_rows)}
+    dev_items = list(rows_by_device.items())
+
+    def shard_view(arr: np.ndarray, lo: int, hi: int) -> np.ndarray:
+        # each device's row range is contiguous in the sorted local union,
+        # so its shard is a VIEW of the batch slot — no fancy-index copy
+        if hi <= lo:
+            return arr[0:0]
+        base = row_pos[lo]
+        return arr[base: base + hi - lo]
 
     def make_batch(indices: np.ndarray, serial: int) -> tuple[Any, Any]:
         samples = [ss.samples[int(indices[r])] for r in local_rows]
@@ -131,14 +222,33 @@ def make_wds_vision_pipeline(ctx: StromContext, paths: Sequence[str], *,
         rngs = [np.random.Generator(np.random.Philox(
                     key=[seed, (serial << 32) + r]))
                 for r in local_rows]
-        images = np.stack(pool.map(tf, blobs, rngs))
         labels_np = np.asarray(labels, dtype=np.int32)
 
-        img_shards, lbl_shards = [], []
-        for device, (lo, hi) in rows_by_device.items():
-            sel = [row_pos[r] for r in range(lo, hi)]
-            img_shards.append(jax.device_put(images[sel], device))
-            lbl_shards.append(jax.device_put(labels_np[sel], device))
+        if to_slot:
+            # workers write final rows straight into the batch slot: the
+            # np.stack full-batch copy and per-row output temporaries of
+            # the legacy path never exist
+            images = np.empty((len(local_rows), image_size, image_size, 3),
+                              dtype=np.uint8)
+            if overlap_put:
+                img_shards = _decode_put_overlapped(
+                    ctx, pool, tf, blobs, rngs, images, dev_items, row_pos)
+            else:
+                with global_stats.timer_us("decode_batch"):
+                    pool.map_into(tf, blobs, rngs, images)
+                img_shards = [ctx.device_put(shard_view(images, lo, hi), d)
+                              for d, (lo, hi) in dev_items]
+            # billed after the decode completes: an aborted batch never
+            # claims slot bytes it didn't deliver (zero-substituted rows DO
+            # occupy their slot and are separately counted in decode_errors)
+            global_stats.add("decode_slot_bytes", images.nbytes)
+        else:
+            with global_stats.timer_us("decode_batch"):
+                images = np.stack(pool.map(tf, blobs, rngs))
+            img_shards = [ctx.device_put(shard_view(images, lo, hi), d)
+                          for d, (lo, hi) in dev_items]
+        lbl_shards = [ctx.device_put(shard_view(labels_np, lo, hi), d)
+                      for d, (lo, hi) in dev_items]
         imgs = jax.make_array_from_single_device_arrays(
             global_shape, sharding, img_shards)
         lbls = jax.make_array_from_single_device_arrays(
@@ -150,7 +260,7 @@ def make_wds_vision_pipeline(ctx: StromContext, paths: Sequence[str], *,
         ctx, auto_prefetch, len(local_rows) * image_size * image_size * 3)
     return Pipeline(sampler, make_batch, depth=depth, auto_depth=auto,
                     max_depth=max_depth, fingerprint=fp,
-                    on_close=pool.close)
+                    on_close=pool.close, decode_pool=pool)
 
 
 def make_predecoded_vision_pipeline(ctx: StromContext, paths: Sequence[str], *,

@@ -10,9 +10,10 @@ dumpable in Prometheus text format.
 
 from __future__ import annotations
 
+import contextlib
 import threading
 import time
-from typing import Iterable
+from typing import Iterable, Sequence
 
 
 class _Counter:
@@ -129,6 +130,16 @@ class StatsRegistry:
     def observe_us(self, name: str, us: float) -> None:
         self.histogram(name).observe_us(us)
 
+    @contextlib.contextmanager
+    def timer_us(self, name: str):
+        """Observe the wall time of a with-block into histogram *name* (the
+        per-batch decode-time histogram rides this)."""
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            self.observe_us(name, (time.perf_counter() - t0) * 1e6)
+
     def snapshot(self) -> dict:
         out: dict = {}
         with self._lock:
@@ -158,6 +169,22 @@ class StatsRegistry:
     def prometheus(self) -> str:
         """Prometheus text exposition of every counter/histogram summary."""
         return _flat_prometheus(self.snapshot(), self.name)
+
+
+def percentile_from_buckets(buckets: Sequence[int], q: float) -> float:
+    """Approximate percentile (upper bucket bound, microseconds) from a log2
+    bucket list of the _Histogram convention — usable on DELTAS of two
+    snapshot bucket lists, where a live _Histogram (cumulative) cannot be."""
+    total = sum(buckets)
+    if not total:
+        return 0.0
+    target = q * total
+    acc = 0
+    for i, n in enumerate(buckets):
+        acc += n
+        if acc >= target:
+            return float(2 ** (i + 1))
+    return float(2 ** len(buckets))
 
 
 def _metric(*parts: str) -> str:

@@ -30,6 +30,10 @@ NEW_ROUND = {  # r5-era shape: binding + context + audit arrays + headline
     "train_data_stalls_attempts": [0],
     "bounded_vision_headline": {"shape": "64x224", "attempted": False,
                                 "link_probe_gbps": 0.0175, "stalls": None},
+    # r6+: decode-path counters from the JPEG vision arms
+    "resnet_images_per_s": 271.5,
+    "resnet_decode_reduced_hits_2": 640,
+    "resnet_decode_slot_bytes": 123456789,
     "binding": {"vs_baseline_host": 1.0315, "vs_baseline_host_raid": 0.9708,
                 "train_data_stalls": 0, "some_future_key": 0.5},
     "context": {"raw_gbps": 3.49},
@@ -65,6 +69,20 @@ def test_table_renders_all_vintages(artifacts, capsys):
     assert "[0.78" not in out
     # the headline gating decision is visible as a decision, not a blank
     assert "skip@0.0175" in out
+    # decode-path section: JPEG-arm img/s + the engaged-optimization
+    # counters render for rounds that carry them, "-" for older rounds
+    assert "decode path" in out
+    assert "resnet_decode_reduced_hits_2" in out
+    assert "640" in out
+
+
+def test_decode_section_hidden_without_decode_keys(tmp_path, capsys):
+    """Rounds that predate the decode counters don't get an all-dash decode
+    section tacked onto the table."""
+    p = tmp_path / "BENCH_r02.json"
+    p.write_text(json.dumps(OLD_ROUND))
+    assert compare_rounds.main([str(p)]) == 0
+    assert "decode path" not in capsys.readouterr().out
 
 
 def test_tail_scrape_fallback(tmp_path, capsys):

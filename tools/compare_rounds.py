@@ -45,6 +45,23 @@ CONTEXT_KEYS = [
     "parquet_wide_selected_gbps",
     "parquet_plain_selected_gbps",
 ]
+# decode-path rows (ISSUE 2 tentpole): JPEG vision arm throughput plus the
+# counters proving which decode optimizations engaged that round. img/s here
+# is fixture-bound but host-CPU-decode-bound (not relay weather), so the
+# round-over-round trend of these rows IS the decode speedup.
+DECODE_KEYS = [
+    "resnet_images_per_s",
+    "resnet_train_images_per_s",
+    "vit_images_per_s",
+    "vit_train_images_per_s",
+    "resnet_decode_reduced_hits_2",
+    "resnet_decode_reduced_hits_4",
+    "resnet_decode_reduced_hits_8",
+    "resnet_decode_slot_bytes",
+    "resnet_decode_errors",
+    "resnet_decode_put_overlap_ms",
+    "resnet_decode_batch_p50_us",
+]
 # per-attempt / per-pass audit arrays (VERDICT.md r4 next #3): printed so
 # the best-of selection's discards are visible in the comparison too
 AUDIT_SUFFIXES = ("_attempts", "_passes")
@@ -136,7 +153,10 @@ def main(argv: list[str]) -> int:
 
     headline_cells = [headline_cell(d) for _, d in rounds]
     have_headline = any(c != "-" for c in headline_cells)
-    name_w = max(len(k) for k in binding_keys + CONTEXT_KEYS + audit_keys) + 2
+    have_decode = any(cell(d, k) != "-" for _, d in rounds
+                      for k in DECODE_KEYS)
+    name_w = max(len(k) for k in binding_keys + CONTEXT_KEYS + DECODE_KEYS
+                 + audit_keys) + 2
     # every rendered cell folds into ONE column width, or rows misalign
     col_w = max(max(len(n) for n, _ in rounds) + 2, 12,
                 *(len(c) + 2 for cs in audit_cells.values() for c in cs),
@@ -152,6 +172,12 @@ def main(argv: list[str]) -> int:
     for k in CONTEXT_KEYS:
         print(k.ljust(name_w)
               + "".join(cell(d, k).rjust(col_w) for _, d in rounds))
+    if have_decode:
+        print("decode path (vision JPEG arms: img/s + which decode "
+              "optimizations engaged):")
+        for k in DECODE_KEYS:
+            print(k.ljust(name_w)
+                  + "".join(cell(d, k).rjust(col_w) for _, d in rounds))
     if audit_keys:
         print("audit (per-attempt/per-pass lists behind each best-of):")
         for k in audit_keys:
