@@ -144,6 +144,14 @@ def main() -> int:
                          "with valid JSON instead of dying rc=124 mid-phase. "
                          "Default 780s: comfortably under the driver's kill "
                          "timeout, so the final JSON always gets emitted")
+    ap.add_argument("--metrics-port", type=int, dest="metrics_port",
+                    default=int(os.environ.get("STROM_METRICS_PORT", "0")),
+                    help="serve /metrics, /stats and /trace on "
+                         "127.0.0.1:<port> while the bench runs (0 = off)")
+    ap.add_argument("--trace-out", dest="trace_out",
+                    default=os.environ.get("STROM_BENCH_TRACE", None),
+                    help="dump the event ring as Trace Event JSON here at "
+                         "the end of the run (Perfetto / chrome://tracing)")
     args = ap.parse_args()
 
     # --- per-phase wall-clock budgeting (BENCH_r05 died rc=124 mid-run:
@@ -178,11 +186,18 @@ def main() -> int:
             pass  # an unwritable tmpdir must not sink the bench itself
 
     def flush_partial(**fields) -> None:
+        from strom.utils.stats import global_stats as _pgs
+
         partial_state.update(fields)
+        # counter evidence per completed phase: a driver-side kill mid-run
+        # still leaves every counter/gauge/histogram the finished phases
+        # advanced, not just their timings (the JSON fields above are a
+        # curated subset; this is the whole registry)
         write_artifact({**partial_state, "partial": True,
                         "budget_s": args.budget,
                         "elapsed_s": round(time.monotonic() - t_start, 1),
-                        "skipped_phases": list(skipped_phases)})
+                        "skipped_phases": list(skipped_phases),
+                        "global_stats": _pgs.snapshot()})
 
     def remaining() -> float:
         return args.budget - (time.monotonic() - t_start)
@@ -212,7 +227,8 @@ def main() -> int:
     size = args.size // args.chunk * args.chunk
 
     cfg = StromConfig(queue_depth=32, num_buffers=64,
-                      overlap_chunk_bytes=args.chunk)
+                      overlap_chunk_bytes=args.chunk,
+                      metrics_port=args.metrics_port)
 
     # --- denominator: raw O_DIRECT sequential read -> host RAM (config #1),
     # --- native vectored path (one io_uring_enter per batch of 128KiB
@@ -302,6 +318,7 @@ def main() -> int:
         largs = argparse.Namespace(
             file=None, size=size, block=cfg.block_size, depth=32, iters=1,
             engine="auto", tmpdir=args.tmpdir, json=True, batch=8,
+            metrics_port=args.metrics_port,
             seq_len=2047, steps=12, prefetch=16, train_step=True,
             model="small", attn="flash",
             # bounded-depth arm (VERDICT.md r3 next #2): 40 steps at depth 4
@@ -383,6 +400,15 @@ def main() -> int:
                 "bounded_train_data_stalls_attempts":
                     [a[1] for a in llama_attempts],
             }
+            # per-step stall attribution for the llama train phase (the
+            # decode-free goodput yardstick) — the SAME single-sourced key
+            # loop as the vision arms, so the llama columns cannot drift
+            # from STALL_FIELDS (strom/obs/stall.py)
+            from strom.obs.stall import STALL_FIELDS as _SF
+
+            for k in _SF:
+                if k in best:
+                    loader_res[f"train_{k}"] = best[k]
             flush_partial(**loader_res)
 
         # config #2: ResNet-50 images/s (the headline metric's second half)
@@ -399,7 +425,8 @@ def main() -> int:
             file=None, size=size, block=cfg.block_size, depth=32, iters=1,
             engine="auto", tmpdir=args.tmpdir, json=True, batch=64,
             image_size=224, steps=10, prefetch=2, decode_workers=8,
-            train_step=True, model="resnet50", auto_prefetch=True)
+            train_step=True, model="resnet50", auto_prefetch=True,
+            metrics_port=args.metrics_port)
         def vision_arm(name: str, fn, bargs, prefix: str,
                        stall_key: str, est_s: float = 100) -> None:
             """One vision bench arm: run with retry, record the artifact
@@ -429,6 +456,14 @@ def main() -> int:
                       "decode_reduced_hits_8", "decode_slot_bytes",
                       "decode_errors", "decode_put_overlap_ms",
                       "decode_batch_p50_us", "decode_batch_mean_us"):
+                if k in res:
+                    loader_res[f"{prefix}_{k}"] = res[k]
+            # per-step stall attribution (ISSUE 3): goodput_pct + bucket
+            # p50/p99 from the event ring — the columns the next perf PR
+            # is chosen with (single-sourced key list: strom/obs/stall.py)
+            from strom.obs.stall import STALL_FIELDS
+
+            for k in STALL_FIELDS:
                 if k in res:
                     loader_res[f"{prefix}_{k}"] = res[k]
             flush_partial(**loader_res)
@@ -577,7 +612,7 @@ def main() -> int:
             engine="auto", tmpdir=args.tmpdir, json=True, batch=64,
             image_size=224, steps=10, prefetch=2, decode_workers=8,
             raid=4, raid_chunk=512 * 1024, train_step=True, model="vit_b16",
-            auto_prefetch=True)
+            auto_prefetch=True, metrics_port=args.metrics_port)
         vision_arm("vit", bench_vit, vargs, "vit", "vit_data_stalls")
 
         # config #3 decode-free arm: the packed shard itself striped over
@@ -599,7 +634,8 @@ def main() -> int:
             file=None, size=size, block=cfg.block_size, depth=32, iters=1,
             engine="auto", tmpdir=args.tmpdir, json=True, rows=2_000_000,
             row_groups=32, prefetch=2, unit_batch=4, raid=4,
-            raid_chunk=512 * 1024, columns=1)
+            raid_chunk=512 * 1024, columns=1,
+            metrics_port=args.metrics_port)
         pres = attempt("parquet", lambda: bench_parquet(pargs)) \
             if phase_ok("parquet", 90) else None
         if pres is not None:
@@ -882,10 +918,22 @@ def main() -> int:
         "on virtual meshes (MULTICHIP_r*.json) and 16/32-device lowering",
     ]
 
+    if args.trace_out:
+        from strom.obs.chrome_trace import dump as _trace_dump
+
+        # an unwritable trace path must not sink the run's artifact
+        try:
+            print(f"trace written to {_trace_dump(args.trace_out)}",
+                  file=sys.stderr)
+        except OSError as e:
+            print(f"trace dump to {args.trace_out} failed: {e}",
+                  file=sys.stderr)
     # the completed artifact replaces the incremental partial file too
     # (partial=False marks it final), so a post-print driver kill still
-    # finds the full object on disk
-    write_artifact({**out, "partial": False})
+    # finds the full object on disk — with the final counter snapshot kept
+    # alongside (the printed line stays the curated schema)
+    write_artifact({**out, "partial": False,
+                    "global_stats": global_stats.snapshot()})
     print(json.dumps(out))
     return 0
 

@@ -174,7 +174,8 @@ def bench_ssd2host(args: argparse.Namespace) -> dict:
         drop_paths = [path]
     cfg = StromConfig.from_env(engine=args.engine, block_size=args.block,
                                queue_depth=args.depth,
-                               num_buffers=max(args.depth * 2, 8))
+                               num_buffers=max(args.depth * 2, 8),
+                               **_obs_config_kw(args))
     raw_passes: list[float] = []
     host_passes: list[float] = []
     dest = alloc_aligned(size)
@@ -290,7 +291,8 @@ def bench_ssd2tpu(args: argparse.Namespace) -> dict:
 
     cfg = StromConfig(engine=args.engine, block_size=args.block,
                       queue_depth=args.depth, num_buffers=max(args.depth * 2, 8),
-                      prefetch_depth=args.prefetch, delivery_workers=args.prefetch)
+                      prefetch_depth=args.prefetch, delivery_workers=args.prefetch,
+                      **_obs_config_kw(args))
     results = []
     for it in range(args.iters):
         _drop_cache_hint(path)
@@ -396,21 +398,33 @@ def _timed_train_phase(pipe_factory, step, steps: int,
 
     *step(batch) -> loss* threads model state via closure. Returns
     (items_per_s, data_stall_steps, final_loss, depth_info) — depth_info
-    carries the prefetch controller's final depth and (step, depth) trace
-    so auto-tuned arms are auditable in the artifact."""
+    carries the prefetch controller's final depth, its (step, depth) trace,
+    and the per-step stall attribution (goodput_pct + ingest-wait/decode/
+    put/read/compute bucket p50/p99 from the event ring, strom/obs/stall)
+    so auto-tuned arms AND where each step's wall time went are auditable
+    in the artifact."""
+    from strom.obs import stall
+    from strom.obs.events import ring
+
     with pipe_factory() as pipe:
         loss = step(next(pipe))  # warmup; also the reported loss at steps=0
         float(loss)
         base_stalls = pipe.data_stall_steps
+        ring_lo = ring.now_us()  # attribute only THIS phase's steps
         t0 = time.perf_counter()
-        for _ in range(steps):
-            loss = step(next(pipe))
+        for i in range(steps):
+            # the attribution window: one consumer step = one next() + the
+            # compute consuming it (strom/obs/stall splits it into buckets)
+            with ring.span("train.step", cat="step", args={"step": i}):
+                loss = step(next(pipe))
         train_loss = float(loss)
         dt = time.perf_counter() - t0
         depth_info = {
             "prefetch_depth_final": pipe.prefetch_depth,
             "prefetch_depth_trace": pipe.prefetch_depth_trace,
         }
+        depth_info.update(stall.flatten_summary(stall.steps_summary(
+            ring.snapshot(), lo_us=ring_lo, hi_us=ring.now_us())))
         return (round(steps * items_per_step / dt, 1),
                 pipe.data_stall_steps - base_stalls, round(train_loss, 4),
                 depth_info)
@@ -495,7 +509,8 @@ def bench_llama(args: argparse.Namespace) -> dict:
         if not os.path.exists(path) or os.path.getsize(path) < want:
             _mk_testfile(path, want)
     cfg = StromConfig(engine=args.engine, block_size=args.block,
-                      queue_depth=args.depth, num_buffers=max(args.depth * 2, 8))
+                      queue_depth=args.depth, num_buffers=max(args.depth * 2, 8),
+                      **_obs_config_kw(args))
     ctx = StromContext(cfg)
     try:
         n_dev = _fit_dp_devices(args.batch)
@@ -645,10 +660,16 @@ def _decode_stats_delta(snap0: dict) -> dict:
     b1 = snap1.get("decode_batch_hist") or []
     db = [a - b for a, b in zip(b1, b0)] if b0 else list(b1)
     n = sum(db)
-    tot = (snap1.get("decode_batch_mean_us", 0.0)
-           * snap1.get("decode_batch_count", 0)
-           - snap0.get("decode_batch_mean_us", 0.0)
-           * snap0.get("decode_batch_count", 0))
+    # exact accumulated sums when the snapshot carries them (it does since
+    # the exposition fix), mean*count reconstruction as the fallback
+    def _tot(snap: dict) -> float:
+        t = snap.get("decode_batch_total_us")
+        if t is None:
+            t = snap.get("decode_batch_mean_us", 0.0) \
+                * snap.get("decode_batch_count", 0)
+        return t
+
+    tot = _tot(snap1) - _tot(snap0)
     out["decode_batch_p50_us"] = percentile_from_buckets(db, 0.50)
     out["decode_batch_mean_us"] = round(tot / n, 1) if n else 0.0
     return out
@@ -662,6 +683,13 @@ def _decode_config_kw(args: argparse.Namespace) -> dict:
         "decode_to_slot": not getattr(args, "no_slot_decode", False),
         "decode_overlap_put": not getattr(args, "no_overlap_put", False),
     }
+
+
+def _obs_config_kw(args: argparse.Namespace) -> dict:
+    """StromConfig observability overrides: --metrics-port starts the live
+    /metrics, /stats, /trace endpoint for the bench context's lifetime
+    (absent in driver-built Namespaces → 0 = off)."""
+    return {"metrics_port": int(getattr(args, "metrics_port", 0) or 0)}
 
 
 def bench_resnet(args: argparse.Namespace) -> dict:
@@ -685,7 +713,7 @@ def bench_resnet(args: argparse.Namespace) -> dict:
         path = _mk_wds_fixture(args.tmpdir, args.batch, args.image_size)
     cfg = StromConfig(engine=args.engine, block_size=args.block,
                       queue_depth=args.depth, num_buffers=max(args.depth * 2, 8),
-                      **_decode_config_kw(args))
+                      **_decode_config_kw(args), **_obs_config_kw(args))
     ctx = StromContext(cfg)
     from strom.utils.stats import global_stats as _gs
 
@@ -821,7 +849,7 @@ def bench_vit(args: argparse.Namespace) -> dict:
                                          args.image_size)
     cfg = StromConfig(engine=args.engine, block_size=args.block,
                       queue_depth=args.depth, num_buffers=max(args.depth * 2, 8),
-                      **_decode_config_kw(args))
+                      **_decode_config_kw(args), **_obs_config_kw(args))
     ctx = StromContext(cfg)
     from strom.utils.stats import global_stats as _gs
 
@@ -1004,7 +1032,8 @@ def bench_parquet(args: argparse.Namespace) -> dict:
         # stripe can't leak the engine.
         members, logical_bytes = _ensure_striped(path, raid, args.raid_chunk)
     cfg = StromConfig(engine=args.engine, block_size=args.block,
-                      queue_depth=args.depth, num_buffers=max(args.depth * 2, 8))
+                      queue_depth=args.depth, num_buffers=max(args.depth * 2, 8),
+                      **_obs_config_kw(args))
     ctx = StromContext(cfg)
     from strom.utils.stats import global_stats as _gs
 
@@ -1328,6 +1357,16 @@ def main(argv: list[str] | None = None) -> int:
         p.add_argument("--engine", default="auto", choices=["auto", "uring", "python"])
         p.add_argument("--tmpdir", default=os.environ.get("STROM_BENCH_DIR", "/tmp"))
         p.add_argument("--json", action="store_true", help="print one JSON line only")
+        p.add_argument("--metrics-port", type=int, default=0,
+                       dest="metrics_port",
+                       help="serve /metrics (Prometheus), /stats (JSON) and "
+                            "/trace (event-ring dump) on 127.0.0.1:<port> "
+                            "while the bench runs (0 = off); scrape with "
+                            "curl localhost:<port>/metrics mid-run")
+        p.add_argument("--trace-out", default=None, dest="trace_out",
+                       help="dump the event ring as Trace Event JSON here "
+                            "when the bench finishes — load the file in "
+                            "chrome://tracing or https://ui.perfetto.dev")
 
     p_nvme = sub.add_parser("nvme", help="config #1: O_DIRECT seq read -> host RAM")
     common(p_nvme)
@@ -1554,6 +1593,18 @@ def main(argv: list[str] | None = None) -> int:
         print(json.dumps(d, indent=None if args.json else 2))
         return 0
     out = args.fn(args)
+    if getattr(args, "trace_out", None):
+        from strom.obs.chrome_trace import dump
+
+        # an unwritable trace path must not sink the completed bench's
+        # result JSON (same policy as the partial-artifact writes)
+        try:
+            print(f"trace written to {dump(args.trace_out)} "
+                  f"(load in chrome://tracing or ui.perfetto.dev)",
+                  file=sys.stderr)
+        except OSError as e:
+            print(f"trace dump to {args.trace_out} failed: {e}",
+                  file=sys.stderr)
     print(json.dumps(out))
     # a failed phase in the combined matrix must fail the process: CI
     # running `strom-bench all` should not read errors-in-JSON as green

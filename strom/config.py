@@ -172,7 +172,18 @@ class StromConfig:
     fault_every: int = 0
 
     # observability
-    trace_annotations: bool = True     # jax.profiler traces around delivery
+    trace_annotations: bool = True     # jax.profiler traces around delivery.
+                                       # Event-ring spans (strom/obs) are
+                                       # NOT gated here: the ring has its
+                                       # own switch, and all sites follow
+                                       # it uniformly so no stall bucket
+                                       # can be zeroed in isolation
+    metrics_port: int = 0              # >0: StromContext serves /metrics
+                                       # (Prometheus), /stats (JSON) and
+                                       # /trace (event-ring dump) on
+                                       # 127.0.0.1:<port> for the context's
+                                       # lifetime (strom/obs/server.py).
+                                       # 0 = no server.
 
     def __post_init__(self) -> None:
         if self.buffer_size == 0:
@@ -199,6 +210,8 @@ class StromConfig:
                              "or exactly -1 (auto)")
         if self.prefetch_max_depth < 1:
             raise ValueError("prefetch_max_depth must be >= 1")
+        if self.metrics_port < 0 or self.metrics_port > 65535:
+            raise ValueError("metrics_port must be in [0, 65535] (0 = off)")
 
     @property
     def resolved_stripe_window_bytes(self) -> int:

@@ -37,6 +37,7 @@ from strom.engine import make_engine
 from strom.engine.base import Engine, EngineError
 from strom.engine.raid0 import (count_stripe_windows, plan_stripe_reads,
                                 plan_stripe_windows)
+from strom.obs.events import ring as _events_ring
 from strom.utils.stats import global_stats
 
 
@@ -250,7 +251,9 @@ class StromContext:
     tests create isolated instances.
     """
 
-    def __init__(self, config: StromConfig | None = None, engine: Engine | None = None):
+    def __init__(self, config: StromConfig | None = None,
+                 engine: Engine | None = None, *,
+                 metrics_port: int | None = None):
         self.config = config or StromConfig.from_env()
         self.engine = engine or make_engine(self.config)
         self._files: dict[str, int] = {}
@@ -300,7 +303,33 @@ class StromContext:
         # one host->HBM stream at a time (see StromConfig.serialize_device_put)
         self._put_lock = threading.Lock() if self.config.serialize_device_put \
             else contextlib.nullcontext()
+        # live observability endpoint (strom/obs/server.py): /metrics,
+        # /stats, /trace on 127.0.0.1 for the context's lifetime. Explicit
+        # metrics_port overrides the config knob; 0 from config = off, an
+        # explicit 0 asks the OS for an ephemeral port (server.port tells).
+        self._metrics_server = None
+        # stats()["steps"] attributes only events from THIS context's
+        # lifetime: the ring is process-global and never cleared, so an
+        # unwindowed summary in a multi-phase process would intersect a
+        # later phase's spans against an EARLIER phase's step windows
+        self._obs_t0_us = _events_ring.now_us()
+        # steps-section cache: full-ring attribution costs ~170ms on a
+        # 1-core box, so a scraper polling /metrics must not pay (and
+        # steal from decode workers) more than once per TTL
+        self._steps_cache: tuple[float, dict] | None = None
+        self._steps_cache_lock = threading.Lock()
+        port = self.config.metrics_port if metrics_port is None else metrics_port
+        if port is not None and (port > 0 or metrics_port == 0):
+            from strom.obs.server import MetricsServer
+
+            self._metrics_server = MetricsServer(self.stats, port=port)
         self._closed = False
+
+    @property
+    def metrics_server(self):
+        """The live endpoint when one was requested (``.port`` carries the
+        bound port), else None."""
+        return self._metrics_server
 
     # -- file registry ------------------------------------------------------
     def file_index(self, path: str) -> int:
@@ -396,7 +425,7 @@ class StromContext:
         from strom.utils.tracing import trace_span
 
         with self._put_lock, \
-                trace_span("strom.device_put",
+                trace_span("strom.device_put", cat="put",
                            enabled=self.config.trace_annotations):
             return jax.device_put(arr, device)
 
@@ -544,13 +573,15 @@ class StromContext:
         # The engine executes the whole gather (block_size chunking, queue
         # -depth pipelining, per-chunk retry, EOF topup): ONE boundary
         # crossing per transfer on the C++ engine (SURVEY.md §3.3 hot loop).
-        with self._engine_lock:
+        planned = sum(ln for (_, _, _, ln) in chunks)
+        with _events_ring.span("strom.read_segments", cat="read",
+                               args={"ops": len(chunks), "bytes": planned}), \
+                self._engine_lock:
             try:
                 total = self.engine.read_vectored(chunks, dest,
                                                   retries=cfg.io_retries)
             except EngineError as e:
                 raise EngineError(e.errno, f"ssd2tpu {e.strerror}") from None
-        planned = sum(ln for (_, _, _, ln) in chunks)
         if total != planned:
             # cheap insurance: any engine accounting bug (short read the
             # engine failed to flag) surfaces loudly instead of as a
@@ -643,7 +674,7 @@ class StromContext:
                 _, slab = item
                 arr_host = slab.view(np_dtype)
                 with self._put_lock, \
-                        trace_span("strom.device_put",
+                        trace_span("strom.device_put", cat="put",
                                    enabled=self.config.trace_annotations):
                     put_t0 = time.perf_counter()
                     for i, d in enumerate(devices):
@@ -779,7 +810,8 @@ class StromContext:
                     self._read_segments(source, [Segment(0, 0, nbytes)], dest, offset)
                     arr_host = dest.view(np_dtype).reshape(shape)
                     with self._put_lock, \
-                            trace_span("strom.device_put", enabled=cfg.trace_annotations):
+                            trace_span("strom.device_put", cat="put",
+                                       enabled=cfg.trace_annotations):
                         out = jax.device_put(arr_host, device)  # device=None → default
                     if pool is not None:
                         out.block_until_ready()
@@ -799,7 +831,7 @@ class StromContext:
                         arr_host = dest.view(np_dtype).reshape(group[0].local_shape)
                         for p in group:
                             with self._put_lock, \
-                                    trace_span("strom.device_put",
+                                    trace_span("strom.device_put", cat="put",
                                                enabled=cfg.trace_annotations):
                                 out.append(jax.device_put(arr_host, p.device))
                     except BaseException:
@@ -980,9 +1012,31 @@ class StromContext:
                 global_stats.counter("decode_put_overlap_ms").value,
             "decode_batch_p50_us": dh.percentile(0.50),
             "decode_batch_mean_us": dh.mean_us,
+            "decode_batch_total_us": dh.total_us,
             "decode_batch_count": dh.count,
             "decode_batch_hist": list(dh.buckets),
         }
+        # per-step stall attribution from the event ring (ISSUE 3 tentpole):
+        # goodput_pct + ingest-wait/decode/put/read/compute bucket p50/p99
+        # over the step windows retained from THIS context's lifetime —
+        # flat keys so the section rides sections_prometheus unchanged.
+        # Recomputed at most once per TTL: a full-ring attribution costs
+        # ~170ms, which a 10s Prometheus poll must not repeatedly steal
+        # from the single core the decode workers share.
+        from strom.obs import stall
+
+        _STEPS_TTL_S = 2.0
+        now = time.monotonic()
+        with self._steps_cache_lock:
+            cached = self._steps_cache
+            if cached is not None and now - cached[0] < _STEPS_TTL_S:
+                steps = dict(cached[1])
+            else:
+                steps = stall.flatten_summary(stall.steps_summary(
+                    _events_ring.snapshot(), lo_us=self._obs_t0_us))
+                self._steps_cache = (now, dict(steps))
+        steps["events_dropped"] = _events_ring.events_dropped
+        out["steps"] = steps
         if self._slab_pool is not None:
             out["slab_pool"] = self._slab_pool.stats()
         out["engine"] = self.engine.stats()
@@ -992,6 +1046,8 @@ class StromContext:
         if self._closed:
             return
         self._closed = True
+        if self._metrics_server is not None:
+            self._metrics_server.close()
         self._executor.shutdown(wait=True)
         self._group_executor.shutdown(wait=True)
         self.engine.close()

@@ -26,7 +26,8 @@ from typing import Callable, Generic, Iterable, Iterator, TypeVar
 
 import time
 
-from strom.utils.stats import StatsRegistry
+from strom.obs.events import ring
+from strom.utils.stats import StatsRegistry, global_stats
 
 T = TypeVar("T")
 
@@ -91,8 +92,15 @@ class Prefetcher(Generic[T]):
         self._lock = threading.Lock()
         self.stats = stats or StatsRegistry("prefetch")
         self.stats.set_gauge("prefetch_depth", self._depth)
+        # mirrored into the GLOBAL registry too, so depth and the stall
+        # count appear in /metrics and bench JSON without bespoke plumbing
+        # (gauge semantics: the CURRENT pipeline's state; a later pipeline
+        # takes the name over, same as every *_last gauge)
+        global_stats.set_gauge("prefetch_depth", self._depth)
+        global_stats.set_gauge("prefetch_data_stall_steps", 0)
         self.depth_trace: list[tuple[int, int]] = [(0, self._depth)]
         self._ready_streak = 0
+        self._was_stalled = False
         self._exhausted = False
         self._fill()
 
@@ -131,6 +139,11 @@ class Prefetcher(Generic[T]):
         self._depth = depth
         self.stats.add("depth_grow" if kind == "grow" else "depth_shrink")
         self.stats.set_gauge("prefetch_depth", depth)
+        global_stats.set_gauge("prefetch_depth", depth)
+        # depth changes on the timeline: the controller's moves line up
+        # against the stalls that caused them
+        ring.instant("prefetch.depth", cat="prefetch",
+                     args={"depth": depth, "kind": kind})
         if len(self.depth_trace) < _TRACE_CAP:
             self.depth_trace.append(
                 (self.stats.counter("steps").value, depth))
@@ -157,14 +170,25 @@ class Prefetcher(Generic[T]):
                 fut = self._queue.popleft()
         if not fut.done():
             self.stats.add("data_stall_steps")
+            global_stats.set_gauge("prefetch_data_stall_steps",
+                                   self.stats.counter("data_stall_steps").value)
+            if not self._was_stalled:  # ready -> stall transition
+                ring.instant("prefetch.state", cat="prefetch",
+                             args={"state": "stall"})
+                self._was_stalled = True
             t0 = time.monotonic()
-            result = fut.result()
+            with ring.span("prefetch.stall_wait", cat="ingest_wait"):
+                result = fut.result()
             self.stats.observe_us("stall_wait", (time.monotonic() - t0) * 1e6)
             if self._auto:
                 # a stall: the window was too shallow for the observed jitter
                 self._ready_streak = 0
                 self._set_depth(min(self._depth * 2, self._max_depth), "grow")
         else:
+            if self._was_stalled:  # stall -> ready transition
+                ring.instant("prefetch.state", cat="prefetch",
+                             args={"state": "ready"})
+                self._was_stalled = False
             result = fut.result()
             done_at = getattr(fut, "_strom_done_at", None)
             if done_at is not None:

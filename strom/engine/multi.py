@@ -34,6 +34,7 @@ import numpy as np
 
 from strom.config import StromConfig
 from strom.engine.base import Completion, Engine, EngineError, RawRead, ReadRequest
+from strom.obs.events import ring as _events
 
 
 class MultiRingEngine(Engine):
@@ -192,7 +193,9 @@ class MultiRingEngine(Engine):
             ring = next(self._rr) % n
             ch = [(self._child_index(ring, fi), fo, do, ln)
                   for (fi, fo, do, ln) in chunks]
-            with self._ring_locks[ring]:
+            with _events.span("engine.multi.read_vectored", cat="read",
+                              args={"ops": len(chunks), "ring": ring}), \
+                    self._ring_locks[ring]:
                 return self._children[ring].read_vectored(ch, dest,
                                                           retries=retries)
         # multi-file gather: stable per-file ring (striped member i → ring
@@ -218,15 +221,17 @@ class MultiRingEngine(Engine):
         # how wide the fan-out went
         global_stats.add("multi_ring_fanout_gathers")
         global_stats.gauge("multi_ring_fanout_width").max(len(live))
-        futs = {r: self._pool.submit(run, r) for r in live}
-        # join ALL rings before raising: a caller reacting to an error must
-        # not race sub-gathers still writing into dest
-        concurrent.futures.wait(futs.values())
-        err = next((f.exception() for f in futs.values()
-                    if f.exception() is not None), None)
-        if err is not None:
-            raise err
-        return sum(f.result() for f in futs.values())
+        with _events.span("engine.multi.read_vectored", cat="read",
+                          args={"ops": len(chunks), "fanout": len(live)}):
+            futs = {r: self._pool.submit(run, r) for r in live}
+            # join ALL rings before raising: a caller reacting to an error
+            # must not race sub-gathers still writing into dest
+            concurrent.futures.wait(futs.values())
+            err = next((f.exception() for f in futs.values()
+                        if f.exception() is not None), None)
+            if err is not None:
+                raise err
+            return sum(f.result() for f in futs.values())
 
     # -- observability ------------------------------------------------------
     def stats(self) -> dict:
@@ -249,12 +254,16 @@ class MultiRingEngine(Engine):
         if all(h is not None for h in hists):
             hist = [sum(h[i] for h in hists) for i in range(len(hists[0]))]
             total = sum(int(s.get("read_latency_count", 0)) for s in per_ring)
-            mean_num = sum(float(s.get("read_latency_mean_us", 0.0))
-                           * int(s.get("read_latency_count", 0))
-                           for s in per_ring)
+            # exact per-ring sums where the child reports them (it does
+            # since the exposition fix), mean*count as the fallback
+            sum_us = sum(float(s.get("read_latency_total_us",
+                                     s.get("read_latency_mean_us", 0.0)
+                                     * s.get("read_latency_count", 0)))
+                         for s in per_ring)
             out["read_latency_hist"] = hist
             out["read_latency_count"] = total
-            out["read_latency_mean_us"] = mean_num / total if total else 0.0
+            out["read_latency_total_us"] = sum_us
+            out["read_latency_mean_us"] = sum_us / total if total else 0.0
             # percentiles from the combined log2 hist — UPPER bucket edge,
             # the same convention as the single-ring engines
             for q, name in ((0.5, "read_latency_p50_us"),

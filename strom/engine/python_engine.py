@@ -20,6 +20,7 @@ import numpy as np
 
 from strom.config import StromConfig
 from strom.engine.base import Completion, Engine, EngineError, RawRead, ReadRequest
+from strom.obs.events import ring as _events_ring
 from strom.probe.odirect import probe_dio
 from strom.probe.residency import cached_pages, range_fully_cached
 from strom.utils.stats import StatsRegistry
@@ -277,11 +278,14 @@ class PythonEngine(Engine):
         return m
 
     def read_vectored(self, chunks, dest, *, retries: int = 1) -> int:
-        self._warm_map = self._snapshot_residency(chunks)
-        try:
-            return super().read_vectored(chunks, dest, retries=retries)
-        finally:
-            self._warm_map = None
+        with _events_ring.span("engine.python.read_vectored", cat="read",
+                               args={"ops": len(chunks),
+                                     "bytes": sum(c[3] for c in chunks)}):
+            self._warm_map = self._snapshot_residency(chunks)
+            try:
+                return super().read_vectored(chunks, dest, retries=retries)
+            finally:
+                self._warm_map = None
 
     # -- worker -------------------------------------------------------------
     def _take_fault(self) -> bool:

@@ -86,6 +86,14 @@ def plan_stripe_windows(segs: Sequence[StripeSegment], n_members: int,
     coalescing) still sees long member-contiguous streaks."""
     if window_bytes <= 0 or n_members <= 1:
         return list(segs)
+    # the planning decision on the timeline: how many member ops entered
+    # the overlap reorder and at what window size (pairs with the
+    # stripe_windows counter; an instant, not a span — planning is pure math)
+    from strom.obs.events import ring
+
+    ring.instant("raid0.stripe_windows", cat="read",
+                 args={"segments": len(segs), "members": n_members,
+                       "window_bytes": window_bytes})
     out: list[StripeSegment] = []
     win: list[StripeSegment] = []
     acc = 0

@@ -439,6 +439,9 @@ class UringEngine(Engine):
             "ext_buffers": int(s.ext_buffers),
             "ops_fixed": int(s.ops_fixed),
             "read_latency_mean_us": (s.lat_total_us / total) if total else 0.0,
+            # exact accumulated sum: the exposition's histogram _sum reads
+            # this instead of reconstructing mean*count
+            "read_latency_total_us": float(s.lat_total_us),
             "read_latency_count": total,
             # raw log2 buckets (bucket i ≈ [2^i, 2^(i+1)) us): feeds the
             # Prometheus histogram exposition (≙ the reference's /proc stats)

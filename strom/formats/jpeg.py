@@ -41,6 +41,7 @@ from typing import Callable, Iterable, Sequence
 
 import numpy as np
 
+from strom.obs.events import ring
 from strom.utils.stats import global_stats
 
 try:
@@ -325,13 +326,20 @@ class DecodePool:
 
     def map(self, fn: Callable[..., np.ndarray],
             items: Iterable, *extra: Sequence) -> list[np.ndarray]:
-        return list(self._pool.map(fn, items, *extra))
+        def traced(*a) -> np.ndarray:
+            # worker span on the shared timeline: per-sample decode+transform
+            # (the legacy allocating path; the slot path traces in _one_into)
+            with ring.span("decode.worker", cat="decode"):
+                return fn(*a)
+
+        return list(self._pool.map(traced, items, *extra))
 
     # -- direct-to-slot mapping --------------------------------------------
     def _one_into(self, fn: Callable[..., np.ndarray], item,
                   rng, row: np.ndarray) -> None:
         try:
-            fn(item, rng, out=row)
+            with ring.span("decode.worker", cat="decode"):
+                fn(item, rng, out=row)
         except ValueError:
             # per-sample failure policy: a truncated/corrupt member costs
             # one zero image and a counter bump, not the whole batch

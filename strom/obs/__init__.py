@@ -1,0 +1,22 @@
+"""Observability subsystem: causal, per-step timeline tracing on top of the
+counter layer in :mod:`strom.utils.stats`.
+
+The reference exposes its DMA path through per-module stat counters and
+latency clocks on a ``/proc`` node (SURVEY.md §2.1 "Stats/observability");
+strom-tpu's counter half lives in ``StatsRegistry``. This package adds the
+*causal* half the counters cannot answer — "which subsystem was a given step
+actually waiting on?":
+
+- :mod:`strom.obs.events` — a bounded, thread-safe event ring every hot path
+  emits begin/end spans and instants into (drop-oldest, ~no allocation).
+- :mod:`strom.obs.chrome_trace` — dump the ring as Trace Event Format JSON
+  (loadable in Perfetto / chrome://tracing).
+- :mod:`strom.obs.server` — a stdlib-http background endpoint serving
+  ``/metrics`` (Prometheus text), ``/stats`` (JSON) and ``/trace`` (ring
+  dump) while a run is live.
+- :mod:`strom.obs.stall` — per-step stall attribution: split step wall time
+  into ingest-wait / decode / put / compute buckets from the ring and report
+  ``goodput_pct``.
+"""
+
+from strom.obs.events import EventRing, ring  # noqa: F401

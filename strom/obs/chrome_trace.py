@@ -1,0 +1,79 @@
+"""Trace Event Format export: the ring as a Perfetto/chrome://tracing file.
+
+The JSON object format (https://docs.google.com/document/d/1CvAClvFfyA5R-
+PhYUmn5OOQtYMH4h6I0nSsKchNAySU) — ``{"traceEvents": [...]}`` with complete
+('X') and instant ('i') events — loads directly in chrome://tracing and
+https://ui.perfetto.dev. One row per thread: the engine reader, decode
+workers, the prefetch pool and the consumer each get their own swimlane, so
+"what was the step waiting on" is visible as literal white space on the
+consumer row above busy worker rows.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+from strom.obs.events import EventRing, ring as _global_ring
+
+
+def to_trace_events(events: list[dict], *, pid: int | None = None
+                    ) -> list[dict]:
+    """Internal event dicts (see ``EventRing.snapshot``) -> Trace Event
+    Format dicts. Pure (unit-testable); timestamps pass through unchanged
+    (already microseconds, the TEF unit)."""
+    pid = os.getpid() if pid is None else pid
+    out = []
+    for e in events:
+        te = {"name": e["name"], "ph": e["ph"], "ts": e["ts_us"],
+              "pid": pid, "tid": e["tid"], "cat": e.get("cat") or "strom"}
+        if e["ph"] == "X":
+            te["dur"] = e.get("dur_us", 0.0)
+        else:
+            te["s"] = "t"  # instant scope: thread
+        if e.get("args"):
+            te["args"] = e["args"]
+        out.append(te)
+    return out
+
+
+def trace_document(events: list[dict], *, meta: dict | None = None) -> dict:
+    doc: dict = {"traceEvents": to_trace_events(events),
+                 "displayTimeUnit": "ms"}
+    if meta:
+        doc["otherData"] = meta
+    return doc
+
+
+def dump(path: str, *, ring: EventRing | None = None,
+         events: list[dict] | None = None, meta: dict | None = None) -> str:
+    """Write the ring (default: the global one) as a Trace Event JSON file;
+    returns *path*. ``events`` overrides the ring for pre-filtered dumps."""
+    if events is None:
+        events = (ring or _global_ring).snapshot()
+    with open(path, "w") as f:
+        json.dump(trace_document(events, meta=meta), f)
+    return path
+
+
+def load_events(path: str) -> list[dict]:
+    """Inverse of :func:`dump` for tools: a Trace Event JSON back into the
+    internal event-dict shape ``strom.obs.stall`` consumes. Tolerates plain
+    event-array files too."""
+    with open(path) as f:
+        doc = json.load(f)
+    tes = doc["traceEvents"] if isinstance(doc, dict) else doc
+    out = []
+    for te in tes:
+        if te.get("ph") not in ("X", "i"):
+            continue
+        e = {"ts_us": float(te.get("ts", 0.0)), "tid": te.get("tid", 0),
+             "cat": te.get("cat", ""), "name": te.get("name", ""),
+             "ph": te["ph"]}
+        if te["ph"] == "X":
+            e["dur_us"] = float(te.get("dur", 0.0))
+        if te.get("args"):
+            e["args"] = te["args"]
+        out.append(e)
+    out.sort(key=lambda e: e["ts_us"])
+    return out

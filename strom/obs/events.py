@@ -1,0 +1,153 @@
+"""Bounded, thread-safe event ring: the timeline half of observability.
+
+Every instrumented hot path (engine gathers, device_put, decode workers,
+prefetch transitions, pipeline ``__next__``, train steps) records spans and
+instants here. Design constraints, in order:
+
+- **cheap enough to leave on**: one ``perf_counter`` read per edge, a tuple
+  store into a preallocated slot list under a short lock — no per-event
+  allocation beyond the tuple, no I/O, no formatting. Spans are recorded as
+  ONE complete event at exit (ts + dur), not begin/end pairs, halving ring
+  pressure.
+- **bounded**: fixed capacity, drop-oldest (ring overwrite) under pressure;
+  ``events_dropped`` counts the overwrites so a truncated timeline is
+  visible, never silent.
+- **causal**: every event carries (ts_us, dur_us, tid, category, name,
+  args) on one shared monotonic clock, so :mod:`strom.obs.stall` can
+  attribute a consumer's wait to what the pipeline was doing at that instant.
+
+Event categories (the ``cat`` field) are the stall-attribution vocabulary:
+``read`` (engine gathers), ``decode`` (JPEG worker spans), ``put``
+(host->HBM dispatch), ``ingest_wait`` (consumer blocked on the pipeline),
+``step`` (one train step, the attribution window). Everything else is
+freeform context.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+import time
+
+# instant events use dur_us = -1 so snapshot() can tell them apart without a
+# second per-event field
+_INSTANT = -1.0
+
+
+class EventRing:
+    """Fixed-capacity ring of (ts_us, dur_us, tid, cat, name, args) tuples.
+
+    One module-level instance (:data:`ring`) is shared process-wide, the same
+    singleton shape as ``strom.utils.stats.global_stats`` — instrumentation
+    sites write unconditionally and tools snapshot when asked.
+    """
+
+    def __init__(self, capacity: int = 1 << 16, *, enabled: bool = True):
+        if capacity < 1:
+            raise ValueError("capacity must be >= 1")
+        self._cap = capacity
+        self._slots: list[tuple | None] = [None] * capacity
+        self._idx = 0          # total events ever written (monotonic)
+        self._dropped = 0      # events overwritten after the first wrap
+        self._lock = threading.Lock()
+        self._t0 = time.perf_counter()
+        self.enabled = enabled
+
+    # -- clock --------------------------------------------------------------
+    def now_us(self) -> float:
+        """Microseconds since ring creation (the trace's time base)."""
+        return (time.perf_counter() - self._t0) * 1e6
+
+    # -- emission -----------------------------------------------------------
+    def _append(self, ev: tuple) -> None:
+        with self._lock:
+            i = self._idx
+            if self._slots[i % self._cap] is not None:
+                self._dropped += 1
+            self._slots[i % self._cap] = ev
+            self._idx = i + 1
+
+    def complete(self, ts_us: float, dur_us: float, cat: str, name: str,
+                 args: dict | None = None) -> None:
+        """Record a finished span (chrome 'X' event)."""
+        if not self.enabled:
+            return
+        self._append((ts_us, dur_us, threading.get_ident(), cat, name, args))
+
+    def instant(self, name: str, cat: str = "",
+                args: dict | None = None) -> None:
+        """Record a point event (chrome 'i' event)."""
+        if not self.enabled:
+            return
+        self._append((self.now_us(), _INSTANT, threading.get_ident(), cat,
+                      name, args))
+
+    @contextlib.contextmanager
+    def span(self, name: str, cat: str = "", args: dict | None = None):
+        """Record the with-block as one complete event (recorded even when
+        the block raises — a failed gather is exactly the span you want on
+        the timeline)."""
+        if not self.enabled:
+            yield
+            return
+        t0 = self.now_us()
+        try:
+            yield
+        finally:
+            self.complete(t0, self.now_us() - t0, cat, name, args)
+
+    # -- inspection ---------------------------------------------------------
+    @property
+    def events_dropped(self) -> int:
+        with self._lock:
+            return self._dropped
+
+    @property
+    def capacity(self) -> int:
+        return self._cap
+
+    def __len__(self) -> int:
+        with self._lock:
+            return min(self._idx, self._cap)
+
+    def snapshot(self) -> list[dict]:
+        """The retained events as dicts, oldest first (ts-sorted within the
+        retained window). The lock is held only for a C-level list copy —
+        a scrape of a full ring must not stall every hot-path writer for
+        the duration of 64Ki dict constructions."""
+        with self._lock:
+            slots = list(self._slots)
+            idx = self._idx
+            dropped = self._dropped
+        n = min(idx, self._cap)
+        evs = [slots[i % self._cap] for i in range(idx - n, idx)]
+        out = []
+        for ev in evs:
+            if ev is None:  # cleared ring / not yet wrapped
+                continue
+            ts, dur, tid, cat, name, args = ev
+            d = {"ts_us": ts, "tid": tid, "cat": cat, "name": name,
+                 "ph": "i" if dur == _INSTANT else "X"}
+            if dur != _INSTANT:
+                d["dur_us"] = dur
+            if args:
+                d["args"] = args
+            out.append(d)
+        # completion order == exit order for spans; sort by START time so
+        # consumers see a timeline (nested spans exit before their parents)
+        out.sort(key=lambda e: e["ts_us"])
+        if dropped:
+            out.insert(0, {"ts_us": out[0]["ts_us"] if out else 0.0,
+                           "tid": 0, "cat": "meta", "name": "events_dropped",
+                           "ph": "i", "args": {"count": dropped}})
+        return out
+
+    def clear(self) -> None:
+        with self._lock:
+            self._slots = [None] * self._cap
+            self._idx = 0
+            self._dropped = 0
+
+
+# the process-wide ring every instrumentation site writes to
+ring = EventRing()

@@ -1,0 +1,108 @@
+"""Live observability endpoint: a stdlib-http background server.
+
+The reference's stats are scrapeable while a run is live (``cat
+/proc/nvme-strom`` mid-transfer); strom-tpu so far only dumped Prometheus
+text at bench end. This server makes the in-process state scrapeable the
+same way — three routes, no dependencies beyond ``http.server``:
+
+- ``GET /metrics`` — Prometheus text: the global registry plus (when an
+  owning context supplies ``stats_fn``) the context/slab-pool/engine
+  sections via ``sections_prometheus`` — what a Prometheus scraper points
+  at during a run.
+- ``GET /stats``   — the same sections as a JSON snapshot (for humans and
+  dashboards that want structure, not exposition format).
+- ``GET /trace``   — the event ring as Trace Event JSON: ``curl -o
+  trace.json localhost:<port>/trace`` mid-run, load in Perfetto.
+
+Wired as ``StromContext(metrics_port=...)`` / ``StromConfig.metrics_port``
+(``STROM_METRICS_PORT``) / ``--metrics-port`` on the benches; port 0 asks
+the OS for an ephemeral port (``.port`` reports the real one).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Callable
+
+from strom.obs.chrome_trace import trace_document
+from strom.obs.events import EventRing, ring as _global_ring
+
+
+class MetricsServer:
+    """Background HTTP server over a stats callable and an event ring.
+
+    *stats_fn* returns the nested sections dict (``StromContext.stats``
+    shape) or None; the global stats registry is always included in
+    ``/metrics``. Serving threads are daemonic: an abandoned server never
+    blocks process exit, though :meth:`close` is the polite path.
+    """
+
+    def __init__(self, stats_fn: Callable[[], dict] | None = None, *,
+                 port: int = 0, host: str = "127.0.0.1",
+                 ring: EventRing | None = None):
+        self._stats_fn = stats_fn
+        self._ring = ring or _global_ring
+        server = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a) -> None:  # no per-scrape stderr spam
+                pass
+
+            def _send(self, code: int, body: bytes, ctype: str) -> None:
+                self.send_response(code)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self) -> None:  # noqa: N802 (http.server API)
+                path = self.path.split("?", 1)[0]
+                try:
+                    if path == "/metrics":
+                        self._send(200, server._metrics().encode(),
+                                   "text/plain; version=0.0.4")
+                    elif path == "/stats":
+                        self._send(200, json.dumps(server._stats()).encode(),
+                                   "application/json")
+                    elif path == "/trace":
+                        doc = trace_document(server._ring.snapshot())
+                        self._send(200, json.dumps(doc).encode(),
+                                   "application/json")
+                    else:
+                        self._send(404, b"not found: try /metrics /stats "
+                                        b"/trace\n", "text/plain")
+                except Exception as e:  # a scrape must never kill the server
+                    with contextlib.suppress(Exception):
+                        self._send(500, repr(e).encode(), "text/plain")
+
+        self._httpd = ThreadingHTTPServer((host, port), Handler)
+        self._httpd.daemon_threads = True
+        self.port: int = self._httpd.server_address[1]
+        self.host = host
+        self._thread = threading.Thread(target=self._httpd.serve_forever,
+                                        name="strom-metrics", daemon=True)
+        self._thread.start()
+
+    # -- route bodies (exceptions bubble to the handler's 500) --------------
+    def _sections(self) -> dict:
+        return self._stats_fn() if self._stats_fn is not None else {}
+
+    def _metrics(self) -> str:
+        from strom.utils.stats import global_stats, sections_prometheus
+
+        return global_stats.prometheus() + sections_prometheus(self._sections())
+
+    def _stats(self) -> dict:
+        from strom.utils.stats import global_stats
+
+        return {"sections": self._sections(),
+                "global": global_stats.snapshot(),
+                "events_dropped": self._ring.events_dropped}
+
+    def close(self) -> None:
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        self._thread.join(timeout=5)

@@ -17,6 +17,7 @@ from typing import Any, Callable, Iterator
 import numpy as np
 
 from strom.delivery.prefetch import Prefetcher
+from strom.obs.events import ring
 from strom.pipelines.sampler import (EpochShuffleSampler, SamplerState,
                                      dataset_fingerprint, load_loader_state,
                                      save_loader_state)
@@ -83,7 +84,13 @@ class Pipeline:
         return self
 
     def __next__(self) -> Any:
-        batch = next(self._prefetcher)
+        # the consumer-blocked window: everything the consumer spends inside
+        # the data loader (stall attribution's ingest_wait bucket — the
+        # decode/put/read spans overlapping THIS window are what the step
+        # was actually waiting on)
+        with ring.span("pipeline.next", cat="ingest_wait",
+                       args={"step": self._consumed}):
+            batch = next(self._prefetcher)
         self._consumed += 1
         # per-host step cadence (consumer compute + any data wait): the raw
         # input to cross-host straggler accounting

@@ -128,6 +128,13 @@ def _decode_put_overlapped(ctx: StromContext, pool: DecodePool, tf: Transform,
     if t_first_put is not None and t_last_decode > t_first_put:
         global_stats.add("decode_put_overlap_ms",
                          int((t_last_decode - t_first_put) * 1000))
+        # the overlap window on the timeline: first put fired while decode
+        # was still in flight, for this long
+        from strom.obs.events import ring
+
+        ring.instant("decode.put_overlap", cat="decode",
+                     args={"overlap_ms":
+                           round((t_last_decode - t_first_put) * 1e3, 2)})
     return shards
 
 

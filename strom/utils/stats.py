@@ -13,6 +13,7 @@ from __future__ import annotations
 import contextlib
 import threading
 import time
+import weakref
 from typing import Iterable, Sequence
 
 
@@ -99,6 +100,8 @@ class StatsRegistry:
         self._gauges: dict[str, _Gauge] = {}
         self._lock = threading.Lock()
         self.created_at = time.time()
+        with _registries_lock:
+            _registries.add(self)
 
     def counter(self, name: str) -> _Counter:
         with self._lock:
@@ -154,9 +157,19 @@ class StatsRegistry:
             out[k + "_p50_us"] = h.percentile(0.50)
             out[k + "_p99_us"] = h.percentile(0.99)
             out[k + "_mean_us"] = h.mean_us
+            # exact sum carried through: the Prometheus _sum must not be
+            # reconstructed as mean_us * count downstream (float32-ish
+            # precision loss once count is large and mean is rounded)
+            out[k + "_total_us"] = h.total_us
             out[k + "_count"] = h.count
             out[k + "_hist"] = list(h.buckets)
         return out
+
+    def counter_names(self) -> frozenset[str]:
+        """Names registered as MONOTONIC counters (vs gauges): the exposition
+        layer types these ``# TYPE ... counter``."""
+        with self._lock:
+            return frozenset(self._counters)
 
     def merge(self, others: Iterable["StatsRegistry"]) -> dict:
         merged = self.snapshot()
@@ -168,7 +181,8 @@ class StatsRegistry:
 
     def prometheus(self) -> str:
         """Prometheus text exposition of every counter/histogram summary."""
-        return _flat_prometheus(self.snapshot(), self.name)
+        return _flat_prometheus(self.snapshot(), self.name,
+                                counters=self.counter_names())
 
 
 def percentile_from_buckets(buckets: Sequence[int], q: float) -> float:
@@ -191,54 +205,114 @@ def _metric(*parts: str) -> str:
     return "_".join(parts).replace(".", "_").replace("-", "_")
 
 
-def _hist_lines(base: str, buckets, mean_us: float) -> list[str]:
+def _hist_lines(base: str, buckets, sum_us: float) -> list[str]:
     """Proper cumulative Prometheus histogram from log2 microsecond buckets
-    (bucket i = [2^i, 2^(i+1)) us). _count/_sum derive from the SAME bucket
+    (bucket i = [2^i, 2^(i+1)) us). _count derives from the SAME bucket
     snapshot (not a separately-read count field), so +Inf always equals
-    _count even when observations race the scrape."""
-    lines = [f"# TYPE {base}_us histogram"]
+    _count even when observations race the scrape; _sum is the EXACT
+    accumulated total carried through the snapshot (*_total_us), not a
+    mean*count reconstruction."""
+    lines = [f"# HELP {base}_us latency histogram (log2 microsecond buckets)",
+             f"# TYPE {base}_us histogram"]
     acc = 0
     for i, n in enumerate(buckets):
         acc += int(n)
         lines.append(f'{base}_us_bucket{{le="{2 ** (i + 1)}"}} {acc}')
     lines.append(f'{base}_us_bucket{{le="+Inf"}} {acc}')
-    lines.append(f"{base}_us_sum {mean_us * acc}")
+    lines.append(f"{base}_us_sum {sum_us}")
     lines.append(f"{base}_us_count {acc}")
     return lines
 
 
-def _flat_prometheus(snap: dict, prefix: str) -> str:
-    """Gauges for numeric/bool leaves; ``*_hist`` bucket lists become real
-    histograms (with ``_sum``/``_count`` from their sibling mean/count keys).
-    Non-numeric leaves (e.g. the engine-name string) are skipped."""
+# histogram-summary suffixes snapshot() derives from one _Histogram: folded
+# into the exposition's histogram block (or dropped), never emitted as
+# free-standing series of their own
+_HIST_SUMMARY_SUFFIXES = ("_total_us", "_mean_us", "_count",
+                          "_p50_us", "_p99_us")
+
+
+def _hist_stem(k: str, snap: dict) -> str | None:
+    """The histogram stem when *k* is a derived summary key of a histogram
+    present in *snap* (e.g. ``read_latency_total_us`` next to
+    ``read_latency_hist``), else None."""
+    for suf in _HIST_SUMMARY_SUFFIXES:
+        if k.endswith(suf) and (k[: -len(suf)] + "_hist") in snap:
+            return k[: -len(suf)]
+    return None
+
+
+def _flat_prometheus(snap: dict, prefix: str,
+                     counters: "frozenset[str] | set[str] | None" = None
+                     ) -> str:
+    """``*_hist`` bucket lists become real histograms (``_sum`` from their
+    exact sibling ``*_total_us``, ``_count`` from the buckets); names in
+    *counters* are typed ``counter`` (monotonic), everything else numeric is
+    a gauge. Histogram summary keys (mean/percentile/total/count siblings of
+    an exposed histogram) are folded into the histogram block rather than
+    duplicated as gauges. Non-numeric leaves (e.g. the engine-name string)
+    are skipped."""
+    counters = counters or frozenset()
     lines: list[str] = []
     for k, v in sorted(snap.items()):
         if k.endswith("_hist") and isinstance(v, (list, tuple)):
             stem = k[: -len("_hist")]
-            lines.extend(_hist_lines(
-                _metric(prefix, stem), v,
-                float(snap.get(stem + "_mean_us", 0.0))))
+            total = snap.get(stem + "_total_us")
+            if total is None:  # older producers: reconstruct as before
+                total = float(snap.get(stem + "_mean_us", 0.0)) \
+                    * int(snap.get(stem + "_count", sum(int(n) for n in v)))
+            lines.extend(_hist_lines(_metric(prefix, stem), v, float(total)))
+        elif _hist_stem(k, snap) is not None:
+            continue  # folded into (or superseded by) the histogram block
         elif isinstance(v, bool):
             m = _metric(prefix, k)
+            lines.append(f"# HELP {m} strom stat {k}")
             lines.append(f"# TYPE {m} gauge")
             lines.append(f"{m} {int(v)}")
         elif isinstance(v, (int, float)):
             m = _metric(prefix, k)
-            lines.append(f"# TYPE {m} gauge")
+            typ = "counter" if k in counters else "gauge"
+            lines.append(f"# HELP {m} strom stat {k}")
+            lines.append(f"# TYPE {m} {typ}")
             lines.append(f"{m} {v}")
     return "\n".join(lines) + "\n"
 
 
-def sections_prometheus(sections: dict, prefix: str = "strom") -> str:
+def all_counter_names() -> frozenset[str]:
+    """Union of monotonic-counter names across every live StatsRegistry
+    (global + per-engine + prefetcher instances): how the sections
+    exposition — which only sees plain dicts — recovers counter-vs-gauge
+    typing for keys that mirror registry counters. The snapshot of the
+    WeakSet is taken under a lock: WeakSet iteration defers only GC
+    REMOVALS, so a registry constructed concurrently (every Prefetcher
+    makes one) could otherwise resize the set mid-scrape."""
+    with _registries_lock:
+        regs = list(_registries)
+    names: set[str] = set()
+    for reg in regs:
+        names.update(reg.counter_names())
+    return frozenset(names)
+
+
+def sections_prometheus(sections: dict, prefix: str = "strom",
+                        counters: "frozenset[str] | None" = None) -> str:
     """Prometheus text for a nested stats dict ({section: {key: value}}) —
     the shape ``StromContext.stats()`` returns. ≙ the reference exposing its
     per-module DMA counters and latency clocks via /proc (SURVEY.md §2.1
     "Stats/observability"): this is the whole data path's state in one
     scrape — context counters, slab pool, engine counters + latency
-    histogram."""
+    histogram. Non-dict sections (a bare string/number at the top level) are
+    skipped — exposition is for structured sections only. Keys mirroring a
+    registered monotonic counter are typed ``counter``."""
+    counters = all_counter_names() if counters is None else counters
     return "".join(
-        _flat_prometheus(vals, f"{prefix}_{sec}")
+        _flat_prometheus(vals, f"{prefix}_{sec}", counters=counters)
         for sec, vals in sections.items() if isinstance(vals, dict))
 
+
+# live registries, for all_counter_names(); weak so short-lived registries
+# (per-pipeline prefetcher stats) don't accumulate forever. Adds are
+# serialized against iteration by the lock (see all_counter_names).
+_registries: "weakref.WeakSet[StatsRegistry]" = weakref.WeakSet()
+_registries_lock = threading.Lock()
 
 global_stats = StatsRegistry("strom")

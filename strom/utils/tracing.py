@@ -1,20 +1,47 @@
-"""Trace annotations so I/O shows up in jax profiler traces (SURVEY.md §5
-"Tracing/profiling"). No-ops when jax.profiler is unavailable or disabled."""
+"""Trace annotations: ONE span call feeds two emitters (SURVEY.md §5
+"Tracing/profiling").
+
+- the jax profiler (``TraceAnnotation``), so I/O shows up inside jax's own
+  device traces — no-op when jax.profiler is unavailable or disabled;
+- the strom event ring (:mod:`strom.obs.events`), so the same span lands on
+  the framework's standalone timeline (Chrome-trace export, live ``/trace``
+  endpoint, stall attribution) even when no jax profiler session is running.
+
+``cat`` is the stall-attribution category (``read`` / ``decode`` / ``put`` /
+``ingest_wait`` / ``step`` — see :mod:`strom.obs.stall`); spans without one
+still render on the timeline but don't participate in bucket accounting.
+"""
 
 from __future__ import annotations
 
 import contextlib
 
+from strom.obs.events import ring
+
 
 @contextlib.contextmanager
-def trace_span(name: str, *, enabled: bool = True):
-    if not enabled:
-        yield
-        return
+def trace_span(name: str, *, enabled: bool = True, cat: str = "",
+               args: dict | None = None):
+    """*enabled* gates the jax-profiler annotation only (the
+    ``trace_annotations`` config knob). The ring emission follows the
+    ring's own switch, same as every directly-instrumented site — so
+    turning jax annotations off cannot silently zero ONE stall bucket
+    (the put spans ride this helper; read/decode/step spans don't) while
+    the others keep recording."""
+    # unconditional: if the ring is enabled mid-span, the exit emission
+    # must not fabricate a span stretching back to process start
+    t0 = ring.now_us()
     try:
-        from jax.profiler import TraceAnnotation
-    except Exception:
-        yield
-        return
-    with TraceAnnotation(name):
-        yield
+        if not enabled:
+            yield
+            return
+        try:
+            from jax.profiler import TraceAnnotation
+        except Exception:
+            yield
+            return
+        with TraceAnnotation(name):
+            yield
+    finally:
+        if ring.enabled:
+            ring.complete(t0, ring.now_us() - t0, cat, name, args)

@@ -34,6 +34,9 @@ NEW_ROUND = {  # r5-era shape: binding + context + audit arrays + headline
     "resnet_images_per_s": 271.5,
     "resnet_decode_reduced_hits_2": 640,
     "resnet_decode_slot_bytes": 123456789,
+    # r6+: per-step stall attribution (strom/obs/stall)
+    "resnet_goodput_pct": 83.4,
+    "resnet_step_ingest_wait_p50_us": 151000.0,
     "binding": {"vs_baseline_host": 1.0315, "vs_baseline_host_raid": 0.9708,
                 "train_data_stalls": 0, "some_future_key": 0.5},
     "context": {"raw_gbps": 3.49},
@@ -74,6 +77,18 @@ def test_table_renders_all_vintages(artifacts, capsys):
     assert "decode path" in out
     assert "resnet_decode_reduced_hits_2" in out
     assert "640" in out
+    # stall-attribution section (ISSUE 3): goodput + bucket medians render
+    assert "stall attribution" in out
+    assert "resnet_goodput_pct" in out
+    assert "83.4" in out
+
+
+def test_stall_section_hidden_without_stall_keys(tmp_path, capsys):
+    """Rounds predating stall attribution don't get an all-dash section."""
+    p = tmp_path / "BENCH_r02.json"
+    p.write_text(json.dumps(OLD_ROUND))
+    assert compare_rounds.main([str(p)]) == 0
+    assert "stall attribution" not in capsys.readouterr().out
 
 
 def test_decode_section_hidden_without_decode_keys(tmp_path, capsys):

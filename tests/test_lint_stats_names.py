@@ -1,0 +1,56 @@
+"""tools/lint_stats_names.py wired in as a tier-1 test: the REPO's own
+global-stats namespace must be free of case/underscore near-duplicates
+(a restyled metric name silently forks the series — producer feeds one
+spelling, dashboards read the other), and the linter itself must actually
+catch one."""
+
+import importlib.util
+import os
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_SPEC = importlib.util.spec_from_file_location(
+    "lint_stats_names", os.path.join(_ROOT, "tools", "lint_stats_names.py"))
+lint = importlib.util.module_from_spec(_SPEC)
+_SPEC.loader.exec_module(lint)
+
+
+def test_repo_is_clean():
+    assert lint.main([_ROOT]) == 0
+
+
+def test_repo_scan_finds_known_names():
+    found = lint.scan_sources(_ROOT)
+    # sanity: the scan actually sees the well-known counters, so a clean
+    # result means "no collisions", not "nothing scanned"
+    assert "ssd2tpubytes" in found
+    assert "decodeerrors" in found
+
+
+def test_collision_detected(tmp_path):
+    pkg = tmp_path / "strom"
+    pkg.mkdir()
+    (pkg / "a.py").write_text(
+        'global_stats.add("coalesce_ops_in", 1)\n'
+        'global_stats.set_gauge("Coalesce_OpsIn", 2)\n')
+    (pkg / "b.py").write_text(
+        'global_stats.observe_us("read_latency", 3.0)\n')
+    found = lint.scan_sources(str(tmp_path))
+    bad = lint.collisions(found)
+    assert len(bad) == 1
+    (norm, uses) = bad[0]
+    assert norm == "coalesceopsin"
+    assert {lit for lit, _ in uses} == {"coalesce_ops_in", "Coalesce_OpsIn"}
+    assert lint.main([str(tmp_path)]) == 1
+
+
+def test_fstring_literals_scanned(tmp_path):
+    pkg = tmp_path / "strom"
+    pkg.mkdir()
+    (pkg / "a.py").write_text(
+        'global_stats.add(f"decode_reduced_hits_{denom}")\n')
+    found = lint.scan_sources(str(tmp_path))
+    assert any("decodereducedhits" in k for k in found)
+
+
+def test_usage_error_on_missing_dir(tmp_path):
+    assert lint.main([str(tmp_path / "nope")]) == 2

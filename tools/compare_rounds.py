@@ -62,6 +62,30 @@ DECODE_KEYS = [
     "resnet_decode_put_overlap_ms",
     "resnet_decode_batch_p50_us",
 ]
+# per-step stall attribution (ISSUE 3 tentpole): goodput_pct = the fraction
+# of train-step wall the consumer spent computing (100 = the 0-stall north
+# star restated), and the bucket p50s say WHICH subsystem the waits went to
+# (ingest-wait split into decode / put / engine-read overlap). These are
+# ratios and per-step medians of same-run timers — weather-independent, so
+# the round-over-round trend IS the overlap story. This section is the tool
+# the next perf PR is chosen with.
+STALL_KEYS = [
+    "train_goodput_pct",
+    "train_step_ingest_wait_p50_us",
+    "train_step_put_p50_us",
+    "train_step_read_p50_us",
+    "resnet_goodput_pct",
+    "resnet_step_ingest_wait_p50_us",
+    "resnet_step_decode_p50_us",
+    "resnet_step_put_p50_us",
+    "resnet_step_read_p50_us",
+    "resnet_step_compute_p50_us",
+    "resnet_predecoded_goodput_pct",
+    "resnet_predecoded_step_ingest_wait_p50_us",
+    "vit_goodput_pct",
+    "vit_step_ingest_wait_p50_us",
+    "vit_predecoded_goodput_pct",
+]
 # per-attempt / per-pass audit arrays (VERDICT.md r4 next #3): printed so
 # the best-of selection's discards are visible in the comparison too
 AUDIT_SUFFIXES = ("_attempts", "_passes")
@@ -155,8 +179,10 @@ def main(argv: list[str]) -> int:
     have_headline = any(c != "-" for c in headline_cells)
     have_decode = any(cell(d, k) != "-" for _, d in rounds
                       for k in DECODE_KEYS)
+    have_stall = any(cell(d, k) != "-" for _, d in rounds
+                     for k in STALL_KEYS)
     name_w = max(len(k) for k in binding_keys + CONTEXT_KEYS + DECODE_KEYS
-                 + audit_keys) + 2
+                 + STALL_KEYS + audit_keys) + 2
     # every rendered cell folds into ONE column width, or rows misalign
     col_w = max(max(len(n) for n, _ in rounds) + 2, 12,
                 *(len(c) + 2 for cs in audit_cells.values() for c in cs),
@@ -176,6 +202,12 @@ def main(argv: list[str]) -> int:
         print("decode path (vision JPEG arms: img/s + which decode "
               "optimizations engaged):")
         for k in DECODE_KEYS:
+            print(k.ljust(name_w)
+                  + "".join(cell(d, k).rjust(col_w) for _, d in rounds))
+    if have_stall:
+        print("stall attribution (per-step goodput + where the waits "
+              "went; 100 goodput = 0-stall):")
+        for k in STALL_KEYS:
             print(k.ljust(name_w)
                   + "".join(cell(d, k).rjust(col_w) for _, d in rounds))
     if audit_keys:

@@ -1,0 +1,106 @@
+#!/usr/bin/env python
+"""Lint the global stats namespace for near-duplicate metric names.
+
+``global_stats`` keys are created on first use, so a typo'd or restyled
+name (``coalesce_ops_in`` vs ``coalesceOpsIn`` vs ``coalesce_opsin``)
+silently forks a metric: the producer feeds one spelling while dashboards,
+bench JSON columns and compare_rounds read the other — both "work", both
+read zero half the time. This tool greps the source for string-literal
+names passed to ``global_stats.add / observe_us / set_gauge / counter /
+gauge / histogram / timer_us`` and FAILS when two distinct literals
+normalize to the same name modulo case and underscores.
+
+Run directly (``python tools/lint_stats_names.py``) or via the tier-1 test
+that wires it into the suite (tests/test_lint_stats_names.py). Exit 0 =
+clean, 1 = collisions, 2 = usage error.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import sys
+from collections import defaultdict
+
+# literal first-argument of a global_stats metric call; f-strings count too
+# (a templated name like decode_reduced_hits_{denom} can still case-collide
+# on its literal part)
+_CALL = re.compile(
+    r"""global_stats\s*\.\s*
+        (?:add|observe_us|set_gauge|counter|gauge|histogram|timer_us)
+        \(\s*f?["']([^"']+)["']""",
+    re.VERBOSE)
+
+# source roots that feed the global registry
+DEFAULT_ROOTS = ("strom", "tools", "bench.py")
+
+
+def _normalize(name: str) -> str:
+    return name.replace("_", "").lower()
+
+
+def scan_sources(root_dir: str, roots=DEFAULT_ROOTS
+                 ) -> dict[str, set[tuple[str, str]]]:
+    """{normalized: {(literal, file:line), ...}} over every .py under
+    *roots* (relative to *root_dir*)."""
+    found: dict[str, set[tuple[str, str]]] = defaultdict(set)
+    files: list[str] = []
+    for r in roots:
+        p = os.path.join(root_dir, r)
+        if os.path.isfile(p):
+            files.append(p)
+        else:
+            for dirpath, _, names in os.walk(p):
+                if "__pycache__" in dirpath:
+                    continue
+                files.extend(os.path.join(dirpath, n) for n in names
+                             if n.endswith(".py"))
+    for path in files:
+        try:
+            with open(path) as f:
+                text = f.read()
+        except OSError:
+            continue
+        for m in _CALL.finditer(text):
+            line = text.count("\n", 0, m.start()) + 1
+            rel = os.path.relpath(path, root_dir)
+            found[_normalize(m.group(1))].add((m.group(1), f"{rel}:{line}"))
+    return found
+
+
+def collisions(found: dict[str, set[tuple[str, str]]]
+               ) -> list[tuple[str, set[tuple[str, str]]]]:
+    """Normalized groups containing more than one DISTINCT literal."""
+    out = []
+    for norm, uses in sorted(found.items()):
+        literals = {lit for lit, _ in uses}
+        if len(literals) > 1:
+            out.append((norm, uses))
+    return out
+
+
+def main(argv: list[str] | None = None) -> int:
+    argv = sys.argv[1:] if argv is None else argv
+    root = argv[0] if argv else \
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    if not os.path.isdir(root):
+        print(f"lint_stats_names: not a directory: {root}", file=sys.stderr)
+        return 2
+    found = scan_sources(root)
+    bad = collisions(found)
+    if not bad:
+        print(f"lint_stats_names: {len(found)} distinct metric names, "
+              "no case/underscore collisions")
+        return 0
+    for norm, uses in bad:
+        print(f"metric name collision (normalized '{norm}'):",
+              file=sys.stderr)
+        for lit, where in sorted(uses):
+            print(f"  {lit!r} at {where}", file=sys.stderr)
+    print(f"lint_stats_names: {len(bad)} collision group(s) — pick ONE "
+          "spelling per metric", file=sys.stderr)
+    return 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

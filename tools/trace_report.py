@@ -1,0 +1,102 @@
+#!/usr/bin/env python
+"""Summarize a strom Trace Event JSON (from ``--trace-out`` or the live
+``/trace`` endpoint): per-span rollups and per-step stall attribution.
+
+Usage: python tools/trace_report.py trace.json [--steps]
+
+Two sections:
+- span rollup: one row per span name (count, total/mean/p50/p99 wall) —
+  which subsystems burned how much wall overall;
+- stall attribution (default on when step windows exist): per-step
+  ingest-wait / decode / put / read / compute buckets and goodput_pct,
+  the same accounting ``ctx.stats()["steps"]`` and the bench JSON carry
+  (strom/obs/stall.py), printed per step so outlier steps are visible.
+
+The file is plain Trace Event Format, so the same trace also loads in
+chrome://tracing / https://ui.perfetto.dev for the zoomable version.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from strom.obs import stall  # noqa: E402
+from strom.obs.chrome_trace import load_events  # noqa: E402
+
+# the ONE nearest-rank percentile convention, shared with the bench-JSON
+# bucket percentiles computed from the same events (strom/obs/stall.py)
+_pct = stall._pct
+
+
+def span_rollup(events: list[dict]) -> list[tuple]:
+    """(name, count, total_ms, mean_us, p50_us, p99_us) per span name,
+    total-descending."""
+    by_name: dict[str, list[float]] = {}
+    for e in events:
+        if e.get("ph") != "X":
+            continue
+        by_name.setdefault(e["name"], []).append(e.get("dur_us", 0.0))
+    rows = []
+    for name, durs in by_name.items():
+        total = sum(durs)
+        rows.append((name, len(durs), total / 1e3, total / len(durs),
+                     _pct(durs, 0.50), _pct(durs, 0.99)))
+    rows.sort(key=lambda r: -r[2])
+    return rows
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(prog="trace_report")
+    ap.add_argument("trace", help="Trace Event JSON (--trace-out / GET /trace)")
+    ap.add_argument("--no-steps", action="store_true",
+                    help="skip the per-step stall attribution section")
+    args = ap.parse_args(argv)
+    try:
+        events = load_events(args.trace)
+    except (OSError, ValueError, KeyError) as e:
+        print(f"trace_report: cannot read {args.trace}: {e}", file=sys.stderr)
+        return 1
+    if not events:
+        print("trace_report: no events in trace", file=sys.stderr)
+        return 1
+    try:
+        _report(events, steps=not args.no_steps)
+    except BrokenPipeError:  # `| head` is a normal way to use this tool
+        return 0
+    return 0
+
+
+def _report(events: list[dict], *, steps: bool) -> None:
+    rows = span_rollup(events)
+    name_w = max([len(r[0]) for r in rows] + [len("span")]) + 2
+    print(f"{'span'.ljust(name_w)}{'count':>8}{'total_ms':>12}"
+          f"{'mean_us':>12}{'p50_us':>10}{'p99_us':>12}")
+    for name, n, total_ms, mean, p50, p99 in rows:
+        print(f"{name.ljust(name_w)}{n:>8}{total_ms:>12.2f}"
+              f"{mean:>12.1f}{p50:>10.1f}{p99:>12.1f}")
+
+    if steps:
+        buckets = stall.step_buckets(events)
+        if buckets:
+            summary = stall.steps_summary(events)
+            print(f"\nsteps: {len(buckets)}  goodput "
+                  f"{summary['goodput_pct']}% "
+                  "(compute / wall; waits attributed below, ms)")
+            print(f"{'step':>5}{'wall':>10}{'ingest_wait':>13}{'decode':>9}"
+                  f"{'put':>9}{'read':>9}{'compute':>10}")
+            for i, s in enumerate(buckets):
+                print(f"{i:>5}{s.wall_us / 1e3:>10.2f}"
+                      f"{s.ingest_wait_us / 1e3:>13.2f}"
+                      f"{s.decode_us / 1e3:>9.2f}{s.put_us / 1e3:>9.2f}"
+                      f"{s.read_us / 1e3:>9.2f}{s.compute_us / 1e3:>10.2f}")
+        else:
+            print("\n(no step windows in trace: run a --train-step bench, "
+                  "or consume a pipeline, to get stall attribution)")
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))
