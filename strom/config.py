@@ -137,6 +137,26 @@ class StromConfig:
     # union then transferring serially. Implies decode_to_slot mechanics.
     decode_overlap_put: bool = True
 
+    # hot-set host cache (strom/delivery/hotcache.py — ISSUE 4 tentpole):
+    # an extent-keyed, byte-budgeted, refcounted LRU of physical byte
+    # ranges in slab-pool-backed host buffers, consulted by the delivery
+    # layer BEFORE engine submission — repeat traffic (epoch 2+, repeat
+    # requests) serves from RAM instead of re-gathering from NVMe. 0 = off.
+    hot_cache_bytes: int = 0
+    # admission policy: "second_touch" (first epoch observes via a
+    # block-granular touch ledger, the second admits — one-shot scans never
+    # displace the hot set) or "always" (force-admit on first read: the
+    # knob for known-repeating workloads and the warm/cold bench arms)
+    hot_cache_admit: str = "second_touch"
+    # touch-ledger quantum: admission tracking is block-granular so the
+    # second-touch test is stable across epochs even though coalescing
+    # splits the same bytes differently per shuffle order
+    hot_cache_block_bytes: int = 1 * MiB
+    # epoch-aware readahead: warm the sampler's next N batches into the hot
+    # cache from a background thread that uses idle engine queue budget and
+    # yields to demand reads (0 = off; needs hot_cache_bytes > 0 to matter)
+    readahead_window_batches: int = 0
+
     # NUMA affinity (multi-socket hosts): pin submitting threads to the NVMe's
     # home node, mbind staging slabs there, optionally steer the device IRQs
     # (needs root). Off by default; no-op on UMA boxes (strom/utils/numa.py).
@@ -212,6 +232,16 @@ class StromConfig:
             raise ValueError("prefetch_max_depth must be >= 1")
         if self.metrics_port < 0 or self.metrics_port > 65535:
             raise ValueError("metrics_port must be in [0, 65535] (0 = off)")
+        if self.hot_cache_bytes < 0:
+            raise ValueError("hot_cache_bytes must be >= 0 (0 = off)")
+        if self.hot_cache_admit not in ("second_touch", "always"):
+            raise ValueError("hot_cache_admit must be 'second_touch' or "
+                             f"'always', got {self.hot_cache_admit!r}")
+        if self.hot_cache_block_bytes <= 0 or self.hot_cache_block_bytes % 4096:
+            raise ValueError("hot_cache_block_bytes must be a positive "
+                             "multiple of 4096")
+        if self.readahead_window_batches < 0:
+            raise ValueError("readahead_window_batches must be >= 0 (0 = off)")
 
     @property
     def resolved_stripe_window_bytes(self) -> int:

@@ -1,5 +1,6 @@
 from strom.delivery.buffers import alloc_aligned  # noqa: F401
 from strom.delivery.coalesce import coalesce_chunks, coalesce_segments  # noqa: F401
 from strom.delivery.handle import DMAHandle  # noqa: F401
+from strom.delivery.hotcache import HotCache, Readahead  # noqa: F401
 from strom.delivery.prefetch import Prefetcher, bound_depth  # noqa: F401
 from strom.delivery.shard import contiguous_segments, plan_sharded_read  # noqa: F401

@@ -32,6 +32,7 @@ from strom.delivery.buffers import SlabPool, alloc_aligned
 from strom.delivery.coalesce import coalesce_chunks, coalesce_segments
 from strom.delivery.extents import ExtentList
 from strom.delivery.handle import DMAHandle, deferred_handle
+from strom.delivery.hotcache import HotCache
 from strom.delivery.shard import DevicePlan, Segment, dedupe_plans, plan_sharded_read
 from strom.engine import make_engine
 from strom.engine.base import Engine, EngineError
@@ -300,6 +301,21 @@ class StromContext:
             huge=self.config.huge_pages,
             on_alloc=self._on_slab_alloc) \
             if self.config.slab_pool_bytes > 0 else None
+        # hot-set host cache (ISSUE 4 tentpole, strom/delivery/hotcache.py):
+        # repeat traffic serves from RAM instead of re-gathering from NVMe.
+        # Buffers come from the slab pool (NUMA-placed, engine-registered);
+        # bound_depth subtracts hot_cache_bytes from the pool budget so
+        # prefetch auto-depth and the cache never double-commit slab memory.
+        self._hot_cache = HotCache(
+            self.config.hot_cache_bytes, pool=self._slab_pool,
+            admit=self.config.hot_cache_admit,
+            block_bytes=self.config.hot_cache_block_bytes) \
+            if self.config.hot_cache_bytes > 0 else None
+        # in-flight DEMAND gathers (not readahead): the readahead thread
+        # checks this between engine-budget-sized slices and yields, so a
+        # consumer's read never queues behind more than one warming slice
+        self._demand_lock = threading.Lock()
+        self._demand_reads = 0
         # one host->HBM stream at a time (see StromConfig.serialize_device_put)
         self._put_lock = threading.Lock() if self.config.serialize_device_put \
             else contextlib.nullcontext()
@@ -330,6 +346,26 @@ class StromContext:
         """The live endpoint when one was requested (``.port`` carries the
         bound port), else None."""
         return self._metrics_server
+
+    @property
+    def hot_cache(self) -> HotCache | None:
+        """The hot-set cache when ``hot_cache_bytes > 0``, else None."""
+        return self._hot_cache
+
+    @contextlib.contextmanager
+    def _demand_gate(self):
+        """Marks a DEMAND engine gather in flight (readahead yields to it)."""
+        with self._demand_lock:
+            self._demand_reads += 1
+        try:
+            yield
+        finally:
+            with self._demand_lock:
+                self._demand_reads -= 1
+
+    def _demand_active(self) -> bool:
+        with self._demand_lock:
+            return self._demand_reads > 0
 
     # -- file registry ------------------------------------------------------
     def file_index(self, path: str) -> int:
@@ -446,11 +482,26 @@ class StromContext:
 
     # -- raw range read into a fresh aligned slab ---------------------------
     def _read_segments(self, source: "Source",
-                       segments: Sequence[Segment], dest: np.ndarray,
-                       base_offset: int = 0) -> int:
+                       segments: Sequence[Segment],
+                       dest: "np.ndarray | None",
+                       base_offset: int = 0, *, _warm: bool = False) -> int:
         """Read (file_offset+base_offset → dest_offset) segments, chunked at
         block_size, pipelined at queue_depth. Returns total bytes read.
-        Raises EngineError on any failed or short chunk."""
+        Raises EngineError on any failed or short chunk.
+
+        The hot-set cache (when configured) is consulted AFTER physical
+        expansion — (path, physical offset) is the only key that repeats
+        across epochs; logical ExtentList offsets are batch-relative and
+        coalescing merges differently per shuffle order — and BEFORE engine
+        submission: cached ranges memcpy from RAM into *dest*, only the
+        miss runs reach the engine (a full hit skips it entirely), and miss
+        bytes are offered for admission once the gather lands.
+
+        ``_warm=True`` is the readahead path: cached ranges are skipped
+        (*dest* may be None — a slab is allocated only once misses exist),
+        misses are read in engine-budget slices that yield to demand
+        gathers, every read byte is force-admitted, and a short pass
+        returns quietly instead of raising."""
         cfg = self.config
         source = self.resolve_source(source)
         if self._numa is not None:
@@ -570,26 +621,172 @@ class StromContext:
             if maps:
                 chunks = plan_chunks_multi(chunks, maps)
 
+        # Hot-set cache consult (ISSUE 4 tentpole): split every physical
+        # chunk into cached ranges (memcpy'd from RAM into dest under a pin
+        # that blocks eviction) and miss runs (the only ops the engine
+        # sees). Full hit => the engine is skipped entirely.
+        cache = self._hot_cache
+        if cache is not None and not cache.enabled:
+            cache = None
+        cache_hit = 0
+        dflat: np.ndarray | None = None
+        if cache is not None and chunks:
+            if not _warm:  # warm mode never copies into dest (may be None)
+                dflat = dest if dest.ndim == 1 and dest.dtype == np.uint8 \
+                    else dest.reshape(-1).view(np.uint8)
+            t0 = _events_ring.now_us()
+            miss_chunks: list[tuple[int, int, int, int]] = []
+            pinned: list = []
+            for fi, fo, do, ln in chunks:
+                path = idx_paths.get(fi)
+                if path is None:  # untracked fd: bypass the cache
+                    miss_chunks.append((fi, fo, do, ln))
+                    continue
+                hits, misses, pins = cache.lookup(path, fo, fo + ln,
+                                                  record=not _warm)
+                pinned.extend(pins)
+                for s, t, view in hits:
+                    if not _warm:  # warm mode discards dest: skip the copy
+                        dflat[do + (s - fo): do + (t - fo)] = view
+                    cache_hit += t - s
+                for s, t in misses:
+                    miss_chunks.append((fi, s, do + (s - fo), t - s))
+            cache.unpin(pinned)
+            if cache_hit and not _warm:
+                _events_ring.complete(t0, _events_ring.now_us() - t0,
+                                      "cache", "cache.serve",
+                                      {"bytes": cache_hit})
+            chunks = miss_chunks
+
+        if _warm:
+            return self._warm_read_chunks(chunks, dest, idx_paths)
+
         # The engine executes the whole gather (block_size chunking, queue
         # -depth pipelining, per-chunk retry, EOF topup): ONE boundary
         # crossing per transfer on the C++ engine (SURVEY.md §3.3 hot loop).
         planned = sum(ln for (_, _, _, ln) in chunks)
-        with _events_ring.span("strom.read_segments", cat="read",
-                               args={"ops": len(chunks), "bytes": planned}), \
-                self._engine_lock:
-            try:
-                total = self.engine.read_vectored(chunks, dest,
-                                                  retries=cfg.io_retries)
-            except EngineError as e:
-                raise EngineError(e.errno, f"ssd2tpu {e.strerror}") from None
-        if total != planned:
-            # cheap insurance: any engine accounting bug (short read the
-            # engine failed to flag) surfaces loudly instead of as a
-            # zero-tailed jax array
-            raise EngineError(errno.EIO,
-                              f"ssd2tpu read {total} bytes, planned {planned}")
-        global_stats.add("ssd2tpu_bytes", total)
+        total = 0
+        if chunks:
+            with self._demand_gate(), \
+                    _events_ring.span("strom.read_segments", cat="read",
+                                      args={"ops": len(chunks),
+                                            "bytes": planned}), \
+                    self._engine_lock:
+                try:
+                    total = self.engine.read_vectored(chunks, dest,
+                                                      retries=cfg.io_retries)
+                except EngineError as e:
+                    raise EngineError(e.errno,
+                                      f"ssd2tpu {e.strerror}") from None
+            if total != planned:
+                # cheap insurance: any engine accounting bug (short read the
+                # engine failed to flag) surfaces loudly instead of as a
+                # zero-tailed jax array
+                raise EngineError(
+                    errno.EIO,
+                    f"ssd2tpu read {total} bytes, planned {planned}")
+            if cache is not None:
+                # admission offer (second-touch policy decides): the engine
+                # already landed the bytes in dest, so admitting is one
+                # memcpy into a cache-owned slab, never an extra read
+                t0a = _events_ring.now_us()
+                admitted = 0
+                for fi, fo, do, ln in chunks:
+                    path = idx_paths.get(fi)
+                    if path is not None:
+                        admitted += cache.admit(path, fo, fo + ln,
+                                                dflat[do: do + ln])
+                if admitted:
+                    _events_ring.complete(t0a, _events_ring.now_us() - t0a,
+                                          "cache", "cache.admit",
+                                          {"bytes": admitted})
+        global_stats.add("ssd2tpu_bytes", total + cache_hit)
+        return total + cache_hit
+
+    def _warm_read_chunks(self, chunks: list[tuple[int, int, int, int]],
+                          dest: np.ndarray, idx_paths: dict[int, str]) -> int:
+        """Readahead engine path: read miss chunks in slices of the
+        in-flight budget (queue_depth x block_size), force-admitting each
+        slice, yielding to demand gathers between slices — a demand read
+        queues behind at most ONE warming slice. Advisory: engine errors
+        and short passes end the warm quietly (the demand path will report
+        them with full context if they matter)."""
+        cache = self._hot_cache
+        cfg = self.config
+        if cache is None or not chunks:
+            return 0
+        # dest is allocated LAZILY, only once there are actual misses: in
+        # steady state (window fully warm) the readahead poll must cost a
+        # cache consult and nothing else — no slab churn, no memcpy
+        acquired: np.ndarray | None = None
+        if dest is None:
+            span = max(do + ln for (_, _, do, ln) in chunks)
+            dest = acquired = self._slab_pool.acquire(span) \
+                if self._slab_pool is not None else alloc_aligned(span)
+        try:
+            dflat = dest if dest.ndim == 1 and dest.dtype == np.uint8 \
+                else dest.reshape(-1).view(np.uint8)
+            budget = max(cfg.queue_depth * cfg.block_size, cfg.block_size)
+            total = 0
+            i = 0
+            while i < len(chunks):
+                if self._demand_active():
+                    cache.note_yield()
+                    break
+                batch: list[tuple[int, int, int, int]] = []
+                b = 0
+                while i < len(chunks) and b < budget:
+                    batch.append(chunks[i])
+                    b += chunks[i][3]
+                    i += 1
+                t0 = _events_ring.now_us()
+                try:
+                    with self._engine_lock:
+                        n = self.engine.read_vectored(batch, dest,
+                                                      retries=cfg.io_retries)
+                except EngineError:
+                    break
+                _events_ring.complete(t0, _events_ring.now_us() - t0, "cache",
+                                      "cache.readahead", {"bytes": n})
+                if n != b:
+                    break
+                for fi, fo, do, ln in batch:
+                    path = idx_paths.get(fi)
+                    if path is not None:
+                        cache.admit(path, fo, fo + ln, dflat[do: do + ln],
+                                    force=True)
+                total += n
+        finally:
+            if acquired is not None and self._slab_pool is not None:
+                self._slab_pool.release(acquired)
         return total
+
+    def warm(self, source: "Source", segments: Sequence[Segment],
+             base_offset: int = 0) -> int:
+        """Readahead entry point (strom.delivery.hotcache.Readahead): make
+        the given ranges cache-resident. Serves nothing — already-cached
+        ranges are skipped without a copy, misses are engine-read into a
+        throwaway slab and force-admitted. Returns bytes warmed; yields
+        (returns 0/short) whenever a demand gather is in flight."""
+        if self._hot_cache is None or not self._hot_cache.enabled \
+                or self._closed:
+            return 0
+        if self._demand_active():
+            self._hot_cache.note_yield()
+            return 0
+        if sum(s.length for s in segments) <= 0:
+            return 0
+        try:
+            # dest=None: the warm path allocates a slab only if there are
+            # misses to read (a fully-warm window costs a consult, nothing
+            # else — see _warm_read_chunks)
+            warmed = self._read_segments(source, segments, None, base_offset,
+                                         _warm=True)
+        except (EngineError, OSError, ValueError):
+            warmed = 0  # advisory: never turn readahead into a crash
+        if warmed:
+            self._hot_cache.note_readahead(warmed)
+        return warmed
 
     # -- intra-transfer streaming (read/transfer overlap) -------------------
     def _deliver_streamed(self, source: "Source", segments: Sequence[Segment],
@@ -802,6 +999,34 @@ class StromContext:
 
             with trace_span("strom.memcpy_ssd2tpu", enabled=cfg.trace_annotations):
                 if sharding is None:
+                    if (self._hot_cache is not None
+                            and self._hot_cache.enabled
+                            and pool is not None
+                            and isinstance(source, str)):
+                        # full-hit fast path: the cached slab IS the host
+                        # buffer jax serializes from — no dest slab, no
+                        # engine, no serve memcpy. The entry stays pinned
+                        # until the put RETIRES (block_until_ready), which
+                        # is what lets eviction recycle slabs fearlessly;
+                        # gated off aliasing backends (pool is None on CPU,
+                        # where the delivered array would share bytes with
+                        # an evictable slab forever).
+                        hit = self._hot_cache.view(source, offset,
+                                                   offset + nbytes)
+                        if hit is not None:
+                            view, entry = hit
+                            try:
+                                arr_host = view.view(np_dtype).reshape(shape)
+                                with self._put_lock, \
+                                        trace_span("strom.device_put",
+                                                   cat="put",
+                                                   enabled=cfg.trace_annotations):
+                                    out = jax.device_put(arr_host, device)
+                                out.block_until_ready()
+                            finally:
+                                self._hot_cache.unpin([entry])
+                            global_stats.add("ssd2tpu_bytes", nbytes)
+                            return out
                     if stream_eligible(nbytes):
                         return self._deliver_streamed(
                             source, [Segment(0, 0, nbytes)], offset, nbytes,
@@ -1037,6 +1262,12 @@ class StromContext:
                 self._steps_cache = (now, dict(steps))
         steps["events_dropped"] = _events_ring.events_dropped
         out["steps"] = steps
+        # hot-set cache observability (ISSUE 4): hit/miss/admission/
+        # eviction/readahead counters + hit ratio, keyed with full metric
+        # names so the sections exposition types them via the global
+        # registry mirror (same contract as the context section)
+        if self._hot_cache is not None:
+            out["cache"] = self._hot_cache.stats()
         if self._slab_pool is not None:
             out["slab_pool"] = self._slab_pool.stats()
         out["engine"] = self.engine.stats()

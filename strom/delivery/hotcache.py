@@ -1,0 +1,501 @@
+"""Hot-set host cache + epoch-aware readahead (ISSUE 4 tentpole).
+
+The pipelines re-gather the same bytes from NVMe on *every* epoch and every
+repeat request, even when the working set fits in host RAM. This module adds
+the missing caching axis from the ROADMAP north star: an extent-keyed,
+byte-budgeted, refcounted LRU over slab-pool-backed host buffers that the
+delivery layer (`StromContext._read_segments`) consults before engine
+submission — a full hit never touches the engine (the bytes memcpy from RAM
+straight toward ``device_put``); a partial hit splits the request so only
+the miss runs are submitted. ≙ the page-cache tier the reference bypasses
+by design (SURVEY.md §2.1 "Page-cache fallback"), rebuilt in userspace where
+O_DIRECT means the kernel's own cache never sees these bytes.
+
+Design points, in the order they bit previous subsystems:
+
+- **Stable keys.** Entries key on ``(physical path, byte range)`` AFTER
+  extent/stripe expansion, not on caller segments: an ExtentList is rebuilt
+  per batch with batch-relative logical offsets, and coalescing merges
+  fragments differently depending on shuffle order — physical ranges are
+  the only identity that repeats across epochs. Interval arithmetic (not
+  whole-entry equality) serves overlaps, so epoch N+1's differently-split
+  request still hits epoch N's entries.
+- **Second-touch admission** (``hot_cache_admit="second_touch"``): the first
+  epoch only *observes* (a block-granular touch ledger, bounded LRU), the
+  second admits — one-shot scans never displace the hot set. Force-admit
+  (``"always"``) is the knob for known-repeating workloads and the warm/cold
+  bench arms; readahead always force-admits (warming IS the prediction).
+- **Refcounted eviction.** Entries are pinned while anything reads them — a
+  serve-memcpy, or a ``device_put`` sourced zero-copy from the cached slab
+  (the full-hit fast path in ``memcpy_ssd2tpu``). Eviction under byte
+  pressure skips pinned entries and an evicted-while-pinned entry only
+  returns its slab to the pool on the LAST unpin, so a recycled slab can
+  never be overwritten mid-put (the same lifetime handshake as
+  SlabPool.release, SURVEY.md §7.4 hard part #3).
+- **Readahead yields to demand.** The epoch-aware readahead thread pulls the
+  sampler's upcoming-batch window (``EpochShuffleSampler.peek`` — it crosses
+  the epoch boundary, so the next epoch's head warms while the tail of this
+  one trains) and warms cache misses in slices of the engine's in-flight
+  budget (``queue_depth * block_size``), checking for in-flight demand reads
+  before every slice: a demand gather never queues behind more than one
+  readahead slice, and an active demand read aborts the warming pass
+  entirely (``cache_readahead_yields``).
+
+Observability: ``cache_hit/miss/admitted/evicted/readahead`` counters and
+the ``cache_hit_ratio`` gauge in the global registry (typed via
+``all_counter_names`` for /metrics), the ``cache`` section of
+``StromContext.stats()`` (→ /stats and Prometheus exposition), and
+``cat="cache"`` spans in the event ring (serve/admit/readahead on the
+timeline next to the reads they replace).
+"""
+
+from __future__ import annotations
+
+import bisect
+import threading
+from collections import OrderedDict
+from typing import Any, Callable, Iterable
+
+import numpy as np
+
+from strom.delivery.buffers import HUGE_PAGE, alloc_aligned, size_class
+from strom.utils.stats import global_stats
+
+ADMIT_POLICIES = ("second_touch", "always")
+
+# bench-JSON columns the warm/cold epoch phase pair emits (cli.py
+# _cache_epoch_phases), single-sourced so the driver's per-arm copy loop
+# (bench.py) and the compare_rounds "cache" section cannot drift from the
+# producer — the same contract STALL_FIELDS enforces for stall attribution
+CACHE_BENCH_FIELDS = (
+    "cold_images_per_s",
+    "warm_images_per_s",
+    "warm_vs_cold",
+    "cache_hit_bytes",
+    "cache_miss_bytes",
+    "cache_admitted_bytes",
+    "cache_readahead_bytes",
+    "cache_epoch_steps",
+)
+
+
+class _Entry:
+    """One cached physical range: ``buf[:hi-lo]`` holds file bytes [lo, hi)
+    of ``skey``. ``refs`` pins it against eviction; ``dead`` marks an entry
+    evicted while pinned (slab freed on last unpin). ``charge`` is what the
+    byte budget is billed — the backing slab's ALLOCATED size (size class,
+    2MiB-rounded under huge pages), not the logical length, so resident
+    memory actually respects ``hot_cache_bytes``."""
+
+    __slots__ = ("skey", "lo", "hi", "buf", "refs", "dead", "charge")
+
+    def __init__(self, skey: Any, lo: int, hi: int, buf: np.ndarray,
+                 charge: int):
+        self.skey = skey
+        self.lo = lo
+        self.hi = hi
+        self.buf = buf
+        self.refs = 0
+        self.dead = False
+        self.charge = charge
+
+    @property
+    def nbytes(self) -> int:
+        return self.hi - self.lo
+
+
+class HotCache:
+    """Extent-keyed, byte-budgeted, refcounted LRU of host byte ranges.
+
+    Thread-safe: metadata mutates under one lock; the actual byte copies
+    happen outside it with the source entries pinned. Buffers come from the
+    delivery slab pool when one is supplied (recycled, NUMA-placed,
+    engine-registered slabs) and fall back to fresh aligned allocations.
+    """
+
+    def __init__(self, max_bytes: int, *, pool=None,
+                 admit: str = "second_touch", block_bytes: int = 1 << 20,
+                 touch_capacity: int = 1 << 16):
+        if max_bytes <= 0:
+            raise ValueError("max_bytes must be positive")
+        if admit not in ADMIT_POLICIES:
+            raise ValueError(f"admit must be one of {ADMIT_POLICIES}, "
+                             f"got {admit!r}")
+        if block_bytes <= 0:
+            raise ValueError("block_bytes must be positive")
+        self.max_bytes = max_bytes
+        self.admit_policy = admit
+        self._block = block_bytes
+        self._pool = pool
+        # phase gate: a disabled cache serves/admits/warms nothing (entries
+        # are kept). The bench arms use it to scope the cache to the
+        # cold/warm epoch pair so the pre-existing headline phases
+        # (flat-out img/s, train stalls, stall attribution) keep their
+        # round-over-round meaning; library contexts stay always-on.
+        self.enabled = True
+        self._lock = threading.Lock()
+        # skey -> entries sorted by lo (disjoint ranges per skey)
+        self._index: dict[Any, list[_Entry]] = {}
+        # LRU: oldest first; value is the entry (key is its id())
+        self._lru: "OrderedDict[int, _Entry]" = OrderedDict()
+        # block-granular touch ledger for second-touch admission, bounded
+        # LRU so a giant cold scan can't grow it without limit
+        self._touched: "OrderedDict[tuple, None]" = OrderedDict()
+        self._touch_cap = touch_capacity
+        self.bytes = 0
+        # instance tallies (authoritative for stats()); the same names are
+        # mirrored into global_stats so /metrics typing and bench deltas
+        # work without bespoke plumbing
+        self.hit_bytes = 0
+        self.miss_bytes = 0
+        self.hits = 0
+        self.misses = 0
+        self.admitted_bytes = 0
+        self.evictions = 0
+        self.evicted_bytes = 0
+        self.readahead_bytes = 0
+        self.readahead_yields = 0
+        self.readahead_errors = 0
+
+    # -- allocation ---------------------------------------------------------
+    def _charge(self, n: int) -> int:
+        """Budget charge for an *n*-byte entry: the backing slab's allocated
+        size — pool slabs round to their size class (and to 2MiB under huge
+        pages), so billing logical bytes would let resident memory overshoot
+        ``max_bytes`` by the rounding factor (and silently break the
+        ``bound_depth`` pool reservation sized on this budget)."""
+        c = size_class(n)
+        if getattr(self._pool, "huge", False):
+            c = (c + HUGE_PAGE - 1) // HUGE_PAGE * HUGE_PAGE
+        return c
+
+    def _alloc(self, n: int) -> np.ndarray:
+        if self._pool is not None:
+            return self._pool.acquire(n)
+        return alloc_aligned(n)
+
+    def _free(self, buf: np.ndarray) -> None:
+        if self._pool is not None:
+            self._pool.release(buf)
+        # else: GC unmaps
+
+    # -- lookup / pinning ---------------------------------------------------
+    def lookup(self, skey: Any, lo: int, hi: int, *, record: bool = True
+               ) -> tuple[list[tuple[int, int, np.ndarray]],
+                          list[tuple[int, int]], list[_Entry]]:
+        """Split [lo, hi) of *skey* into cached and missing ranges.
+
+        Returns ``(hits, misses, pinned)``: hits are ``(h_lo, h_hi, view)``
+        where *view* is a zero-copy window of the backing slab; *pinned*
+        holds the entries backing those views with their refcount raised —
+        the caller MUST :meth:`unpin` them once it stops reading the views
+        (after the memcpy, or after a device_put sourced from them retires).
+        ``record=False`` skips the hit/miss counters (readahead probes must
+        not inflate the demand hit ratio).
+        """
+        hits: list[tuple[int, int, np.ndarray]] = []
+        misses: list[tuple[int, int]] = []
+        pinned: list[_Entry] = []
+        with self._lock:
+            entries = self._index.get(skey, ())
+            pos = lo
+            i = bisect.bisect_right(entries, lo, key=lambda e: e.lo) - 1 \
+                if entries else 0
+            i = max(i, 0)
+            while pos < hi and i < len(entries):
+                e = entries[i]
+                if e.hi <= pos:
+                    i += 1
+                    continue
+                if e.lo >= hi:
+                    break
+                if e.lo > pos:
+                    misses.append((pos, e.lo))
+                    pos = e.lo
+                s, t = max(pos, e.lo), min(hi, e.hi)
+                e.refs += 1
+                pinned.append(e)
+                self._lru.move_to_end(id(e))
+                hits.append((s, t, e.buf[s - e.lo: t - e.lo]))
+                pos = t
+                i += 1
+            if pos < hi:
+                misses.append((pos, hi))
+            if record:
+                hb = sum(t - s for s, t, _ in hits)
+                mb = sum(t - s for s, t in misses)
+                self.hit_bytes += hb
+                self.miss_bytes += mb
+                self.hits += len(hits)
+                self.misses += len(misses)
+        if record:
+            if hits:
+                global_stats.add("cache_hits", len(hits))
+                global_stats.add("cache_hit_bytes",
+                                 sum(t - s for s, t, _ in hits))
+            if misses:
+                global_stats.add("cache_misses", len(misses))
+                global_stats.add("cache_miss_bytes",
+                                 sum(t - s for s, t in misses))
+        return hits, misses, pinned
+
+    def view(self, skey: Any, lo: int, hi: int, *, record: bool = True
+             ) -> tuple[np.ndarray, _Entry] | None:
+        """A single pinned zero-copy view when ONE entry covers the whole
+        [lo, hi) — the full-hit fast path ``memcpy_ssd2tpu`` device_puts
+        from directly. Caller must :meth:`unpin` after the put retires."""
+        with self._lock:
+            entries = self._index.get(skey, ())
+            if not entries:
+                return None
+            i = bisect.bisect_right(entries, lo, key=lambda e: e.lo) - 1
+            if i < 0:
+                return None
+            e = entries[i]
+            if not (e.lo <= lo and hi <= e.hi):
+                return None
+            e.refs += 1
+            self._lru.move_to_end(id(e))
+            if record:
+                self.hit_bytes += hi - lo
+                self.hits += 1
+        if record:
+            global_stats.add("cache_hits")
+            global_stats.add("cache_hit_bytes", hi - lo)
+        return e.buf[lo - e.lo: hi - e.lo], e
+
+    def unpin(self, entries: Iterable[_Entry]) -> None:
+        """Drop pins taken by :meth:`lookup`/:meth:`view`; frees the slab of
+        any entry that was evicted while pinned."""
+        dead_bufs = []
+        with self._lock:
+            for e in entries:
+                e.refs -= 1
+                if e.dead and e.refs == 0:
+                    dead_bufs.append(e.buf)
+                    e.buf = None  # type: ignore[assignment]
+        for buf in dead_bufs:
+            self._free(buf)
+
+    # -- admission / eviction -----------------------------------------------
+    def _blocks(self, skey: Any, lo: int, hi: int) -> list[tuple]:
+        return [(skey, b) for b in range(lo // self._block,
+                                         (hi - 1) // self._block + 1)]
+
+    def _touch(self, blocks: list[tuple]) -> bool:
+        """Mark blocks touched; True when EVERY block had been touched
+        before (the second-touch admission test)."""
+        seen = all(b in self._touched for b in blocks)
+        for b in blocks:
+            self._touched[b] = None
+            self._touched.move_to_end(b)
+        while len(self._touched) > self._touch_cap:
+            self._touched.popitem(last=False)
+        return seen
+
+    def admit(self, skey: Any, lo: int, hi: int, data: np.ndarray, *,
+              force: bool = False) -> int:
+        """Offer file bytes [lo, hi) of *skey* (``data`` holds them) for
+        admission. Subject to the admission policy (unless *force*), the
+        byte budget (LRU eviction of unpinned entries makes room) and
+        disjointness (already-cached subranges are skipped). Returns bytes
+        actually admitted."""
+        n = hi - lo
+        if n <= 0 or self._charge(n) > self.max_bytes:
+            return 0
+        with self._lock:
+            if not force and self.admit_policy == "second_touch" \
+                    and not self._touch(self._blocks(skey, lo, hi)):
+                return 0
+        # gaps only (keeps per-skey entries disjoint); lookup pins the
+        # overlapped entries — unpin immediately, we only needed the holes
+        _, gaps, pinned = self.lookup(skey, lo, hi, record=False)
+        self.unpin(pinned)
+        admitted = 0
+        for g_lo, g_hi in gaps:
+            admitted += self._insert(skey, g_lo, g_hi,
+                                     data[g_lo - lo: g_hi - lo])
+        if admitted:
+            with self._lock:
+                self.admitted_bytes += admitted
+            global_stats.add("cache_admitted_bytes", admitted)
+        return admitted
+
+    def _insert(self, skey: Any, lo: int, hi: int, data: np.ndarray) -> int:
+        n = hi - lo
+        charge = self._charge(n)
+        buf = self._alloc(n)
+        buf[:n] = data[:n]
+        with self._lock:
+            # make room (skip pinned entries: never free a slab with an
+            # in-flight reader/put)
+            while self.bytes + charge > self.max_bytes:
+                victim = next((e for e in self._lru.values() if e.refs == 0),
+                              None)
+                if victim is None:
+                    break
+                self._evict_locked(victim)
+            if self.bytes + charge > self.max_bytes:
+                drop = buf  # everything left is pinned: skip admission
+            else:
+                # a concurrent admit may have covered part of this gap
+                # between our lookup and now; keep entries disjoint
+                entries = self._index.setdefault(skey, [])
+                i = bisect.bisect_right(entries, lo, key=lambda e: e.lo)
+                prev_ok = i == 0 or entries[i - 1].hi <= lo
+                next_ok = i == len(entries) or entries[i].lo >= hi
+                if not (prev_ok and next_ok):
+                    drop = buf
+                else:
+                    e = _Entry(skey, lo, hi, buf, charge)
+                    entries.insert(i, e)
+                    self._lru[id(e)] = e
+                    self.bytes += charge
+                    drop = None
+        if drop is not None:
+            self._free(drop)
+            return 0
+        return n
+
+    def _evict_locked(self, e: _Entry) -> None:
+        """Remove *e* from the index/LRU (lock held). The slab returns to
+        the pool now when unpinned, else on the last unpin."""
+        self._lru.pop(id(e), None)
+        entries = self._index.get(e.skey)
+        if entries is not None:
+            i = bisect.bisect_right(entries, e.lo, key=lambda x: x.lo) - 1
+            if 0 <= i < len(entries) and entries[i] is e:
+                entries.pop(i)
+            if not entries:
+                del self._index[e.skey]
+        self.bytes -= e.charge
+        self.evictions += 1
+        self.evicted_bytes += e.nbytes
+        global_stats.add("cache_evictions")
+        global_stats.add("cache_evicted_bytes", e.nbytes)
+        if e.refs == 0:
+            buf, e.buf = e.buf, None  # type: ignore[assignment]
+            # pool.release takes its own lock; safe under ours (no inverse
+            # ordering exists), but keep the critical section honest anyway
+            self._free(buf)
+        else:
+            e.dead = True  # last unpin frees
+
+    def clear(self) -> None:
+        """Drop every entry AND the touch ledger (a cleared cache forgets
+        its observations too — the cold/warm bench pair depends on this).
+        Pinned entries leave the index immediately (no new lookup can hit
+        them) but their slabs free on the last unpin."""
+        with self._lock:
+            for e in list(self._lru.values()):
+                self._evict_locked(e)
+            self._touched.clear()
+
+    # -- readahead accounting ----------------------------------------------
+    def note_readahead(self, nbytes: int) -> None:
+        with self._lock:
+            self.readahead_bytes += nbytes
+        global_stats.add("cache_readahead_bytes", nbytes)
+
+    def note_yield(self) -> None:
+        with self._lock:
+            self.readahead_yields += 1
+        global_stats.add("cache_readahead_yields")
+
+    def note_error(self) -> None:
+        """A readahead tick died (window_fn raised, source vanished): the
+        thread keeps running, but 'readahead silently broken' must be
+        distinguishable from 'nothing to warm' (readahead_bytes 0 alone
+        cannot tell the two apart)."""
+        with self._lock:
+            self.readahead_errors += 1
+        global_stats.add("cache_readahead_errors")
+
+    # -- introspection ------------------------------------------------------
+    @property
+    def entries(self) -> int:
+        with self._lock:
+            return len(self._lru)
+
+    def stats(self) -> dict:
+        """The ``cache`` section of ``StromContext.stats()`` — full metric
+        names as keys so the sections exposition types the counters via the
+        global registry mirror (PR 3 exposition rules)."""
+        with self._lock:
+            served = self.hit_bytes + self.miss_bytes
+            ratio = self.hit_bytes / served if served else 0.0
+            out = {
+                "cache_budget_bytes": self.max_bytes,
+                "cache_bytes": self.bytes,
+                "cache_entries": len(self._lru),
+                "cache_hit_bytes": self.hit_bytes,
+                "cache_miss_bytes": self.miss_bytes,
+                "cache_hits": self.hits,
+                "cache_misses": self.misses,
+                "cache_admitted_bytes": self.admitted_bytes,
+                "cache_evictions": self.evictions,
+                "cache_evicted_bytes": self.evicted_bytes,
+                "cache_readahead_bytes": self.readahead_bytes,
+                "cache_readahead_yields": self.readahead_yields,
+                "cache_readahead_errors": self.readahead_errors,
+                "cache_hit_ratio": round(ratio, 4),
+            }
+        global_stats.set_gauge("cache_hit_ratio", out["cache_hit_ratio"])
+        return out
+
+
+class Readahead:
+    """Epoch-aware readahead: warm the upcoming-batch window into the cache.
+
+    *window_fn* returns an iterable of ``(source, segments, base_offset)``
+    read requests describing the next ``readahead_window_batches`` batches
+    (pipelines build it from ``EpochShuffleSampler.peek``, which crosses the
+    epoch boundary — the next epoch's head warms while this one drains).
+    Each tick re-pulls the window, so the thread tracks the sampler as the
+    prefetcher advances it; fully-warm windows back off to a longer sleep.
+
+    All warming goes through ``StromContext.warm``, which serves only
+    MISSES, force-admits what it reads, and yields to demand reads between
+    engine-budget-sized slices — this thread can therefore never turn a
+    demand gather into a queue-depth casualty (asserted in
+    tests/test_hotcache.py).
+    """
+
+    def __init__(self, ctx, window_fn: Callable[[], Iterable[tuple]], *,
+                 interval_s: float = 0.02):
+        self._ctx = ctx
+        self._window_fn = window_fn
+        self._interval = interval_s
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name="strom-readahead")
+        self._thread.start()
+
+    def _run(self) -> None:
+        while not self._stop.is_set():
+            cache = getattr(self._ctx, "hot_cache", None)
+            if cache is None or not cache.enabled:
+                # gate BEFORE window_fn: building the window (sampler.peek
+                # + per-batch extents) is exactly the CPU the disabled
+                # phases must not pay on a 1-core box
+                self._stop.wait(self._interval * 5)
+                continue
+            warmed = 0
+            try:
+                for source, segments, base_offset in self._window_fn():
+                    if self._stop.is_set():
+                        break
+                    warmed += self._ctx.warm(source, segments, base_offset)
+            except Exception:
+                # advisory path: a racing pipeline/context close (or a
+                # transient engine error) must neither kill the thread nor
+                # spew into the consumer's stderr — but it must be COUNTED,
+                # or a broken window_fn reads as "nothing to warm"
+                cache = getattr(self._ctx, "hot_cache", None)
+                if cache is not None:
+                    cache.note_error()
+            self._stop.wait(self._interval if warmed else self._interval * 5)
+
+    def close(self) -> None:
+        self._stop.set()
+        self._thread.join(timeout=5)

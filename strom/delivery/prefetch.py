@@ -41,13 +41,25 @@ _TRACE_CAP = 512
 
 
 def bound_depth(pool_bytes: int, batch_bytes: int, *, floor: int = 2,
-                cap: int = 32) -> int:
+                cap: int = 32, reserve_bytes: int = 0) -> int:
     """Max prefetch depth a slab pool of *pool_bytes* can stage when each
     in-flight batch owns ~*batch_bytes* of slabs until its device_put
-    retires. Unknown sizes (<=0) fall back to *cap*."""
+    retires. Unknown sizes (<=0) fall back to *cap*.
+
+    *reserve_bytes* is pool capacity spoken for by someone else — the
+    hot-set cache's ``hot_cache_bytes`` budget (strom/delivery/hotcache.py):
+    cache entries live in pool slabs for the run's lifetime, so auto-depth
+    growth sized against the FULL pool would double-commit that memory
+    (depth grows, the cache admits, and together they overshoot the pool —
+    ISSUE 4 satellite). A reserve at or beyond the pool collapses depth to
+    *floor*, never errors: the cache keeps its budget, prefetch keeps its
+    minimum overlap."""
     if pool_bytes <= 0 or batch_bytes <= 0:
         return cap
-    return max(floor, min(cap, pool_bytes // batch_bytes))
+    avail = pool_bytes - max(reserve_bytes, 0)
+    if avail <= 0:
+        return floor
+    return max(floor, min(cap, avail // batch_bytes))
 
 
 class Prefetcher(Generic[T]):

@@ -168,8 +168,12 @@ def _auto_depth_bounds(ctx, auto_prefetch: bool | None,
     auto = ctx.config.prefetch_auto if auto_prefetch is None else auto_prefetch
     if not auto:
         return False, None
+    # the hot cache's byte budget lives in the same slab pool the in-flight
+    # batches stage through: reserve it so depth growth can't starve the
+    # cache (nor the cache starve the prefetch window) — ISSUE 4 satellite
     return True, bound_depth(ctx.config.slab_pool_bytes, batch_bytes,
-                             cap=ctx.config.prefetch_max_depth)
+                             cap=ctx.config.prefetch_max_depth,
+                             reserve_bytes=ctx.config.hot_cache_bytes)
 
 
 def resolve_state(paths: tuple[str, ...], *, seed: int,

@@ -59,6 +59,13 @@ class EpochShuffleSampler:
         self.batch = batch
         self.shuffle = shuffle
         self.state = state or SamplerState(seed=seed)
+        # permutation memo for peek(): the readahead thread polls the
+        # upcoming window every few ms, and re-permuting num_records per
+        # poll would be a dataset-sized tax on a warming path. TWO epochs
+        # retained, not one: near an epoch boundary every peek needs both
+        # perm(e) and perm(e+1), and a single-slot memo would recompute
+        # both on every poll for the whole boundary window
+        self._peek_perms: dict[int, np.ndarray] = {}
 
     @property
     def batches_per_epoch(self) -> int:
@@ -69,6 +76,38 @@ class EpochShuffleSampler:
             return np.arange(self.num_records, dtype=np.int64)
         rng = np.random.Generator(np.random.Philox(key=[self.state.seed, epoch]))
         return rng.permutation(self.num_records).astype(np.int64)
+
+    def _perm_cached(self, epoch: int) -> np.ndarray:
+        perm = self._peek_perms.get(epoch)
+        if perm is None:
+            perm = self._perm(epoch)
+            # keep this epoch + its neighbor; drop anything older
+            self._peek_perms = {e: p for e, p in self._peek_perms.items()
+                                if e >= epoch - 1}
+            self._peek_perms[epoch] = perm
+        return perm
+
+    def peek(self, n: int) -> list[np.ndarray]:
+        """The next *n* index batches from the CURRENT cursor, without
+        advancing it — the upcoming-segment window the epoch-aware readahead
+        (strom/delivery/hotcache.py) warms. Crosses the epoch boundary: the
+        permutation is deterministic in (seed, epoch), so the next epoch's
+        head is known before this one ends and can warm while it drains.
+
+        Advisory read: the consumer's thunk generator advances ``state``
+        concurrently, and a torn (epoch, cursor) read at the boundary only
+        shifts WHICH batches warm — cache contents stay correct either way.
+        """
+        epoch, i = self.state.epoch, self.state.batch_in_epoch
+        out: list[np.ndarray] = []
+        while len(out) < n:
+            if i >= self.batches_per_epoch:
+                epoch += 1
+                i = 0
+            perm = self._perm_cached(epoch)
+            out.append(perm[i * self.batch: (i + 1) * self.batch])
+            i += 1
+        return out
 
     def __iter__(self) -> Iterator[np.ndarray]:
         """Infinite stream of batches; advance `state` as a side effect so a

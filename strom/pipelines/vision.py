@@ -40,6 +40,46 @@ from strom.utils.stats import global_stats
 Transform = Callable[..., np.ndarray]
 
 
+def _make_readahead(ctx: StromContext, sampler: EpochShuffleSampler,
+                    extents_for_batch: Callable[[np.ndarray], Any]):
+    """Epoch-aware readahead for a vision pipeline (ISSUE 4): a background
+    thread that pulls the sampler's upcoming-batch window (``peek`` crosses
+    the epoch boundary, so next epoch's head warms while this one drains),
+    maps each batch to its ExtentList via *extents_for_batch*, and warms
+    cache misses through ``ctx.warm`` — which yields to demand gathers.
+    None when the hot cache or the readahead window is off."""
+    if ctx.hot_cache is None or ctx.config.readahead_window_batches <= 0:
+        return None
+    from strom.delivery.hotcache import Readahead
+    from strom.delivery.shard import Segment
+
+    window_batches = ctx.config.readahead_window_batches
+
+    def window():
+        out = []
+        for indices in sampler.peek(window_batches):
+            el = extents_for_batch(indices)
+            if el.size:
+                out.append((el, [Segment(0, 0, el.size)], 0))
+        return out
+
+    return Readahead(ctx, window)
+
+
+def _chain_close(*closers) -> Callable[[], None] | None:
+    """One on_close callable running every non-None closer (readahead dies
+    before the decode pool, both before the pipeline returns)."""
+    live = [c for c in closers if c is not None]
+    if not live:
+        return None
+
+    def close() -> None:
+        for c in live:
+            c()
+
+    return close
+
+
 def default_train_transform(size: int) -> Transform:
     """Full-scale decode + RandomResizedCrop (the pre-reduced-scale
     behavior, kept for callers that pinned it); pipelines default to
@@ -265,9 +305,16 @@ def make_wds_vision_pipeline(ctx: StromContext, paths: Sequence[str], *,
     depth = prefetch_depth if prefetch_depth is not None else ctx.config.prefetch_depth
     auto, max_depth = _auto_depth_bounds(
         ctx, auto_prefetch, len(local_rows) * image_size * image_size * 3)
+    # warm this host's member bytes for the upcoming batches (tar payloads
+    # re-read every epoch; decode still runs per-step, the NVMe gather not)
+    ra = _make_readahead(
+        ctx, sampler,
+        lambda indices: ss.batch_extents([int(indices[r]) for r in local_rows],
+                                         [image_ext, label_ext]))
     return Pipeline(sampler, make_batch, depth=depth, auto_depth=auto,
                     max_depth=max_depth, fingerprint=fp,
-                    on_close=pool.close, decode_pool=pool)
+                    on_close=_chain_close(ra.close if ra else None, pool.close),
+                    decode_pool=pool)
 
 
 def make_predecoded_vision_pipeline(ctx: StromContext, paths: Sequence[str], *,
@@ -325,8 +372,14 @@ def make_predecoded_vision_pipeline(ctx: StromContext, paths: Sequence[str], *,
     depth = prefetch_depth if prefetch_depth is not None else ctx.config.prefetch_depth
     auto, max_depth = _auto_depth_bounds(
         ctx, auto_prefetch, batch * image_size * image_size * 3)
+    # the decode-free arm is a pure engine gather: warming the upcoming
+    # record extents turns epoch 2+ into RAM memcpys end to end
+    ra = _make_readahead(
+        ctx, sampler,
+        lambda indices: shards.extents([int(i) for i in indices]))
     return Pipeline(sampler, make_batch, depth=depth, auto_depth=auto,
-                    max_depth=max_depth, fingerprint=fp)
+                    max_depth=max_depth, fingerprint=fp,
+                    on_close=ra.close if ra else None)
 
 
 def make_imagenet_resnet_pipeline(ctx: StromContext, paths: Sequence[str], *,

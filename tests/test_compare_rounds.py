@@ -37,6 +37,10 @@ NEW_ROUND = {  # r5-era shape: binding + context + audit arrays + headline
     # r6+: per-step stall attribution (strom/obs/stall)
     "resnet_goodput_pct": 83.4,
     "resnet_step_ingest_wait_p50_us": 151000.0,
+    # r6+: hot-set cache cold/warm epoch pair (strom/delivery/hotcache)
+    "resnet_predecoded_warm_vs_cold": 2.208,
+    "resnet_predecoded_cache_hit_bytes": 4411304,
+    "resnet_predecoded_cache_miss_bytes": 0,
     "binding": {"vs_baseline_host": 1.0315, "vs_baseline_host_raid": 0.9708,
                 "train_data_stalls": 0, "some_future_key": 0.5},
     "context": {"raw_gbps": 3.49},
@@ -81,6 +85,37 @@ def test_table_renders_all_vintages(artifacts, capsys):
     assert "stall attribution" in out
     assert "resnet_goodput_pct" in out
     assert "83.4" in out
+    # hot-set cache section (ISSUE 4): warm/cold ratio + hit/miss bytes
+    assert "hot-set cache" in out
+    assert "resnet_predecoded_warm_vs_cold" in out
+    assert "2.208" in out
+
+
+def test_cache_section_hidden_without_cache_keys(tmp_path, capsys):
+    """Rounds predating the hot cache don't get an all-dash cache section."""
+    p = tmp_path / "BENCH_r02.json"
+    p.write_text(json.dumps(OLD_ROUND))
+    assert compare_rounds.main([str(p)]) == 0
+    assert "hot-set cache" not in capsys.readouterr().out
+
+
+def test_cache_keys_match_producers():
+    """Producer↔report key parity (ISSUE 4 satellite, the decode/stall
+    pattern): every compare_rounds cache column must be an arm prefix plus
+    a key cli._cache_epoch_phases actually emits (single-sourced in
+    strom.delivery.hotcache.CACHE_BENCH_FIELDS) — a rename on either side
+    fails HERE, not on a dashboard."""
+    from strom.delivery.hotcache import CACHE_BENCH_FIELDS
+
+    prefixes = ("resnet_predecoded", "vit_predecoded", "resnet", "vit")
+    produced = set(CACHE_BENCH_FIELDS)
+    for key in compare_rounds.CACHE_KEYS:
+        suffix = next((key[len(p) + 1:] for p in prefixes
+                       if key.startswith(p + "_")), None)
+        assert suffix is not None, key
+        assert suffix in produced, \
+            f"compare_rounds consumes {key!r} but the cache phase pair " \
+            f"produces no {suffix!r} (renamed column?)"
 
 
 def test_stall_section_hidden_without_stall_keys(tmp_path, capsys):

@@ -43,6 +43,37 @@ def test_collision_detected(tmp_path):
     assert lint.main([str(tmp_path)]) == 1
 
 
+def test_fields_tuple_literals_scanned(tmp_path):
+    """Single-sourced name tuples (CACHE_BENCH_FIELDS, STALL_FIELDS, the
+    compare_rounds *_KEYS lists) are part of the metric namespace: a
+    restyled spelling there forks a dashboard column exactly like a
+    restyled call site (ISSUE 4 satellite)."""
+    pkg = tmp_path / "strom"
+    pkg.mkdir()
+    (pkg / "a.py").write_text(
+        'CACHE_BENCH_FIELDS = (\n'
+        '    "cache_hit_bytes",\n'
+        '    "warm_images_per_s",\n'
+        ')\n')
+    (pkg / "b.py").write_text(
+        'global_stats.add("Cache_HitBytes", 1)\n')
+    found = lint.scan_sources(str(tmp_path))
+    assert "warmimagespers" in found
+    bad = lint.collisions(found)
+    assert len(bad) == 1
+    assert bad[0][0] == "cachehitbytes"
+    assert lint.main([str(tmp_path)]) == 1
+
+
+def test_repo_fields_tuples_seen():
+    """The real repo scan picks up the single-sourced tuples (cache bench
+    columns + stall fields), so 'clean' covers them too."""
+    found = lint.scan_sources(_ROOT)
+    assert "warmvscold" in found          # hotcache CACHE_BENCH_FIELDS
+    assert "cachehitbytes" in found
+    assert "goodputpct" in found          # stall STALL_FIELDS
+
+
 def test_fstring_literals_scanned(tmp_path):
     pkg = tmp_path / "strom"
     pkg.mkdir()

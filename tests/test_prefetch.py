@@ -151,3 +151,23 @@ def test_bound_depth_by_slab_pool():
     assert bound_depth(16 << 20, 64 << 20) == 2            # floored
     assert bound_depth(0, 64 << 20) == 32                  # pool off -> cap
     assert bound_depth(512 << 20, 0) == 32                 # unknown batch
+
+
+def test_bound_depth_reserves_hot_cache_budget():
+    """ISSUE 4 satellite: auto-depth growth is sized against the slab pool
+    MINUS the hot cache's byte budget — cache entries hold pool slabs for
+    the run's lifetime, so depth sized on the full pool would double-commit
+    that memory (and conversely, a depth claiming the whole pool would
+    starve admission)."""
+    from strom.delivery.prefetch import bound_depth
+
+    # half the pool reserved: depth halves
+    assert bound_depth(512 << 20, 64 << 20, reserve_bytes=256 << 20) == 4
+    # reserve swallows the pool: floor, never an error
+    assert bound_depth(512 << 20, 64 << 20, reserve_bytes=512 << 20) == 2
+    assert bound_depth(512 << 20, 64 << 20, reserve_bytes=1 << 40,
+                       floor=3) == 3
+    # no reserve = unchanged legacy behavior
+    assert bound_depth(512 << 20, 64 << 20, reserve_bytes=0) == 8
+    # pool off: the cap still wins (nothing to reserve from)
+    assert bound_depth(0, 64 << 20, reserve_bytes=256 << 20) == 32

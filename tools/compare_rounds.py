@@ -86,6 +86,32 @@ STALL_KEYS = [
     "vit_step_ingest_wait_p50_us",
     "vit_predecoded_goodput_pct",
 ]
+# hot-set cache (ISSUE 4 tentpole): the cold/warm epoch pair per vision
+# arm. warm_vs_cold is a same-run ratio (weather-independent: both epochs
+# ride the same relay/disk state seconds apart) and the hit/miss byte
+# deltas prove WHERE warm traffic came from — warm misses ~ 0 means the
+# engine (and the read stall bucket) collapsed on repeat traffic. Suffixes
+# are single-sourced in strom.delivery.hotcache.CACHE_BENCH_FIELDS
+# (parity-tested in tests/test_compare_rounds.py, same contract as the
+# decode/stall sections).
+CACHE_KEYS = [
+    "resnet_warm_vs_cold",
+    "resnet_cold_images_per_s",
+    "resnet_warm_images_per_s",
+    "resnet_cache_hit_bytes",
+    "resnet_cache_miss_bytes",
+    "resnet_cache_readahead_bytes",
+    "resnet_predecoded_warm_vs_cold",
+    "resnet_predecoded_cold_images_per_s",
+    "resnet_predecoded_warm_images_per_s",
+    "resnet_predecoded_cache_hit_bytes",
+    "resnet_predecoded_cache_miss_bytes",
+    "vit_warm_vs_cold",
+    "vit_cache_hit_bytes",
+    "vit_cache_miss_bytes",
+    "vit_predecoded_warm_vs_cold",
+    "vit_predecoded_cache_hit_bytes",
+]
 # per-attempt / per-pass audit arrays (VERDICT.md r4 next #3): printed so
 # the best-of selection's discards are visible in the comparison too
 AUDIT_SUFFIXES = ("_attempts", "_passes")
@@ -181,8 +207,10 @@ def main(argv: list[str]) -> int:
                       for k in DECODE_KEYS)
     have_stall = any(cell(d, k) != "-" for _, d in rounds
                      for k in STALL_KEYS)
+    have_cache = any(cell(d, k) != "-" for _, d in rounds
+                     for k in CACHE_KEYS)
     name_w = max(len(k) for k in binding_keys + CONTEXT_KEYS + DECODE_KEYS
-                 + STALL_KEYS + audit_keys) + 2
+                 + STALL_KEYS + CACHE_KEYS + audit_keys) + 2
     # every rendered cell folds into ONE column width, or rows misalign
     col_w = max(max(len(n) for n, _ in rounds) + 2, 12,
                 *(len(c) + 2 for cs in audit_cells.values() for c in cs),
@@ -208,6 +236,12 @@ def main(argv: list[str]) -> int:
         print("stall attribution (per-step goodput + where the waits "
               "went; 100 goodput = 0-stall):")
         for k in STALL_KEYS:
+            print(k.ljust(name_w)
+                  + "".join(cell(d, k).rjust(col_w) for _, d in rounds))
+    if have_cache:
+        print("hot-set cache (cold/warm epoch pair: warm serves from RAM; "
+              "warm miss ~0 = read bucket collapsed):")
+        for k in CACHE_KEYS:
             print(k.ljust(name_w)
                   + "".join(cell(d, k).rjust(col_w) for _, d in rounds))
     if audit_keys:

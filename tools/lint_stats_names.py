@@ -31,6 +31,17 @@ _CALL = re.compile(
         \(\s*f?["']([^"']+)["']""",
     re.VERBOSE)
 
+# single-sourced metric-name tuples (STALL_FIELDS, CACHE_BENCH_FIELDS, the
+# compare_rounds *_KEYS column lists, cli _DECODE_COUNTERS, ...): their
+# literals name the SAME series the producers feed, so a restyled spelling
+# here forks a dashboard column exactly like a restyled call site — scan
+# every string literal inside the declaration's bracket (ISSUE 4 satellite:
+# the cache bench/report columns are linted tier-1 alongside the counters)
+_FIELDS_DECL = re.compile(
+    r"^_?[A-Z][A-Z0-9_]*_(?:FIELDS|KEYS|COUNTERS)\s*=\s*(?:tuple|list)?\s*[\(\[]",
+    re.MULTILINE)
+_STR_LIT = re.compile(r"""["']([^"'\n]+)["']""")
+
 # source roots that feed the global registry
 DEFAULT_ROOTS = ("strom", "tools", "bench.py")
 
@@ -61,10 +72,25 @@ def scan_sources(root_dir: str, roots=DEFAULT_ROOTS
                 text = f.read()
         except OSError:
             continue
+        rel = os.path.relpath(path, root_dir)
         for m in _CALL.finditer(text):
             line = text.count("\n", 0, m.start()) + 1
-            rel = os.path.relpath(path, root_dir)
             found[_normalize(m.group(1))].add((m.group(1), f"{rel}:{line}"))
+        for m in _FIELDS_DECL.finditer(text):
+            # scan to the declaration's closing bracket (nesting-aware:
+            # list-comprehension tuples like STALL_FIELDS nest brackets)
+            depth, end = 1, m.end()
+            while end < len(text) and depth:
+                c = text[end]
+                if c in "([":
+                    depth += 1
+                elif c in ")]":
+                    depth -= 1
+                end += 1
+            for s in _STR_LIT.finditer(text, m.end(), end):
+                line = text.count("\n", 0, s.start()) + 1
+                found[_normalize(s.group(1))].add(
+                    (s.group(1), f"{rel}:{line}"))
     return found
 
 
