@@ -199,6 +199,47 @@ def main() -> int:
                         "skipped_phases": list(skipped_phases),
                         "global_stats": _pgs.snapshot()})
 
+    # --- hard-kill guard (ISSUE 5 satellite): BENCH_r05 ended rc=124 with
+    # --- parsed:null — the driver's `timeout` SIGTERM landed mid-phase and
+    # --- the round's structured evidence never reached stdout, despite the
+    # --- per-phase budgets AND the on-disk partial. The driver parses
+    # --- STDOUT, so the guard prints the accumulated per-phase results as
+    # --- the final single-line JSON the moment a SIGTERM arrives, and a
+    # --- SIGALRM armed at (budget - margin) does the same even if the
+    # --- driver's kill never comes (e.g. a phase wedged past every budget
+    # --- check). Either way: rc=0, valid JSON, partial=True.
+    import signal
+
+    GUARD_MARGIN_S = 20
+
+    def _emergency_flush(signum, frame):
+        doc = {**partial_state, "partial": True,
+               "budget_s": args.budget,
+               "elapsed_s": round(time.monotonic() - t_start, 1),
+               "skipped_phases": list(skipped_phases)
+               + [f"killed:{signal.Signals(signum).name}"]}
+        write_artifact(doc)
+        try:
+            # raw fd write, NOT print(): the handler can fire while the
+            # main thread is mid-print — a buffered write here would either
+            # glue the JSON onto a half-written line or die with a
+            # reentrant-call RuntimeError, and either way the driver's
+            # line scrape loses the evidence. The leading newline detaches
+            # the JSON from any partial line already on stdout.
+            payload = ("\n" + json.dumps(doc) + "\n").encode()
+            os.write(1, payload)
+        finally:
+            # skip atexit/GC: a wedged engine thread or relay RPC must not
+            # outlive the flush into the driver's SIGKILL window
+            os._exit(0)
+
+    signal.signal(signal.SIGTERM, _emergency_flush)
+    signal.signal(signal.SIGALRM, _emergency_flush)
+    if args.budget > GUARD_MARGIN_S * 2:
+        # tiny smoke budgets skip the alarm (it would fire into a healthy
+        # run); the SIGTERM guard alone covers them
+        signal.alarm(int(args.budget) - GUARD_MARGIN_S)
+
     def remaining() -> float:
         return args.budget - (time.monotonic() - t_start)
 
@@ -483,6 +524,18 @@ def main() -> int:
             for k in CACHE_BENCH_FIELDS:
                 if k in res:
                     loader_res[f"{prefix}_{k}"] = res[k]
+            # intra-batch streaming columns (ISSUE 5): batches on the
+            # completion-driven path, samples decoded while later extents
+            # were in flight, first-decode latency and tail-extent spread
+            # (single-sourced key list: strom.delivery.stream.STREAM_FIELDS)
+            from strom.delivery.stream import STREAM_FIELDS
+
+            if "stream_intra_batch" in res:
+                loader_res[f"{prefix}_stream_intra_batch"] = \
+                    res["stream_intra_batch"]
+            for k in STREAM_FIELDS:
+                if k in res:
+                    loader_res[f"{prefix}_{k}"] = res[k]
             if res.get("warm_images_per_s") is not None:
                 print(f"{name} hot-cache epochs: cold "
                       f"{res.get('cold_images_per_s')} img/s -> warm "
@@ -503,6 +556,21 @@ def main() -> int:
 
         vision_arm("resnet", bench_resnet, rargs,
                    "resnet", "resnet_data_stalls")
+
+        # ISSUE 5 acceptance A/B: the SAME resnet JPEG arm with intra-batch
+        # streaming disabled (--no-stream) — batches are bit-identical, so
+        # the resnet_* vs resnet_nostream_* diff in ingest-wait p50 and
+        # data-stall steps prices exactly the completion-driven dataflow.
+        # The compared flat-out/train phases run with the hot cache
+        # DISABLED in both arms (_bench_cache_scope gates it to the
+        # cold/warm epoch pair, and readahead follows cache.enabled), so
+        # the A/B is cache-clean; hot_cache_bytes=0 here just skips the
+        # nostream arm's (A/B-irrelevant) epoch pair to save budget.
+        nsargs = argparse.Namespace(**{**vars(rargs), "no_stream": True,
+                                       "hot_cache_bytes": 0,
+                                       "readahead_window": 0})
+        vision_arm("resnet NO-STREAM", bench_resnet, nsargs,
+                   "resnet_nostream", "resnet_nostream_data_stalls")
 
         # config #2, decode-free arm: the JPEG numbers above stall by
         # construction on this 1-core box (decode and the consumer share the
@@ -965,6 +1033,11 @@ def main() -> int:
     # alongside (the printed line stays the curated schema)
     write_artifact({**out, "partial": False,
                     "global_stats": global_stats.snapshot()})
+    # disarm the kill guard: the real artifact is complete, and a late
+    # signal re-printing the partial would become the LAST stdout line —
+    # exactly what a line-scraping driver would then parse
+    signal.alarm(0)
+    signal.signal(signal.SIGTERM, signal.SIG_DFL)
     print(json.dumps(out))
     return 0
 

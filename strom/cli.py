@@ -646,32 +646,43 @@ _DECODE_COUNTERS = ("decode_reduced_hits_2", "decode_reduced_hits_4",
                     "decode_errors", "decode_put_overlap_ms")
 
 
+def _hist_delta(snap0: dict, snap1: dict, stem: str) -> tuple[float, float]:
+    """(p50_us, mean_us) of histogram *stem* over the snapshot delta —
+    shared by the decode and stream bench columns so the bucket math (and
+    the mean*count fallback for snapshots predating exact ``*_total_us``
+    sums) can never diverge between them."""
+    from strom.utils.stats import percentile_from_buckets
+
+    b0 = snap0.get(stem + "_hist") or []
+    b1 = snap1.get(stem + "_hist") or []
+    db = [a - b for a, b in zip(b1, b0)] if b0 else list(b1)
+    n = sum(db)
+
+    def _tot(snap: dict) -> float:
+        t = snap.get(stem + "_total_us")
+        if t is None:
+            t = snap.get(stem + "_mean_us", 0.0) \
+                * snap.get(stem + "_count", 0)
+        return t
+
+    tot = _tot(snap1) - _tot(snap0)
+    return (percentile_from_buckets(db, 0.50),
+            round(tot / n, 1) if n else 0.0)
+
+
 def _decode_stats_delta(snap0: dict) -> dict:
     """Decode-path counter AND histogram deltas since *snap0* (the process
     -global registry is shared across bench phases in one process — same
     delta discipline as bench_parquet's scheduler counters; a cumulative
     p50 would bill the resnet arm's batches to the vit arm's column)."""
-    from strom.utils.stats import global_stats, percentile_from_buckets
+    from strom.utils.stats import global_stats
 
     snap1 = global_stats.snapshot()
     out = {k: int(snap1.get(k, 0) - snap0.get(k, 0))
            for k in _DECODE_COUNTERS}
-    b0 = snap0.get("decode_batch_hist") or []
-    b1 = snap1.get("decode_batch_hist") or []
-    db = [a - b for a, b in zip(b1, b0)] if b0 else list(b1)
-    n = sum(db)
-    # exact accumulated sums when the snapshot carries them (it does since
-    # the exposition fix), mean*count reconstruction as the fallback
-    def _tot(snap: dict) -> float:
-        t = snap.get("decode_batch_total_us")
-        if t is None:
-            t = snap.get("decode_batch_mean_us", 0.0) \
-                * snap.get("decode_batch_count", 0)
-        return t
-
-    tot = _tot(snap1) - _tot(snap0)
-    out["decode_batch_p50_us"] = percentile_from_buckets(db, 0.50)
-    out["decode_batch_mean_us"] = round(tot / n, 1) if n else 0.0
+    p50, mean = _hist_delta(snap0, snap1, "decode_batch")
+    out["decode_batch_p50_us"] = p50
+    out["decode_batch_mean_us"] = mean
     return out
 
 
@@ -682,7 +693,44 @@ def _decode_config_kw(args: argparse.Namespace) -> dict:
         "decode_reduced_scale": not getattr(args, "full_decode", False),
         "decode_to_slot": not getattr(args, "no_slot_decode", False),
         "decode_overlap_put": not getattr(args, "no_overlap_put", False),
+        # intra-batch streaming (ISSUE 5): --no-stream is the A/B flag that
+        # restores the gather-ALL → decode-ALL → put-ALL barrier path
+        # (bit-identical batches, so the stall columns are the only diff);
+        # an explicit --stream wins over --no-stream
+        "stream_intra_batch": bool(getattr(args, "stream", False))
+        or not getattr(args, "no_stream", False),
     }
+
+
+def _stream_stats_begin() -> None:
+    """Arm-scope the stream peak gauge: a max-gauge cannot be
+    delta'd, so each bench arm zeroes it where it snapshots its counter
+    baseline — otherwise the --no-stream A/B arm (and every later arm)
+    would report the PREVIOUS arm's peak as its own."""
+    from strom.utils.stats import global_stats
+
+    global_stats.set_gauge("stream_inflight_peak", 0)
+
+
+def _stream_stats_delta(snap0: dict) -> dict:
+    """Streaming-path counter/histogram deltas since *snap0* — the bench
+    columns proving the completion-driven dataflow engaged (single-sourced
+    key list: strom.delivery.stream.STREAM_FIELDS; same delta discipline as
+    ``_decode_stats_delta``). ``stream_inflight_peak`` is a max-gauge
+    zeroed at arm start (``_stream_stats_begin``), so the value IS this
+    arm's peak."""
+    from strom.utils.stats import global_stats
+
+    snap1 = global_stats.snapshot()
+    out = {k: int(snap1.get(k, 0) - snap0.get(k, 0))
+           for k in ("stream_batches", "stream_instant_bytes",
+                     "stream_samples_early")}
+    out["stream_inflight_peak"] = int(snap1.get("stream_inflight_peak", 0))
+    for stem in ("stream_first_decode_lat", "stream_tail_extent"):
+        p50, mean = _hist_delta(snap0, snap1, stem)
+        out[stem + "_p50_us"] = p50
+        out[stem + "_mean_us"] = mean
+    return out
 
 
 def _obs_config_kw(args: argparse.Namespace) -> dict:
@@ -816,6 +864,7 @@ def bench_resnet(args: argparse.Namespace) -> dict:
     _bench_cache_scope(ctx)
     from strom.utils.stats import global_stats as _gs
 
+    _stream_stats_begin()  # arm-scope the stream peak gauge
     _dec0 = _gs.snapshot()
     try:
         n_dev = _fit_dp_devices(args.batch)
@@ -872,7 +921,8 @@ def bench_resnet(args: argparse.Namespace) -> dict:
         if not predecoded:
             out.update({"decode_reduced_scale": cfg.decode_reduced_scale,
                         "decode_to_slot": cfg.decode_to_slot,
-                        "decode_overlap_put": cfg.decode_overlap_put})
+                        "decode_overlap_put": cfg.decode_overlap_put,
+                        "stream_intra_batch": cfg.stream_intra_batch})
         if cfg.hot_cache_bytes:
             # ISSUE 4 satellite: cold/warm epoch pair — repeat traffic must
             # serve from the hot cache, not NVMe (see _cache_epoch_phases)
@@ -928,6 +978,7 @@ def bench_resnet(args: argparse.Namespace) -> dict:
                              "bounded_train_images_per_s", data_paths)
         if not predecoded:
             out.update(_decode_stats_delta(_dec0))
+            out.update(_stream_stats_delta(_dec0))
     finally:
         ctx.close()
     return out
@@ -961,6 +1012,7 @@ def bench_vit(args: argparse.Namespace) -> dict:
     _bench_cache_scope(ctx)
     from strom.utils.stats import global_stats as _gs
 
+    _stream_stats_begin()  # arm-scope the stream peak gauge
     _dec0 = _gs.snapshot()
     try:
         predecoded = bool(getattr(args, "predecoded", False))
@@ -1018,7 +1070,8 @@ def bench_vit(args: argparse.Namespace) -> dict:
         if not predecoded:
             out.update({"decode_reduced_scale": cfg.decode_reduced_scale,
                         "decode_to_slot": cfg.decode_to_slot,
-                        "decode_overlap_put": cfg.decode_overlap_put})
+                        "decode_overlap_put": cfg.decode_overlap_put,
+                        "stream_intra_batch": cfg.stream_intra_batch})
         if cfg.hot_cache_bytes:
             # ISSUE 4 satellite: cold/warm epoch pair over the striped set —
             # the warm epoch's stripe gathers collapse into RAM memcpys
@@ -1071,6 +1124,7 @@ def bench_vit(args: argparse.Namespace) -> dict:
                              "bounded_train_images_per_s", members)
         if not predecoded:
             out.update(_decode_stats_delta(_dec0))
+            out.update(_stream_stats_delta(_dec0))
     finally:
         ctx.close()
     return out
@@ -1457,6 +1511,14 @@ def _add_decode_flags(p: argparse.ArgumentParser) -> None:
                    dest="no_overlap_put",
                    help="disable overlapped shard delivery: decode the whole "
                         "batch, then device_put each device group serially")
+    p.add_argument("--no-stream", action="store_true", dest="no_stream",
+                   help="disable intra-batch streaming (ISSUE 5): restore "
+                        "the gather-ALL -> decode-ALL -> put-ALL barrier "
+                        "path — the A/B control for the completion-driven "
+                        "read->decode->put dataflow (batches bit-identical)")
+    p.add_argument("--stream", action="store_true", dest="stream",
+                   help="explicitly enable intra-batch streaming (the "
+                        "default; pairs with --no-stream for A/B scripts)")
 
 
 def _add_cache_flags(p: argparse.ArgumentParser) -> None:

@@ -137,6 +137,17 @@ class StromConfig:
     # union then transferring serially. Implies decode_to_slot mechanics.
     decode_overlap_put: bool = True
 
+    # intra-batch streaming (strom/delivery/stream.py — ISSUE 5 tentpole):
+    # the JPEG vision batch path submits its gather through the engine's
+    # async vectored API and hands each sample to the decode pool the
+    # moment its extents complete (hot-cache hits count as instant
+    # completions) — read, decode, and per-device put overlap at extent
+    # granularity WITHIN a batch instead of running gather-ALL → decode-ALL
+    # → put-ALL. Requires decode_to_slot + decode_overlap_put mechanics
+    # (falls back to the barrier path when a custom transform lacks out=).
+    # Batches are bit-identical either way (--no-stream is the A/B flag).
+    stream_intra_batch: bool = True
+
     # hot-set host cache (strom/delivery/hotcache.py — ISSUE 4 tentpole):
     # an extent-keyed, byte-budgeted, refcounted LRU of physical byte
     # ranges in slab-pool-backed host buffers, consulted by the delivery

@@ -4,3 +4,4 @@ from strom.delivery.handle import DMAHandle  # noqa: F401
 from strom.delivery.hotcache import HotCache, Readahead  # noqa: F401
 from strom.delivery.prefetch import Prefetcher, bound_depth  # noqa: F401
 from strom.delivery.shard import contiguous_segments, plan_sharded_read  # noqa: F401
+from strom.delivery.stream import STREAM_FIELDS, StreamingGather  # noqa: F401

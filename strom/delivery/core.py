@@ -481,27 +481,19 @@ class StromContext:
         return em
 
     # -- raw range read into a fresh aligned slab ---------------------------
-    def _read_segments(self, source: "Source",
-                       segments: Sequence[Segment],
-                       dest: "np.ndarray | None",
-                       base_offset: int = 0, *, _warm: bool = False) -> int:
-        """Read (file_offset+base_offset → dest_offset) segments, chunked at
-        block_size, pipelined at queue_depth. Returns total bytes read.
-        Raises EngineError on any failed or short chunk.
-
-        The hot-set cache (when configured) is consulted AFTER physical
-        expansion — (path, physical offset) is the only key that repeats
-        across epochs; logical ExtentList offsets are batch-relative and
-        coalescing merges differently per shuffle order — and BEFORE engine
-        submission: cached ranges memcpy from RAM into *dest*, only the
-        miss runs reach the engine (a full hit skips it entirely), and miss
-        bytes are offered for admission once the gather lands.
-
-        ``_warm=True`` is the readahead path: cached ranges are skipped
-        (*dest* may be None — a slab is allocated only once misses exist),
-        misses are read in engine-budget slices that yield to demand
-        gathers, every read byte is force-admitted, and a short pass
-        returns quietly instead of raising."""
+    def _plan_chunks(self, source: "Source", segments: Sequence[Segment],
+                     base_offset: int = 0
+                     ) -> tuple[list[tuple[int, int, int, int]],
+                                dict[int, str]]:
+        """Expand logical (file_offset+base_offset → dest_offset) segments
+        into the physical (file_index, file_offset, dest_offset, length)
+        chunk list an engine gather executes: striped-alias resolution,
+        segment/op coalescing, stripe windows, and extent-aware ordering all
+        applied. Shared by the blocking read path (:meth:`_read_segments`)
+        and the completion-driven streaming path
+        (:class:`strom.delivery.stream.StreamingGather`) so their plans can
+        never drift. Returns ``(chunks, idx_paths)`` where *idx_paths* maps
+        file indexes back to paths (hot-cache keys, FIEMAP lookups)."""
         cfg = self.config
         source = self.resolve_source(source)
         if self._numa is not None:
@@ -620,11 +612,72 @@ class StromContext:
                     maps[fi] = em
             if maps:
                 chunks = plan_chunks_multi(chunks, maps)
+        return chunks, idx_paths
 
-        # Hot-set cache consult (ISSUE 4 tentpole): split every physical
-        # chunk into cached ranges (memcpy'd from RAM into dest under a pin
-        # that blocks eviction) and miss runs (the only ops the engine
-        # sees). Full hit => the engine is skipped entirely.
+    def _consult_cache(self, cache, chunks: list[tuple[int, int, int, int]],
+                       idx_paths: dict[int, str],
+                       dflat: "np.ndarray | None", *, warm: bool = False
+                       ) -> tuple[list[tuple[int, int, int, int]], int,
+                                  list[tuple[int, int]]]:
+        """Hot-set cache consult (ISSUE 4 tentpole): split every physical
+        chunk into cached ranges (memcpy'd from RAM into *dflat* under a pin
+        that blocks eviction) and miss runs (the only ops the engine sees).
+        Full hit => the engine is skipped entirely. Returns ``(miss_chunks,
+        hit_bytes, hit_ranges)`` — *hit_ranges* are the dest [lo, hi) spans
+        served from RAM, which the streaming path reports as INSTANT
+        completions. ``warm=True`` (readahead) records nothing and never
+        copies (*dflat* may be None)."""
+        cache_hit = 0
+        t0 = _events_ring.now_us()
+        miss_chunks: list[tuple[int, int, int, int]] = []
+        hit_ranges: list[tuple[int, int]] = []
+        pinned: list = []
+        for fi, fo, do, ln in chunks:
+            path = idx_paths.get(fi)
+            if path is None:  # untracked fd: bypass the cache
+                miss_chunks.append((fi, fo, do, ln))
+                continue
+            hits, misses, pins = cache.lookup(path, fo, fo + ln,
+                                              record=not warm)
+            pinned.extend(pins)
+            for s, t, view in hits:
+                if not warm:  # warm mode discards dest: skip the copy
+                    dflat[do + (s - fo): do + (t - fo)] = view
+                    hit_ranges.append((do + (s - fo), do + (t - fo)))
+                cache_hit += t - s
+            for s, t in misses:
+                miss_chunks.append((fi, s, do + (s - fo), t - s))
+        cache.unpin(pinned)
+        if cache_hit and not warm:
+            _events_ring.complete(t0, _events_ring.now_us() - t0,
+                                  "cache", "cache.serve",
+                                  {"bytes": cache_hit})
+        return miss_chunks, cache_hit, hit_ranges
+
+    def _read_segments(self, source: "Source",
+                       segments: Sequence[Segment],
+                       dest: "np.ndarray | None",
+                       base_offset: int = 0, *, _warm: bool = False) -> int:
+        """Read (file_offset+base_offset → dest_offset) segments, chunked at
+        block_size, pipelined at queue_depth. Returns total bytes read.
+        Raises EngineError on any failed or short chunk.
+
+        The hot-set cache (when configured) is consulted AFTER physical
+        expansion — (path, physical offset) is the only key that repeats
+        across epochs; logical ExtentList offsets are batch-relative and
+        coalescing merges differently per shuffle order — and BEFORE engine
+        submission: cached ranges memcpy from RAM into *dest*, only the
+        miss runs reach the engine (a full hit skips it entirely), and miss
+        bytes are offered for admission once the gather lands.
+
+        ``_warm=True`` is the readahead path: cached ranges are skipped
+        (*dest* may be None — a slab is allocated only once misses exist),
+        misses are read in engine-budget slices that yield to demand
+        gathers, every read byte is force-admitted, and a short pass
+        returns quietly instead of raising."""
+        cfg = self.config
+        chunks, idx_paths = self._plan_chunks(source, segments, base_offset)
+
         cache = self._hot_cache
         if cache is not None and not cache.enabled:
             cache = None
@@ -634,29 +687,8 @@ class StromContext:
             if not _warm:  # warm mode never copies into dest (may be None)
                 dflat = dest if dest.ndim == 1 and dest.dtype == np.uint8 \
                     else dest.reshape(-1).view(np.uint8)
-            t0 = _events_ring.now_us()
-            miss_chunks: list[tuple[int, int, int, int]] = []
-            pinned: list = []
-            for fi, fo, do, ln in chunks:
-                path = idx_paths.get(fi)
-                if path is None:  # untracked fd: bypass the cache
-                    miss_chunks.append((fi, fo, do, ln))
-                    continue
-                hits, misses, pins = cache.lookup(path, fo, fo + ln,
-                                                  record=not _warm)
-                pinned.extend(pins)
-                for s, t, view in hits:
-                    if not _warm:  # warm mode discards dest: skip the copy
-                        dflat[do + (s - fo): do + (t - fo)] = view
-                    cache_hit += t - s
-                for s, t in misses:
-                    miss_chunks.append((fi, s, do + (s - fo), t - s))
-            cache.unpin(pinned)
-            if cache_hit and not _warm:
-                _events_ring.complete(t0, _events_ring.now_us() - t0,
-                                      "cache", "cache.serve",
-                                      {"bytes": cache_hit})
-            chunks = miss_chunks
+            chunks, cache_hit, _ = self._consult_cache(
+                cache, chunks, idx_paths, dflat, warm=_warm)
 
         if _warm:
             return self._warm_read_chunks(chunks, dest, idx_paths)
@@ -760,6 +792,36 @@ class StromContext:
             if acquired is not None and self._slab_pool is not None:
                 self._slab_pool.release(acquired)
         return total
+
+    def alloc_read_buffer(self, source: "Source", nbytes: int) -> np.ndarray:
+        """A fresh aligned host buffer for gathers from *source*, NUMA-bound
+        the same way ``pread`` binds its slab — the allocation path for
+        callers (the streamed batch assembly) that drive the gather
+        themselves instead of going through pread."""
+        dest = alloc_aligned(nbytes)
+        if self._numa is not None and \
+                self._numa.resolve(self._numa_path(
+                    self.resolve_source(source))) is not None:
+            self._numa.bind(dest)
+        return dest
+
+    # -- completion-driven streaming gather (ISSUE 5 tentpole) --------------
+    def stream_segments(self, source: "Source", segments: Sequence[Segment],
+                        dest: np.ndarray, base_offset: int = 0):
+        """Begin a completion-driven gather of *segments* into *dest*: the
+        same plan ``_read_segments`` would execute (striped aliases,
+        coalescing, stripe windows, extent-aware ordering, hot-cache
+        consult), but submitted through the engine's async vectored API so
+        dest ranges surface the moment their extents land — cache hits as
+        instant completions, the engine never waited on. Returns a
+        :class:`strom.delivery.stream.StreamingGather`; see its docstring
+        for the poll/finish/close protocol. The gather owns the engine's
+        transfer path (engine lock + demand gate) until finish/close."""
+        from strom.delivery.stream import StreamingGather
+
+        if self._closed:
+            raise RuntimeError("StromContext is closed")
+        return StreamingGather(self, source, segments, dest, base_offset)
 
     def warm(self, source: "Source", segments: Sequence[Segment],
              base_offset: int = 0) -> int:
@@ -1240,6 +1302,35 @@ class StromContext:
             "decode_batch_total_us": dh.total_us,
             "decode_batch_count": dh.count,
             "decode_batch_hist": list(dh.buckets),
+        }
+        # intra-batch streaming observability (ISSUE 5 tentpole): batches
+        # that took the completion-driven path, the peak async depth, bytes
+        # served as instant (cache) completions, the first-decode latency
+        # (gather start -> first sample handed to the decode pool) and the
+        # tail-extent spread (first -> last completion: the wait the old
+        # barrier imposed on EVERY sample; with streaming, work overlapped
+        # it). Flat keys, full metric names — same exposition contract as
+        # the cache section.
+        fd = global_stats.histogram("stream_first_decode_lat")
+        te = global_stats.histogram("stream_tail_extent")
+        out["stream"] = {
+            "stream_batches": global_stats.counter("stream_batches").value,
+            "stream_inflight_peak":
+                global_stats.gauge("stream_inflight_peak").value,
+            "stream_instant_bytes":
+                global_stats.counter("stream_instant_bytes").value,
+            "stream_samples_early":
+                global_stats.counter("stream_samples_early").value,
+            "stream_first_decode_lat_p50_us": fd.percentile(0.50),
+            "stream_first_decode_lat_mean_us": fd.mean_us,
+            "stream_first_decode_lat_total_us": fd.total_us,
+            "stream_first_decode_lat_count": fd.count,
+            "stream_first_decode_lat_hist": list(fd.buckets),
+            "stream_tail_extent_p50_us": te.percentile(0.50),
+            "stream_tail_extent_mean_us": te.mean_us,
+            "stream_tail_extent_total_us": te.total_us,
+            "stream_tail_extent_count": te.count,
+            "stream_tail_extent_hist": list(te.buckets),
         }
         # per-step stall attribution from the event ring (ISSUE 3 tentpole):
         # goodput_pct + ingest-wait/decode/put/read/compute bucket p50/p99

@@ -1,0 +1,256 @@
+"""Completion-driven intra-batch streaming (ISSUE 5 tentpole).
+
+The blocking hot path runs each batch as gather-ALL → decode-ALL → put-ALL:
+every sample in the batch waits for the slowest extent before the first
+decode starts, so completions sit idle in the ring while decode workers
+starve (the ingest-wait bucket the stall attribution keeps billing the JPEG
+arm). :class:`StreamingGather` removes that barrier: it plans a batch gather
+exactly like ``StromContext._read_segments`` (same striped-alias resolution,
+coalescing, stripe windows, extent-aware ordering — shared via
+``_plan_chunks``), submits it through the engine's async vectored API
+(``submit_vectored``/``poll``/``drain``, ISSUE 5 engine layer), and surfaces
+CHUNK-granular dest-range completions the moment they land — hot-cache hits
+count as INSTANT completions (served before the engine sees a single op).
+The vision pipelines map completed ranges onto samples and hand each sample
+to the decode pool the moment its extents are in, so read, decode, and
+device_put overlap at extent granularity *within* one batch, not just
+across batches.
+
+Ordering / lifecycle rules (documented in ARCHITECTURE.md "Intra-batch
+streaming"):
+
+- Completions are UNORDERED across chunks (the whole point); each dest byte
+  completes exactly once — ranges from distinct completions never overlap,
+  so per-sample byte accounting is a plain countdown.
+- The gather owns the engine's transfer path from construction to
+  close/finish: the delivery engine lock (per-ring locks on the multi
+  engine) is held for the token's lifetime, and the demand gate is entered
+  so readahead yields exactly as it does to a blocking gather.
+- Hot-cache pins taken while serving hits are dropped before construction
+  returns (the bytes are already memcpy'd into *dest*); admission offers
+  for miss chunks happen per-completion, so an early extent can serve the
+  NEXT batch's lookup while this batch's tail is still in flight.
+- ``close()`` is idempotent and safe mid-flight: the engine token is
+  cancelled (every in-flight piece reaped — no completion may outlive the
+  gather), locks and the demand gate release, and no slab pin survives.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import errno
+from typing import Sequence
+
+import numpy as np
+
+from strom.delivery.shard import Segment
+from strom.engine.base import EngineError
+from strom.obs.events import ring as _events_ring
+from strom.utils.stats import global_stats
+
+# bench-JSON columns the streaming arms emit (cli.py _stream_stats_delta),
+# single-sourced so the driver's per-arm copy loop (bench.py) and the
+# compare_rounds "streaming" section cannot drift from the producer — the
+# same contract STALL_FIELDS / CACHE_BENCH_FIELDS enforce.
+STREAM_FIELDS = (
+    "stream_batches",
+    "stream_inflight_peak",
+    "stream_instant_bytes",
+    "stream_samples_early",
+    "stream_first_decode_lat_p50_us",
+    "stream_first_decode_lat_mean_us",
+    "stream_tail_extent_p50_us",
+    "stream_tail_extent_mean_us",
+)
+
+
+class StreamingGather:
+    """One completion-driven gather of *segments* from *source* into *dest*.
+
+    Protocol::
+
+        g = ctx.stream_segments(source, segments, dest)
+        try:
+            while not g.done:
+                for lo, hi in g.poll():   # dest byte ranges, landed
+                    ...dispatch work on dest[lo:hi]...
+            g.finish()                    # integrity check + stats
+        finally:
+            g.close()                     # idempotent; cancels if unfinished
+
+    ``poll`` first returns the cache-served (instant) ranges, then engine
+    completions as chunks retire. ``finish`` raises the gather's error (the
+    same EngineError surface as ``_read_segments``) only after every
+    in-flight piece has retired.
+    """
+
+    def __init__(self, ctx, source, segments: Sequence[Segment],
+                 dest: np.ndarray, base_offset: int = 0):
+        self._ctx = ctx
+        self._dflat = dest if dest.ndim == 1 and dest.dtype == np.uint8 \
+            else dest.reshape(-1).view(np.uint8)
+        self._closed = False
+        self._finished = False
+        self._token = None
+        self._admitted = 0
+        self.t0_us = _events_ring.now_us()
+        self._first_c_us: int | None = None
+        self._last_c_us: int | None = None
+        # resources held for the gather's lifetime: demand gate (readahead
+        # yields to us) + the delivery engine lock (a live token owns the
+        # engine's gather path exactly like a blocking read_vectored call)
+        self._stack = contextlib.ExitStack()
+        try:
+            chunks, idx_paths = ctx._plan_chunks(source, segments,
+                                                 base_offset)
+            self._idx_paths = idx_paths
+            cache = ctx._hot_cache
+            if cache is not None and not cache.enabled:
+                cache = None
+            self._cache = cache
+            self._instant: list[tuple[int, int]] = []
+            hit_bytes = 0
+            if cache is not None and chunks:
+                chunks, hit_bytes, self._instant = ctx._consult_cache(
+                    cache, chunks, idx_paths, self._dflat)
+            self._chunks = chunks
+            self._miss_planned = sum(ln for (_, _, _, ln) in chunks)
+            self.total_bytes = self._miss_planned + hit_bytes
+            self.instant_bytes = hit_bytes
+            if hit_bytes:
+                global_stats.add("stream_instant_bytes", hit_bytes)
+            if chunks:
+                self._stack.enter_context(ctx._demand_gate())
+                self._stack.enter_context(ctx._engine_lock)
+                self._token = ctx.engine.submit_vectored(
+                    chunks, dest, retries=ctx.config.io_retries)
+            global_stats.add("stream_batches")
+        except BaseException:
+            self._stack.close()
+            self._closed = True
+            raise
+
+    @property
+    def done(self) -> bool:
+        """Every byte accounted for: instants drained and the engine token
+        (if any) retired. ``finish`` must still be called."""
+        return not self._instant \
+            and (self._token is None or self._token.done)
+
+    def poll(self, min_completions: int = 1,
+             timeout_s: float | None = None) -> list[tuple[int, int]]:
+        """Landed dest ranges since the last call. The first call returns
+        the cache-served ranges immediately (instant completions); later
+        calls reap the engine. ``min_completions=0`` never blocks."""
+        if self._closed:
+            return []
+        if self._instant:
+            out, self._instant = self._instant, []
+            now = _events_ring.now_us()
+            if self._first_c_us is None:
+                self._first_c_us = now
+            self._last_c_us = now
+            return out
+        if self._token is None or self._token.done:
+            return []
+        out: list[tuple[int, int]] = []
+        for c in self._ctx.engine.poll(self._token, min_completions,
+                                       timeout_s):
+            if c.result < 0:
+                continue  # error chunk: surfaced by finish() after drain
+            fi, fo, do, ln = self._chunks[c.index]
+            now = _events_ring.now_us()
+            if self._first_c_us is None:
+                self._first_c_us = now
+            self._last_c_us = now
+            out.append((do, do + ln))
+            if self._cache is not None:
+                # admission offer per completion (second-touch policy
+                # decides): the bytes just landed in dest — one memcpy,
+                # never an extra read, and an early extent can serve the
+                # next batch's lookup while this batch's tail is in flight
+                path = self._idx_paths.get(fi)
+                if path is not None:
+                    self._admitted += self._cache.admit(
+                        path, fo, fo + ln, self._dflat[do: do + ln])
+        return out
+
+    def finish(self) -> int:
+        """Drain the token, verify byte accounting, emit the stream span +
+        counters, release the engine lock/demand gate. Returns total bytes
+        (cache hits included). Raises the gather's first error — only after
+        every in-flight piece has retired (no write can race the caller's
+        reaction)."""
+        if self._finished:
+            return self.total_bytes
+        total = self._miss_planned
+        try:
+            if self._token is not None:
+                total = self._ctx.engine.drain(self._token)
+        except EngineError as e:
+            self._release()
+            raise EngineError(e.errno, f"ssd2tpu {e.strerror}") from None
+        if total != self._miss_planned:
+            # cheap insurance, same as _read_segments: any engine
+            # accounting bug surfaces loudly, not as a zero-tailed batch
+            self._release()
+            raise EngineError(
+                errno.EIO, f"ssd2tpu streamed read {total} bytes, "
+                           f"planned {self._miss_planned}")
+        self._release()
+        global_stats.add("ssd2tpu_bytes", self.total_bytes)
+        return self.total_bytes
+
+    def _release(self) -> None:
+        if self._finished:
+            return
+        self._finished = True
+        self._closed = True
+        tok = self._token
+        if tok is not None:
+            global_stats.gauge("stream_inflight_peak").max(tok.inflight_peak)
+            # keep the stall attribution's `read` bucket lit on streamed
+            # batches: the async token never passes through read_vectored's
+            # instrumented wrappers, so the engine window is billed here
+            end = self._last_c_us if self._last_c_us is not None \
+                else _events_ring.now_us()
+            _events_ring.complete(self.t0_us, max(end - self.t0_us, 0),
+                                  "read", "stream.read",
+                                  {"ops": len(self._chunks),
+                                   "bytes": self._miss_planned})
+        if self._first_c_us is not None and self._last_c_us is not None:
+            # the spread the old barrier serialized on: how long the
+            # slowest extent lagged the first completion — with streaming,
+            # work done during this window is the win
+            global_stats.observe_us("stream_tail_extent",
+                                    self._last_c_us - self._first_c_us)
+        if self._admitted:
+            _events_ring.complete(self.t0_us,
+                                  _events_ring.now_us() - self.t0_us,
+                                  "cache", "cache.admit",
+                                  {"bytes": self._admitted})
+        _events_ring.complete(self.t0_us,
+                              _events_ring.now_us() - self.t0_us,
+                              "stream", "stream.gather",
+                              {"bytes": self.total_bytes,
+                               "instant_bytes": self.instant_bytes,
+                               "ops": len(self._chunks)})
+        self._stack.close()
+
+    def close(self) -> None:
+        """Idempotent teardown. A live token is CANCELLED: every in-flight
+        piece is reaped before the engine lock releases, so no completion
+        (and no engine write into *dest*) outlives the gather — the
+        leaked-pin/leaked-completion contract tests assert this."""
+        if self._finished:
+            return
+        if self._token is not None and not self._token.done:
+            with contextlib.suppress(Exception):
+                self._ctx.engine.cancel(self._token)
+        self._release()
+
+    def __enter__(self) -> "StreamingGather":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()

@@ -27,6 +27,7 @@ import abc
 import dataclasses
 import errno
 import os
+import time
 from typing import Iterable, Sequence
 
 import numpy as np
@@ -34,6 +35,7 @@ import numpy as np
 from strom.config import StromConfig
 
 _ENODATA = errno.ENODATA
+_ECANCELED = errno.ECANCELED
 
 
 @dataclasses.dataclass(frozen=True)
@@ -70,8 +72,67 @@ class Completion:
     result: int        # bytes read (>=0) or negative errno
 
 
+@dataclasses.dataclass(frozen=True)
+class ChunkCompletion:
+    """One gather chunk retired by the async vectored path: *index* is the
+    position in the chunk list handed to :meth:`Engine.submit_vectored`;
+    *result* is the chunk's full byte count, or a negative errno when the
+    chunk failed (retries exhausted / short read → -ENODATA)."""
+
+    index: int
+    result: int
+
+
 class EngineError(OSError):
     pass
+
+
+class StreamToken:
+    """Handle for one in-flight vectored gather (:meth:`Engine.submit_vectored`).
+
+    The token owns the submission state machine's bookkeeping: which chunks
+    retired, how many block-size pieces are in flight, and the per-chunk
+    error results. It is NOT thread-safe — exactly one thread drives
+    poll/drain per token (the delivery layer's streaming gather does), the
+    same contract read_vectored has always had.
+    """
+
+    __slots__ = ("chunks", "retries", "_d8", "_left", "_results", "_pending",
+                 "_pieces", "_backlog", "_exhausted", "_ready", "bytes_done",
+                 "cancelled", "inflight_peak", "_err", "chunks_done")
+
+    def __init__(self, chunks: Sequence[tuple[int, int, int, int]],
+                 dest: np.ndarray, block: int, retries: int):
+        self.chunks = list(chunks)
+        self.retries = retries
+        self._d8 = dest.view(np.uint8).reshape(-1)
+        # bytes of each chunk not yet landed; a chunk retires when it hits 0
+        self._left = [ln for (_, _, _, ln) in self.chunks]
+        self._results: list[int | None] = [None] * len(self.chunks)
+        # tag -> (chunk_idx, file_idx, file_off, dest_off, want, attempts)
+        self._pending: dict[int, tuple[int, int, int, int, int, int]] = {}
+        self._pieces = ((ci, fi, fo + p, do + p, min(block, ln - p), 0)
+                        for ci, (fi, fo, do, ln) in enumerate(self.chunks)
+                        for p in range(0, ln, block))
+        # pieces bounced by a full queue (EAGAIN / partial batch accept):
+        # resubmitted before the iterator advances
+        self._backlog: list[tuple[int, int, int, int, int, int]] = []
+        self._exhausted = not self.chunks
+        self._ready: list[ChunkCompletion] = []
+        self.bytes_done = 0
+        self.cancelled = False
+        self.inflight_peak = 0
+        self._err: EngineError | None = None
+        self.chunks_done = 0
+
+    @property
+    def done(self) -> bool:
+        return (self._exhausted and not self._backlog and not self._pending) \
+            or self.cancelled
+
+    @property
+    def error(self) -> EngineError | None:
+        return self._err
 
 
 class Engine(abc.ABC):
@@ -263,6 +324,237 @@ class Engine(abc.ABC):
 
             global_stats.gauge("gather_inflight_peak").max(inflight_peak)
         return total
+
+    # -- async vectored gather: completion-driven submission ---------------
+    # The intra-batch streaming API (ISSUE 5 tentpole): submit a whole
+    # gather, then poll it for CHUNK-granular completions while doing other
+    # work (decode, device_put) between polls — the SQ/CQ decoupling the
+    # blocking read_vectored hides inside one call. On the uring engine the
+    # generic implementation below batches submissions through
+    # sc_submit_raw_batch (one io_uring_enter per refill) and reaps through
+    # sc_wait — real ring-native decoupling; on the python engine the same
+    # code rides the worker pool's submit/done queues. MultiRingEngine
+    # overrides it to fan per-file sub-tokens across member rings.
+    #
+    # Concurrency contract: a live token owns the engine's gather path the
+    # same way a read_vectored call does — the delivery layer holds its
+    # engine lock from submit_vectored until drain/close (per-ring locks on
+    # the multi engine). Exactly one thread drives poll/drain per token.
+
+    def submit_vectored(self, chunks: Sequence[tuple[int, int, int, int]],
+                        dest: np.ndarray, *, retries: int = 1) -> StreamToken:
+        """Begin an async gather of (file_index, file_offset, dest_offset,
+        length) chunks into *dest*. Pieces are submitted up to queue_depth
+        immediately; the rest flow in as :meth:`poll` reaps completions.
+        The returned token must be driven to :meth:`drain` (or handed to
+        :meth:`cancel`) before the engine is used for another transfer."""
+        tok = StreamToken(chunks, dest, self.config.block_size, retries)
+        self._track_token(tok)
+        self._pump_token(tok)
+        return tok
+
+    def poll(self, token: StreamToken, min_completions: int = 1,
+             timeout_s: float | None = None) -> list[ChunkCompletion]:
+        """Advance the gather: reap engine completions, retry failed pieces,
+        top the submission queue back up, and return chunks that fully
+        retired since the last call. Blocks until *min_completions* chunk
+        completions are available (0 = never block), the token is done, or
+        *timeout_s* elapses."""
+        if token.cancelled:
+            raise EngineError(_ECANCELED, "token cancelled (engine closing?)")
+        deadline = None if timeout_s is None else \
+            time.monotonic() + timeout_s
+        self._pump_token(token)
+        while (len(token._ready) < max(min_completions, 1)
+               and token._pending and not token.cancelled):
+            if min_completions <= 0:
+                wait_s = 0.0
+            elif deadline is None:
+                wait_s = None
+            else:
+                wait_s = max(0.0, deadline - time.monotonic())
+            got = self._reap_token(token, wait_s)
+            self._pump_token(token)
+            if min_completions <= 0:
+                break
+            if not got and deadline is not None \
+                    and time.monotonic() >= deadline:
+                break
+        out = token._ready
+        token._ready = []
+        if token.done:
+            self._untrack_token(token)
+        return out
+
+    def drain(self, token: StreamToken) -> int:
+        """Run the token to completion and return total bytes landed.
+        Raises the first chunk error (retries exhausted, short read) AFTER
+        every in-flight piece has retired — a caller reacting to the error
+        can never race live engine writes into its buffer."""
+        while not token.done:
+            self.poll(token, min_completions=1)
+        self._untrack_token(token)
+        if token.cancelled:
+            raise EngineError(_ECANCELED, "token cancelled (engine closing?)")
+        if token._err is not None:
+            raise token._err
+        return token.bytes_done
+
+    def cancel(self, token: StreamToken, timeout_s: float = 30.0) -> None:
+        """Stop feeding the token and reap everything already in flight
+        (the kernel/worker owns the dest bytes until each piece completes —
+        abandoning them would leave writes landing into recycled memory).
+        The token is marked cancelled FIRST — a concurrent poll/drain
+        driver (close() racing a live streamed gather) raises ECANCELED on
+        its next call and stops competing for completions — then the
+        remaining pieces are reaped in short wait slices, re-checking the
+        (possibly concurrently drained) pending set between slices."""
+        token.cancelled = True
+        token._exhausted = True
+        token._backlog.clear()
+        deadline = time.monotonic() + timeout_s
+        while token._pending and time.monotonic() < deadline:
+            self._reap_token(token, 0.05)
+        self._untrack_token(token)
+
+    # token bookkeeping for cancellation-on-close: engines call
+    # _cancel_live_tokens() at the top of close() so no completion is left
+    # in flight against a dying ring/worker pool
+    def _track_token(self, tok: StreamToken) -> None:
+        if not hasattr(self, "_live_tokens"):
+            self._live_tokens: list[StreamToken] = []
+        self._live_tokens.append(tok)
+
+    def _untrack_token(self, tok: StreamToken) -> None:
+        toks = getattr(self, "_live_tokens", None)
+        if toks is not None and tok in toks:
+            toks.remove(tok)
+
+    def _cancel_live_tokens(self) -> None:
+        for tok in list(getattr(self, "_live_tokens", ())):
+            try:
+                self.cancel(tok)
+            except Exception:
+                pass
+
+    def _pump_token(self, tok: StreamToken) -> None:
+        """Refill the submission queue from the backlog + piece iterator up
+        to queue_depth, batched through ONE submit_raw call (one
+        io_uring_enter on the native engine). Partial accepts (a concurrent
+        submitter raced us past the depth pre-check — uring's ``.accepted``
+        contract) push the unaccepted tail back onto the backlog."""
+        if tok._err is not None or tok.cancelled:
+            return
+        qd = self.config.queue_depth
+        while len(tok._pending) < qd:
+            batch: list[tuple[int, int, int, int, int, int]] = []
+            while len(tok._pending) + len(batch) < qd:
+                if tok._backlog:
+                    batch.append(tok._backlog.pop())
+                    continue
+                if tok._exhausted:
+                    break
+                try:
+                    batch.append(next(tok._pieces))
+                except StopIteration:
+                    tok._exhausted = True
+                    break
+            if not batch:
+                return
+            if not hasattr(self, "_vec_tag"):
+                self._vec_tag = 0
+            reqs = []
+            for piece in batch:
+                ci, fi, fo, do, want, attempts = piece
+                tag = self._vec_tag
+                self._vec_tag += 1
+                # registered BEFORE submission: a completion can land (and a
+                # concurrent reap must find the entry) inside submit_raw
+                tok._pending[tag] = piece
+                reqs.append(RawRead(fi, fo, want,
+                                    tok._d8[do: do + want], tag))
+            try:
+                self.submit_raw(reqs)
+            except EngineError as e:
+                if e.errno != errno.EAGAIN:
+                    # unsubmittable op (bad index/addr, closed engine):
+                    # resubmitting is futile — requests past `accepted`
+                    # (0 when absent) never entered the ring; unregister
+                    # them and fail the token (in-flight pieces still
+                    # drain through poll/drain)
+                    accepted = getattr(e, "accepted", 0)
+                    for r in reqs[accepted:]:
+                        tok._pending.pop(r.tag, None)
+                    tok._err = e
+                    tok._exhausted = True
+                    tok._backlog.clear()
+                    return
+                # queue full: requests[accepted:] never entered the ring —
+                # back onto the backlog for the next refill
+                accepted = getattr(e, "accepted", 0)
+                for r, piece in zip(reqs[accepted:], batch[accepted:]):
+                    tok._pending.pop(r.tag, None)
+                    tok._backlog.append(piece)
+                break
+            if len(tok._pending) > tok.inflight_peak:
+                tok.inflight_peak = len(tok._pending)
+        if len(tok._pending) > tok.inflight_peak:
+            tok.inflight_peak = len(tok._pending)
+
+    def _reap_token(self, tok: StreamToken, timeout_s: float | None) -> int:
+        """One wait() round: retire pieces, resubmit failed ones within the
+        retry budget, record chunk completions. Returns completions seen."""
+        try:
+            comps = self.wait(min_completions=1, timeout_s=timeout_s)
+        except EngineError as e:
+            tok._err = tok._err or e
+            tok._exhausted = True
+            tok._backlog.clear()
+            return 0
+        n = 0
+        for c in comps:
+            piece = tok._pending.pop(c.tag, None)
+            if piece is None:
+                continue  # foreign tag: not ours to account
+            n += 1
+            ci, fi, fo, do, want, attempts = piece
+            if c.result < 0 and attempts < tok.retries \
+                    and tok._err is None and not tok.cancelled:
+                from strom.utils.stats import global_stats
+
+                global_stats.add("chunk_retries")
+                tok._backlog.append((ci, fi, fo, do, want, attempts + 1))
+                continue
+            if c.result < 0:
+                err = EngineError(
+                    -c.result, f"read failed after {attempts + 1} attempts: "
+                               f"{os.strerror(-c.result)}")
+            elif c.result < want:
+                tok.bytes_done += c.result
+                err = EngineError(
+                    _ENODATA, f"short read ({c.result} < {want}) — "
+                              "file smaller than requested range?")
+            else:
+                tok.bytes_done += c.result
+                err = None
+            if err is not None:
+                if tok._err is None:
+                    tok._err = err
+                tok._exhausted = True  # stop feeding; drain what's in flight
+                tok._backlog.clear()
+                if tok._results[ci] is None:
+                    tok._results[ci] = -(err.errno or errno.EIO)
+                    tok.chunks_done += 1
+                    tok._ready.append(
+                        ChunkCompletion(ci, tok._results[ci]))
+                continue
+            tok._left[ci] -= want
+            if tok._left[ci] == 0 and tok._results[ci] is None:
+                ln = tok.chunks[ci][3]
+                tok._results[ci] = ln
+                tok.chunks_done += 1
+                tok._ready.append(ChunkCompletion(ci, ln))
+        return n
 
     # -- convenience: synchronous read of an arbitrary range ----------------
     def read_into(self, file_index: int, offset: int, length: int,

@@ -28,13 +28,56 @@ from __future__ import annotations
 import concurrent.futures
 import itertools
 import threading
+import time
 from typing import Sequence
 
 import numpy as np
 
 from strom.config import StromConfig
-from strom.engine.base import Completion, Engine, EngineError, RawRead, ReadRequest
+from strom.engine.base import (ChunkCompletion, Completion, Engine,
+                               EngineError, RawRead, ReadRequest, StreamToken)
 from strom.obs.events import ring as _events
+
+
+class _FanToken:
+    """A multi-ring async gather: one child StreamToken per member ring,
+    chunk indices mapped back to the caller's list. Duck-types the
+    StreamToken surface the delivery layer reads (done / cancelled /
+    bytes_done / inflight_peak / chunks / error)."""
+
+    __slots__ = ("chunks", "parts", "locks", "cancelled", "chunks_done")
+
+    def __init__(self, chunks, parts, locks):
+        self.chunks = list(chunks)
+        # [(ring_index, child_engine, child_token, [parent_chunk_idx]), ...]
+        self.parts = parts
+        self.locks = locks  # acquired ring locks, released exactly once
+        self.cancelled = False
+        self.chunks_done = 0
+
+    @property
+    def done(self) -> bool:
+        return self.cancelled or all(p[2].done for p in self.parts)
+
+    @property
+    def bytes_done(self) -> int:
+        return sum(p[2].bytes_done for p in self.parts)
+
+    @property
+    def inflight_peak(self) -> int:
+        # total concurrent depth across member rings: the fan-out's whole
+        # point is that per-ring queues fill independently
+        return sum(p[2].inflight_peak for p in self.parts)
+
+    @property
+    def error(self) -> EngineError | None:
+        return next((p[2].error for p in self.parts
+                     if p[2].error is not None), None)
+
+    def _release_locks(self) -> None:
+        locks, self.locks = self.locks, []
+        for lk in locks:
+            lk.release()
 
 
 class MultiRingEngine(Engine):
@@ -233,6 +276,141 @@ class MultiRingEngine(Engine):
                 raise err
             return sum(f.result() for f in futs.values())
 
+    # -- async vectored gather: fan tokens across member rings --------------
+    def submit_vectored(self, chunks: Sequence[tuple[int, int, int, int]],
+                        dest: np.ndarray, *, retries: int = 1):
+        """ISSUE 5: the async twin of read_vectored's routing — chunks fan
+        per file onto member rings (member i → ring i mod N, stable) and
+        each ring gets its own child StreamToken; completions map back to
+        the caller's chunk indices. The live rings' transfer locks are held
+        for the token's lifetime (a concurrent blocking gather on the same
+        ring would reap — and drop, as foreign tags — the token's
+        completions), released at drain/cancel."""
+        if self._closed:
+            raise EngineError(9, "engine closed")
+        n = len(self._children)
+        files = {c[0] for c in chunks}
+        per_ring: dict[int, tuple[list, list]] = {}  # ring -> (chunks, imap)
+        if chunks and (n == 1 or len(files) == 1):
+            ring = next(self._rr) % n
+            per_ring[ring] = (
+                [(self._child_index(ring, fi), fo, do, ln)
+                 for (fi, fo, do, ln) in chunks],
+                list(range(len(chunks))))
+        else:
+            for i, (fi, fo, do, ln) in enumerate(chunks):
+                ring = fi % n
+                ch, imap = per_ring.setdefault(ring, ([], []))
+                ch.append((self._child_index(ring, fi), fo, do, ln))
+                imap.append(i)
+        live = sorted(per_ring)  # lock in ring order: no ABBA with a peer
+        locks = []
+        parts = []
+        try:
+            for r in live:
+                self._ring_locks[r].acquire()
+                locks.append(self._ring_locks[r])
+            if len(live) > 1:
+                from strom.utils.stats import global_stats
+
+                global_stats.add("multi_ring_fanout_gathers")
+                global_stats.gauge("multi_ring_fanout_width").max(len(live))
+            for r in live:
+                ch, imap = per_ring[r]
+                parts.append((r, self._children[r],
+                              self._children[r].submit_vectored(
+                                  ch, dest, retries=retries), imap))
+        except BaseException:
+            for _, child, ctok, _ in parts:
+                try:
+                    child.cancel(ctok)
+                except Exception:
+                    pass
+            for lk in locks:
+                lk.release()
+            raise
+        tok = _FanToken(chunks, parts, locks)
+        self._track_token(tok)
+        if tok.done:  # empty gather
+            tok._release_locks()
+            self._untrack_token(tok)
+        return tok
+
+    def poll(self, token, min_completions: int = 1,
+             timeout_s: float | None = None) -> list[ChunkCompletion]:
+        if isinstance(token, StreamToken):  # a child token handed back raw
+            return super().poll(token, min_completions, timeout_s)
+        if token.cancelled:
+            import errno as _errno
+
+            raise EngineError(_errno.ECANCELED,
+                              "token cancelled (engine closing?)")
+        out: list[ChunkCompletion] = []
+        deadline = None if timeout_s is None else \
+            time.monotonic() + timeout_s
+        block_rr = 0
+        while True:
+            live = [(child, ctok, imap)
+                    for _, child, ctok, imap in token.parts
+                    if not ctok.done]
+            for child, ctok, imap in live:
+                for c in child.poll(ctok, min_completions=0):
+                    token.chunks_done += 1
+                    out.append(ChunkCompletion(imap[c.index], c.result))
+            if (len(out) >= min_completions or min_completions <= 0
+                    or token.done):
+                break
+            if deadline is not None and time.monotonic() >= deadline:
+                break
+            # block briefly on ONE unfinished ring (rotating), so a quiet
+            # ring can't starve completions sitting ready on another
+            live = [(child, ctok, imap)
+                    for _, child, ctok, imap in token.parts
+                    if not ctok.done]
+            if not live:
+                break
+            child, ctok, imap = live[block_rr % len(live)]
+            block_rr += 1
+            wait_s = 0.005
+            if deadline is not None:
+                wait_s = min(wait_s, max(0.0, deadline - time.monotonic()))
+            for c in child.poll(ctok, min_completions=1, timeout_s=wait_s):
+                token.chunks_done += 1
+                out.append(ChunkCompletion(imap[c.index], c.result))
+        if token.done:
+            token._release_locks()
+            self._untrack_token(token)
+        return out
+
+    def drain(self, token) -> int:
+        if isinstance(token, StreamToken):
+            return super().drain(token)
+        while not token.done:
+            self.poll(token, min_completions=1)
+        token._release_locks()
+        self._untrack_token(token)
+        if token.cancelled:
+            import errno as _errno
+
+            raise EngineError(_errno.ECANCELED,
+                              "token cancelled (engine closing?)")
+        err = token.error
+        if err is not None:
+            raise err
+        return token.bytes_done
+
+    def cancel(self, token, timeout_s: float = 30.0) -> None:
+        if isinstance(token, StreamToken):
+            return super().cancel(token, timeout_s)
+        for _, child, ctok, _ in token.parts:
+            try:
+                child.cancel(ctok, timeout_s)
+            except Exception:
+                pass
+        token.cancelled = True
+        token._release_locks()
+        self._untrack_token(token)
+
     # -- observability ------------------------------------------------------
     def stats(self) -> dict:
         per_ring = [c.stats() for c in self._children]
@@ -293,6 +471,10 @@ class MultiRingEngine(Engine):
         if self._closed:
             return
         self._closed = True
+        # cancel fan tokens while the member rings are still alive (each
+        # child close() cancels its own tokens too — this just guarantees
+        # the parent's ring locks release and the imaps drop first)
+        self._cancel_live_tokens()
         self._pool.shutdown(wait=True)
         for c in self._children:
             c.close()

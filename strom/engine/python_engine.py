@@ -186,6 +186,11 @@ class PythonEngine(Engine):
         if self._closed:
             return
         self._closed = True
+        # cancellation-on-close (ISSUE 5): reap every async token's in-flight
+        # pieces BEFORE the worker sentinels go in — the workers drain the
+        # queued requests first (FIFO), so the reap completes, and no worker
+        # is left writing into a caller slab after close() returns
+        self._cancel_live_tokens()
         for _ in self._workers:
             self._submit_q.put(None)
         for w in self._workers:

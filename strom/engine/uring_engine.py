@@ -465,6 +465,11 @@ class UringEngine(Engine):
     def close(self) -> None:
         if self._closed:
             return
+        # cancellation-on-close (ISSUE 5): drain every async token's
+        # in-flight SQEs while the ring still exists — destroying a ring
+        # with ops in flight would leave the kernel DMA-ing into pages whose
+        # registration died with it
+        self._cancel_live_tokens()
         # take the dest lock BEFORE flipping _closed and destroying the ring:
         # a slab finalizer mid-unregister would otherwise race sc_destroy and
         # call into a freed engine

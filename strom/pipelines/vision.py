@@ -20,8 +20,12 @@ decoding the whole union then transferring serially.
 
 from __future__ import annotations
 
+import bisect
 import concurrent.futures
+import contextlib
 import inspect
+import queue as _queue
+import threading
 import time
 from typing import Any, Callable, Sequence
 
@@ -124,6 +128,45 @@ def _local_batch_rows(sharding: Any, batch: int) -> dict:
     return out
 
 
+def _init_group_state(ctx: StromContext, images: np.ndarray,
+                      dev_items: Sequence, row_pos: dict
+                      ) -> tuple[list[list[int]], list[int], list]:
+    """Per-device completion bookkeeping shared by the overlapped and
+    streamed batch paths: which device groups each row feeds, how many
+    rows each group still waits on, and pre-put shards for empty row
+    ranges (nothing to wait for)."""
+    pos_devs: list[list[int]] = [[] for _ in range(images.shape[0])]
+    pending: list[int] = []
+    shards: list = [None] * len(dev_items)
+    for di, (device, (lo, hi)) in enumerate(dev_items):
+        for r in range(lo, hi):
+            pos_devs[row_pos[r]].append(di)
+        pending.append(hi - lo)
+        if hi <= lo:  # empty row range: nothing to wait for
+            shards[di] = ctx.device_put(images[0:0], device)
+    return pos_devs, pending, shards
+
+
+def _note_decode_overlap(t_decode0: float | None, t_first_put: float | None,
+                         t_last_decode: float | None) -> None:
+    """`decode_batch` histogram + decode/put-overlap counters, emitted
+    identically by the overlapped and streamed paths (a fix to the metric
+    applies to both or the A/B arms silently diverge)."""
+    if t_decode0 is None or t_last_decode is None:
+        return
+    global_stats.observe_us("decode_batch", (t_last_decode - t_decode0) * 1e6)
+    if t_first_put is not None and t_last_decode > t_first_put:
+        global_stats.add("decode_put_overlap_ms",
+                         int((t_last_decode - t_first_put) * 1000))
+        # the overlap window on the timeline: first put fired while decode
+        # was still in flight, for this long
+        from strom.obs.events import ring
+
+        ring.instant("decode.put_overlap", cat="decode",
+                     args={"overlap_ms":
+                           round((t_last_decode - t_first_put) * 1e3, 2)})
+
+
 def _decode_put_overlapped(ctx: StromContext, pool: DecodePool, tf: Transform,
                            blobs: Sequence, rngs: Sequence,
                            images: np.ndarray, dev_items: Sequence,
@@ -137,15 +180,8 @@ def _decode_put_overlapped(ctx: StromContext, pool: DecodePool, tf: Transform,
     `decode_batch` histogram (per-batch decode wall), `decode_put_overlap_ms`
     (the window during which puts overlapped in-flight decode)."""
     n = images.shape[0]
-    pos_devs: list[list[int]] = [[] for _ in range(n)]
-    pending: list[int] = []
-    shards: list = [None] * len(dev_items)
-    for di, (device, (lo, hi)) in enumerate(dev_items):
-        for r in range(lo, hi):
-            pos_devs[row_pos[r]].append(di)
-        pending.append(hi - lo)
-        if hi <= lo:  # empty row range: nothing to wait for
-            shards[di] = ctx.device_put(images[0:0], device)
+    pos_devs, pending, shards = _init_group_state(ctx, images, dev_items,
+                                                  row_pos)
     futs = {pool.submit_into(tf, blobs[i], rngs[i], images[i]): i
             for i in range(n)}
     t0 = time.perf_counter()
@@ -164,18 +200,155 @@ def _decode_put_overlapped(ctx: StromContext, pool: DecodePool, tf: Transform,
                     t_first_put = time.perf_counter()
                 shards[di] = ctx.device_put(images[base: base + hi - lo],
                                             device)
-    global_stats.observe_us("decode_batch", (t_last_decode - t0) * 1e6)
-    if t_first_put is not None and t_last_decode > t_first_put:
-        global_stats.add("decode_put_overlap_ms",
-                         int((t_last_decode - t_first_put) * 1000))
-        # the overlap window on the timeline: first put fired while decode
-        # was still in flight, for this long
-        from strom.obs.events import ring
-
-        ring.instant("decode.put_overlap", cat="decode",
-                     args={"overlap_ms":
-                           round((t_last_decode - t_first_put) * 1e3, 2)})
+    _note_decode_overlap(t0, t_first_put, t_last_decode)
     return shards
+
+
+def _decode_put_streamed(ctx: StromContext, pool: DecodePool, tf: Transform,
+                         el, sizes: Sequence[tuple[int, int]],
+                         rngs: Sequence, images: np.ndarray,
+                         dev_items: Sequence, row_pos: dict
+                         ) -> tuple[list, list[int]]:
+    """Completion-driven batch assembly (ISSUE 5 tentpole): the member
+    gather is submitted through ``ctx.stream_segments`` and each sample is
+    handed to the decode pool THE MOMENT its extents land (hot-cache hits
+    count as instant completions), with per-device shard puts firing
+    through the same completion-ordered machinery as
+    :func:`_decode_put_overlapped` — read, decode, and put overlapped at
+    extent granularity within one batch, instead of gather-ALL → decode-ALL
+    → put-ALL.
+
+    *sizes* is ``[(image_bytes, label_bytes)]`` per local row, in the
+    logical order *el* concatenates them. Returns ``(img_shards, labels)``
+    with identical contents to the barrier path (bit-identity is
+    regression-tested): decode order differs, bytes don't.
+
+    Structure: a pump thread drives the gather (poll → per-sample byte
+    countdown → decode submit), so the engine's queue refills at read pace
+    no matter how long the consumer side spends in device_put; decode
+    completions flow back to THIS thread over a queue, which fires each
+    device's put the moment its row group finishes decoding."""
+    from strom.delivery.shard import Segment
+    from strom.obs.events import ring
+
+    n = images.shape[0]
+    starts: list[int] = []
+    ends: list[int] = []
+    pos = 0
+    for isz, lsz in sizes:
+        starts.append(pos)
+        pos += isz + lsz
+        ends.append(pos)
+    remaining = [e - s for s, e in zip(starts, ends)]
+    labels: list[int] = [0] * n
+    buf = ctx.alloc_read_buffer(el, max(el.size, 1))
+
+    pos_devs, pending, shards = _init_group_state(ctx, images, dev_items,
+                                                  row_pos)
+
+    events: "_queue.SimpleQueue" = _queue.SimpleQueue()
+    stop = threading.Event()
+    futs: list = []
+    futs_lock = threading.Lock()
+    t_decode0: list[float | None] = [None]
+
+    g = ctx.stream_segments(el, [Segment(0, 0, el.size)], buf)
+
+    def submit_sample(i: int) -> None:
+        isz, lsz = sizes[i]
+        s = starts[i]
+        labels[i] = int(buf[s + isz: s + isz + lsz].tobytes() or b"0")
+        if t_decode0[0] is None:
+            t_decode0[0] = time.perf_counter()
+            # gather start -> first decode dispatch: the latency the old
+            # barrier padded out to the slowest extent of the batch
+            global_stats.observe_us("stream_first_decode_lat",
+                                    ring.now_us() - g.t0_us)
+        if not g.done:
+            # dispatched while later extents were still in flight: the
+            # intra-batch overlap, as a counter instead of a guess
+            global_stats.add("stream_samples_early")
+        f = pool.submit_into(tf, buf[s: s + isz], rngs[i], images[i])
+        with futs_lock:
+            futs.append(f)
+        f.add_done_callback(lambda fut, p=i: events.put(("decoded", p, fut)))
+
+    def pump() -> None:
+        try:
+            # degenerate rows (0-byte image+label members) have no extents
+            # to wait for: dispatch them up front, or their countdown never
+            # fires and the consumer below blocks forever
+            for i in range(n):
+                if remaining[i] == 0:
+                    submit_sample(i)
+            while not g.done:
+                if stop.is_set():
+                    g.close()
+                    events.put(("aborted", None))
+                    return
+                for lo_b, hi_b in g.poll(min_completions=1, timeout_s=0.05):
+                    i = max(bisect.bisect_right(starts, lo_b) - 1, 0)
+                    while i < n and starts[i] < hi_b:
+                        ov = min(hi_b, ends[i]) - max(lo_b, starts[i])
+                        if ov > 0:
+                            remaining[i] -= ov
+                            if remaining[i] == 0:
+                                submit_sample(i)
+                        i += 1
+            g.finish()
+            events.put(("done", None))
+        except BaseException as e:  # surfaced on the consumer side
+            with contextlib.suppress(Exception):
+                g.close()
+            events.put(("error", e))
+
+    pt = threading.Thread(target=pump, name="strom-stream-pump", daemon=True)
+    pt.start()
+    decoded = 0
+    gather_done = False
+    err: BaseException | None = None
+    t_first_put: float | None = None
+    t_last_decode: float | None = None
+    try:
+        while decoded < n or not gather_done:
+            kind, *payload = events.get()
+            if kind == "decoded":
+                p, fut = payload
+                fut.result()  # per-sample decode errors were absorbed by
+                # the pool; anything else (a transform bug) aborts the batch
+                decoded += 1
+                t_last_decode = time.perf_counter()
+                for di in pos_devs[p]:
+                    pending[di] -= 1
+                    if pending[di] == 0:
+                        device, (lo, hi) = dev_items[di]
+                        base = row_pos[lo]
+                        if t_first_put is None:
+                            t_first_put = time.perf_counter()
+                        shards[di] = ctx.device_put(
+                            images[base: base + hi - lo], device)
+            elif kind == "done":
+                gather_done = True
+            elif kind == "error":
+                err = payload[0]
+                break
+    except BaseException as e:
+        err = e
+    finally:
+        stop.set()
+        pt.join(timeout=30)
+        if err is not None:
+            # decode workers write into `images` (and read `buf`): both must
+            # outlive every in-flight job before the error propagates
+            with futs_lock:
+                flist = list(futs)
+            for f in flist:
+                with contextlib.suppress(Exception):
+                    f.result()
+    if err is not None:
+        raise err
+    _note_decode_overlap(t_decode0[0], t_first_put, t_last_decode)
+    return shards, labels
 
 
 def make_wds_vision_pipeline(ctx: StromContext, paths: Sequence[str], *,
@@ -193,6 +366,7 @@ def make_wds_vision_pipeline(ctx: StromContext, paths: Sequence[str], *,
                              decode_reduced_scale: bool | None = None,
                              decode_to_slot: bool | None = None,
                              decode_overlap_put: bool | None = None,
+                             stream_intra_batch: bool | None = None,
                              resume_from: str | SamplerState | None = None
                              ) -> Pipeline:
     """Infinite stream of (images [B,S,S,3] uint8, labels [B] int32) jax.Array
@@ -232,6 +406,12 @@ def make_wds_vision_pipeline(ctx: StromContext, paths: Sequence[str], *,
     # custom transforms without an out= keyword keep the stack path
     to_slot = to_slot and tf_out_ok
     overlap_put = overlap_put and to_slot
+    # intra-batch streaming (ISSUE 5): completion-driven read→decode→put
+    # dataflow. Rides the slot + overlapped-put mechanics; falls back to
+    # the barrier path with either off (bit-identical batches regardless).
+    stream = cfg.stream_intra_batch if stream_intra_batch is None \
+        else stream_intra_batch
+    stream = stream and overlap_put
     pool = DecodePool(decode_workers)
     label_sharding = NamedSharding(
         sharding.mesh,
@@ -256,19 +436,38 @@ def make_wds_vision_pipeline(ctx: StromContext, paths: Sequence[str], *,
         samples = [ss.samples[int(indices[r])] for r in local_rows]
         el = ss.batch_extents([int(indices[r]) for r in local_rows],
                               [image_ext, label_ext])
-        buf = ctx.pread(el)
-        # split the concatenated buffer back into per-sample members
-        blobs, labels, pos = [], [], 0
-        for s in samples:
-            isz = s.members[image_ext].size
-            lsz = s.members[label_ext].size
-            blobs.append(buf[pos: pos + isz])
-            labels.append(int(buf[pos + isz: pos + isz + lsz].tobytes() or b"0"))
-            pos += isz + lsz
+        sizes = [(s.members[image_ext].size, s.members[label_ext].size)
+                 for s in samples]
         # Philox keys are two 64-bit words: (seed, serial ‖ row)
         rngs = [np.random.Generator(np.random.Philox(
                     key=[seed, (serial << 32) + r]))
                 for r in local_rows]
+
+        if stream:
+            # completion-driven dataflow (ISSUE 5): samples decode the
+            # moment their extents land, device groups put the moment their
+            # rows decode — no gather barrier anywhere in the batch
+            images = np.empty((len(local_rows), image_size, image_size, 3),
+                              dtype=np.uint8)
+            img_shards, labels = _decode_put_streamed(
+                ctx, pool, tf, el, sizes, rngs, images, dev_items, row_pos)
+            labels_np = np.asarray(labels, dtype=np.int32)
+            global_stats.add("decode_slot_bytes", images.nbytes)
+            lbl_shards = [ctx.device_put(shard_view(labels_np, lo, hi), d)
+                          for d, (lo, hi) in dev_items]
+            imgs = jax.make_array_from_single_device_arrays(
+                global_shape, sharding, img_shards)
+            lbls = jax.make_array_from_single_device_arrays(
+                (batch,), label_sharding, lbl_shards)
+            return imgs, lbls
+
+        buf = ctx.pread(el)
+        # split the concatenated buffer back into per-sample members
+        blobs, labels, pos = [], [], 0
+        for isz, lsz in sizes:
+            blobs.append(buf[pos: pos + isz])
+            labels.append(int(buf[pos + isz: pos + isz + lsz].tobytes() or b"0"))
+            pos += isz + lsz
         labels_np = np.asarray(labels, dtype=np.int32)
 
         if to_slot:

@@ -41,6 +41,12 @@ NEW_ROUND = {  # r5-era shape: binding + context + audit arrays + headline
     "resnet_predecoded_warm_vs_cold": 2.208,
     "resnet_predecoded_cache_hit_bytes": 4411304,
     "resnet_predecoded_cache_miss_bytes": 0,
+    # r6+: intra-batch streaming (strom/delivery/stream) + the --no-stream
+    # A/B arm's companion columns
+    "resnet_stream_intra_batch": True,
+    "resnet_stream_batches": 14,
+    "resnet_stream_samples_early": 301,
+    "resnet_nostream_data_stalls": 6,
     "binding": {"vs_baseline_host": 1.0315, "vs_baseline_host_raid": 0.9708,
                 "train_data_stalls": 0, "some_future_key": 0.5},
     "context": {"raw_gbps": 3.49},
@@ -116,6 +122,49 @@ def test_cache_keys_match_producers():
         assert suffix in produced, \
             f"compare_rounds consumes {key!r} but the cache phase pair " \
             f"produces no {suffix!r} (renamed column?)"
+
+
+def test_stream_section_renders(artifacts, capsys):
+    """r6+ artifacts get the streaming section with the A/B rows."""
+    assert compare_rounds.main(artifacts) == 0
+    out = capsys.readouterr().out
+    assert "streaming" in out
+    assert "resnet_stream_samples_early" in out
+    assert "resnet_nostream_data_stalls" in out
+
+
+def test_stream_section_hidden_without_stream_keys(tmp_path, capsys):
+    """Rounds predating intra-batch streaming don't get an all-dash
+    streaming section."""
+    p = tmp_path / "BENCH_r02.json"
+    p.write_text(json.dumps(OLD_ROUND))
+    assert compare_rounds.main([str(p)]) == 0
+    assert "streaming" not in capsys.readouterr().out
+
+
+def test_stream_keys_match_producers():
+    """Producer↔report key parity for the streaming section (ISSUE 5
+    satellite, the decode/stall/cache pattern): every *_stream_* column
+    must be an arm prefix plus a key the bench arms actually emit
+    (single-sourced in strom.delivery.stream.STREAM_FIELDS plus the
+    stream_intra_batch flag); the resnet_nostream_* A/B rows must be
+    ordinary arm columns (img/s, stalls, stall attribution)."""
+    from strom.delivery.stream import STREAM_FIELDS
+    from strom.obs.stall import STALL_FIELDS
+
+    prefixes = ("resnet_nostream", "resnet", "vit")
+    stream_produced = set(STREAM_FIELDS) | {"stream_intra_batch"}
+    arm_produced = set(STALL_FIELDS) | {
+        "images_per_s", "train_images_per_s", "data_stalls"}
+    for key in compare_rounds.STREAM_KEYS:
+        prefix = next((p for p in prefixes if key.startswith(p + "_")), None)
+        assert prefix is not None, key
+        suffix = key[len(prefix) + 1:]
+        produced = stream_produced if suffix.startswith("stream") \
+            else arm_produced
+        assert suffix in produced, \
+            f"compare_rounds consumes {key!r} but the bench arms produce " \
+            f"no {suffix!r} (renamed column?)"
 
 
 def test_stall_section_hidden_without_stall_keys(tmp_path, capsys):

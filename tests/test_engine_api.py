@@ -1,0 +1,336 @@
+"""Engine-API conformance for the async vectored path (ISSUE 5 satellite):
+submit_vectored / poll / drain semantics and completion-count accounting,
+parametrized over EVERY Engine implementation — the python thread-pool
+engine, the native io_uring engine, and the multi-ring engine in both its
+single-ring and fan-out shapes. One behavioral contract, three machines."""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from strom.config import StromConfig
+from strom.engine.base import EngineError
+
+MiB = 1024 * 1024
+
+
+def _uring_ok() -> bool:
+    from strom.engine.uring_engine import uring_available
+
+    return uring_available()
+
+
+@pytest.fixture(params=["python", "uring", "multi", "multi2"])
+def any_engine(request):
+    """One instance of every Engine subclass/shape (uring-backed shapes
+    skip where the sandbox refuses io_uring_setup)."""
+    cfg = StromConfig(queue_depth=8, num_buffers=16)
+    if request.param == "python":
+        from strom.engine.python_engine import PythonEngine
+
+        eng = PythonEngine(cfg)
+    elif request.param == "uring":
+        if not _uring_ok():
+            pytest.skip("io_uring unavailable in this sandbox")
+        from strom.engine.uring_engine import UringEngine
+
+        eng = UringEngine(cfg)
+    else:
+        if not _uring_ok():
+            pytest.skip("io_uring unavailable in this sandbox")
+        from strom.engine.multi import MultiRingEngine
+
+        eng = MultiRingEngine(cfg, rings=2 if request.param == "multi2" else 1)
+    yield eng
+    eng.close()
+
+
+def _chunks_for(eng, path: str, nbytes: int, n: int):
+    fi = eng.register_file(path)
+    per = nbytes // n // 512 * 512
+    return [(fi, i * per, i * per, per) for i in range(n)], n * per
+
+
+class TestSubmitPollDrain:
+    def test_integrity_and_exactly_once_accounting(self, any_engine,
+                                                   data_file):
+        """Every chunk completes exactly once (the completion-count
+        contract), bytes land where the plan says, drain returns the sum."""
+        path, golden = data_file
+        chunks, total = _chunks_for(any_engine, path, 4 * MiB, 16)
+        dest = np.zeros(total, dtype=np.uint8)
+        tok = any_engine.submit_vectored(chunks, dest)
+        seen: list[int] = []
+        while not tok.done:
+            for c in any_engine.poll(tok, min_completions=1):
+                assert c.result == chunks[c.index][3]
+                seen.append(c.index)
+        assert sorted(seen) == list(range(16))  # exactly once each
+        assert any_engine.drain(tok) == total
+        np.testing.assert_array_equal(dest, golden[:total])
+        assert any_engine.in_flight() == 0
+
+    def test_multi_piece_chunks_complete_once(self, any_engine, data_file):
+        """A chunk larger than block_size (several engine ops) still
+        surfaces as ONE completion, when its last piece lands."""
+        path, golden = data_file
+        fi = any_engine.register_file(path)
+        ln = 1 * MiB  # 8 block-size pieces at the 128KiB default
+        chunks = [(fi, 0, 0, ln), (fi, ln, ln, ln)]
+        dest = np.zeros(2 * ln, dtype=np.uint8)
+        tok = any_engine.submit_vectored(chunks, dest)
+        seen = []
+        while not tok.done:
+            seen.extend(any_engine.poll(tok, min_completions=1))
+        assert sorted(c.index for c in seen) == [0, 1]
+        assert all(c.result == ln for c in seen)
+        assert any_engine.drain(tok) == 2 * ln
+        np.testing.assert_array_equal(dest, golden[: 2 * ln])
+
+    def test_drain_without_polling(self, any_engine, data_file):
+        path, golden = data_file
+        chunks, total = _chunks_for(any_engine, path, 2 * MiB, 4)
+        dest = np.zeros(total, dtype=np.uint8)
+        tok = any_engine.submit_vectored(chunks, dest)
+        assert any_engine.drain(tok) == total
+        np.testing.assert_array_equal(dest, golden[:total])
+
+    def test_poll_zero_never_blocks(self, any_engine, data_file):
+        path, _ = data_file
+        chunks, total = _chunks_for(any_engine, path, 4 * MiB, 8)
+        dest = np.zeros(total, dtype=np.uint8)
+        tok = any_engine.submit_vectored(chunks, dest)
+        t0 = time.monotonic()
+        any_engine.poll(tok, min_completions=0)
+        assert time.monotonic() - t0 < 1.0
+        any_engine.drain(tok)
+
+    def test_empty_gather(self, any_engine):
+        dest = np.zeros(0, dtype=np.uint8)
+        tok = any_engine.submit_vectored([], dest)
+        assert tok.done
+        assert any_engine.poll(tok, min_completions=0) == []
+        assert any_engine.drain(tok) == 0
+
+    def test_inflight_peak_reported(self, any_engine, data_file):
+        path, _ = data_file
+        chunks, total = _chunks_for(any_engine, path, 4 * MiB, 16)
+        dest = np.zeros(total, dtype=np.uint8)
+        tok = any_engine.submit_vectored(chunks, dest)
+        any_engine.drain(tok)
+        assert 1 <= tok.inflight_peak
+
+    def test_sequential_tokens_reuse_engine(self, any_engine, data_file):
+        """A drained token leaves the engine clean for the next gather —
+        no stale tags, no leaked queue depth."""
+        path, golden = data_file
+        for _ in range(3):
+            chunks, total = _chunks_for(any_engine, path, 1 * MiB, 4)
+            dest = np.zeros(total, dtype=np.uint8)
+            tok = any_engine.submit_vectored(chunks, dest)
+            assert any_engine.drain(tok) == total
+            np.testing.assert_array_equal(dest, golden[:total])
+        assert any_engine.in_flight() == 0
+
+
+class TestErrorsAndCancellation:
+    def test_short_read_surfaces_after_full_drain(self, any_engine,
+                                                  data_file):
+        """A chunk past EOF errors the gather — raised by drain only after
+        every in-flight piece retired (in_flight() == 0 at raise time)."""
+        path, _ = data_file
+        fi = any_engine.register_file(path)
+        import os as _os
+
+        size = _os.stat(path).st_size
+        ok = 512 * 1024
+        chunks = [(fi, 0, 0, ok), (fi, size - 4096, ok, 1 * MiB)]
+        dest = np.zeros(ok + 1 * MiB, dtype=np.uint8)
+        tok = any_engine.submit_vectored(chunks, dest)
+        with pytest.raises(EngineError):
+            any_engine.drain(tok)
+        assert any_engine.in_flight() == 0
+
+    def test_error_chunk_completion_is_negative(self, any_engine,
+                                                data_file):
+        path, _ = data_file
+        fi = any_engine.register_file(path)
+        import os as _os
+
+        size = _os.stat(path).st_size
+        chunks = [(fi, size - 4096, 0, 1 * MiB)]  # extends past EOF
+        dest = np.zeros(1 * MiB, dtype=np.uint8)
+        tok = any_engine.submit_vectored(chunks, dest)
+        seen = []
+        while not tok.done:
+            seen.extend(any_engine.poll(tok, min_completions=1))
+        assert any(c.result < 0 for c in seen)
+        with pytest.raises(EngineError):
+            any_engine.drain(tok)
+
+    def test_cancel_reaps_everything(self, any_engine, data_file):
+        path, _ = data_file
+        chunks, total = _chunks_for(any_engine, path, 4 * MiB, 16)
+        dest = np.zeros(total, dtype=np.uint8)
+        tok = any_engine.submit_vectored(chunks, dest)
+        any_engine.cancel(tok)
+        assert tok.cancelled
+        assert any_engine.in_flight() == 0
+        with pytest.raises(EngineError):
+            any_engine.poll(tok)
+
+    def test_close_cancels_live_token(self, any_engine, data_file):
+        """Cancellation-on-close: closing an engine with a token in flight
+        reaps every completion (no worker/kernel write outlives close) and
+        marks the token cancelled instead of hanging or leaking."""
+        path, _ = data_file
+        chunks, total = _chunks_for(any_engine, path, 4 * MiB, 16)
+        dest = np.zeros(total, dtype=np.uint8)
+        tok = any_engine.submit_vectored(chunks, dest)
+        t = threading.Thread(target=any_engine.close)
+        t.start()
+        t.join(timeout=30)
+        assert not t.is_alive(), "close() hung on a live token"
+        assert tok.cancelled
+
+
+@pytest.fixture()
+def py_multi(monkeypatch):
+    """A 2-ring MultiRingEngine with PYTHON-engine children: the _FanToken
+    routing/merge/cancel state machine runs even where the sandbox refuses
+    io_uring_setup (the uring-parametrized tests above cover ring-native
+    behavior when it exists)."""
+    import strom.engine.multi as multi_mod
+    from strom.engine.python_engine import PythonEngine
+
+    class _PyChild(PythonEngine):
+        def __init__(self, config, variant=""):
+            super().__init__(config)
+
+    import strom.engine.uring_engine as ue
+
+    monkeypatch.setattr(ue, "UringEngine", _PyChild)
+    eng = multi_mod.MultiRingEngine(StromConfig(queue_depth=8,
+                                                num_buffers=16), rings=2)
+    yield eng
+    eng.close()
+
+
+class TestFanTokenLogic:
+    def test_two_file_fanout_integrity(self, py_multi, tmp_path, rng):
+        datas, fis = [], []
+        for i in range(2):
+            d = rng.integers(0, 256, 1 * MiB, dtype=np.uint8)
+            p = tmp_path / f"f{i}.bin"
+            d.tofile(p)
+            datas.append(d)
+            fis.append(py_multi.register_file(str(p)))
+        half = 512 * 1024
+        chunks = [(fis[0], 0, 0, half), (fis[1], 0, half, half),
+                  (fis[0], half, 2 * half, half), (fis[1], half, 3 * half,
+                                                   half)]
+        dest = np.zeros(4 * half, dtype=np.uint8)
+        tok = py_multi.submit_vectored(chunks, dest)
+        seen = []
+        while not tok.done:
+            seen.extend(py_multi.poll(tok, min_completions=1))
+        assert sorted(c.index for c in seen) == [0, 1, 2, 3]
+        assert all(c.result == half for c in seen)
+        assert py_multi.drain(tok) == 4 * half
+        np.testing.assert_array_equal(dest[:half], datas[0][:half])
+        np.testing.assert_array_equal(dest[half: 2 * half], datas[1][:half])
+        np.testing.assert_array_equal(dest[2 * half: 3 * half],
+                                      datas[0][half:])
+        np.testing.assert_array_equal(dest[3 * half:], datas[1][half:])
+        # ring locks released: a blocking gather runs fine afterwards
+        dest2 = np.zeros(half, dtype=np.uint8)
+        assert py_multi.read_vectored([(fis[0], 0, 0, half)], dest2) == half
+
+    def test_single_file_rides_one_ring(self, py_multi, data_file):
+        path, golden = data_file
+        fi = py_multi.register_file(path)
+        chunks = [(fi, i * 256 * 1024, i * 256 * 1024, 256 * 1024)
+                  for i in range(8)]
+        dest = np.zeros(2 * MiB, dtype=np.uint8)
+        tok = py_multi.submit_vectored(chunks, dest)
+        assert py_multi.drain(tok) == 2 * MiB
+        np.testing.assert_array_equal(dest, golden[: 2 * MiB])
+
+    def test_cancel_releases_ring_locks(self, py_multi, tmp_path, rng):
+        datas, fis = [], []
+        for i in range(2):
+            d = rng.integers(0, 256, 1 * MiB, dtype=np.uint8)
+            p = tmp_path / f"c{i}.bin"
+            d.tofile(p)
+            datas.append(d)
+            fis.append(py_multi.register_file(str(p)))
+        half = 512 * 1024
+        chunks = [(fis[0], 0, 0, half), (fis[1], 0, half, half)]
+        dest = np.zeros(2 * half, dtype=np.uint8)
+        tok = py_multi.submit_vectored(chunks, dest)
+        py_multi.cancel(tok)
+        assert tok.cancelled
+        assert py_multi.in_flight() == 0
+        with pytest.raises(EngineError):
+            py_multi.drain(tok)
+        # both ring locks must be free again
+        dest2 = np.zeros(half, dtype=np.uint8)
+        assert py_multi.read_vectored([(fis[0], 0, 0, half)], dest2) == half
+        assert py_multi.read_vectored([(fis[1], 0, 0, half)], dest2) == half
+
+    def test_close_with_live_fan_token(self, py_multi, tmp_path, rng):
+        d = rng.integers(0, 256, 2 * MiB, dtype=np.uint8)
+        p = tmp_path / "x.bin"
+        d.tofile(p)
+        f0 = py_multi.register_file(str(p))
+        chunks = [(f0, i * 256 * 1024, i * 256 * 1024, 256 * 1024)
+                  for i in range(8)]
+        dest = np.zeros(2 * MiB, dtype=np.uint8)
+        tok = py_multi.submit_vectored(chunks, dest)
+        t = threading.Thread(target=py_multi.close)
+        t.start()
+        t.join(timeout=30)
+        assert not t.is_alive(), "multi close() hung on a live fan token"
+        assert tok.cancelled
+
+
+class TestMultiRingFanout:
+    def test_fanout_maps_indices_back(self, tmp_path, rng):
+        """A two-file gather on a 2-ring engine fans per file; completions
+        still name the CALLER's chunk indices."""
+        if not _uring_ok():
+            pytest.skip("io_uring unavailable in this sandbox")
+        from strom.engine.multi import MultiRingEngine
+
+        datas, paths = [], []
+        for i in range(2):
+            d = rng.integers(0, 256, 1 * MiB, dtype=np.uint8)
+            p = tmp_path / f"m{i}.bin"
+            d.tofile(p)
+            datas.append(d)
+            paths.append(str(p))
+        eng = MultiRingEngine(StromConfig(queue_depth=8, num_buffers=16),
+                              rings=2)
+        try:
+            fis = [eng.register_file(p) for p in paths]
+            half = 512 * 1024
+            chunks = [(fis[0], 0, 0, half), (fis[1], 0, half, half),
+                      (fis[0], half, 2 * half, half),
+                      (fis[1], half, 3 * half, half)]
+            dest = np.zeros(4 * half, dtype=np.uint8)
+            tok = eng.submit_vectored(chunks, dest)
+            seen = []
+            while not tok.done:
+                seen.extend(eng.poll(tok, min_completions=1))
+            assert sorted(c.index for c in seen) == [0, 1, 2, 3]
+            assert eng.drain(tok) == 4 * half
+            np.testing.assert_array_equal(dest[:half], datas[0][:half])
+            np.testing.assert_array_equal(dest[half: 2 * half],
+                                          datas[1][:half])
+            np.testing.assert_array_equal(dest[2 * half: 3 * half],
+                                          datas[0][half:])
+            np.testing.assert_array_equal(dest[3 * half:], datas[1][half:])
+        finally:
+            eng.close()

@@ -1,0 +1,266 @@
+"""Intra-batch streaming parity + lifecycle (ISSUE 5 satellite): the
+completion-driven read→decode→put dataflow must deliver BIT-IDENTICAL
+batches to the barrier path on every engine — including batches served
+fully or partially from the hot cache (instant completions) — and
+cancellation-on-close must leave no leaked slab pins and no in-flight
+completions."""
+
+import numpy as np
+import pytest
+
+from strom.config import StromConfig
+from strom.delivery.core import StromContext
+from strom.delivery.shard import Segment
+
+MiB = 1024 * 1024
+
+cv2 = pytest.importorskip("cv2")
+
+
+@pytest.fixture(scope="module")
+def mesh2():
+    import jax
+
+    from strom.parallel.mesh import make_mesh
+
+    return make_mesh({"dp": 2}, devices=jax.devices()[:2])
+
+
+@pytest.fixture(scope="module")
+def wds_tar(tmp_path_factory):
+    from tests.test_formats import make_wds_shard
+
+    rng = np.random.default_rng(5)
+    td = tmp_path_factory.mktemp("stream_wds")
+    samples = []
+    for i in range(24):
+        img = rng.integers(0, 256, (48 + (i % 5), 56, 3), dtype=np.uint8)
+        ok, buf = cv2.imencode(".jpg", img)
+        assert ok
+        samples.append((f"s{i:04d}", {"jpg": buf.tobytes(),
+                                      "cls": str(i % 10).encode()}))
+    p = str(td / "stream.tar")
+    make_wds_shard(p, samples)
+    return p
+
+
+def _run_epochs(path, mesh2, *, stream, engine, epochs=2, batch=8,
+                hot_cache=0, admit="always"):
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from strom.pipelines.vision import make_wds_vision_pipeline
+
+    sharding = NamedSharding(mesh2, P("dp", None, None, None))
+    cfg = StromConfig(engine=engine, queue_depth=8, num_buffers=16,
+                      hot_cache_bytes=hot_cache, hot_cache_admit=admit)
+    ctx = StromContext(cfg)
+    out = []
+    try:
+        with make_wds_vision_pipeline(ctx, [path], batch=batch,
+                                      image_size=32, sharding=sharding,
+                                      seed=11, decode_workers=2,
+                                      stream_intra_batch=stream) as pipe:
+            spe = pipe.sampler.batches_per_epoch
+            for _ in range(spe * epochs):
+                imgs, lbls = next(pipe)
+                out.append((np.asarray(imgs), np.asarray(lbls)))
+    finally:
+        ctx.close()
+    return out
+
+
+class TestBitIdentity:
+    def test_streamed_matches_barrier(self, engine_name, wds_tar, mesh2):
+        """Streamed vs --no-stream over two epochs: identical bytes, every
+        batch (decode order differs; contents must not)."""
+        a = _run_epochs(wds_tar, mesh2, stream=True, engine=engine_name)
+        b = _run_epochs(wds_tar, mesh2, stream=False, engine=engine_name)
+        assert len(a) == len(b)
+        for (ia, la), (ib, lb) in zip(a, b):
+            np.testing.assert_array_equal(ia, ib)
+            np.testing.assert_array_equal(la, lb)
+
+    def test_streamed_engaged(self, engine_name, wds_tar, mesh2):
+        """The parity above must compare the STREAMED path, not a silent
+        fallback: the stream counters prove it engaged."""
+        from strom.utils.stats import global_stats
+
+        snap0 = global_stats.snapshot()
+        _run_epochs(wds_tar, mesh2, stream=True, engine=engine_name,
+                    epochs=1)
+        snap1 = global_stats.snapshot()
+        assert snap1.get("stream_batches", 0) > snap0.get("stream_batches", 0)
+
+    def test_hot_cache_hit_and_partial_hit_batches(self, engine_name,
+                                                   wds_tar, mesh2):
+        """Epoch 2 under force-admit serves from the cache (full-hit
+        batches = pure instant completions); a mid-run partial admission
+        exercises mixed instant+engine batches. Bytes must match the
+        cache-free barrier path throughout."""
+        from strom.utils.stats import global_stats
+
+        golden = _run_epochs(wds_tar, mesh2, stream=False,
+                             engine=engine_name, hot_cache=0)
+        snap0 = global_stats.snapshot()
+        cached = _run_epochs(wds_tar, mesh2, stream=True,
+                             engine=engine_name, hot_cache=64 * MiB,
+                             admit="always")
+        snap1 = global_stats.snapshot()
+        for (ia, la), (ib, lb) in zip(cached, golden):
+            np.testing.assert_array_equal(ia, ib)
+            np.testing.assert_array_equal(la, lb)
+        # epoch 2 was served (at least partly) as instant completions
+        assert snap1.get("stream_instant_bytes", 0) \
+            > snap0.get("stream_instant_bytes", 0)
+
+    def test_partial_hit_single_batch(self, engine_name, wds_tar, mesh2,
+                                      tmp_path):
+        """One streamed gather whose plan is split between cached ranges
+        (instant) and engine misses lands the same bytes as pread."""
+        import os
+
+        size = os.stat(wds_tar).st_size
+        cfg = StromConfig(engine=engine_name, queue_depth=8, num_buffers=16,
+                          hot_cache_bytes=64 * MiB, hot_cache_admit="always")
+        ctx = StromContext(cfg)
+        try:
+            golden = np.fromfile(wds_tar, dtype=np.uint8)
+            # admit only the FIRST HALF: the gather below is a partial hit
+            half = size // 2 // 4096 * 4096
+            ctx.hot_cache.admit(wds_tar, 0, half, golden[:half], force=True)
+            from strom.delivery.buffers import alloc_aligned
+
+            dest = alloc_aligned(size)
+            g = ctx.stream_segments(wds_tar, [Segment(0, 0, size)], dest)
+            ranges = []
+            while not g.done:
+                ranges.extend(g.poll(min_completions=1))
+            assert g.finish() == size
+            g.close()
+            np.testing.assert_array_equal(dest[:size], golden)
+            # every byte completed exactly once
+            covered = np.zeros(size, dtype=bool)
+            for lo, hi in ranges:
+                assert not covered[lo:hi].any(), "range completed twice"
+                covered[lo:hi] = True
+            assert covered.all()
+            assert g.instant_bytes > 0
+        finally:
+            ctx.close()
+
+
+class TestDegenerateSamples:
+    def test_zero_byte_members_dont_hang(self, engine_name, mesh2,
+                                         tmp_path_factory):
+        """A sample whose image AND label members are 0 bytes has NO
+        extents to wait for — the streamed path must dispatch it up front
+        instead of deadlocking on a byte countdown that never fires. The
+        empty blob then fails decode the same way the barrier path fails
+        (cv2 raises on an empty buffer; the zero-image policy only absorbs
+        ValueError — pre-existing semantics, parity asserted here): both
+        paths RAISE promptly, neither hangs."""
+        from tests.test_formats import make_wds_shard
+
+        rng_l = np.random.default_rng(9)
+        td = tmp_path_factory.mktemp("stream_degen")
+        samples = []
+        for i in range(8):
+            if i == 3:
+                samples.append((f"s{i:04d}", {"jpg": b"", "cls": b""}))
+                continue
+            img = rng_l.integers(0, 256, (40, 40, 3), dtype=np.uint8)
+            ok, buf = cv2.imencode(".jpg", img)
+            assert ok
+            samples.append((f"s{i:04d}", {"jpg": buf.tobytes(),
+                                          "cls": str(i).encode()}))
+        p = str(td / "degen.tar")
+        make_wds_shard(p, samples)
+        with pytest.raises(Exception, match="(?i)empty|imdecode|decode"):
+            _run_epochs(p, mesh2, stream=True, engine=engine_name,
+                        epochs=1, batch=8)
+        with pytest.raises(Exception, match="(?i)empty|imdecode|decode"):
+            _run_epochs(p, mesh2, stream=False, engine=engine_name,
+                        epochs=1, batch=8)
+
+
+class TestExposure:
+    def test_stream_section_in_stats_and_metrics(self, engine_name,
+                                                 wds_tar, mesh2):
+        """Acceptance: the stream counters appear in ctx.stats() and the
+        Prometheus exposition, with stream_batches typed as a counter."""
+        from strom.delivery.stream import STREAM_FIELDS
+        from strom.utils.stats import sections_prometheus
+
+        _run_epochs(wds_tar, mesh2, stream=True, engine=engine_name,
+                    epochs=1)
+        ctx = StromContext(StromConfig(engine=engine_name, queue_depth=4,
+                                       num_buffers=8))
+        try:
+            stats = ctx.stats()
+            assert "stream" in stats
+            sec = stats["stream"]
+            # every bench column the arms copy must exist in the section
+            # (stream_intra_batch is a config flag, not a stat)
+            for k in STREAM_FIELDS:
+                assert k in sec, k
+            assert sec["stream_batches"] > 0
+            text = sections_prometheus(stats)
+            assert "strom_stream_stream_batches" in text
+            assert "# TYPE strom_stream_stream_batches counter" in text
+            assert "strom_stream_stream_tail_extent_us_bucket" in text
+        finally:
+            ctx.close()
+
+
+class TestCancellation:
+    def test_close_leaves_no_pins_or_inflight(self, engine_name, wds_tar,
+                                              mesh2):
+        """Closing a streamed gather mid-flight (the pipeline-teardown
+        path): no hot-cache entry stays pinned, no completion stays in
+        flight, the engine is reusable."""
+        import os
+
+        size = os.stat(wds_tar).st_size
+        cfg = StromConfig(engine=engine_name, queue_depth=4, num_buffers=8,
+                          hot_cache_bytes=64 * MiB, hot_cache_admit="always")
+        ctx = StromContext(cfg)
+        try:
+            golden = np.fromfile(wds_tar, dtype=np.uint8)
+            half = size // 2 // 4096 * 4096
+            ctx.hot_cache.admit(wds_tar, 0, half, golden[:half], force=True)
+            from strom.delivery.buffers import alloc_aligned
+
+            dest = alloc_aligned(size)
+            g = ctx.stream_segments(wds_tar, [Segment(0, 0, size)], dest)
+            g.poll(min_completions=1)  # consume the instants at least
+            g.close()  # mid-flight abandon
+            assert ctx.engine.in_flight() == 0
+            with ctx.hot_cache._lock:
+                assert all(e.refs == 0
+                           for e in ctx.hot_cache._lru.values()), \
+                    "streamed gather leaked a cache pin"
+            # the engine (and its lock) must be free for the next transfer
+            np.testing.assert_array_equal(
+                ctx.pread(wds_tar, 0, 4096), golden[:4096])
+        finally:
+            ctx.close()
+
+    def test_context_close_with_live_gather(self, engine_name, wds_tar):
+        """Engine close cancels the token under a live gather: no hang, no
+        in-flight completions."""
+        import os
+
+        size = os.stat(wds_tar).st_size
+        ctx = StromContext(StromConfig(engine=engine_name, queue_depth=4,
+                                       num_buffers=8))
+        from strom.delivery.buffers import alloc_aligned
+
+        dest = alloc_aligned(size)
+        g = ctx.stream_segments(wds_tar, [Segment(0, 0, size)], dest)
+        # close the gather first (releases the engine lock), then the ctx —
+        # the engine-level cancellation test (close with a LIVE token) is
+        # TestErrorsAndCancellation.test_close_cancels_live_token
+        g.close()
+        assert ctx.engine.in_flight() == 0
+        ctx.close()

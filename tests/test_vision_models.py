@@ -33,6 +33,8 @@ class TestResNet:
         np.testing.assert_array_equal(np.asarray(eval_state["stem"]["mean"]),
                                       np.asarray(state["stem"]["mean"]))
 
+    @pytest.mark.slow  # convergence demo (~4s): numerics are covered
+    # by the forward/grad tests above; tier-1 runtime headroom (ISSUE 5)
     def test_overfits_small_batch(self, tiny):
         import optax
 
@@ -111,6 +113,8 @@ class TestViT:
         l2 = forward(params, jnp.array(x_shuf), cfg)
         assert not np.allclose(np.asarray(l1), np.asarray(l2), atol=1e-5)
 
+    @pytest.mark.slow  # convergence demo (~4s): numerics are covered
+    # by the forward/permutation tests above; tier-1 runtime headroom
     def test_overfits_small_batch(self, tiny):
         import optax
 

@@ -112,6 +112,34 @@ CACHE_KEYS = [
     "vit_predecoded_warm_vs_cold",
     "vit_predecoded_cache_hit_bytes",
 ]
+# intra-batch streaming (ISSUE 5 tentpole): the completion-driven
+# read→decode→put dataflow on the JPEG vision arms. stream_samples_early
+# counts decodes dispatched while later extents were still in flight (the
+# overlap, as a counter); first_decode_lat is gather-start → first decode
+# dispatch (the latency the old barrier padded to the slowest extent);
+# tail_extent_p50 is the first→last completion spread that work now
+# overlaps. The resnet_nostream_* columns are the same arm with --no-stream
+# (bit-identical batches), so resnet vs resnet_nostream ingest-wait/stall
+# rows price exactly the streaming dataflow. Suffixes single-sourced in
+# strom.delivery.stream.STREAM_FIELDS (parity-tested, same contract as the
+# decode/stall/cache sections).
+STREAM_KEYS = [
+    "resnet_stream_intra_batch",
+    "resnet_stream_batches",
+    "resnet_stream_samples_early",
+    "resnet_stream_inflight_peak",
+    "resnet_stream_instant_bytes",
+    "resnet_stream_first_decode_lat_p50_us",
+    "resnet_stream_tail_extent_p50_us",
+    "resnet_nostream_train_images_per_s",
+    "resnet_nostream_data_stalls",
+    "resnet_nostream_step_ingest_wait_p50_us",
+    "resnet_nostream_goodput_pct",
+    "vit_stream_batches",
+    "vit_stream_samples_early",
+    "vit_stream_first_decode_lat_p50_us",
+    "vit_stream_tail_extent_p50_us",
+]
 # per-attempt / per-pass audit arrays (VERDICT.md r4 next #3): printed so
 # the best-of selection's discards are visible in the comparison too
 AUDIT_SUFFIXES = ("_attempts", "_passes")
@@ -209,8 +237,10 @@ def main(argv: list[str]) -> int:
                      for k in STALL_KEYS)
     have_cache = any(cell(d, k) != "-" for _, d in rounds
                      for k in CACHE_KEYS)
+    have_stream = any(cell(d, k) != "-" for _, d in rounds
+                      for k in STREAM_KEYS)
     name_w = max(len(k) for k in binding_keys + CONTEXT_KEYS + DECODE_KEYS
-                 + STALL_KEYS + CACHE_KEYS + audit_keys) + 2
+                 + STALL_KEYS + CACHE_KEYS + STREAM_KEYS + audit_keys) + 2
     # every rendered cell folds into ONE column width, or rows misalign
     col_w = max(max(len(n) for n, _ in rounds) + 2, 12,
                 *(len(c) + 2 for cs in audit_cells.values() for c in cs),
@@ -242,6 +272,12 @@ def main(argv: list[str]) -> int:
         print("hot-set cache (cold/warm epoch pair: warm serves from RAM; "
               "warm miss ~0 = read bucket collapsed):")
         for k in CACHE_KEYS:
+            print(k.ljust(name_w)
+                  + "".join(cell(d, k).rjust(col_w) for _, d in rounds))
+    if have_stream:
+        print("streaming (completion-driven intra-batch dataflow; "
+              "resnet vs resnet_nostream rows are the A/B):")
+        for k in STREAM_KEYS:
             print(k.ljust(name_w)
                   + "".join(cell(d, k).rjust(col_w) for _, d in rounds))
     if audit_keys:
