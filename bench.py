@@ -152,6 +152,18 @@ def main() -> int:
                     default=os.environ.get("STROM_BENCH_TRACE", None),
                     help="dump the event ring as Trace Event JSON here at "
                          "the end of the run (Perfetto / chrome://tracing)")
+    ap.add_argument("--flight-dir", dest="flight_dir",
+                    default=os.environ.get("STROM_FLIGHT_DIR", None),
+                    help="flight-recorder bundle directory (default: "
+                         "<tmpdir>/strom_flight; 'off' disables). A killed "
+                         "or wedged run leaves an atomic crash bundle — "
+                         "trace + stats + thread stacks + progress samples "
+                         "— loadable via strom.obs.flight.load_bundle")
+    ap.add_argument("--flight-stall-s", dest="flight_stall_s", type=float,
+                    default=float(os.environ.get("STROM_FLIGHT_STALL_S",
+                                                 "60")),
+                    help="flight recorder no-progress threshold (seconds); "
+                         "<= 0 disables the stall trigger")
     args = ap.parse_args()
 
     # --- per-phase wall-clock budgeting (BENCH_r05 died rc=124 mid-run:
@@ -239,6 +251,25 @@ def main() -> int:
         # tiny smoke budgets skip the alarm (it would fire into a healthy
         # run); the SIGTERM guard alone covers them
         signal.alarm(int(args.budget) - GUARD_MARGIN_S)
+
+    # --- flight recorder (ISSUE 6 tentpole): armed AFTER the emergency
+    # --- flush installs, so its SIGTERM hook chains to it — a driver kill
+    # --- dumps the crash bundle (trace + stats + per-thread stacks +
+    # --- last-N progress samples, atomic dir rename) FIRST, then the JSON
+    # --- guard prints the partial artifact and exits. r05's rc=124 left
+    # --- nothing to diagnose; this run shape leaves both the artifact and
+    # --- the black box. Default ON under the tmpdir; --flight-dir off
+    # --- disables.
+    flight_dir = args.flight_dir
+    if flight_dir is None:
+        flight_dir = os.path.join(args.tmpdir, "strom_flight")
+    if flight_dir and flight_dir.lower() != "off":
+        try:
+            from strom.obs.flight import FlightRecorder
+
+            FlightRecorder(flight_dir, stall_s=args.flight_stall_s)
+        except Exception as e:  # the bench must run even with a bad dir
+            print(f"flight recorder disabled: {e}", file=sys.stderr)
 
     def remaining() -> float:
         return args.budget - (time.monotonic() - t_start)

@@ -735,9 +735,15 @@ def _stream_stats_delta(snap0: dict) -> dict:
 
 def _obs_config_kw(args: argparse.Namespace) -> dict:
     """StromConfig observability overrides: --metrics-port starts the live
-    /metrics, /stats, /trace endpoint for the bench context's lifetime
-    (absent in driver-built Namespaces → 0 = off)."""
-    return {"metrics_port": int(getattr(args, "metrics_port", 0) or 0)}
+    /metrics, /stats, /trace, /flight endpoint for the bench context's
+    lifetime; --flight-dir arms the flight recorder so a killed bench
+    (the driver's `timeout`, an OOM-adjacent wedge) leaves an atomic
+    crash bundle instead of an undiagnosable rc (absent in driver-built
+    Namespaces → both off)."""
+    return {"metrics_port": int(getattr(args, "metrics_port", 0) or 0),
+            "flight_dir": getattr(args, "flight_dir", "") or "",
+            "flight_stall_s":
+                float(getattr(args, "flight_stall_s", 30.0) or 0.0)}
 
 
 def _cache_config_kw(args: argparse.Namespace) -> dict:
@@ -1569,6 +1575,19 @@ def main(argv: list[str] | None = None) -> int:
                        help="dump the event ring as Trace Event JSON here "
                             "when the bench finishes — load the file in "
                             "chrome://tracing or https://ui.perfetto.dev")
+        p.add_argument("--flight-dir", default=os.environ.get(
+                           "STROM_FLIGHT_DIR", ""), dest="flight_dir",
+                       help="arm the flight recorder: dump an atomic crash "
+                            "bundle (trace + stats + thread stacks + "
+                            "last-N progress samples) here on SIGTERM, "
+                            "unhandled exception, or a stalled run "
+                            "(strom/obs/flight.py; empty = off)")
+        p.add_argument("--flight-stall-s", type=float, default=30.0,
+                       dest="flight_stall_s",
+                       help="no-step-progress watchdog threshold in "
+                            "seconds for the flight recorder's stall "
+                            "trigger (<= 0 disables it; signal/exception "
+                            "dumps stay armed)")
 
     p_nvme = sub.add_parser("nvme", help="config #1: O_DIRECT seq read -> host RAM")
     common(p_nvme)

@@ -31,6 +31,8 @@ def _env_cast(value: str, typ: Any) -> Any:
                 mult = m
                 break
         return int(v) * mult
+    if typ is float:
+        return float(value.strip())
     if typ is str:
         return value
     if typ == tuple[str, ...]:
@@ -210,11 +212,26 @@ class StromConfig:
                                        # it uniformly so no stall bucket
                                        # can be zeroed in isolation
     metrics_port: int = 0              # >0: StromContext serves /metrics
-                                       # (Prometheus), /stats (JSON) and
-                                       # /trace (event-ring dump) on
-                                       # 127.0.0.1:<port> for the context's
-                                       # lifetime (strom/obs/server.py).
-                                       # 0 = no server.
+                                       # (Prometheus), /stats (JSON),
+                                       # /trace (event-ring dump) and
+                                       # /flight (on-demand flight capture)
+                                       # on 127.0.0.1:<port> for the
+                                       # context's lifetime
+                                       # (strom/obs/server.py). 0 = no
+                                       # server.
+    # flight recorder (strom/obs/flight.py — ISSUE 6 tentpole): a non-empty
+    # flight_dir starts a watchdog thread for the context's lifetime that
+    # samples step progress / slab occupancy / engine in-flight / ring
+    # high-water into a small ring, and dumps an atomic crash bundle
+    # (Chrome trace + stats snapshot + per-thread stacks + last-N samples)
+    # there on SIGTERM, unhandled exception, or no step progress for longer
+    # than flight_stall_s ("" = recorder off; /flight on the live server
+    # still captures on demand).
+    flight_dir: str = ""
+    flight_stall_s: float = 30.0       # no-progress watchdog threshold in
+                                       # seconds; <= 0 disables the stall
+                                       # trigger (signal/exception dumps
+                                       # stay armed)
 
     def __post_init__(self) -> None:
         if self.buffer_size == 0:
@@ -276,6 +293,8 @@ class StromConfig:
                     kwargs[field.name] = _env_cast(os.environ[env_key], int)
                 elif typ in ("bool", bool):
                     kwargs[field.name] = _env_cast(os.environ[env_key], bool)
+                elif typ in ("float", float):
+                    kwargs[field.name] = _env_cast(os.environ[env_key], float)
                 elif typ in ("str", str):
                     kwargs[field.name] = os.environ[env_key]
         kwargs.update(overrides)

@@ -254,9 +254,24 @@ class StromContext:
 
     def __init__(self, config: StromConfig | None = None,
                  engine: Engine | None = None, *,
-                 metrics_port: int | None = None):
+                 metrics_port: int | None = None,
+                 scope: "dict | None | object" = None):
         self.config = config or StromConfig.from_env()
         self.engine = engine or make_engine(self.config)
+        # telemetry scope (ISSUE 6 tentpole): a dict of labels becomes a
+        # label-scoped child view of the global registry — every delivery
+        # counter/histogram written through it lands in BOTH the scoped
+        # series (a Prometheus-labeled twin on /metrics) and the unlabeled
+        # aggregate. None = the identity scope (global registry, the
+        # single-tenant behavior). A prebuilt ScopedStats passes through so
+        # several contexts can share one tenant scope.
+        if scope is None:
+            self.scope = global_stats
+        elif isinstance(scope, dict):
+            self.scope = global_stats.scoped(**scope)
+        else:
+            self.scope = scope
+        self.engine.set_scope(self.scope)
         self._files: dict[str, int] = {}
         # path → StripedFile aliases (register_striped): lets format readers
         # that traffic in path-keyed extents (tar members, Parquet column
@@ -309,7 +324,8 @@ class StromContext:
         self._hot_cache = HotCache(
             self.config.hot_cache_bytes, pool=self._slab_pool,
             admit=self.config.hot_cache_admit,
-            block_bytes=self.config.hot_cache_block_bytes) \
+            block_bytes=self.config.hot_cache_block_bytes,
+            scope=self.scope) \
             if self.config.hot_cache_bytes > 0 else None
         # in-flight DEMAND gathers (not readahead): the readahead thread
         # checks this between engine-budget-sized slices and yields, so a
@@ -334,11 +350,26 @@ class StromContext:
         # steal from decode workers) more than once per TTL
         self._steps_cache: tuple[float, dict] | None = None
         self._steps_cache_lock = threading.Lock()
+        # flight recorder (ISSUE 6 tentpole, strom/obs/flight.py): with a
+        # flight_dir configured, a watchdog samples progress/pressure for
+        # the context's lifetime and dumps an atomic crash bundle on
+        # SIGTERM / unhandled exception / no-step-progress — the post-
+        # mortem for runs that die the way BENCH_r05 did (rc=124, nothing
+        # to diagnose). Created BEFORE the live server so /flight can
+        # serve the recorder's sample history, not just a point capture.
+        self._flight = None
+        if self.config.flight_dir:
+            from strom.obs.flight import FlightRecorder
+
+            self._flight = FlightRecorder(
+                self.config.flight_dir, ctx=self,
+                stall_s=self.config.flight_stall_s)
         port = self.config.metrics_port if metrics_port is None else metrics_port
         if port is not None and (port > 0 or metrics_port == 0):
             from strom.obs.server import MetricsServer
 
-            self._metrics_server = MetricsServer(self.stats, port=port)
+            self._metrics_server = MetricsServer(self.stats, port=port,
+                                                 flight=self._flight, ctx=self)
         self._closed = False
 
     @property
@@ -346,6 +377,12 @@ class StromContext:
         """The live endpoint when one was requested (``.port`` carries the
         bound port), else None."""
         return self._metrics_server
+
+    @property
+    def flight_recorder(self):
+        """The flight recorder when ``flight_dir`` is configured, else
+        None (the /flight route still captures on demand without one)."""
+        return self._flight
 
     @property
     def hot_cache(self) -> HotCache | None:
@@ -532,11 +569,11 @@ class StromContext:
                 # the queue while window N's completions drain, instead of
                 # a chunk-granular round-robin hopping members every
                 # raid_chunk bytes (see plan_stripe_windows)
-                global_stats.add("stripe_windows",
+                self.scope.add("stripe_windows",
                                  count_stripe_windows(segs, len(sf.members),
                                                       wb))
                 segs = plan_stripe_windows(segs, len(sf.members), wb)
-                global_stats.set_gauge("stripe_overlap_window_bytes", wb)
+                self.scope.set_gauge("stripe_overlap_window_bytes", wb)
             for s in segs:
                 chunks.append((member_idx[s.member], s.member_offset,
                                dest_off + (s.logical_offset - file_off),
@@ -571,10 +608,10 @@ class StromContext:
                 if cfg.coalesce_max_bytes and len(runs) > 1:
                     n_in = len(runs)
                     runs = coalesce_segments(runs, cfg.coalesce_max_bytes)
-                    global_stats.add("coalesce_ops_in", n_in)
-                    global_stats.add("coalesce_ops_out", len(runs))
-                    global_stats.set_gauge("coalesce_ops_in_last", n_in)
-                    global_stats.set_gauge("coalesce_ops_out_last", len(runs))
+                    self.scope.add("coalesce_ops_in", n_in)
+                    self.scope.add("coalesce_ops_out", len(runs))
+                    self.scope.set_gauge("coalesce_ops_in_last", n_in)
+                    self.scope.set_gauge("coalesce_ops_out_last", len(runs))
                 for s in runs:
                     stripe_chunks(sf, s.file_offset, s.dest_offset, s.length)
         else:
@@ -590,10 +627,10 @@ class StromContext:
             # segment level above, before stripe expansion.
             n_in = len(chunks)
             chunks = coalesce_chunks(chunks, cfg.coalesce_max_bytes)
-            global_stats.add("coalesce_ops_in", n_in)
-            global_stats.add("coalesce_ops_out", len(chunks))
-            global_stats.set_gauge("coalesce_ops_in_last", n_in)
-            global_stats.set_gauge("coalesce_ops_out_last", len(chunks))
+            self.scope.add("coalesce_ops_in", n_in)
+            self.scope.add("coalesce_ops_out", len(chunks))
+            self.scope.set_gauge("coalesce_ops_in_last", n_in)
+            self.scope.set_gauge("coalesce_ops_out_last", len(chunks))
 
         if cfg.extent_aware and chunks and not member_cache:
             # extent-aware planning for plain-file gathers of every source
@@ -732,7 +769,7 @@ class StromContext:
                     _events_ring.complete(t0a, _events_ring.now_us() - t0a,
                                           "cache", "cache.admit",
                                           {"bytes": admitted})
-        global_stats.add("ssd2tpu_bytes", total + cache_hit)
+        self.scope.add("ssd2tpu_bytes", total + cache_hit)
         return total + cache_hit
 
     def _warm_read_chunks(self, chunks: list[tuple[int, int, int, int]],
@@ -807,7 +844,8 @@ class StromContext:
 
     # -- completion-driven streaming gather (ISSUE 5 tentpole) --------------
     def stream_segments(self, source: "Source", segments: Sequence[Segment],
-                        dest: np.ndarray, base_offset: int = 0):
+                        dest: np.ndarray, base_offset: int = 0, *,
+                        scope=None):
         """Begin a completion-driven gather of *segments* into *dest*: the
         same plan ``_read_segments`` would execute (striped aliases,
         coalescing, stripe windows, extent-aware ordering, hot-cache
@@ -821,7 +859,8 @@ class StromContext:
 
         if self._closed:
             raise RuntimeError("StromContext is closed")
-        return StreamingGather(self, source, segments, dest, base_offset)
+        return StreamingGather(self, source, segments, dest, base_offset,
+                               scope=scope)
 
     def warm(self, source: "Source", segments: Sequence[Segment],
              base_offset: int = 0) -> int:
@@ -909,11 +948,11 @@ class StromContext:
                 fail.append(e)
                 ready.put(None)
             finally:
-                global_stats.add("stream_reader_wall_us",
-                                 int((time.perf_counter() - r_t0) * 1e6))
-                global_stats.add("stream_reader_idle_us", int(idle * 1e6))
-                global_stats.add("stream_reader_read_us",
-                                 int(read_busy * 1e6))
+                self.scope.add("stream_reader_wall_us",
+                               int((time.perf_counter() - r_t0) * 1e6))
+                self.scope.add("stream_reader_idle_us", int(idle * 1e6))
+                self.scope.add("stream_reader_read_us",
+                               int(read_busy * 1e6))
 
         t = threading.Thread(target=reader, name="strom-stream-reader",
                              daemon=True)
@@ -960,10 +999,10 @@ class StromContext:
         # the software kept the host->HBM link saturated the whole transfer —
         # a weather-independent measure where absolute GB/s is hostage to the
         # (shared, token-bucket-throttled) transfer relay (BASELINE.md §C).
-        global_stats.add("device_put_busy_us",
-                         int(put_busy * 1e6))
-        global_stats.add("stream_wall_us",
-                         int((time.perf_counter() - wall_t0) * 1e6))
+        self.scope.add("device_put_busy_us",
+                       int(put_busy * 1e6))
+        self.scope.add("stream_wall_us",
+                       int((time.perf_counter() - wall_t0) * 1e6))
         return [_reshape_donated(b, tuple(local_shape)) for b in bufs]
 
     def _resolve_read_shape(self, source: "Source", offset: int,
@@ -1087,7 +1126,7 @@ class StromContext:
                                 out.block_until_ready()
                             finally:
                                 self._hot_cache.unpin([entry])
-                            global_stats.add("ssd2tpu_bytes", nbytes)
+                            self.scope.add("ssd2tpu_bytes", nbytes)
                             return out
                     if stream_eligible(nbytes):
                         return self._deliver_streamed(
@@ -1263,8 +1302,22 @@ class StromContext:
     def buffer_info(self) -> dict:
         return self.engine.buffer_info()
 
-    def stats(self) -> dict:
-        out = {"context": {
+    def stats(self, sections: "Sequence[str] | None" = None) -> dict:
+        """Nested per-section stats (the /stats and sections-exposition
+        shape). *sections* selects a subset by name — the live endpoint's
+        per-section TTL cache uses it so a scrape that only wants counters
+        never recomputes the expensive stall-attribution section (ISSUE 6
+        satellite). None = every section (the pre-existing contract).
+        Known sections: context, decode, stream, steps, cache, slab_pool,
+        engine, scopes."""
+        want = None if sections is None else set(sections)
+
+        def wanted(name: str) -> bool:
+            return want is None or name in want
+
+        out: dict = {}
+        if wanted("context"):
+            out["context"] = {
             "registered_files": len(self._files),
             "ssd2tpu_bytes": global_stats.counter("ssd2tpu_bytes").value,
             # delivery-scheduler observability: op counts before/after
@@ -1279,30 +1332,31 @@ class StromContext:
             "stripe_overlap_window_bytes":
                 global_stats.gauge("stripe_overlap_window_bytes").value,
             "stripe_windows": global_stats.counter("stripe_windows").value,
-        }}
+            }
         # decode-path observability (vision pipelines; ISSUE 2 tentpole):
         # reduced-scale hit counts per denominator, bytes decoded straight
         # into batch slots, per-sample decode failures absorbed by the
         # zero-image policy, and the decode/put overlap window
-        dh = global_stats.histogram("decode_batch")
-        out["decode"] = {
-            "decode_reduced_hits_2":
-                global_stats.counter("decode_reduced_hits_2").value,
-            "decode_reduced_hits_4":
-                global_stats.counter("decode_reduced_hits_4").value,
-            "decode_reduced_hits_8":
-                global_stats.counter("decode_reduced_hits_8").value,
-            "decode_slot_bytes":
-                global_stats.counter("decode_slot_bytes").value,
-            "decode_errors": global_stats.counter("decode_errors").value,
-            "decode_put_overlap_ms":
-                global_stats.counter("decode_put_overlap_ms").value,
-            "decode_batch_p50_us": dh.percentile(0.50),
-            "decode_batch_mean_us": dh.mean_us,
-            "decode_batch_total_us": dh.total_us,
-            "decode_batch_count": dh.count,
-            "decode_batch_hist": list(dh.buckets),
-        }
+        if wanted("decode"):
+            dh = global_stats.histogram("decode_batch")
+            out["decode"] = {
+                "decode_reduced_hits_2":
+                    global_stats.counter("decode_reduced_hits_2").value,
+                "decode_reduced_hits_4":
+                    global_stats.counter("decode_reduced_hits_4").value,
+                "decode_reduced_hits_8":
+                    global_stats.counter("decode_reduced_hits_8").value,
+                "decode_slot_bytes":
+                    global_stats.counter("decode_slot_bytes").value,
+                "decode_errors": global_stats.counter("decode_errors").value,
+                "decode_put_overlap_ms":
+                    global_stats.counter("decode_put_overlap_ms").value,
+                "decode_batch_p50_us": dh.percentile(0.50),
+                "decode_batch_mean_us": dh.mean_us,
+                "decode_batch_total_us": dh.total_us,
+                "decode_batch_count": dh.count,
+                "decode_batch_hist": list(dh.buckets),
+            }
         # intra-batch streaming observability (ISSUE 5 tentpole): batches
         # that took the completion-driven path, the peak async depth, bytes
         # served as instant (cache) completions, the first-decode latency
@@ -1311,57 +1365,69 @@ class StromContext:
         # barrier imposed on EVERY sample; with streaming, work overlapped
         # it). Flat keys, full metric names — same exposition contract as
         # the cache section.
-        fd = global_stats.histogram("stream_first_decode_lat")
-        te = global_stats.histogram("stream_tail_extent")
-        out["stream"] = {
-            "stream_batches": global_stats.counter("stream_batches").value,
-            "stream_inflight_peak":
-                global_stats.gauge("stream_inflight_peak").value,
-            "stream_instant_bytes":
-                global_stats.counter("stream_instant_bytes").value,
-            "stream_samples_early":
-                global_stats.counter("stream_samples_early").value,
-            "stream_first_decode_lat_p50_us": fd.percentile(0.50),
-            "stream_first_decode_lat_mean_us": fd.mean_us,
-            "stream_first_decode_lat_total_us": fd.total_us,
-            "stream_first_decode_lat_count": fd.count,
-            "stream_first_decode_lat_hist": list(fd.buckets),
-            "stream_tail_extent_p50_us": te.percentile(0.50),
-            "stream_tail_extent_mean_us": te.mean_us,
-            "stream_tail_extent_total_us": te.total_us,
-            "stream_tail_extent_count": te.count,
-            "stream_tail_extent_hist": list(te.buckets),
-        }
+        if wanted("stream"):
+            fd = global_stats.histogram("stream_first_decode_lat")
+            te = global_stats.histogram("stream_tail_extent")
+            out["stream"] = {
+                "stream_batches":
+                    global_stats.counter("stream_batches").value,
+                "stream_inflight_peak":
+                    global_stats.gauge("stream_inflight_peak").value,
+                "stream_instant_bytes":
+                    global_stats.counter("stream_instant_bytes").value,
+                "stream_samples_early":
+                    global_stats.counter("stream_samples_early").value,
+                "stream_first_decode_lat_p50_us": fd.percentile(0.50),
+                "stream_first_decode_lat_mean_us": fd.mean_us,
+                "stream_first_decode_lat_total_us": fd.total_us,
+                "stream_first_decode_lat_count": fd.count,
+                "stream_first_decode_lat_hist": list(fd.buckets),
+                "stream_tail_extent_p50_us": te.percentile(0.50),
+                "stream_tail_extent_mean_us": te.mean_us,
+                "stream_tail_extent_total_us": te.total_us,
+                "stream_tail_extent_count": te.count,
+                "stream_tail_extent_hist": list(te.buckets),
+            }
         # per-step stall attribution from the event ring (ISSUE 3 tentpole):
         # goodput_pct + ingest-wait/decode/put/read/compute bucket p50/p99
         # over the step windows retained from THIS context's lifetime —
         # flat keys so the section rides sections_prometheus unchanged.
         # Recomputed at most once per TTL: a full-ring attribution costs
         # ~170ms, which a 10s Prometheus poll must not repeatedly steal
-        # from the single core the decode workers share.
-        from strom.obs import stall
+        # from the single core the decode workers share. Section-selective
+        # callers (the live endpoint's per-section cache) skip it entirely
+        # by leaving "steps" out of *sections*.
+        if wanted("steps"):
+            from strom.obs import stall
 
-        _STEPS_TTL_S = 2.0
-        now = time.monotonic()
-        with self._steps_cache_lock:
-            cached = self._steps_cache
-            if cached is not None and now - cached[0] < _STEPS_TTL_S:
-                steps = dict(cached[1])
-            else:
-                steps = stall.flatten_summary(stall.steps_summary(
-                    _events_ring.snapshot(), lo_us=self._obs_t0_us))
-                self._steps_cache = (now, dict(steps))
-        steps["events_dropped"] = _events_ring.events_dropped
-        out["steps"] = steps
+            _STEPS_TTL_S = 2.0
+            now = time.monotonic()
+            with self._steps_cache_lock:
+                cached = self._steps_cache
+                if cached is not None and now - cached[0] < _STEPS_TTL_S:
+                    steps = dict(cached[1])
+                else:
+                    steps = stall.flatten_summary(stall.steps_summary(
+                        _events_ring.snapshot(), lo_us=self._obs_t0_us))
+                    self._steps_cache = (now, dict(steps))
+            steps["events_dropped"] = _events_ring.events_dropped
+            out["steps"] = steps
         # hot-set cache observability (ISSUE 4): hit/miss/admission/
         # eviction/readahead counters + hit ratio, keyed with full metric
         # names so the sections exposition types them via the global
         # registry mirror (same contract as the context section)
-        if self._hot_cache is not None:
+        if wanted("cache") and self._hot_cache is not None:
             out["cache"] = self._hot_cache.stats()
-        if self._slab_pool is not None:
+        if wanted("slab_pool") and self._slab_pool is not None:
             out["slab_pool"] = self._slab_pool.stats()
-        out["engine"] = self.engine.stats()
+        if wanted("engine"):
+            out["engine"] = self.engine.stats()
+        # scoped telemetry (ISSUE 6 tentpole): every label scope's series as
+        # {label-string: snapshot} — the JSON twin of the labeled samples
+        # /metrics renders; the sections exposition skips it (nested dicts),
+        # so labels appear on /metrics exactly once, via the registry.
+        if wanted("scopes"):
+            out["scopes"] = global_stats.scopes_snapshot()
         return out
 
     def close(self) -> None:
@@ -1370,6 +1436,8 @@ class StromContext:
         self._closed = True
         if self._metrics_server is not None:
             self._metrics_server.close()
+        if self._flight is not None:
+            self._flight.close()
         self._executor.shutdown(wait=True)
         self._group_executor.shutdown(wait=True)
         self.engine.close()

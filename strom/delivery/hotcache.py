@@ -59,7 +59,6 @@ from typing import Any, Callable, Iterable
 import numpy as np
 
 from strom.delivery.buffers import HUGE_PAGE, alloc_aligned, size_class
-from strom.utils.stats import global_stats
 
 ADMIT_POLICIES = ("second_touch", "always")
 
@@ -115,7 +114,7 @@ class HotCache:
 
     def __init__(self, max_bytes: int, *, pool=None,
                  admit: str = "second_touch", block_bytes: int = 1 << 20,
-                 touch_capacity: int = 1 << 16):
+                 touch_capacity: int = 1 << 16, scope=None):
         if max_bytes <= 0:
             raise ValueError("max_bytes must be positive")
         if admit not in ADMIT_POLICIES:
@@ -143,9 +142,16 @@ class HotCache:
         self._touched: "OrderedDict[tuple, None]" = OrderedDict()
         self._touch_cap = touch_capacity
         self.bytes = 0
+        # telemetry scope (ISSUE 6): the owning context's label scope, so a
+        # tenant's cache traffic is distinguishable on /metrics; None = the
+        # global registry (single-tenant behavior unchanged)
+        from strom.utils.stats import global_stats
+
+        self._scope = scope if scope is not None else global_stats
         # instance tallies (authoritative for stats()); the same names are
-        # mirrored into global_stats so /metrics typing and bench deltas
-        # work without bespoke plumbing
+        # mirrored into the telemetry scope (scoped series + global
+        # aggregate) so /metrics typing and bench deltas work without
+        # bespoke plumbing
         self.hit_bytes = 0
         self.miss_bytes = 0
         self.hits = 0
@@ -230,12 +236,12 @@ class HotCache:
                 self.misses += len(misses)
         if record:
             if hits:
-                global_stats.add("cache_hits", len(hits))
-                global_stats.add("cache_hit_bytes",
+                self._scope.add("cache_hits", len(hits))
+                self._scope.add("cache_hit_bytes",
                                  sum(t - s for s, t, _ in hits))
             if misses:
-                global_stats.add("cache_misses", len(misses))
-                global_stats.add("cache_miss_bytes",
+                self._scope.add("cache_misses", len(misses))
+                self._scope.add("cache_miss_bytes",
                                  sum(t - s for s, t in misses))
         return hits, misses, pinned
 
@@ -260,8 +266,8 @@ class HotCache:
                 self.hit_bytes += hi - lo
                 self.hits += 1
         if record:
-            global_stats.add("cache_hits")
-            global_stats.add("cache_hit_bytes", hi - lo)
+            self._scope.add("cache_hits")
+            self._scope.add("cache_hit_bytes", hi - lo)
         return e.buf[lo - e.lo: hi - e.lo], e
 
     def unpin(self, entries: Iterable[_Entry]) -> None:
@@ -318,7 +324,7 @@ class HotCache:
         if admitted:
             with self._lock:
                 self.admitted_bytes += admitted
-            global_stats.add("cache_admitted_bytes", admitted)
+            self._scope.add("cache_admitted_bytes", admitted)
         return admitted
 
     def _insert(self, skey: Any, lo: int, hi: int, data: np.ndarray) -> int:
@@ -371,8 +377,8 @@ class HotCache:
         self.bytes -= e.charge
         self.evictions += 1
         self.evicted_bytes += e.nbytes
-        global_stats.add("cache_evictions")
-        global_stats.add("cache_evicted_bytes", e.nbytes)
+        self._scope.add("cache_evictions")
+        self._scope.add("cache_evicted_bytes", e.nbytes)
         if e.refs == 0:
             buf, e.buf = e.buf, None  # type: ignore[assignment]
             # pool.release takes its own lock; safe under ours (no inverse
@@ -395,12 +401,12 @@ class HotCache:
     def note_readahead(self, nbytes: int) -> None:
         with self._lock:
             self.readahead_bytes += nbytes
-        global_stats.add("cache_readahead_bytes", nbytes)
+        self._scope.add("cache_readahead_bytes", nbytes)
 
     def note_yield(self) -> None:
         with self._lock:
             self.readahead_yields += 1
-        global_stats.add("cache_readahead_yields")
+        self._scope.add("cache_readahead_yields")
 
     def note_error(self) -> None:
         """A readahead tick died (window_fn raised, source vanished): the
@@ -409,7 +415,7 @@ class HotCache:
         cannot tell the two apart)."""
         with self._lock:
             self.readahead_errors += 1
-        global_stats.add("cache_readahead_errors")
+        self._scope.add("cache_readahead_errors")
 
     # -- introspection ------------------------------------------------------
     @property
@@ -440,7 +446,7 @@ class HotCache:
                 "cache_readahead_errors": self.readahead_errors,
                 "cache_hit_ratio": round(ratio, 4),
             }
-        global_stats.set_gauge("cache_hit_ratio", out["cache_hit_ratio"])
+        self._scope.set_gauge("cache_hit_ratio", out["cache_hit_ratio"])
         return out
 
 

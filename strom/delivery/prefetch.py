@@ -80,7 +80,8 @@ class Prefetcher(Generic[T]):
                  stats: StatsRegistry | None = None,
                  auto_depth: bool = False,
                  min_depth: int = 1,
-                 max_depth: int | None = None):
+                 max_depth: int | None = None,
+                 scope=None):
         if depth < 1:
             raise ValueError("depth must be >= 1")
         if min_depth < 1:
@@ -103,13 +104,17 @@ class Prefetcher(Generic[T]):
         self._queue: deque[concurrent.futures.Future] = deque()
         self._lock = threading.Lock()
         self.stats = stats or StatsRegistry("prefetch")
+        # telemetry scope (ISSUE 6): the pipeline's label scope, so two
+        # pipelines' depth/stall series are distinguishable on /metrics;
+        # None = the global registry (single-tenant behavior unchanged)
+        self._scope = scope if scope is not None else global_stats
         self.stats.set_gauge("prefetch_depth", self._depth)
-        # mirrored into the GLOBAL registry too, so depth and the stall
-        # count appear in /metrics and bench JSON without bespoke plumbing
-        # (gauge semantics: the CURRENT pipeline's state; a later pipeline
-        # takes the name over, same as every *_last gauge)
-        global_stats.set_gauge("prefetch_depth", self._depth)
-        global_stats.set_gauge("prefetch_data_stall_steps", 0)
+        # mirrored into the telemetry scope too (scoped series + global
+        # aggregate), so depth and the stall count appear in /metrics and
+        # bench JSON without bespoke plumbing (gauge semantics: the CURRENT
+        # pipeline's state; within a scope, the latest pipeline wins)
+        self._scope.set_gauge("prefetch_depth", self._depth)
+        self._scope.set_gauge("prefetch_data_stall_steps", 0)
         self.depth_trace: list[tuple[int, int]] = [(0, self._depth)]
         self._ready_streak = 0
         self._was_stalled = False
@@ -151,7 +156,7 @@ class Prefetcher(Generic[T]):
         self._depth = depth
         self.stats.add("depth_grow" if kind == "grow" else "depth_shrink")
         self.stats.set_gauge("prefetch_depth", depth)
-        global_stats.set_gauge("prefetch_depth", depth)
+        self._scope.set_gauge("prefetch_depth", depth)
         # depth changes on the timeline: the controller's moves line up
         # against the stalls that caused them
         ring.instant("prefetch.depth", cat="prefetch",
@@ -182,8 +187,8 @@ class Prefetcher(Generic[T]):
                 fut = self._queue.popleft()
         if not fut.done():
             self.stats.add("data_stall_steps")
-            global_stats.set_gauge("prefetch_data_stall_steps",
-                                   self.stats.counter("data_stall_steps").value)
+            self._scope.set_gauge("prefetch_data_stall_steps",
+                                  self.stats.counter("data_stall_steps").value)
             if not self._was_stalled:  # ready -> stall transition
                 ring.instant("prefetch.state", cat="prefetch",
                              args={"state": "stall"})

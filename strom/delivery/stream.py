@@ -46,7 +46,6 @@ import numpy as np
 from strom.delivery.shard import Segment
 from strom.engine.base import EngineError
 from strom.obs.events import ring as _events_ring
-from strom.utils.stats import global_stats
 
 # bench-JSON columns the streaming arms emit (cli.py _stream_stats_delta),
 # single-sourced so the driver's per-arm copy loop (bench.py) and the
@@ -85,8 +84,12 @@ class StreamingGather:
     """
 
     def __init__(self, ctx, source, segments: Sequence[Segment],
-                 dest: np.ndarray, base_offset: int = 0):
+                 dest: np.ndarray, base_offset: int = 0, *, scope=None):
         self._ctx = ctx
+        # telemetry scope (ISSUE 6): pipelines pass their label scope so two
+        # tenants' streamed gathers surface distinguishable stream_* series;
+        # default: the context's scope (single-tenant behavior unchanged)
+        self._scope = scope if scope is not None else ctx.scope
         self._dflat = dest if dest.ndim == 1 and dest.dtype == np.uint8 \
             else dest.reshape(-1).view(np.uint8)
         self._closed = False
@@ -118,13 +121,13 @@ class StreamingGather:
             self.total_bytes = self._miss_planned + hit_bytes
             self.instant_bytes = hit_bytes
             if hit_bytes:
-                global_stats.add("stream_instant_bytes", hit_bytes)
+                self._scope.add("stream_instant_bytes", hit_bytes)
             if chunks:
                 self._stack.enter_context(ctx._demand_gate())
                 self._stack.enter_context(ctx._engine_lock)
                 self._token = ctx.engine.submit_vectored(
                     chunks, dest, retries=ctx.config.io_retries)
-            global_stats.add("stream_batches")
+            self._scope.add("stream_batches")
         except BaseException:
             self._stack.close()
             self._closed = True
@@ -198,7 +201,7 @@ class StreamingGather:
                 errno.EIO, f"ssd2tpu streamed read {total} bytes, "
                            f"planned {self._miss_planned}")
         self._release()
-        global_stats.add("ssd2tpu_bytes", self.total_bytes)
+        self._scope.add("ssd2tpu_bytes", self.total_bytes)
         return self.total_bytes
 
     def _release(self) -> None:
@@ -208,7 +211,7 @@ class StreamingGather:
         self._closed = True
         tok = self._token
         if tok is not None:
-            global_stats.gauge("stream_inflight_peak").max(tok.inflight_peak)
+            self._scope.gauge("stream_inflight_peak").max(tok.inflight_peak)
             # keep the stall attribution's `read` bucket lit on streamed
             # batches: the async token never passes through read_vectored's
             # instrumented wrappers, so the engine window is billed here
@@ -222,8 +225,8 @@ class StreamingGather:
             # the spread the old barrier serialized on: how long the
             # slowest extent lagged the first completion — with streaming,
             # work done during this window is the win
-            global_stats.observe_us("stream_tail_extent",
-                                    self._last_c_us - self._first_c_us)
+            self._scope.observe_us("stream_tail_extent",
+                                   self._last_c_us - self._first_c_us)
         if self._admitted:
             _events_ring.complete(self.t0_us,
                                   _events_ring.now_us() - self.t0_us,

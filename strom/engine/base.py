@@ -215,6 +215,58 @@ class Engine(abc.ABC):
     def __exit__(self, *exc) -> None:
         self.close()
 
+    # -- per-op telemetry scope (multi-tenant observability) ----------------
+    # The delivery context threads its label scope here so two pipelines /
+    # tenants sharing one engine fleet surface distinguishable per-op
+    # latency (engine_op_lat_us histogram) and queue occupancy
+    # (engine_inflight gauge) on /metrics, while the unlabeled aggregate
+    # stays the whole engine's truth. engine_inflight is a LAST-STATE gauge
+    # (not a sum across scopes): each write snapshots the engine-wide
+    # in-flight count at that scope's most recent submit/reap edge.
+    def set_scope(self, scope) -> None:
+        """Install the telemetry scope (a ``StatsRegistry`` or
+        ``ScopedStats``) per-op accounting writes through."""
+        self._op_scope = scope
+
+    @property
+    def op_scope(self):
+        sc = getattr(self, "_op_scope", None)
+        if sc is None:
+            from strom.utils.stats import global_stats
+
+            return global_stats
+        return sc
+
+    def _note_submitted(self, requests: Sequence) -> None:
+        """Stamp submit time per tag (engine_op_lat_us measures submit →
+        completion, the queue-resident latency the consumer actually pays,
+        not just device service time) and refresh the occupancy gauge."""
+        m = getattr(self, "_op_submit_t", None)
+        if m is None:
+            m = self._op_submit_t = {}
+        t = time.perf_counter()
+        for r in requests:
+            m[r.tag] = t
+        try:
+            self.op_scope.set_gauge("engine_inflight", self.in_flight())
+        except Exception:
+            pass  # accounting must never fail a submission
+
+    def _note_completed(self, completions: Sequence[Completion]) -> None:
+        m = getattr(self, "_op_submit_t", None)
+        sc = self.op_scope
+        if m:
+            t = time.perf_counter()
+            h = sc.histogram("engine_op_lat")
+            for c in completions:
+                t0 = m.pop(c.tag, None)
+                if t0 is not None:
+                    h.observe_us((t - t0) * 1e6)
+        try:
+            sc.set_gauge("engine_inflight", self.in_flight())
+        except Exception:
+            pass
+
     # -- optional registered-dest support (io_uring READ_FIXED) -------------
     def register_dest(self, arr: np.ndarray) -> int:
         """Register a caller slab so gathers into it can use pre-pinned
@@ -280,9 +332,7 @@ class Engine(abc.ABC):
                     fi, fo, do, want, attempts = entry
                     if c.result < 0:
                         if attempts < retries and err is None:
-                            from strom.utils.stats import global_stats
-
-                            global_stats.add("chunk_retries")
+                            self.op_scope.add("chunk_retries")
                             tag = self._vec_tag
                             self._vec_tag += 1
                             self.submit_raw(
@@ -320,9 +370,7 @@ class Engine(abc.ABC):
             # gather kept the queue full across op boundaries (the overlap
             # claim); a shallow peak means the op stream, not the engine,
             # was the limit
-            from strom.utils.stats import global_stats
-
-            global_stats.gauge("gather_inflight_peak").max(inflight_peak)
+            self.op_scope.gauge("gather_inflight_peak").max(inflight_peak)
         return total
 
     # -- async vectored gather: completion-driven submission ---------------
@@ -520,9 +568,7 @@ class Engine(abc.ABC):
             ci, fi, fo, do, want, attempts = piece
             if c.result < 0 and attempts < tok.retries \
                     and tok._err is None and not tok.cancelled:
-                from strom.utils.stats import global_stats
-
-                global_stats.add("chunk_retries")
+                self.op_scope.add("chunk_retries")
                 tok._backlog.append((ci, fi, fo, do, want, attempts + 1))
                 continue
             if c.result < 0:

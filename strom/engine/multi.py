@@ -122,6 +122,14 @@ class MultiRingEngine(Engine):
     def num_rings(self) -> int:
         return len(self._children)
 
+    def set_scope(self, scope) -> None:
+        """Propagate the telemetry scope to every member ring: per-op
+        latency/occupancy accounting happens at the child engines (they own
+        the submit/wait edges), so the scope must live there too."""
+        self._op_scope = scope
+        for c in self._children:
+            c.set_scope(scope)
+
     # -- files --------------------------------------------------------------
     def register_file(self, path: str, *, o_direct: bool | None = None) -> int:
         with self._reg_lock:
@@ -257,13 +265,11 @@ class MultiRingEngine(Engine):
         live = [r for r in range(n) if per_ring[r]]
         if len(live) == 1:
             return run(live[0])
-        from strom.utils.stats import global_stats
-
         # overlap observability: gathers whose member sub-gathers ran on
         # independent rings concurrently (the per-device blk-mq twin), and
         # how wide the fan-out went
-        global_stats.add("multi_ring_fanout_gathers")
-        global_stats.gauge("multi_ring_fanout_width").max(len(live))
+        self.op_scope.add("multi_ring_fanout_gathers")
+        self.op_scope.gauge("multi_ring_fanout_width").max(len(live))
         with _events.span("engine.multi.read_vectored", cat="read",
                           args={"ops": len(chunks), "fanout": len(live)}):
             futs = {r: self._pool.submit(run, r) for r in live}
@@ -311,10 +317,8 @@ class MultiRingEngine(Engine):
                 self._ring_locks[r].acquire()
                 locks.append(self._ring_locks[r])
             if len(live) > 1:
-                from strom.utils.stats import global_stats
-
-                global_stats.add("multi_ring_fanout_gathers")
-                global_stats.gauge("multi_ring_fanout_width").max(len(live))
+                self.op_scope.add("multi_ring_fanout_gathers")
+                self.op_scope.gauge("multi_ring_fanout_width").max(len(live))
             for r in live:
                 ch, imap = per_ring[r]
                 parts.append((r, self._children[r],

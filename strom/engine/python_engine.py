@@ -134,6 +134,7 @@ class PythonEngine(Engine):
                     _errno.EAGAIN,
                     f"queue depth exceeded ({self._in_flight}+{len(requests)} > {self.config.queue_depth})")
             self._in_flight += len(requests)
+        self._note_submitted(requests)
         for r in requests:
             self._submit_q.put(r)
         self._stats.add("ops_submitted", len(requests))
@@ -146,6 +147,7 @@ class PythonEngine(Engine):
             if self._in_flight + len(requests) > self.config.queue_depth:
                 raise EngineError(_errno.EAGAIN, "queue depth exceeded")
             self._in_flight += len(requests)
+        self._note_submitted(requests)
         for r in requests:
             self._submit_q.put(r)
         self._stats.add("ops_submitted", len(requests))
@@ -170,6 +172,7 @@ class PythonEngine(Engine):
         if out:
             with self._lock:
                 self._in_flight -= len(out)
+            self._note_completed(out)
         return out
 
     def in_flight(self) -> int:

@@ -269,10 +269,18 @@ class UringEngine(Engine):
                 self._lib.sc_unregister_dest(self._h, reg[0])
 
     def submit(self, requests: Sequence[ReadRequest]) -> int:
-        for r in requests:
+        self._note_submitted(requests)
+        for i, r in enumerate(requests):
             rc = self._lib.sc_submit_read(self._h, r.file_index, r.offset, r.length,
                                           r.buf_index, r.buf_offset, r.tag)
             if rc < 0:
+                # requests[i:] never entered the ring: drop their latency
+                # stamps (same cleanup contract as submit_raw) — a stale
+                # stamp would leak, and a later reused tag would pop it
+                # into a wildly inflated engine_op_lat observation
+                stamps = getattr(self, "_op_submit_t", None) or {}
+                for rr in requests[i:]:
+                    stamps.pop(rr.tag, None)
                 raise EngineError(-rc, f"submit: {os.strerror(-rc)}")
         return len(requests)
 
@@ -312,18 +320,24 @@ class UringEngine(Engine):
         # Register keepalives BEFORE the C call: the kernel can complete an op
         # inside sc_submit_raw_batch, and a concurrent wait() must find the
         # entry to pop — insert-after-submit would leak the pinned dest.
+        # (Same ordering for the per-op latency stamps: a completion landing
+        # inside the submit call must find its t0.)
+        self._note_submitted(requests)
         for r in requests:
             self._raw_keepalive[r.tag] = r.dest
         stop = ctypes.c_int32(0)
         rc = self._lib.sc_submit_raw_batch(self._h, ops, len(requests),
                                            ctypes.byref(stop))
+        stamps = getattr(self, "_op_submit_t", None) or {}
         if rc < 0:
             for r in requests:
                 self._raw_keepalive.pop(r.tag, None)
+                stamps.pop(r.tag, None)
             raise EngineError(-rc, f"submit_raw: {os.strerror(-rc)}")
         if rc < len(requests):
             for r in requests[rc:]:
                 self._raw_keepalive.pop(r.tag, None)
+                stamps.pop(r.tag, None)
             if stop.value:
                 # an op the engine can never accept (bad file index/addr):
                 # retrying it is futile — surface its true errno
@@ -349,6 +363,8 @@ class UringEngine(Engine):
         if self._raw_keepalive:
             for c in out:
                 self._raw_keepalive.pop(c.tag, None)
+        if out:
+            self._note_completed(out)
         return out
 
     def read_vectored(self, chunks: Sequence[tuple[int, int, int, int]],
@@ -371,16 +387,24 @@ class UringEngine(Engine):
         base = d8.__array_interface__["data"][0]
         reg = self._dest_regs.get(base)
         dest_buf_index = reg[0] if reg is not None and need <= reg[1] else -1
-        before = self._native_chunk_retries()
+        before = self._native_lat_snapshot()
         res = self._lib.sc_read_vectored(self._h, segs, len(chunks),
                                          ctypes.c_void_p(base),
                                          self.config.block_size, retries,
                                          dest_buf_index)
-        retried = self._native_chunk_retries() - before
+        after = self._native_lat_snapshot()
+        retried = after[0] - before[0]
         if retried > 0:
-            from strom.utils.stats import global_stats
-
-            global_stats.add("chunk_retries", retried)
+            self.op_scope.add("chunk_retries", retried)
+        # per-op latency for the native gather path (it never crosses the
+        # Python submit/wait hooks): mirror the native latency histogram's
+        # DELTA into the scoped engine_op_lat_us series — same log2 bucket
+        # convention, so the scoped and engine-section histograms agree
+        delta = [a - b for a, b in zip(after[1], before[1])]
+        if any(delta):
+            self.op_scope.histogram("engine_op_lat").add_buckets(
+                delta, after[2] - before[2])
+        self.op_scope.set_gauge("engine_inflight", self.in_flight())
         if res < 0:
             if -res == _errno.ENODATA:
                 raise EngineError(_errno.ENODATA,
@@ -393,6 +417,16 @@ class UringEngine(Engine):
         s = _ScStats()
         self._lib.sc_get_stats(self._h, ctypes.byref(s))
         return int(s.chunk_retries)
+
+    def _native_lat_snapshot(self) -> tuple[int, list[int], float]:
+        """(chunk_retries, lat_hist buckets, lat_total_us) in one stats
+        read: the before/after pair the native read_vectored path diffs to
+        mirror per-op latency into the telemetry scope."""
+        s = _ScStats()
+        self._lib.sc_get_stats(self._h, ctypes.byref(s))
+        return (int(s.chunk_retries),
+                [int(s.lat_hist[i]) for i in range(_HIST_BUCKETS)],
+                float(s.lat_total_us))
 
     def in_flight(self) -> int:
         return self._lib.sc_in_flight(self._h)

@@ -103,6 +103,22 @@ class EventRing:
             return self._dropped
 
     @property
+    def events_written(self) -> int:
+        """Total events ever recorded (monotonic, survives wraps): the
+        flight recorder's ring-pressure signal — a delta between two
+        samples is the event rate, where ``len(ring)`` saturates at
+        capacity the moment the ring wraps."""
+        with self._lock:
+            return self._idx
+
+    @property
+    def high_water(self) -> int:
+        """Max retained occupancy so far (== capacity once wrapped): how
+        close the ring has come to dropping history."""
+        with self._lock:
+            return min(self._idx, self._cap)
+
+    @property
     def capacity(self) -> int:
         return self._cap
 

@@ -3,16 +3,26 @@
 The reference's stats are scrapeable while a run is live (``cat
 /proc/nvme-strom`` mid-transfer); strom-tpu so far only dumped Prometheus
 text at bench end. This server makes the in-process state scrapeable the
-same way — three routes, no dependencies beyond ``http.server``:
+same way — four routes, no dependencies beyond ``http.server``:
 
-- ``GET /metrics`` — Prometheus text: the global registry plus (when an
+- ``GET /metrics`` — Prometheus text: the global registry (scoped series
+  as LABELED samples — two pipelines on one context are distinguishable
+  per label while the unlabeled aggregate stays their sum) plus (when an
   owning context supplies ``stats_fn``) the context/slab-pool/engine
-  sections via ``sections_prometheus`` — what a Prometheus scraper points
-  at during a run.
-- ``GET /stats``   — the same sections as a JSON snapshot (for humans and
-  dashboards that want structure, not exposition format).
+  sections via ``sections_prometheus``. ``?sections=context,cache``
+  restricts the section sweep — a scrape that only wants counters never
+  pays for the ~170ms stall-attribution section — and rendered section
+  text is cached per section with a short TTL so a polling scraper
+  amortizes even the cheap ones.
+- ``GET /stats``   — the same sections as a JSON snapshot (scopes
+  included), for humans and dashboards that want structure.
 - ``GET /trace``   — the event ring as Trace Event JSON: ``curl -o
   trace.json localhost:<port>/trace`` mid-run, load in Perfetto.
+- ``GET /flight``  — an on-demand flight capture (strom/obs/flight.py):
+  per-thread stacks, stats snapshot, event-ring trace, and — when a
+  FlightRecorder is attached — its watchdog sample history.
+  ``?dump=1`` additionally writes an atomic bundle to the recorder's
+  ``flight_dir`` and reports the path.
 
 Wired as ``StromContext(metrics_port=...)`` / ``StromConfig.metrics_port``
 (``STROM_METRICS_PORT``) / ``--metrics-port`` on the benches; port 0 asks
@@ -24,27 +34,45 @@ from __future__ import annotations
 import contextlib
 import json
 import threading
+import time
+import urllib.parse
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Callable
 
 from strom.obs.chrome_trace import trace_document
 from strom.obs.events import EventRing, ring as _global_ring
 
+# sections that are nested maps (not flat numeric leaves): excluded from
+# the Prometheus section sweep — their data reaches /metrics another way
+# (scopes render as labels straight from the registry) or is non-numeric
+_NON_EXPOSITION_SECTIONS = frozenset({"scopes"})
+
 
 class MetricsServer:
     """Background HTTP server over a stats callable and an event ring.
 
     *stats_fn* returns the nested sections dict (``StromContext.stats``
-    shape) or None; the global stats registry is always included in
-    ``/metrics``. Serving threads are daemonic: an abandoned server never
-    blocks process exit, though :meth:`close` is the polite path.
+    shape) or None; it may accept a ``sections=`` keyword (StromContext's
+    does) to compute only a subset — the per-section TTL cache uses that
+    so refreshing one stale section never recomputes the rest. The global
+    stats registry is always included in ``/metrics``. Serving threads are
+    daemonic: an abandoned server never blocks process exit, though
+    :meth:`close` is the polite path.
     """
 
     def __init__(self, stats_fn: Callable[[], dict] | None = None, *,
                  port: int = 0, host: str = "127.0.0.1",
-                 ring: EventRing | None = None):
+                 ring: EventRing | None = None,
+                 flight=None, ctx=None, section_ttl_s: float = 2.0):
         self._stats_fn = stats_fn
         self._ring = ring or _global_ring
+        self._flight = flight
+        self._ctx = ctx
+        self._ttl = max(float(section_ttl_s), 0.0)
+        # per-section rendered exposition cache: name -> (monotonic_t, text)
+        self._sec_cache: dict[str, tuple[float, str]] = {}
+        self._known_sections: list[str] = []
+        self._cache_lock = threading.Lock()
         server = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -59,10 +87,15 @@ class MetricsServer:
                 self.wfile.write(body)
 
             def do_GET(self) -> None:  # noqa: N802 (http.server API)
-                path = self.path.split("?", 1)[0]
+                path, _, query = self.path.partition("?")
+                q = urllib.parse.parse_qs(query)
                 try:
                     if path == "/metrics":
-                        self._send(200, server._metrics().encode(),
+                        only = None
+                        if "sections" in q:
+                            only = [s for part in q["sections"]
+                                    for s in part.split(",") if s]
+                        self._send(200, server._metrics(only).encode(),
                                    "text/plain; version=0.0.4")
                     elif path == "/stats":
                         self._send(200, json.dumps(server._stats()).encode(),
@@ -71,9 +104,15 @@ class MetricsServer:
                         doc = trace_document(server._ring.snapshot())
                         self._send(200, json.dumps(doc).encode(),
                                    "application/json")
+                    elif path == "/flight":
+                        dump = q.get("dump", ["0"])[0] not in ("0", "", "no")
+                        self._send(200,
+                                   json.dumps(server._flight_doc(dump),
+                                              default=str).encode(),
+                                   "application/json")
                     else:
                         self._send(404, b"not found: try /metrics /stats "
-                                        b"/trace\n", "text/plain")
+                                        b"/trace /flight\n", "text/plain")
                 except Exception as e:  # a scrape must never kill the server
                     with contextlib.suppress(Exception):
                         self._send(500, repr(e).encode(), "text/plain")
@@ -87,20 +126,84 @@ class MetricsServer:
         self._thread.start()
 
     # -- route bodies (exceptions bubble to the handler's 500) --------------
-    def _sections(self) -> dict:
-        return self._stats_fn() if self._stats_fn is not None else {}
+    def _call_stats(self, only: "list[str] | None" = None) -> dict:
+        if self._stats_fn is None:
+            return {}
+        if only is not None:
+            try:
+                return self._stats_fn(sections=only)
+            except TypeError:  # stats_fn predates section selection
+                pass
+        return self._stats_fn()
 
-    def _metrics(self) -> str:
-        from strom.utils.stats import global_stats, sections_prometheus
+    def _section_texts(self, only: "list[str] | None") -> list[str]:
+        """Rendered exposition per wanted section, served from the TTL
+        cache; only STALE wanted sections are recomputed (one stats_fn
+        call for the whole stale set). First scrape (section names
+        unknown) computes everything once to learn them."""
+        from strom.utils.stats import sections_prometheus
 
-        return global_stats.prometheus() + sections_prometheus(self._sections())
+        with self._cache_lock:
+            known = list(self._known_sections)
+        if not known:
+            secs = self._call_stats()
+            now = time.monotonic()
+            with self._cache_lock:
+                self._known_sections = [s for s in secs
+                                        if s not in _NON_EXPOSITION_SECTIONS]
+                for name, vals in secs.items():
+                    if name in _NON_EXPOSITION_SECTIONS:
+                        continue
+                    self._sec_cache[name] = (
+                        now, sections_prometheus({name: vals}))
+                known = list(self._known_sections)
+        wanted = [s for s in (only if only is not None else known)
+                  if s not in _NON_EXPOSITION_SECTIONS]
+        now = time.monotonic()
+        with self._cache_lock:
+            stale = [s for s in wanted
+                     if s not in self._sec_cache
+                     or now - self._sec_cache[s][0] >= self._ttl]
+        if stale:
+            secs = self._call_stats(stale)
+            now = time.monotonic()
+            with self._cache_lock:
+                for name, vals in secs.items():
+                    if name in _NON_EXPOSITION_SECTIONS:
+                        continue
+                    self._sec_cache[name] = (
+                        now, sections_prometheus({name: vals}))
+                    if name not in self._known_sections:
+                        self._known_sections.append(name)
+        with self._cache_lock:
+            return [self._sec_cache[s][1] for s in wanted
+                    if s in self._sec_cache]
+
+    def _metrics(self, only: "list[str] | None" = None) -> str:
+        from strom.utils.stats import global_stats
+
+        return global_stats.prometheus() + "".join(self._section_texts(only))
 
     def _stats(self) -> dict:
         from strom.utils.stats import global_stats
 
-        return {"sections": self._sections(),
+        return {"sections": self._call_stats(),
                 "global": global_stats.snapshot(),
+                "scopes": global_stats.scopes_snapshot(),
                 "events_dropped": self._ring.events_dropped}
+
+    def _flight_doc(self, dump: bool = False) -> dict:
+        if self._flight is not None:
+            doc = self._flight.capture("on_demand")
+            if dump:
+                doc["bundle_path"] = self._flight.dump("on_demand")
+            return doc
+        from strom.obs.flight import capture_doc
+
+        doc = capture_doc(ctx=self._ctx, ring=self._ring)
+        if dump:
+            doc["bundle_path"] = None  # no recorder → no flight_dir to hit
+        return doc
 
     def close(self) -> None:
         self._httpd.shutdown()

@@ -36,10 +36,18 @@ class Pipeline:
                  executor: concurrent.futures.Executor | None = None,
                  on_close: Callable[[], None] | None = None,
                  decode_pool: Any | None = None,
-                 epoch_sync: bool = False):
+                 epoch_sync: bool = False,
+                 scope: Any | None = None):
         self.sampler = sampler
         self.fingerprint = fingerprint or {}
         self._on_close = on_close
+        # telemetry scope (ISSUE 6): label-scoped stats view the pipeline's
+        # step/prefetch accounting writes through, so concurrent pipelines
+        # on one context surface distinguishable per-scope series. None =
+        # the global registry (single-tenant behavior unchanged).
+        from strom.utils.stats import global_stats
+
+        self.scope = scope if scope is not None else global_stats
         # the DecodePool feeding make_batch, when one exists (vision
         # pipelines): surfaces the per-sample decode-failure counter
         self._decode_pool = decode_pool
@@ -78,7 +86,8 @@ class Pipeline:
         self._prefetcher: Prefetcher = Prefetcher(thunks(), depth=depth,
                                                   auto_depth=auto_depth,
                                                   max_depth=max_depth,
-                                                  executor=executor)
+                                                  executor=executor,
+                                                  scope=self.scope)
 
     def __iter__(self) -> "Pipeline":
         return self
@@ -92,6 +101,11 @@ class Pipeline:
                        args={"step": self._consumed}):
             batch = next(self._prefetcher)
         self._consumed += 1
+        # step-progress heartbeat: the flight recorder's watchdog
+        # (strom/obs/flight.py) distinguishes "slow but advancing" from
+        # "wedged" by watching this counter; scoped, so per-pipeline step
+        # rates are also distinguishable on /metrics
+        self.scope.add("pipeline_steps")
         # per-host step cadence (consumer compute + any data wait): the raw
         # input to cross-host straggler accounting
         now = time.monotonic()

@@ -30,7 +30,8 @@ def make_llama_pipeline(ctx: StromContext, paths: Sequence[str], *,
                         prefetch_depth: int | None = None,
                         auto_prefetch: bool | None = None,
                         resume_from: str | SamplerState | None = None,
-                        epoch_sync: bool = False
+                        epoch_sync: bool = False,
+                        scope: dict | None = None
                         ) -> Pipeline:
     """Infinite stream of token batches [batch, seq_len+1] (inputs+targets
     window), delivered as jax.Arrays with *sharding*.
@@ -50,6 +51,10 @@ def make_llama_pipeline(ctx: StromContext, paths: Sequence[str], *,
                               ctx=ctx)
     sampler = EpochShuffleSampler(shards.num_records, batch, seed=seed,
                                   shuffle=shuffle, state=state)
+    # telemetry scope (ISSUE 6): label-scoped series for this pipeline,
+    # refined over the context's scope (tenant labels compose underneath)
+    pscope = ctx.scope.scoped(**(scope if scope is not None
+                                 else {"pipeline": "llama"}))
     shape = (batch, seq_len + 1)
 
     def make_batch(indices: np.ndarray, serial: int) -> Any:
@@ -63,4 +68,4 @@ def make_llama_pipeline(ctx: StromContext, paths: Sequence[str], *,
         batch * (seq_len + 1) * np.dtype(dtype).itemsize)
     return Pipeline(sampler, make_batch, depth=depth, auto_depth=auto,
                     max_depth=max_depth, fingerprint=fp,
-                    epoch_sync=epoch_sync)
+                    epoch_sync=epoch_sync, scope=pscope)

@@ -97,7 +97,8 @@ def parquet_scan_aggregate(ctx: StromContext, paths: Sequence[str],
                            process_index: int | None = None,
                            process_count: int | None = None,
                            reduce: str = "collective",
-                           decode_workers: int = 4) -> Any:
+                           decode_workers: int = 4,
+                           scope: dict | None = None) -> Any:
     """Scan shards' row groups, sum map_fn's partial aggregates, reduce
     globally. Returns the aggregate pytree (host numpy leaves).
 
@@ -205,8 +206,13 @@ def parquet_scan_aggregate(ctx: StromContext, paths: Sequence[str],
                          default=0)
         max_depth = bound_depth(ctx.config.slab_pool_bytes, unit_bytes,
                                 cap=ctx.config.prefetch_max_depth)
+    # telemetry scope (ISSUE 6): parquet scans surface their prefetch
+    # depth/stall series under their own label, distinguishable from any
+    # concurrent vision/llama pipeline on the same context
+    pscope = ctx.scope.scoped(**(scope if scope is not None
+                                 else {"pipeline": "parquet"}))
     pf = Prefetcher(thunks, depth=prefetch_depth, auto_depth=auto,
-                    max_depth=max_depth)
+                    max_depth=max_depth, scope=pscope)
     try:
         for cols in pf:
             dev = next(dev_cycle)

@@ -147,17 +147,19 @@ def _init_group_state(ctx: StromContext, images: np.ndarray,
     return pos_devs, pending, shards
 
 
-def _note_decode_overlap(t_decode0: float | None, t_first_put: float | None,
+def _note_decode_overlap(scope, t_decode0: float | None,
+                         t_first_put: float | None,
                          t_last_decode: float | None) -> None:
     """`decode_batch` histogram + decode/put-overlap counters, emitted
     identically by the overlapped and streamed paths (a fix to the metric
-    applies to both or the A/B arms silently diverge)."""
+    applies to both or the A/B arms silently diverge). *scope* is the
+    pipeline's telemetry scope (scoped series + global aggregate)."""
     if t_decode0 is None or t_last_decode is None:
         return
-    global_stats.observe_us("decode_batch", (t_last_decode - t_decode0) * 1e6)
+    scope.observe_us("decode_batch", (t_last_decode - t_decode0) * 1e6)
     if t_first_put is not None and t_last_decode > t_first_put:
-        global_stats.add("decode_put_overlap_ms",
-                         int((t_last_decode - t_first_put) * 1000))
+        scope.add("decode_put_overlap_ms",
+                  int((t_last_decode - t_first_put) * 1000))
         # the overlap window on the timeline: first put fired while decode
         # was still in flight, for this long
         from strom.obs.events import ring
@@ -170,7 +172,7 @@ def _note_decode_overlap(t_decode0: float | None, t_first_put: float | None,
 def _decode_put_overlapped(ctx: StromContext, pool: DecodePool, tf: Transform,
                            blobs: Sequence, rngs: Sequence,
                            images: np.ndarray, dev_items: Sequence,
-                           row_pos: dict) -> list:
+                           row_pos: dict, scope=None) -> list:
     """Decode every row into its slot and `device_put` each device's row
     group the moment its rows finish (completion-ordered — the per-group
     analogue of `_deliver_streamed`'s read/transfer overlap: early groups
@@ -200,14 +202,15 @@ def _decode_put_overlapped(ctx: StromContext, pool: DecodePool, tf: Transform,
                     t_first_put = time.perf_counter()
                 shards[di] = ctx.device_put(images[base: base + hi - lo],
                                             device)
-    _note_decode_overlap(t0, t_first_put, t_last_decode)
+    _note_decode_overlap(scope or global_stats, t0, t_first_put,
+                         t_last_decode)
     return shards
 
 
 def _decode_put_streamed(ctx: StromContext, pool: DecodePool, tf: Transform,
                          el, sizes: Sequence[tuple[int, int]],
                          rngs: Sequence, images: np.ndarray,
-                         dev_items: Sequence, row_pos: dict
+                         dev_items: Sequence, row_pos: dict, scope=None
                          ) -> tuple[list, list[int]]:
     """Completion-driven batch assembly (ISSUE 5 tentpole): the member
     gather is submitted through ``ctx.stream_segments`` and each sample is
@@ -252,7 +255,8 @@ def _decode_put_streamed(ctx: StromContext, pool: DecodePool, tf: Transform,
     futs_lock = threading.Lock()
     t_decode0: list[float | None] = [None]
 
-    g = ctx.stream_segments(el, [Segment(0, 0, el.size)], buf)
+    scope = scope or global_stats
+    g = ctx.stream_segments(el, [Segment(0, 0, el.size)], buf, scope=scope)
 
     def submit_sample(i: int) -> None:
         isz, lsz = sizes[i]
@@ -262,12 +266,12 @@ def _decode_put_streamed(ctx: StromContext, pool: DecodePool, tf: Transform,
             t_decode0[0] = time.perf_counter()
             # gather start -> first decode dispatch: the latency the old
             # barrier padded out to the slowest extent of the batch
-            global_stats.observe_us("stream_first_decode_lat",
-                                    ring.now_us() - g.t0_us)
+            scope.observe_us("stream_first_decode_lat",
+                             ring.now_us() - g.t0_us)
         if not g.done:
             # dispatched while later extents were still in flight: the
             # intra-batch overlap, as a counter instead of a guess
-            global_stats.add("stream_samples_early")
+            scope.add("stream_samples_early")
         f = pool.submit_into(tf, buf[s: s + isz], rngs[i], images[i])
         with futs_lock:
             futs.append(f)
@@ -347,7 +351,7 @@ def _decode_put_streamed(ctx: StromContext, pool: DecodePool, tf: Transform,
                     f.result()
     if err is not None:
         raise err
-    _note_decode_overlap(t_decode0[0], t_first_put, t_last_decode)
+    _note_decode_overlap(scope, t_decode0[0], t_first_put, t_last_decode)
     return shards, labels
 
 
@@ -367,7 +371,8 @@ def make_wds_vision_pipeline(ctx: StromContext, paths: Sequence[str], *,
                              decode_to_slot: bool | None = None,
                              decode_overlap_put: bool | None = None,
                              stream_intra_batch: bool | None = None,
-                             resume_from: str | SamplerState | None = None
+                             resume_from: str | SamplerState | None = None,
+                             scope: dict | None = None
                              ) -> Pipeline:
     """Infinite stream of (images [B,S,S,3] uint8, labels [B] int32) jax.Array
     pairs sharded per *sharding* (a NamedSharding over a rank-4 image batch;
@@ -375,6 +380,11 @@ def make_wds_vision_pipeline(ctx: StromContext, paths: Sequence[str], *,
 
     Augmentation is deterministic in (seed, batch serial, row): identical
     across hosts and across checkpoint resume.
+
+    *scope*: telemetry labels for this pipeline (ISSUE 6), refined over the
+    context's scope — defaults to ``{"pipeline": "vision"}`` so two
+    pipelines on one context surface distinguishable per-scope series on
+    /metrics while the unlabeled aggregates stay their sum.
     """
     import jax
     from jax.sharding import NamedSharding, PartitionSpec as P
@@ -413,6 +423,8 @@ def make_wds_vision_pipeline(ctx: StromContext, paths: Sequence[str], *,
         else stream_intra_batch
     stream = stream and overlap_put
     pool = DecodePool(decode_workers)
+    pscope = ctx.scope.scoped(**(scope if scope is not None
+                                 else {"pipeline": "vision"}))
     label_sharding = NamedSharding(
         sharding.mesh,
         P(sharding.spec[0] if len(sharding.spec) else None))
@@ -450,9 +462,10 @@ def make_wds_vision_pipeline(ctx: StromContext, paths: Sequence[str], *,
             images = np.empty((len(local_rows), image_size, image_size, 3),
                               dtype=np.uint8)
             img_shards, labels = _decode_put_streamed(
-                ctx, pool, tf, el, sizes, rngs, images, dev_items, row_pos)
+                ctx, pool, tf, el, sizes, rngs, images, dev_items, row_pos,
+                scope=pscope)
             labels_np = np.asarray(labels, dtype=np.int32)
-            global_stats.add("decode_slot_bytes", images.nbytes)
+            pscope.add("decode_slot_bytes", images.nbytes)
             lbl_shards = [ctx.device_put(shard_view(labels_np, lo, hi), d)
                           for d, (lo, hi) in dev_items]
             imgs = jax.make_array_from_single_device_arrays(
@@ -478,18 +491,19 @@ def make_wds_vision_pipeline(ctx: StromContext, paths: Sequence[str], *,
                               dtype=np.uint8)
             if overlap_put:
                 img_shards = _decode_put_overlapped(
-                    ctx, pool, tf, blobs, rngs, images, dev_items, row_pos)
+                    ctx, pool, tf, blobs, rngs, images, dev_items, row_pos,
+                    scope=pscope)
             else:
-                with global_stats.timer_us("decode_batch"):
+                with pscope.timer_us("decode_batch"):
                     pool.map_into(tf, blobs, rngs, images)
                 img_shards = [ctx.device_put(shard_view(images, lo, hi), d)
                               for d, (lo, hi) in dev_items]
             # billed after the decode completes: an aborted batch never
             # claims slot bytes it didn't deliver (zero-substituted rows DO
             # occupy their slot and are separately counted in decode_errors)
-            global_stats.add("decode_slot_bytes", images.nbytes)
+            pscope.add("decode_slot_bytes", images.nbytes)
         else:
-            with global_stats.timer_us("decode_batch"):
+            with pscope.timer_us("decode_batch"):
                 images = np.stack(pool.map(tf, blobs, rngs))
             img_shards = [ctx.device_put(shard_view(images, lo, hi), d)
                           for d, (lo, hi) in dev_items]
@@ -513,7 +527,7 @@ def make_wds_vision_pipeline(ctx: StromContext, paths: Sequence[str], *,
     return Pipeline(sampler, make_batch, depth=depth, auto_depth=auto,
                     max_depth=max_depth, fingerprint=fp,
                     on_close=_chain_close(ra.close if ra else None, pool.close),
-                    decode_pool=pool)
+                    decode_pool=pool, scope=pscope)
 
 
 def make_predecoded_vision_pipeline(ctx: StromContext, paths: Sequence[str], *,
@@ -524,7 +538,8 @@ def make_predecoded_vision_pipeline(ctx: StromContext, paths: Sequence[str], *,
                                     shuffle: bool = True,
                                     prefetch_depth: int | None = None,
                                     auto_prefetch: bool | None = None,
-                                    resume_from: str | SamplerState | None = None
+                                    resume_from: str | SamplerState | None = None,
+                                    scope: dict | None = None
                                     ) -> Pipeline:
     """Decode-free vision loader over pre-decoded shards (see
     :mod:`strom.formats.predecoded`): batches are pure engine gathers +
@@ -559,6 +574,8 @@ def make_predecoded_vision_pipeline(ctx: StromContext, paths: Sequence[str], *,
     label_sharding = NamedSharding(
         sharding.mesh,
         P(sharding.spec[0] if len(sharding.spec) else None))
+    pscope = ctx.scope.scoped(**(scope if scope is not None
+                                 else {"pipeline": "predecoded"}))
     shape = (batch, image_size, image_size, 3)
 
     def make_batch(indices: np.ndarray, serial: int) -> tuple[Any, Any]:
@@ -578,7 +595,7 @@ def make_predecoded_vision_pipeline(ctx: StromContext, paths: Sequence[str], *,
         lambda indices: shards.extents([int(i) for i in indices]))
     return Pipeline(sampler, make_batch, depth=depth, auto_depth=auto,
                     max_depth=max_depth, fingerprint=fp,
-                    on_close=ra.close if ra else None)
+                    on_close=ra.close if ra else None, scope=pscope)
 
 
 def make_imagenet_resnet_pipeline(ctx: StromContext, paths: Sequence[str], *,
@@ -586,6 +603,7 @@ def make_imagenet_resnet_pipeline(ctx: StromContext, paths: Sequence[str], *,
                                   image_size: int = 224,
                                   **kw: Any) -> Pipeline:
     """BASELINE config #2: ImageNet raw-JPEG shards → ResNet-50 input pipeline."""
+    kw.setdefault("scope", {"pipeline": "resnet"})
     return make_wds_vision_pipeline(ctx, paths, batch=batch,
                                     image_size=image_size, sharding=sharding,
                                     **kw)
@@ -599,6 +617,7 @@ def make_vit_wds_pipeline(ctx: StromContext, paths: Sequence[str], *,
 
     Identical mechanics; shard *paths* typically live on a RAID0 set's member
     mounts so the gather fans out across NVMe devices."""
+    kw.setdefault("scope", {"pipeline": "vit"})
     return make_wds_vision_pipeline(ctx, paths, batch=batch,
                                     image_size=image_size, sharding=sharding,
                                     **kw)

@@ -72,6 +72,18 @@ class _Histogram:
             self.count += 1
             self.total_us += us
 
+    def add_buckets(self, buckets: Sequence[int], total_us: float) -> None:
+        """Bulk-merge a log2 bucket delta of the SAME convention (the native
+        engine's per-op latency histogram, mirrored into a scope after a
+        gather that never crossed the Python per-op path)."""
+        with self._lock:
+            n = 0
+            for i, b in enumerate(buckets[: self.N_BUCKETS]):
+                self.buckets[i] += int(b)
+                n += int(b)
+            self.count += n
+            self.total_us += total_us
+
     def percentile(self, q: float) -> float:
         """Approximate percentile in microseconds (upper bucket bound)."""
         with self._lock:
@@ -90,6 +102,173 @@ class _Histogram:
         return self.total_us / self.count if self.count else 0.0
 
 
+class _FanCounter:
+    """Counter pair fanned by a scope: one add lands in the scoped series
+    AND the aggregate, so the aggregate is always the sum of its scopes."""
+
+    __slots__ = ("_scoped", "_agg")
+
+    def __init__(self, scoped: _Counter, agg: _Counter) -> None:
+        self._scoped = scoped
+        self._agg = agg
+
+    def add(self, n: int = 1) -> None:
+        self._scoped.add(n)
+        self._agg.add(n)
+
+    @property
+    def value(self) -> int:
+        return self._scoped.value
+
+
+class _FanGauge:
+    __slots__ = ("_scoped", "_agg")
+
+    def __init__(self, scoped: _Gauge, agg: _Gauge) -> None:
+        self._scoped = scoped
+        self._agg = agg
+
+    def set(self, v: float) -> None:
+        self._scoped.set(v)
+        self._agg.set(v)
+
+    def max(self, v: float) -> None:
+        self._scoped.max(v)
+        self._agg.max(v)
+
+    @property
+    def value(self) -> float:
+        return self._scoped.value
+
+
+class _FanHistogram:
+    __slots__ = ("_scoped", "_agg")
+
+    def __init__(self, scoped: _Histogram, agg: _Histogram) -> None:
+        self._scoped = scoped
+        self._agg = agg
+
+    def observe_us(self, us: float) -> None:
+        self._scoped.observe_us(us)
+        self._agg.observe_us(us)
+
+    def add_buckets(self, buckets: Sequence[int], total_us: float) -> None:
+        self._scoped.add_buckets(buckets, total_us)
+        self._agg.add_buckets(buckets, total_us)
+
+    def percentile(self, q: float) -> float:
+        return self._scoped.percentile(q)
+
+    @property
+    def mean_us(self) -> float:
+        return self._scoped.mean_us
+
+    @property
+    def count(self) -> int:
+        return self._scoped.count
+
+    @property
+    def buckets(self) -> list[int]:
+        return self._scoped.buckets
+
+    @property
+    def total_us(self) -> float:
+        return self._scoped.total_us
+
+
+def format_labels(labels: dict) -> str:
+    """Canonical Prometheus label body (sorted, escaped): the scope's
+    identity string — ``pipeline="resnet",tenant="t0"``. Escaping follows
+    the text exposition format (backslash, quote, AND newline — one
+    unescaped newline in a label value would make a scraper reject the
+    whole /metrics body)."""
+    def esc(v: str) -> str:
+        return str(v).replace("\\", r"\\").replace('"', r'\"') \
+            .replace("\n", r"\n")
+
+    return ",".join(f'{k}="{esc(v)}"' for k, v in sorted(labels.items()))
+
+
+class ScopedStats:
+    """Label-scoped child view of a :class:`StatsRegistry` (the multi-tenant
+    telemetry substrate): every write through the scope updates BOTH the
+    scoped series and the parent aggregate, so per-pipeline/per-tenant
+    series render as Prometheus labels while the unlabeled aggregate stays
+    exactly the sum of its scopes. Scopes with identical labels share one
+    underlying series store — ``registry.scoped(tenant="t0")`` twice is the
+    same scope. Refine with :meth:`scoped` (labels merge, later keys win).
+    """
+
+    __slots__ = ("parent", "labels", "_reg", "_fans")
+
+    def __init__(self, parent: "StatsRegistry", labels: dict[str, str]):
+        self.parent = parent
+        self.labels = dict(labels)
+        self._reg = parent._scope_registry(self.labels)
+        # fan-object cache: scoped writes sit on per-sample/per-completion
+        # hot paths, and resolving (scoped, aggregate) series costs two
+        # locked dict lookups + an allocation per call — memoize per name
+        # instead (plain dict: get/set are GIL-atomic, a rare duplicate
+        # build is harmless)
+        self._fans: dict = {}
+
+    @property
+    def name(self) -> str:
+        return self.parent.name
+
+    @property
+    def label_str(self) -> str:
+        return format_labels(self.labels)
+
+    def scoped(self, **labels) -> "ScopedStats":
+        merged = dict(self.labels)
+        merged.update({k: str(v) for k, v in labels.items() if v is not None})
+        return self.parent.scoped(**merged)
+
+    # -- series accessors (fan scoped + aggregate) --------------------------
+    def counter(self, name: str) -> _FanCounter:
+        fan = self._fans.get(("c", name))
+        if fan is None:
+            fan = self._fans[("c", name)] = _FanCounter(
+                self._reg.counter(name), self.parent.counter(name))
+        return fan
+
+    def gauge(self, name: str) -> _FanGauge:
+        fan = self._fans.get(("g", name))
+        if fan is None:
+            fan = self._fans[("g", name)] = _FanGauge(
+                self._reg.gauge(name), self.parent.gauge(name))
+        return fan
+
+    def histogram(self, name: str) -> _FanHistogram:
+        fan = self._fans.get(("h", name))
+        if fan is None:
+            fan = self._fans[("h", name)] = _FanHistogram(
+                self._reg.histogram(name), self.parent.histogram(name))
+        return fan
+
+    def add(self, name: str, n: int = 1) -> None:
+        self.counter(name).add(n)
+
+    def set_gauge(self, name: str, value: float) -> None:
+        self.gauge(name).set(value)
+
+    def observe_us(self, name: str, us: float) -> None:
+        self.histogram(name).observe_us(us)
+
+    @contextlib.contextmanager
+    def timer_us(self, name: str):
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            self.observe_us(name, (time.perf_counter() - t0) * 1e6)
+
+    def snapshot(self) -> dict:
+        """The SCOPED series only (the aggregate lives on the parent)."""
+        return self._reg.snapshot()
+
+
 class StatsRegistry:
     """Named counters + histograms; one global instance + per-engine instances."""
 
@@ -99,9 +278,46 @@ class StatsRegistry:
         self._hists: dict[str, _Histogram] = {}
         self._gauges: dict[str, _Gauge] = {}
         self._lock = threading.Lock()
+        # label-tuple -> child StatsRegistry holding that scope's series
+        # (created by scoped(); see ScopedStats)
+        self._scopes: dict[tuple, "StatsRegistry"] = {}
+        self.labels: dict[str, str] = {}
         self.created_at = time.time()
         with _registries_lock:
             _registries.add(self)
+
+    def scoped(self, **labels) -> "ScopedStats | StatsRegistry":
+        """A label-scoped child view: ``registry.scoped(pipeline="resnet",
+        tenant="t0")``. Writes through the view update the scoped series AND
+        this registry's aggregate. No labels → this registry itself (the
+        identity scope), so callers can thread a scope unconditionally."""
+        labels = {k: str(v) for k, v in labels.items() if v is not None}
+        if not labels:
+            return self
+        return ScopedStats(self, labels)
+
+    def _scope_registry(self, labels: dict[str, str]) -> "StatsRegistry":
+        key = tuple(sorted(labels.items()))
+        with self._lock:
+            reg = self._scopes.get(key)
+        if reg is not None:
+            return reg
+        # constructed OUTSIDE self._lock: StatsRegistry.__init__ takes the
+        # module registries lock, and holding both here would deadlock
+        # against all_counter_names (which takes them in the other order)
+        fresh = StatsRegistry(self.name)
+        fresh.labels = dict(labels)
+        with self._lock:
+            return self._scopes.setdefault(key, fresh)
+
+    def scopes_snapshot(self) -> dict[str, dict]:
+        """{label-string: snapshot} for every scope ever written through —
+        the ``scopes`` section of ``StromContext.stats()`` and the labeled
+        half of the Prometheus exposition."""
+        with self._lock:
+            scopes = dict(self._scopes)
+        return {format_labels(reg.labels): reg.snapshot()
+                for reg in scopes.values()}
 
     def counter(self, name: str) -> _Counter:
         with self._lock:
@@ -180,9 +396,13 @@ class StatsRegistry:
         return merged
 
     def prometheus(self) -> str:
-        """Prometheus text exposition of every counter/histogram summary."""
+        """Prometheus text exposition of every counter/histogram summary.
+        Scoped series (``scoped(...)`` children) render as LABELED samples
+        of the same metric families, directly under the unlabeled aggregate
+        — one ``# HELP``/``# TYPE`` header per family covers both."""
         return _flat_prometheus(self.snapshot(), self.name,
-                                counters=self.counter_names())
+                                counters=self.counter_names(),
+                                scopes=self.scopes_snapshot())
 
 
 def percentile_from_buckets(buckets: Sequence[int], q: float) -> float:
@@ -205,22 +425,30 @@ def _metric(*parts: str) -> str:
     return "_".join(parts).replace(".", "_").replace("-", "_")
 
 
-def _hist_lines(base: str, buckets, sum_us: float) -> list[str]:
+def _hist_lines(base: str, buckets, sum_us: float, *, labels: str = "",
+                header: bool = True) -> list[str]:
     """Proper cumulative Prometheus histogram from log2 microsecond buckets
     (bucket i = [2^i, 2^(i+1)) us). _count derives from the SAME bucket
     snapshot (not a separately-read count field), so +Inf always equals
     _count even when observations race the scrape; _sum is the EXACT
     accumulated total carried through the snapshot (*_total_us), not a
-    mean*count reconstruction."""
-    lines = [f"# HELP {base}_us latency histogram (log2 microsecond buckets)",
-             f"# TYPE {base}_us histogram"]
+    mean*count reconstruction. *labels* (a pre-formatted label body) scopes
+    every sample; *header* emits the family's # HELP/# TYPE — pass False
+    for labeled samples appended under an already-emitted family header."""
+    lines = []
+    if header:
+        lines += [
+            f"# HELP {base}_us latency histogram (log2 microsecond buckets)",
+            f"# TYPE {base}_us histogram"]
+    extra = f",{labels}" if labels else ""
     acc = 0
     for i, n in enumerate(buckets):
         acc += int(n)
-        lines.append(f'{base}_us_bucket{{le="{2 ** (i + 1)}"}} {acc}')
-    lines.append(f'{base}_us_bucket{{le="+Inf"}} {acc}')
-    lines.append(f"{base}_us_sum {sum_us}")
-    lines.append(f"{base}_us_count {acc}")
+        lines.append(f'{base}_us_bucket{{le="{2 ** (i + 1)}"{extra}}} {acc}')
+    lines.append(f'{base}_us_bucket{{le="+Inf"{extra}}} {acc}')
+    brace = f"{{{labels}}}" if labels else ""
+    lines.append(f"{base}_us_sum{brace} {sum_us}")
+    lines.append(f"{base}_us_count{brace} {acc}")
     return lines
 
 
@@ -242,7 +470,8 @@ def _hist_stem(k: str, snap: dict) -> str | None:
 
 
 def _flat_prometheus(snap: dict, prefix: str,
-                     counters: "frozenset[str] | set[str] | None" = None
+                     counters: "frozenset[str] | set[str] | None" = None,
+                     scopes: "dict[str, dict] | None" = None
                      ) -> str:
     """``*_hist`` bucket lists become real histograms (``_sum`` from their
     exact sibling ``*_total_us``, ``_count`` from the buckets); names in
@@ -250,8 +479,17 @@ def _flat_prometheus(snap: dict, prefix: str,
     a gauge. Histogram summary keys (mean/percentile/total/count siblings of
     an exposed histogram) are folded into the histogram block rather than
     duplicated as gauges. Non-numeric leaves (e.g. the engine-name string)
-    are skipped."""
+    are skipped.
+
+    *scopes* ({label-string: scope snapshot}) appends LABELED samples for
+    every scope carrying the key directly under the family's unlabeled
+    aggregate sample — one # HELP/# TYPE per family covers both, which is
+    what lets a Prometheus server see ``strom_ssd2tpu_bytes`` and
+    ``strom_ssd2tpu_bytes{tenant="t0"}`` as one metric family. Every scoped
+    write also lands in the aggregate, so the aggregate snapshot's key set
+    is always a superset of each scope's."""
     counters = counters or frozenset()
+    scopes = scopes or {}
     lines: list[str] = []
     for k, v in sorted(snap.items()):
         if k.endswith("_hist") and isinstance(v, (list, tuple)):
@@ -260,7 +498,15 @@ def _flat_prometheus(snap: dict, prefix: str,
             if total is None:  # older producers: reconstruct as before
                 total = float(snap.get(stem + "_mean_us", 0.0)) \
                     * int(snap.get(stem + "_count", sum(int(n) for n in v)))
-            lines.extend(_hist_lines(_metric(prefix, stem), v, float(total)))
+            base = _metric(prefix, stem)
+            lines.extend(_hist_lines(base, v, float(total)))
+            for lbl, ssnap in sorted(scopes.items()):
+                sv = ssnap.get(k)
+                if not isinstance(sv, (list, tuple)):
+                    continue
+                stotal = float(ssnap.get(stem + "_total_us", 0.0))
+                lines.extend(_hist_lines(base, sv, stotal, labels=lbl,
+                                         header=False))
         elif _hist_stem(k, snap) is not None:
             continue  # folded into (or superseded by) the histogram block
         elif isinstance(v, bool):
@@ -274,6 +520,12 @@ def _flat_prometheus(snap: dict, prefix: str,
             lines.append(f"# HELP {m} strom stat {k}")
             lines.append(f"# TYPE {m} {typ}")
             lines.append(f"{m} {v}")
+            for lbl, ssnap in sorted(scopes.items()):
+                sv = ssnap.get(k)
+                if isinstance(sv, bool):
+                    lines.append(f"{m}{{{lbl}}} {int(sv)}")
+                elif isinstance(sv, (int, float)):
+                    lines.append(f"{m}{{{lbl}}} {sv}")
     return "\n".join(lines) + "\n"
 
 

@@ -1,0 +1,237 @@
+"""Bench regression sentinel (ISSUE 6 tentpole) + its tier-1 CI wiring.
+
+Two layers: synthetic artifact sets prove the verdict logic (good /
+regressed / invalid / grandfathered), and the CI-wiring test runs the
+sentinel over the REPO'S OWN checked-in BENCH_r*.json / MULTICHIP_r*.json
+with the pre-sentinel history pinned as baseline — so a future round that
+regresses or ships an invalid artifact fails this suite loudly, while
+today's history (r05 is rc=124/parsed=null) stays green.
+"""
+
+import importlib.util
+import json
+import os
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_SPEC = importlib.util.spec_from_file_location(
+    "bench_sentinel", os.path.join(_ROOT, "tools", "bench_sentinel.py"))
+sentinel = importlib.util.module_from_spec(_SPEC)
+_SPEC.loader.exec_module(sentinel)
+
+# the rounds checked in when the sentinel landed: their verdicts are
+# baseline (they feed history; they don't gate). A NEW round appended
+# after this pin gates normally — bump the pin only with a round that
+# passed the gate.
+GRANDFATHER_THROUGH = "BENCH_r05.json"
+
+
+def mk_round(tmp_path, name, binding=None, rc=0, parsed="auto", **fields):
+    doc = {"n": 1, "cmd": "python bench.py", "rc": rc}
+    if parsed == "auto":
+        inner = {"metric": "ssd2hbm_bandwidth", "value": 1.0,
+                 "unit": "GB/s", **fields}
+        if binding is not None:
+            inner["binding"] = binding
+        doc["parsed"] = inner
+        doc["tail"] = json.dumps(inner)
+    else:
+        doc["parsed"] = parsed
+        doc["tail"] = None
+    p = tmp_path / name
+    p.write_text(json.dumps(doc))
+    return str(p)
+
+
+class TestLoadRound:
+    def test_valid(self, tmp_path):
+        p = mk_round(tmp_path, "BENCH_r01.json",
+                     binding={"vs_link": 0.99})
+        r = sentinel.load_round(p)
+        assert r["valid"] and r["reason"] == ""
+        assert sentinel.metric_value(r["data"], "vs_link") == 0.99
+
+    def test_rc124_parsed_null_is_invalid_not_crash(self, tmp_path):
+        p = mk_round(tmp_path, "BENCH_r05.json", rc=124, parsed=None)
+        r = sentinel.load_round(p)
+        assert not r["valid"]
+        assert "rc=124" in r["reason"]
+
+    def test_unreadable_is_invalid(self, tmp_path):
+        p = tmp_path / "BENCH_r03.json"
+        p.write_text("{nope")
+        r = sentinel.load_round(str(p))
+        assert not r["valid"] and "unreadable" in r["reason"]
+
+    def test_rc0_no_metrics_is_invalid(self, tmp_path):
+        p = tmp_path / "BENCH_r02.json"
+        p.write_text(json.dumps({"rc": 0, "parsed": None, "tail": "junk"}))
+        r = sentinel.load_round(str(p))
+        assert not r["valid"]
+
+
+class TestVerdicts:
+    def test_good_trajectory_ok(self, tmp_path):
+        paths = [mk_round(tmp_path, f"BENCH_r0{i}.json",
+                          binding={"vs_link": 0.98 + i / 1000})
+                 for i in (1, 2, 3)]
+        v = sentinel.run_sentinel(paths, band=0.25, known_invalid=set())
+        assert v["verdict"] == "ok"
+        assert v["regressions"] == [] and v["invalid_rounds"] == []
+
+    def test_regression_beyond_band_fails(self, tmp_path):
+        paths = [
+            mk_round(tmp_path, "BENCH_r01.json", binding={"vs_link": 0.99}),
+            mk_round(tmp_path, "BENCH_r02.json", binding={"vs_link": 0.98}),
+            mk_round(tmp_path, "BENCH_r03.json", binding={"vs_link": 0.50}),
+        ]
+        v = sentinel.run_sentinel(paths, band=0.25, known_invalid=set())
+        assert v["verdict"] == "fail"
+        hit = next(h for h in v["regressions"] if h["metric"] == "vs_link")
+        assert hit["latest_round"] == "BENCH_r03.json"
+        assert hit["previous"] == 0.98 and hit["best"] == 0.99
+
+    def test_noise_inside_band_passes(self, tmp_path):
+        paths = [
+            mk_round(tmp_path, "BENCH_r01.json", binding={"vs_link": 0.99}),
+            mk_round(tmp_path, "BENCH_r02.json", binding={"vs_link": 0.90}),
+        ]
+        v = sentinel.run_sentinel(paths, band=0.25, known_invalid=set())
+        assert v["verdict"] == "ok"
+
+    def test_one_bad_round_against_good_history_needs_both(self, tmp_path):
+        """Worse than previous but NOT worse than best-of-history (or vice
+        versa) doesn't fire: single-round noise isn't a regression."""
+        paths = [
+            mk_round(tmp_path, "BENCH_r01.json", binding={"vs_link": 0.50}),
+            mk_round(tmp_path, "BENCH_r02.json", binding={"vs_link": 0.99}),
+            mk_round(tmp_path, "BENCH_r03.json", binding={"vs_link": 0.60}),
+        ]
+        # 0.60 is worse than prev 0.99 beyond band, but NOT beyond-band
+        # worse than best-of-history-min... best for "up" is max(0.5,0.99)
+        # = 0.99 → 0.60 < 0.99*0.75 → fires. Use a shape where history
+        # already contains a comparable low: gate on both = no fire when
+        # best is low too.
+        paths2 = [
+            mk_round(tmp_path, "BENCH_r11.json", binding={"vs_link": 0.55}),
+            mk_round(tmp_path, "BENCH_r12.json", binding={"vs_link": 0.60}),
+            mk_round(tmp_path, "BENCH_r13.json", binding={"vs_link": 0.50}),
+        ]
+        v2 = sentinel.run_sentinel(paths2, band=0.25, known_invalid=set())
+        assert all(h["metric"] != "vs_link" for h in v2["regressions"])
+        v = sentinel.run_sentinel(paths, band=0.25, known_invalid=set())
+        assert any(h["metric"] == "vs_link" for h in v["regressions"])
+
+    def test_stall_counter_small_jitter_tolerated(self, tmp_path):
+        paths = [
+            mk_round(tmp_path, "BENCH_r01.json",
+                     binding={"train_data_stalls": 0}),
+            mk_round(tmp_path, "BENCH_r02.json",
+                     binding={"train_data_stalls": 1}),
+        ]
+        v = sentinel.run_sentinel(paths, band=0.25, known_invalid=set())
+        assert v["verdict"] == "ok"  # 0 -> 1 stall is jitter (ABS_SLACK)
+        paths.append(mk_round(tmp_path, "BENCH_r03.json",
+                              binding={"train_data_stalls": 40}))
+        v = sentinel.run_sentinel(paths, band=0.25, known_invalid=set())
+        assert any(h["metric"] == "train_data_stalls"
+                   for h in v["regressions"])
+
+    def test_invalid_round_fails_unless_grandfathered(self, tmp_path):
+        paths = [
+            mk_round(tmp_path, "BENCH_r01.json", binding={"vs_link": 0.99}),
+            mk_round(tmp_path, "BENCH_r02.json", rc=124, parsed=None),
+        ]
+        v = sentinel.run_sentinel(paths, band=0.25, known_invalid=set())
+        assert v["verdict"] == "fail"
+        assert v["invalid_rounds"] == ["BENCH_r02.json"]
+        v2 = sentinel.run_sentinel(paths, band=0.25,
+                                   known_invalid={"BENCH_r02.json"})
+        assert v2["verdict"] == "ok"
+        assert v2["grandfathered_invalid"] == ["BENCH_r02.json"]
+
+    def test_grandfather_through_pins_history_but_gates_future(self,
+                                                               tmp_path):
+        hist = [
+            mk_round(tmp_path, "BENCH_r01.json", binding={"vs_link": 0.99}),
+            mk_round(tmp_path, "BENCH_r02.json", rc=124, parsed=None),
+        ]
+        v = sentinel.run_sentinel(hist, band=0.25, known_invalid=set(),
+                                  grandfather_through="BENCH_r02.json")
+        assert v["verdict"] == "ok"
+        # a FUTURE invalid round past the pin still gates
+        future = hist + [mk_round(tmp_path, "BENCH_r03.json", rc=1,
+                                  parsed=None)]
+        v2 = sentinel.run_sentinel(future, band=0.25, known_invalid=set(),
+                                   grandfather_through="BENCH_r02.json")
+        assert v2["verdict"] == "fail"
+        # ...and so does a future regression
+        future2 = hist + [mk_round(tmp_path, "BENCH_r04.json",
+                                   binding={"vs_link": 0.40})]
+        v3 = sentinel.run_sentinel(future2, band=0.25, known_invalid=set(),
+                                   grandfather_through="BENCH_r02.json")
+        assert v3["verdict"] == "fail"
+
+    def test_multichip_ok_shrink_fails(self, tmp_path):
+        a = tmp_path / "MULTICHIP_r01.json"
+        a.write_text(json.dumps({"n_devices": 16, "rc": 0, "ok": 8,
+                                 "skipped": 0}))
+        b = tmp_path / "MULTICHIP_r02.json"
+        b.write_text(json.dumps({"n_devices": 16, "rc": 0, "ok": 6,
+                                 "skipped": 2}))
+        v = sentinel.run_sentinel([str(a), str(b)], band=0.25,
+                                  known_invalid=set())
+        assert any(h["metric"] == "multichip_ok" for h in v["regressions"])
+
+
+class TestCli:
+    def test_main_exits_nonzero_on_invalid(self, tmp_path, capsys):
+        paths = [
+            mk_round(tmp_path, "BENCH_r01.json", binding={"vs_link": 0.99}),
+            mk_round(tmp_path, "BENCH_r02.json", rc=124, parsed=None),
+        ]
+        assert sentinel.main(paths) == 1
+        out = capsys.readouterr().out
+        assert "INVALID" in out and "| vs_link |" in out
+
+    def test_check_mode_emits_verdict_json(self, tmp_path, capsys):
+        paths = [mk_round(tmp_path, "BENCH_r01.json",
+                          binding={"vs_link": 0.99})]
+        assert sentinel.main(["--check"] + paths) == 0
+        doc = json.loads(capsys.readouterr().out)
+        assert doc["verdict"] == "ok"
+
+    def test_json_out(self, tmp_path):
+        paths = [mk_round(tmp_path, "BENCH_r01.json",
+                          binding={"vs_link": 0.99})]
+        out = tmp_path / "v.json"
+        assert sentinel.main(["--json", str(out)] + paths) == 0
+        assert json.loads(out.read_text())["verdict"] == "ok"
+
+
+class TestRepoArtifacts:
+    """The CI wiring (ISSUE 6 satellite): the sentinel runs over the
+    checked-in artifacts every tier-1 run."""
+
+    def test_r05_fails_the_plain_gate(self):
+        """Acceptance: `python tools/bench_sentinel.py BENCH_r0*.json`
+        exits nonzero on the r05 invalid artifact."""
+        import glob as _g
+
+        paths = sorted(_g.glob(os.path.join(_ROOT, "BENCH_r0*.json")))
+        assert paths, "checked-in BENCH artifacts missing"
+        v = sentinel.run_sentinel(paths, band=0.25, known_invalid=set())
+        assert v["verdict"] == "fail"
+        assert "BENCH_r05.json" in v["invalid_rounds"]
+
+    def test_checked_in_trajectory_gates_future_rounds(self, capsys):
+        """`--check --grandfather-through <pin>`: green on today's
+        history; a future bad round past the pin flips it red (proved on
+        synthetic futures in TestVerdicts)."""
+        rc = sentinel.main(["--check", "--grandfather-through",
+                            GRANDFATHER_THROUGH])
+        doc = json.loads(capsys.readouterr().out)
+        assert rc == 0, f"sentinel gate failed: {doc}"
+        assert doc["verdict"] == "ok"
+        # the r05 invalidity is still REPORTED (grandfathered, not hidden)
+        assert "BENCH_r05.json" in doc["invalid_rounds"]
+        assert "BENCH_r05.json" in doc["grandfathered_invalid"]

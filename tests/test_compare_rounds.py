@@ -195,14 +195,32 @@ def test_tail_scrape_fallback(tmp_path, capsys):
     assert "0.9901" in capsys.readouterr().out
 
 
-def test_unreadable_artifact_skipped(tmp_path, capsys):
+def test_unreadable_artifact_flagged_invalid(tmp_path, capsys):
+    """A corrupt artifact keeps its column with a visible INVALID status
+    (ISSUE 6 satellite) instead of being silently dropped."""
     good = tmp_path / "BENCH_r05.json"
     good.write_text(json.dumps(NEW_ROUND))
     bad = tmp_path / "BENCH_r04.json"
     bad.write_text("{not json")
     assert compare_rounds.main([str(bad), str(good)]) == 0
     captured = capsys.readouterr()
-    assert "skipping" in captured.err
+    assert "invalid round" in captured.err
+    assert "INVALID(unreadable)" in captured.out
+    assert "vs_baseline_host" in captured.out
+
+
+def test_rc124_round_flagged_invalid(tmp_path, capsys):
+    """BENCH_r05's shape today: rc=124, parsed=null — a visible invalid
+    column, no crash, the good rounds still tabulate."""
+    good = tmp_path / "BENCH_r01.json"
+    good.write_text(json.dumps(NEW_ROUND))
+    dead = tmp_path / "BENCH_r02.json"
+    dead.write_text(json.dumps(
+        {"n": 5, "cmd": "python bench.py", "rc": 124, "tail": None,
+         "parsed": None}))
+    assert compare_rounds.main([str(good), str(dead)]) == 0
+    captured = capsys.readouterr()
+    assert "INVALID(rc=124" in captured.out
     assert "vs_baseline_host" in captured.out
 
 

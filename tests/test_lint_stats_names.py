@@ -19,7 +19,7 @@ def test_repo_is_clean():
 
 
 def test_repo_scan_finds_known_names():
-    found = lint.scan_sources(_ROOT)
+    found, _labels = lint.scan_sources(_ROOT)
     # sanity: the scan actually sees the well-known counters, so a clean
     # result means "no collisions", not "nothing scanned"
     assert "ssd2tpubytes" in found
@@ -34,7 +34,7 @@ def test_collision_detected(tmp_path):
         'global_stats.set_gauge("Coalesce_OpsIn", 2)\n')
     (pkg / "b.py").write_text(
         'global_stats.observe_us("read_latency", 3.0)\n')
-    found = lint.scan_sources(str(tmp_path))
+    found, _labels = lint.scan_sources(str(tmp_path))
     bad = lint.collisions(found)
     assert len(bad) == 1
     (norm, uses) = bad[0]
@@ -57,7 +57,7 @@ def test_fields_tuple_literals_scanned(tmp_path):
         ')\n')
     (pkg / "b.py").write_text(
         'global_stats.add("Cache_HitBytes", 1)\n')
-    found = lint.scan_sources(str(tmp_path))
+    found, _labels = lint.scan_sources(str(tmp_path))
     assert "warmimagespers" in found
     bad = lint.collisions(found)
     assert len(bad) == 1
@@ -68,7 +68,7 @@ def test_fields_tuple_literals_scanned(tmp_path):
 def test_repo_fields_tuples_seen():
     """The real repo scan picks up the single-sourced tuples (cache bench
     columns + stall fields), so 'clean' covers them too."""
-    found = lint.scan_sources(_ROOT)
+    found, _labels = lint.scan_sources(_ROOT)
     assert "warmvscold" in found          # hotcache CACHE_BENCH_FIELDS
     assert "cachehitbytes" in found
     assert "goodputpct" in found          # stall STALL_FIELDS
@@ -79,9 +79,59 @@ def test_fstring_literals_scanned(tmp_path):
     pkg.mkdir()
     (pkg / "a.py").write_text(
         'global_stats.add(f"decode_reduced_hits_{denom}")\n')
-    found = lint.scan_sources(str(tmp_path))
+    found, _labels = lint.scan_sources(str(tmp_path))
     assert any("decodereducedhits" in k for k in found)
 
 
 def test_usage_error_on_missing_dir(tmp_path):
     assert lint.main([str(tmp_path / "nope")]) == 2
+
+
+def test_scope_call_sites_scanned(tmp_path):
+    """Writes through a threaded scope (self.scope / pscope / op_scope)
+    land in the same aggregate namespace as global_stats calls, so the
+    lint must see them — a restyled spelling through a scope forks the
+    metric exactly the same way (ISSUE 6 satellite)."""
+    pkg = tmp_path / "strom"
+    pkg.mkdir()
+    (pkg / "a.py").write_text(
+        'self.scope.add("ssd2tpu_bytes", n)\n'
+        'pscope.observe_us("decode_batch", us)\n'
+        'self.op_scope.set_gauge("engine_inflight", d)\n')
+    (pkg / "b.py").write_text(
+        'global_stats.add("SSD2TPU_Bytes", 1)\n')
+    found, _labels = lint.scan_sources(str(tmp_path))
+    assert "decodebatch" in found
+    assert "engineinflight" in found
+    bad = lint.collisions(found)
+    assert [norm for norm, _ in bad] == ["ssd2tpubytes"]
+    assert lint.main([str(tmp_path)]) == 1
+
+
+def test_scope_label_keys_linted(tmp_path):
+    """.scoped() label KEYS are their own collision domain: `pipeline` vs
+    `Pipe_Line` would fork every labeled series on /metrics."""
+    pkg = tmp_path / "strom"
+    pkg.mkdir()
+    (pkg / "a.py").write_text(
+        'ctx.scope.scoped(pipeline="resnet", tenant=name)\n')
+    (pkg / "b.py").write_text(
+        's = registry.scoped(Pipe_Line="vit")\n')
+    _found, labels = lint.scan_sources(str(tmp_path))
+    assert "tenant" in labels
+    bad = lint.collisions(labels)
+    assert [norm for norm, _ in bad] == ["pipeline"]
+    assert lint.main([str(tmp_path)]) == 1
+
+
+def test_repo_flight_and_sentinel_tuples_seen():
+    """FLIGHT_FIELDS (strom/obs/flight.py) and SENTINEL_FIELDS
+    (tools/bench_sentinel.py) ride the same *_FIELDS scan as the cache/
+    stall tuples, so their spellings cannot fork from the producers."""
+    found, labels = lint.scan_sources(_ROOT)
+    assert "pipelinesteps" in found       # FLIGHT_FIELDS + Pipeline scope
+    assert "ringeventsdropped" in found   # FLIGHT_FIELDS
+    assert "trainwgoodputpct" not in found  # sanity: no phantom names
+    assert "vsbaselinehost" in found      # SENTINEL_FIELDS via binding set
+    # the repo actually uses scoped labels (pipeline=, tenant= in tests)
+    assert "pipeline" in labels

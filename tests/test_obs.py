@@ -301,6 +301,65 @@ class TestMetricsServer:
         finally:
             srv.close()
 
+    def test_sections_filter_skips_unwanted(self):
+        """?sections= restricts the sweep AND the server only asks
+        stats_fn for the wanted sections (ISSUE 6 satellite: a
+        counters-only scrape never recomputes stall attribution)."""
+        calls = []
+
+        def stats_fn(sections=None):
+            calls.append(tuple(sections) if sections is not None else None)
+            secs = {"cheap": {"a": 1}, "costly": {"b": 2}}
+            if sections is None:
+                return secs
+            return {k: v for k, v in secs.items() if k in sections}
+
+        srv = MetricsServer(stats_fn, port=0, section_ttl_s=0.0)
+        try:
+            # first scrape learns the section names (full compute, once)
+            st, body = self._get(srv.port, "/metrics?sections=cheap")
+            assert st == 200
+            assert "strom_cheap_a 1" in body
+            st, body = self._get(srv.port, "/metrics?sections=cheap")
+            assert "strom_cheap_a 1" in body and "strom_costly_b" not in body
+            # after warmup, refreshes name only the wanted section
+            assert calls[-1] == ("cheap",)
+        finally:
+            srv.close()
+
+    def test_section_ttl_caches_renders(self):
+        calls = []
+
+        def stats_fn(sections=None):
+            calls.append(1)
+            return {"sec": {"n": len(calls)}}
+
+        srv = MetricsServer(stats_fn, port=0, section_ttl_s=60.0)
+        try:
+            _, body1 = self._get(srv.port, "/metrics")
+            n_after_first = len(calls)
+            _, body2 = self._get(srv.port, "/metrics")
+            # within the TTL the rendered text is reused: no new compute
+            assert len(calls) == n_after_first
+            assert "strom_sec_n 1" in body1 and "strom_sec_n 1" in body2
+        finally:
+            srv.close()
+
+    def test_scoped_series_render_with_help_and_type(self):
+        """Labeled twins of a scoped write appear under ONE # HELP/# TYPE
+        family header on /metrics (ISSUE 6 satellite)."""
+        from strom.utils.stats import global_stats
+
+        global_stats.scoped(pipeline="obs_t").add("obs_scoped_probe", 4)
+        srv = MetricsServer(port=0)
+        try:
+            _, body = self._get(srv.port, "/metrics")
+            assert "# TYPE strom_obs_scoped_probe counter" in body
+            assert body.count("# TYPE strom_obs_scoped_probe ") == 1
+            assert 'strom_obs_scoped_probe{pipeline="obs_t"} 4' in body
+        finally:
+            srv.close()
+
 
 class TestWiring:
     """The instrumentation sites actually emit: one pread lights up the

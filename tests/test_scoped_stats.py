@@ -1,0 +1,256 @@
+"""Scoped telemetry (ISSUE 6 tentpole): label-scoped StatsRegistry views.
+
+The multi-tenant invariant under test everywhere here: a write through a
+scope lands in BOTH the scoped series and the aggregate, so per-scope
+series render as Prometheus labels while the unlabeled aggregate equals
+the sum of its scopes — under concurrency, through every series kind, and
+end to end through a StromContext serving /metrics.
+"""
+
+import threading
+import urllib.request
+
+import numpy as np
+import pytest
+
+from strom.utils.stats import (ScopedStats, StatsRegistry, format_labels,
+                               global_stats)
+
+
+def fresh():
+    return StatsRegistry("t")
+
+
+class TestScopedRegistry:
+    def test_identity_scope(self):
+        r = fresh()
+        assert r.scoped() is r
+        assert r.scoped(tenant=None) is r  # None labels drop out
+
+    def test_same_labels_share_series(self):
+        r = fresh()
+        a = r.scoped(tenant="t0")
+        b = r.scoped(tenant="t0")
+        a.add("x", 2)
+        b.add("x", 3)
+        assert a.counter("x").value == 5
+        assert r.counter("x").value == 5
+
+    def test_counter_fans_to_aggregate(self):
+        r = fresh()
+        s = r.scoped(pipeline="resnet")
+        s.add("bytes", 7)
+        assert s.counter("bytes").value == 7
+        assert r.counter("bytes").value == 7
+
+    def test_gauge_and_histogram_fan(self):
+        r = fresh()
+        s = r.scoped(pipeline="vit")
+        s.set_gauge("depth", 4)
+        s.gauge("peak").max(9)
+        s.observe_us("lat", 100.0)
+        with s.timer_us("lat"):
+            pass
+        assert r.gauge("depth").value == 4
+        assert r.gauge("peak").value == 9
+        assert r.histogram("lat").count == 2
+        assert s.histogram("lat").count == 2
+
+    def test_refinement_merges_labels(self):
+        r = fresh()
+        t = r.scoped(tenant="t0")
+        p = t.scoped(pipeline="resnet")
+        assert p.labels == {"tenant": "t0", "pipeline": "resnet"}
+        p.add("x")
+        # lands in the refined scope + aggregate, NOT the parent scope
+        assert r.counter("x").value == 1
+        assert t.counter("x").value == 0
+        assert p.counter("x").value == 1
+
+    def test_label_str_canonical(self):
+        r = fresh()
+        s = r.scoped(b="2", a="1")
+        assert s.label_str == 'a="1",b="2"'
+        assert format_labels({"q": 'say "hi"'}) == r'q="say \"hi\""'
+
+    def test_counter_typing_flows_through_scope(self):
+        """Names created through scopes register as counters in the
+        aggregate too, so /metrics types the labeled series correctly."""
+        r = fresh()
+        r.scoped(t="0").add("my_counter")
+        assert "my_counter" in r.counter_names()
+
+    def test_concurrent_churn_aggregate_equals_sum(self):
+        """The acceptance invariant: 4 threads x 2 scopes hammering the
+        same names — aggregate == sum of scopes for counters AND
+        histogram counts, no drops under the fan-out."""
+        r = fresh()
+        scopes = [r.scoped(pipeline="resnet", tenant="t0"),
+                  r.scoped(pipeline="vit", tenant="t0")]
+        n_iter = 2000
+
+        def churn(scope):
+            for i in range(n_iter):
+                scope.add("ops")
+                scope.add("bytes", 3)
+                scope.observe_us("lat", float(i % 64 + 1))
+                scope.gauge("depth").set(i)
+
+        threads = [threading.Thread(target=churn, args=(s,))
+                   for s in scopes for _ in range(4)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert r.counter("ops").value == 8 * n_iter
+        assert r.counter("bytes").value == 8 * n_iter * 3
+        assert sum(s.counter("ops").value for s in scopes) \
+            == r.counter("ops").value
+        assert sum(s.counter("bytes").value for s in scopes) \
+            == r.counter("bytes").value
+        assert r.histogram("lat").count == 8 * n_iter
+        assert sum(s.histogram("lat").count for s in scopes) \
+            == r.histogram("lat").count
+        # bucket-level identity, not just counts
+        agg = r.histogram("lat").buckets
+        summed = [a + b for a, b in zip(scopes[0].histogram("lat").buckets,
+                                        scopes[1].histogram("lat").buckets)]
+        assert agg == summed
+
+    def test_scopes_snapshot(self):
+        r = fresh()
+        r.scoped(tenant="t0").add("x", 1)
+        r.scoped(tenant="t1").add("x", 2)
+        snaps = r.scopes_snapshot()
+        assert snaps['tenant="t0"']["x"] == 1
+        assert snaps['tenant="t1"']["x"] == 2
+
+    def test_add_buckets_merge(self):
+        """Bulk bucket merge (the uring native-gather mirror path) keeps
+        count/total consistent on both halves of the fan."""
+        r = fresh()
+        s = r.scoped(tenant="t0")
+        s.histogram("engine_op_lat").add_buckets([0, 2, 1], 300.0)
+        assert r.histogram("engine_op_lat").count == 3
+        assert s.histogram("engine_op_lat").count == 3
+        assert r.histogram("engine_op_lat").total_us == 300.0
+
+
+class TestScopedExposition:
+    def test_labeled_samples_under_one_family(self):
+        r = fresh()
+        r.scoped(pipeline="resnet").add("ops", 2)
+        r.scoped(pipeline="vit").add("ops", 3)
+        text = r.prometheus()
+        assert "# TYPE t_ops counter" in text
+        assert text.count("# TYPE t_ops ") == 1  # one header per family
+        assert "t_ops 5" in text
+        assert 't_ops{pipeline="resnet"} 2' in text
+        assert 't_ops{pipeline="vit"} 3' in text
+        # unlabeled aggregate precedes labeled samples in the family block
+        lines = text.splitlines()
+        assert lines.index("t_ops 5") \
+            < lines.index('t_ops{pipeline="resnet"} 2')
+
+    def test_labeled_histograms(self):
+        r = fresh()
+        r.scoped(tenant="a").observe_us("lat", 100.0)
+        r.scoped(tenant="b").observe_us("lat", 3.0)
+        text = r.prometheus()
+        assert text.count("# TYPE t_lat_us histogram") == 1
+        assert 't_lat_us_bucket{le="128",tenant="a"} 1' in text
+        assert 't_lat_us_count{tenant="a"} 1' in text
+        assert 't_lat_us_count{tenant="b"} 1' in text
+        assert "t_lat_us_count 2" in text
+        # exact sums carried per scope
+        assert 't_lat_us_sum{tenant="a"} 100.0' in text
+
+    def test_no_scopes_no_labels(self):
+        r = fresh()
+        r.add("plain", 1)
+        text = r.prometheus()
+        assert "t_plain 1" in text
+        assert "{" not in text.replace('le="', "")  # only histogram les
+
+
+class TestContextScope:
+    @pytest.fixture
+    def ctx2(self, tmp_path):
+        from strom.config import StromConfig
+        from strom.delivery.core import StromContext
+
+        path = tmp_path / "f.bin"
+        path.write_bytes(np.random.default_rng(0).bytes(1 << 20))
+        cfg = StromConfig(engine="python", slab_pool_bytes=0)
+        ctx = StromContext(cfg, metrics_port=0, scope={"tenant": "t9"})
+        yield ctx, str(path)
+        ctx.close()
+
+    def test_context_scope_labels_delivery(self, ctx2):
+        ctx, path = ctx2
+        before = ctx.scope.counter("ssd2tpu_bytes").value
+        ctx.memcpy_ssd2host(path, length=1 << 20)
+        assert ctx.scope.counter("ssd2tpu_bytes").value - before == 1 << 20
+
+    def test_engine_op_accounting_scoped(self, ctx2):
+        ctx, path = ctx2
+        h = ctx.scope.histogram("engine_op_lat")
+        before = h.count
+        ctx.memcpy_ssd2host(path, length=1 << 20)
+        assert h.count > before  # per-op latency landed in the scope
+        # the aggregate carries at least as much
+        assert global_stats.histogram("engine_op_lat").count >= h.count
+
+    def test_two_scopes_distinguishable_on_metrics(self, ctx2):
+        """Acceptance shape: two pipelines' scopes on one context produce
+        distinguishable labeled series on /metrics while the aggregate is
+        their sum."""
+        ctx, path = ctx2
+        a = ctx.scope.scoped(pipeline="resnet")
+        b = ctx.scope.scoped(pipeline="vit")
+        base = global_stats.counter("t6_probe").value
+        a.add("t6_probe", 2)
+        b.add("t6_probe", 5)
+        port = ctx.metrics_server.port
+        text = urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/metrics").read().decode()
+        assert f'strom_t6_probe {base + 7}' in text
+        assert 'strom_t6_probe{pipeline="resnet",tenant="t9"} 2' in text
+        assert 'strom_t6_probe{pipeline="vit",tenant="t9"} 5' in text
+
+    def test_stats_scopes_section(self, ctx2):
+        ctx, path = ctx2
+        ctx.scope.add("t6_probe2", 1)
+        snap = ctx.stats()
+        assert 'tenant="t9"' in snap["scopes"]
+        assert snap["scopes"]['tenant="t9"']["t6_probe2"] == 1
+        sub = ctx.stats(sections=["context"])
+        assert set(sub) == {"context"}
+
+
+class TestPipelineScopes:
+    def test_prefetcher_scope(self):
+        from strom.delivery.prefetch import Prefetcher
+
+        r = fresh()
+        s = r.scoped(pipeline="p0")
+        pf = Prefetcher(iter([lambda: 1, lambda: 2]), depth=1, scope=s)
+        assert list(pf) == [1, 2]
+        assert s.gauge("prefetch_depth").value == 1
+
+    def test_pipeline_steps_counter(self):
+        """Pipeline.__next__ advances the scoped step heartbeat the flight
+        recorder watches."""
+        from strom.pipelines.base import Pipeline
+        from strom.pipelines.sampler import EpochShuffleSampler
+
+        r = fresh()
+        s = r.scoped(pipeline="px")
+        sampler = EpochShuffleSampler(8, 4, seed=0, shuffle=False)
+        pipe = Pipeline(sampler, lambda idx, serial: len(idx), depth=1,
+                        scope=s)
+        next(pipe)
+        next(pipe)
+        pipe.close()
+        assert s.counter("pipeline_steps").value == 2
+        assert r.counter("pipeline_steps").value == 2

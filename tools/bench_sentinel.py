@@ -1,0 +1,351 @@
+#!/usr/bin/env python
+"""Bench regression sentinel: turn the BENCH_r*.json trajectory into a gate.
+
+The repo accumulates one bench artifact per round (plus MULTICHIP_r*.json
+from the dry-run lowering sweep), and until now nothing READ the sequence:
+r05 shipped ``rc: 124, parsed: null`` and no machinery noticed. This tool
+loads the whole trajectory, normalizes each round through the SAME
+single-sourced field tuples ``tools/compare_rounds.py`` renders (the
+weather-independent comparison set — absolute GB/s is relay weather,
+BASELINE.md §C), and emits:
+
+- a **markdown trajectory table** per metric (one column per round),
+- a **machine verdict JSON** (``--json`` / stdout in ``--check``):
+  per-round validity, per-metric regression flags, and one overall verdict,
+- a **nonzero exit** when any round is invalid (``rc != 0`` or
+  ``parsed: null`` — a round that produced no evidence is a failure, not a
+  hole in the table) or the newest valid round regressed beyond the noise
+  band against BOTH the previous valid round and the best of history
+  (single-round noise shouldn't page anyone; a real regression is worse
+  than everything before it).
+
+Invalid artifacts are first-class verdicts: the sentinel never crashes on
+them (that would make the watchdog die exactly when the patient does).
+``--known-invalid`` grandfathers named artifacts (the tier-1 wiring lists
+BENCH_r05.json, whose invalidity predates the sentinel) so the suite gates
+FUTURE rounds without re-flagging history.
+
+Usage:
+    python tools/bench_sentinel.py [artifacts...] [--band 0.25]
+        [--json OUT.json] [--check] [--known-invalid NAME ...]
+(no artifacts: every BENCH_r*.json and MULTICHIP_r*.json in the repo root)
+
+Exit codes: 0 = clean, 1 = regression and/or non-grandfathered invalid
+round, 2 = usage error.
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import sys
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, _HERE)  # runnable as a script from anywhere
+
+from compare_rounds import (BINDING_ORDER, CACHE_KEYS, DECODE_KEYS,  # noqa: E402
+                            STALL_KEYS, STREAM_KEYS, unwrap)
+
+# The gated metric set: (metric, direction) over the single-sourced
+# comparison tuples, where direction is "up" (bigger is better) or "down"
+# (smaller is better). Only weather-independent metrics are gated —
+# compare_rounds' binding/stall/cache/stream sections — plus the decode
+# img/s trend (fixture-bound but host-CPU-bound, the ISSUE 2/3 headline).
+# Metrics not listed here still PRINT in the trajectory table; they just
+# never fail the gate. Single-sourced (linted by tools/lint_stats_names.py
+# alongside FLIGHT_FIELDS) so a restyled spelling can't fork the gate from
+# the producers.
+SENTINEL_FIELDS = (
+    ("vs_baseline_host", "up"),
+    ("vs_link", "up"),
+    ("link_busy_frac", "up"),
+    ("train_data_stalls", "down"),
+    ("bounded_train_data_stalls", "down"),
+    ("resnet_predecoded_stalls", "down"),
+    ("resnet_predecoded_stalls_bounded", "down"),
+    ("vit_predecoded_stalls", "down"),
+    ("vit_predecoded_stalls_bounded", "down"),
+    ("resnet_images_per_s", "up"),
+    ("resnet_train_images_per_s", "up"),
+    ("vit_images_per_s", "up"),
+    ("vit_train_images_per_s", "up"),
+    ("train_goodput_pct", "up"),
+    ("resnet_goodput_pct", "up"),
+    ("resnet_predecoded_goodput_pct", "up"),
+    ("vit_goodput_pct", "up"),
+    ("resnet_warm_vs_cold", "up"),
+    ("vit_warm_vs_cold", "up"),
+    ("resnet_stream_samples_early", "up"),
+)
+
+# absolute slack for count-like "down" metrics around small values: going
+# 0 -> 1 stall is jitter, not a regression (the llama stall phase is
+# best-of-3 for exactly this reason); 0 -> above the slack still fails
+ABS_SLACK = 2.0
+
+TABLE_KEYS = list(dict.fromkeys(
+    BINDING_ORDER + DECODE_KEYS + STALL_KEYS + CACHE_KEYS + STREAM_KEYS))
+
+
+def load_round(path: str) -> dict:
+    """One artifact -> {'name', 'valid', 'reason', 'rc', 'data'}.
+
+    Invalid (rc != 0, parsed null with nothing recoverable, unreadable
+    file, truncated JSON) is a VERDICT, not an exception."""
+    name = os.path.basename(path)
+    try:
+        with open(path) as f:
+            raw = json.load(f)
+    except (OSError, json.JSONDecodeError) as e:
+        return {"name": name, "valid": False,
+                "reason": f"unreadable: {e}", "rc": None, "data": {}}
+    if not isinstance(raw, dict):
+        return {"name": name, "valid": False,
+                "reason": f"not an object: {type(raw).__name__}",
+                "rc": None, "data": {}}
+    rc = raw.get("rc")
+    data = unwrap(raw)
+    has_metrics = isinstance(data, dict) and (
+        "metric" in data or "binding" in data)
+    if rc not in (None, 0):
+        return {"name": name, "valid": False,
+                "reason": f"rc={rc}"
+                + ("" if has_metrics else ", parsed=null"),
+                "rc": rc, "data": data if has_metrics else {}}
+    if not has_metrics:
+        return {"name": name, "valid": False,
+                "reason": "no parsed metrics (parsed=null, no JSON in tail)",
+                "rc": rc, "data": {}}
+    return {"name": name, "valid": True, "reason": "", "rc": rc,
+            "data": data}
+
+
+def load_multichip(path: str) -> dict:
+    """MULTICHIP_r*.json rounds carry {n_devices, rc, ok, skipped}: valid
+    when rc == 0; the gated quantity is the ok-count trend."""
+    name = os.path.basename(path)
+    try:
+        with open(path) as f:
+            raw = json.load(f)
+    except (OSError, json.JSONDecodeError) as e:
+        return {"name": name, "valid": False,
+                "reason": f"unreadable: {e}", "rc": None, "data": {}}
+    rc = raw.get("rc")
+    if rc not in (None, 0):
+        return {"name": name, "valid": False, "reason": f"rc={rc}",
+                "rc": rc, "data": {}}
+    return {"name": name, "valid": True, "reason": "", "rc": rc,
+            "data": {"multichip_ok": raw.get("ok"),
+                     "multichip_skipped": raw.get("skipped"),
+                     "multichip_n_devices": raw.get("n_devices")}}
+
+
+def metric_value(data: dict, key: str):
+    binding = data.get("binding") or {}
+    v = binding.get(key, data.get(key))
+    return v if isinstance(v, (int, float)) and not isinstance(v, bool) \
+        else None
+
+
+def check_metric(key: str, direction: str, series: list[tuple[str, float]],
+                 band: float) -> dict | None:
+    """Regression verdict for one metric over the VALID rounds carrying it.
+
+    The newest value regresses when it's worse than BOTH the previous
+    value and the best of all history by more than the noise *band*
+    (relative), with ``ABS_SLACK`` absolute slack for near-zero "down"
+    counters. One noisy round against a good history doesn't fire; a new
+    worst-in-history does."""
+    if len(series) < 2:
+        return None
+    (prev_name, prev), (last_name, last) = series[-2], series[-1]
+    history = [v for _, v in series[:-1]]
+    best = max(history) if direction == "up" else min(history)
+
+    def worse_than(v: float, ref: float) -> bool:
+        if direction == "up":
+            return v < ref * (1.0 - band)
+        slack = max(abs(ref) * band, ABS_SLACK)
+        return v > ref + slack
+
+    if worse_than(last, prev) and worse_than(last, best):
+        return {"metric": key, "direction": direction,
+                "latest_round": last_name, "latest": last,
+                "previous_round": prev_name, "previous": prev,
+                "best": best, "band": band}
+    return None
+
+
+def fmt(v) -> str:
+    if v is None:
+        return "-"
+    if isinstance(v, float):
+        return f"{v:.4g}"
+    return str(v)
+
+
+def trajectory_table(rounds: list[dict], keys: list[str]) -> str:
+    """Markdown table: one row per metric, one column per round; invalid
+    rounds keep their column with an INVALID header row so a hole in the
+    trajectory is visible, never silent."""
+    names = [r["name"].replace("BENCH_", "").replace("MULTICHIP_", "mc_")
+             .replace(".json", "") for r in rounds]
+    lines = ["| metric | " + " | ".join(names) + " |",
+             "|---" * (len(rounds) + 1) + "|",
+             "| _round status_ | " + " | ".join(
+                 "ok" if r["valid"] else f"**INVALID** ({r['reason']})"
+                 for r in rounds) + " |"]
+    for k in keys:
+        vals = [metric_value(r["data"], k) for r in rounds]
+        if all(v is None for v in vals):
+            continue
+        lines.append(f"| {k} | " + " | ".join(fmt(v) for v in vals) + " |")
+    return "\n".join(lines)
+
+
+def run_sentinel(paths: list[str], *, band: float,
+                 known_invalid: set[str],
+                 grandfather_through: str | None = None) -> dict:
+    """The whole verdict as one JSON-able dict (the machine artifact).
+
+    *grandfather_through* (an artifact basename, e.g. ``BENCH_r05.json``)
+    marks everything up to and including that round as BASELINE: those
+    rounds still feed the history every later round is judged against, but
+    their own invalidity/regressions no longer gate — the CI wiring pins
+    the history that predates the sentinel and gates only future rounds."""
+    bench_rounds = [load_round(p) for p in paths
+                    if "MULTICHIP" not in os.path.basename(p).upper()]
+    mc_rounds = [load_multichip(p) for p in paths
+                 if "MULTICHIP" in os.path.basename(p).upper()]
+    rounds = bench_rounds + mc_rounds
+
+    def grandfathered(name: str) -> bool:
+        if name in known_invalid:
+            return True
+        if grandfather_through is None:
+            return False
+        # rounds sort lexically (rNN zero-padded); compare within the same
+        # artifact family so MULTICHIP names don't cross-compare to BENCH
+        gf = grandfather_through
+        fam = gf.split("_r")[0]
+        return name.startswith(fam) and name <= gf
+
+    invalid = [r for r in rounds if not r["valid"]]
+    gating_invalid = [r for r in invalid if not grandfathered(r["name"])]
+
+    regressions = []
+    valid_bench = [r for r in bench_rounds if r["valid"]]
+    for key, direction in SENTINEL_FIELDS:
+        series = [(r["name"], metric_value(r["data"], key))
+                  for r in valid_bench]
+        series = [(n, v) for n, v in series if v is not None]
+        hit = check_metric(key, direction, series, band)
+        if hit is not None:
+            hit["grandfathered"] = grandfathered(hit["latest_round"])
+            regressions.append(hit)
+    # multichip gate: ok-count may not shrink round-over-round (a config
+    # that stopped lowering is a regression even at rc=0)
+    valid_mc = [(r["name"], r["data"].get("multichip_ok"))
+                for r in mc_rounds
+                if r["valid"] and isinstance(r["data"].get("multichip_ok"),
+                                             (int, float))]
+    if len(valid_mc) >= 2 and valid_mc[-1][1] < valid_mc[-2][1]:
+        regressions.append({
+            "metric": "multichip_ok", "direction": "up",
+            "latest_round": valid_mc[-1][0], "latest": valid_mc[-1][1],
+            "previous_round": valid_mc[-2][0], "previous": valid_mc[-2][1],
+            "best": max(v for _, v in valid_mc[:-1]), "band": 0.0,
+            "grandfathered": grandfathered(valid_mc[-1][0])})
+    gating_regressions = [h for h in regressions if not h["grandfathered"]]
+    ok = not gating_regressions and not gating_invalid
+    return {
+        "verdict": "ok" if ok else "fail",
+        "band": band,
+        "rounds": [{k: r[k] for k in ("name", "valid", "reason", "rc")}
+                   for r in rounds],
+        "invalid_rounds": [r["name"] for r in invalid],
+        "grandfathered_invalid": sorted(
+            r["name"] for r in invalid if grandfathered(r["name"])),
+        "regressions": regressions,
+        "_rounds_full": rounds,  # stripped before JSON emit
+    }
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(
+        description="bench trajectory regression sentinel")
+    ap.add_argument("artifacts", nargs="*",
+                    help="BENCH_r*.json / MULTICHIP_r*.json paths "
+                         "(default: repo root sweep)")
+    ap.add_argument("--band", type=float, default=0.25,
+                    help="relative noise band before a worse value counts "
+                         "as a regression (default 0.25: same-run ratios "
+                         "jitter; the gate is for step changes)")
+    ap.add_argument("--json", dest="json_out", default=None,
+                    help="write the machine verdict JSON here")
+    ap.add_argument("--check", action="store_true",
+                    help="CI mode: print the verdict JSON on stdout "
+                         "(table goes to stderr) and exit by verdict")
+    ap.add_argument("--known-invalid", nargs="*", default=[],
+                    dest="known_invalid", metavar="NAME",
+                    help="artifact basenames whose invalidity predates the "
+                         "sentinel (still reported, no longer gating)")
+    ap.add_argument("--grandfather-through", default=None,
+                    dest="grandfather_through", metavar="NAME",
+                    help="treat rounds up to and including this basename "
+                         "as baseline: they feed history but their own "
+                         "verdicts never gate (the tier-1 wiring pins the "
+                         "pre-sentinel history here)")
+    args = ap.parse_args(argv)
+
+    paths = args.artifacts
+    if not paths:
+        root = os.path.dirname(_HERE)
+        paths = sorted(glob.glob(os.path.join(root, "BENCH_r*.json"))) + \
+            sorted(glob.glob(os.path.join(root, "MULTICHIP_r*.json")))
+    if not paths:
+        print("bench_sentinel: no artifacts found", file=sys.stderr)
+        return 2
+
+    verdict = run_sentinel(paths, band=args.band,
+                           known_invalid=set(args.known_invalid),
+                           grandfather_through=args.grandfather_through)
+    rounds = verdict.pop("_rounds_full")
+
+    table = trajectory_table(rounds, TABLE_KEYS)
+    out = sys.stderr if args.check else sys.stdout
+    print("## bench trajectory (weather-independent comparison set)",
+          file=out)
+    print(table, file=out)
+    print(file=out)
+    if verdict["regressions"]:
+        print("### regressions (beyond the "
+              f"{verdict['band']:.0%} noise band, vs previous AND "
+              "best-of-history)", file=out)
+        for hit in verdict["regressions"]:
+            grand = " [grandfathered]" if hit.get("grandfathered") else ""
+            print(f"- **{hit['metric']}**: {fmt(hit['latest'])} "
+                  f"({hit['latest_round']}) vs prev {fmt(hit['previous'])} "
+                  f"({hit['previous_round']}), best {fmt(hit['best'])}"
+                  f"{grand}", file=out)
+    for r in rounds:
+        if not r["valid"]:
+            grand = " [grandfathered]" \
+                if r["name"] in verdict["grandfathered_invalid"] else ""
+            print(f"- invalid round: {r['name']} — {r['reason']}{grand}",
+                  file=out)
+    print(f"verdict: {verdict['verdict']}", file=out)
+
+    if args.json_out:
+        with open(args.json_out, "w") as f:
+            json.dump(verdict, f, indent=1)
+    if args.check:
+        json.dump(verdict, sys.stdout, indent=1)
+        print()
+    return 0 if verdict["verdict"] == "ok" else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

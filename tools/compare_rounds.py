@@ -145,6 +145,23 @@ STREAM_KEYS = [
 AUDIT_SUFFIXES = ("_attempts", "_passes")
 
 
+def round_status(raw: dict, unwrapped: dict) -> str:
+    """"ok" or an INVALID marker for the status row: an artifact with a
+    nonzero driver rc or no recoverable metrics (BENCH_r05's ``rc: 124,
+    parsed: null``) keeps its column — every cell "-" — with the reason
+    visible up top, instead of silently reading as "nothing measured"
+    (ISSUE 6 satellite: invalid rounds are verdicts, not holes)."""
+    rc = raw.get("rc")
+    has_metrics = isinstance(unwrapped, dict) and (
+        "metric" in unwrapped or "binding" in unwrapped)
+    if rc not in (None, 0):
+        return f"INVALID(rc={rc})" if has_metrics \
+            else f"INVALID(rc={rc},parsed=null)"
+    if not has_metrics:
+        return "INVALID(no-metrics)"
+    return "ok"
+
+
 def unwrap(d: dict) -> dict:
     """The driver records {'cmd', 'rc', 'parsed', 'tail', ...}; prefer the
     pre-parsed inner dict (immune to tail-window truncation), then fall
@@ -182,12 +199,28 @@ def main(argv: list[str]) -> int:
         print("no BENCH_r*.json artifacts found", file=sys.stderr)
         return 1
     rounds = []
+    statuses = []
     for p in paths:
         try:
             with open(p) as f:
-                rounds.append((os.path.basename(p), unwrap(json.load(f))))
-        except (OSError, json.JSONDecodeError) as e:
+                raw = json.load(f)
+        except OSError as e:
+            # the file itself is absent/unopenable: a usage problem, not a
+            # round that ran — skip it without a column
             print(f"skipping {p}: {e}", file=sys.stderr)
+            continue
+        except json.JSONDecodeError as e:
+            # the round RAN but its artifact is truncated/corrupt: keep the
+            # column, flag it — same contract as an rc!=0 round (ISSUE 6
+            # satellite: invalid rounds are verdicts, not holes)
+            print(f"invalid round {p}: {e}", file=sys.stderr)
+            rounds.append((os.path.basename(p), {}))
+            statuses.append("INVALID(unreadable)")
+            continue
+        d = unwrap(raw) if isinstance(raw, dict) else {}
+        rounds.append((os.path.basename(p), d if isinstance(d, dict) else {}))
+        statuses.append(round_status(raw if isinstance(raw, dict) else {},
+                                     d))
     if not rounds:
         return 1
     binding_keys = list(BINDING_ORDER)
@@ -245,9 +278,14 @@ def main(argv: list[str]) -> int:
     col_w = max(max(len(n) for n, _ in rounds) + 2, 12,
                 *(len(c) + 2 for cs in audit_cells.values() for c in cs),
                 *(len(c) + 2 for c in headline_cells),
+                *(len(s) + 2 for s in statuses),
                 2)
     header = " " * name_w + "".join(n.rjust(col_w) for n, _ in rounds)
     print(header)
+    # round validity first: an INVALID column explains a row of "-" cells
+    # before anyone misreads them as "nothing measured that round"
+    print("round".ljust(name_w)
+          + "".join(s.rjust(col_w) for s in statuses))
     print("binding (comparable round-over-round):")
     for k in binding_keys:
         print(k.ljust(name_w)

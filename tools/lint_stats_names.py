@@ -22,14 +22,25 @@ import re
 import sys
 from collections import defaultdict
 
-# literal first-argument of a global_stats metric call; f-strings count too
-# (a templated name like decode_reduced_hits_{denom} can still case-collide
-# on its literal part)
+# literal first-argument of a metric call; f-strings count too (a templated
+# name like decode_reduced_hits_{denom} can still case-collide on its
+# literal part). The receiver may be the global registry OR any scoped
+# view/threaded scope (self.scope, ctx.scope, pscope, self._scope,
+# op_scope...): scoped writes land in the SAME aggregate namespace (ISSUE 6
+# — every scope write fans into the global series), so a restyled spelling
+# through a scope forks a metric exactly like one through global_stats.
 _CALL = re.compile(
-    r"""global_stats\s*\.\s*
+    r"""(?:\bglobal_stats|[A-Za-z_][\w.]*(?:scope|_stats|\.stats))\s*\.\s*
         (?:add|observe_us|set_gauge|counter|gauge|histogram|timer_us)
         \(\s*f?["']([^"']+)["']""",
     re.VERBOSE)
+
+# label kwargs of .scoped(...) calls: scope LABEL KEYS (pipeline=, tenant=)
+# are their own namespace rendered into every labeled series — `pipeline`
+# vs `pipe_line` would fork the per-tenant series exactly like a restyled
+# metric name, so they're linted in a separate collision domain
+_SCOPED_CALL = re.compile(r"\.scoped\(\s*([^()]*)\)")
+_KWARG = re.compile(r"(?:^|,)\s*(\*\*)?([A-Za-z_]\w*)\s*=")
 
 # single-sourced metric-name tuples (STALL_FIELDS, CACHE_BENCH_FIELDS, the
 # compare_rounds *_KEYS column lists, cli _DECODE_COUNTERS, ...): their
@@ -51,10 +62,16 @@ def _normalize(name: str) -> str:
 
 
 def scan_sources(root_dir: str, roots=DEFAULT_ROOTS
-                 ) -> dict[str, set[tuple[str, str]]]:
-    """{normalized: {(literal, file:line), ...}} over every .py under
-    *roots* (relative to *root_dir*)."""
+                 ) -> tuple[dict[str, set[tuple[str, str]]],
+                            dict[str, set[tuple[str, str]]]]:
+    """(metric_names, label_keys): each {normalized: {(literal, file:line),
+    ...}} over every .py under *roots* (relative to *root_dir*). Metric
+    names come from registry/scope calls AND single-sourced *_FIELDS/
+    *_KEYS/*_COUNTERS tuples (FLIGHT_FIELDS, SENTINEL_FIELDS included —
+    they name the same series the producers feed); label keys come from
+    ``.scoped(...)`` kwargs and live in their own collision domain."""
     found: dict[str, set[tuple[str, str]]] = defaultdict(set)
+    labels: dict[str, set[tuple[str, str]]] = defaultdict(set)
     files: list[str] = []
     for r in roots:
         p = os.path.join(root_dir, r)
@@ -76,6 +93,13 @@ def scan_sources(root_dir: str, roots=DEFAULT_ROOTS
         for m in _CALL.finditer(text):
             line = text.count("\n", 0, m.start()) + 1
             found[_normalize(m.group(1))].add((m.group(1), f"{rel}:{line}"))
+        for m in _SCOPED_CALL.finditer(text):
+            line = text.count("\n", 0, m.start()) + 1
+            for km in _KWARG.finditer(m.group(1)):
+                if km.group(1):  # **expansion: keys are dynamic, skip
+                    continue
+                labels[_normalize(km.group(2))].add(
+                    (km.group(2), f"{rel}:{line}"))
         for m in _FIELDS_DECL.finditer(text):
             # scan to the declaration's closing bracket (nesting-aware:
             # list-comprehension tuples like STALL_FIELDS nest brackets)
@@ -91,7 +115,7 @@ def scan_sources(root_dir: str, roots=DEFAULT_ROOTS
                 line = text.count("\n", 0, s.start()) + 1
                 found[_normalize(s.group(1))].add(
                     (s.group(1), f"{rel}:{line}"))
-    return found
+    return found, labels
 
 
 def collisions(found: dict[str, set[tuple[str, str]]]
@@ -112,19 +136,27 @@ def main(argv: list[str] | None = None) -> int:
     if not os.path.isdir(root):
         print(f"lint_stats_names: not a directory: {root}", file=sys.stderr)
         return 2
-    found = scan_sources(root)
+    found, labels = scan_sources(root)
     bad = collisions(found)
-    if not bad:
-        print(f"lint_stats_names: {len(found)} distinct metric names, "
-              "no case/underscore collisions")
+    bad_labels = collisions(labels)
+    if not bad and not bad_labels:
+        print(f"lint_stats_names: {len(found)} distinct metric names + "
+              f"{len(labels)} scope label keys, no case/underscore "
+              "collisions")
         return 0
     for norm, uses in bad:
         print(f"metric name collision (normalized '{norm}'):",
               file=sys.stderr)
         for lit, where in sorted(uses):
             print(f"  {lit!r} at {where}", file=sys.stderr)
-    print(f"lint_stats_names: {len(bad)} collision group(s) — pick ONE "
-          "spelling per metric", file=sys.stderr)
+    for norm, uses in bad_labels:
+        print(f"scope label key collision (normalized '{norm}'):",
+              file=sys.stderr)
+        for lit, where in sorted(uses):
+            print(f"  {lit!r} at {where}", file=sys.stderr)
+    print(f"lint_stats_names: {len(bad) + len(bad_labels)} collision "
+          "group(s) — pick ONE spelling per metric/label",
+          file=sys.stderr)
     return 1
 
 
