@@ -170,6 +170,26 @@ class StromConfig:
     # yields to demand reads (0 = off; needs hot_cache_bytes > 0 to matter)
     readahead_window_batches: int = 0
 
+    # multi-tenant I/O scheduler (strom/sched — ISSUE 7 tentpole): the
+    # shared arbiter that replaces the per-transfer engine lock. Tenants
+    # (pipelines, daemon clients, readahead) submit gathers into per-tenant
+    # queues with priority classes (interactive > training > background);
+    # a weighted fair drain grants the engine one slice at a time, with
+    # per-tenant byte/IOPS token buckets and slab-pool admission control.
+    # Off = the pre-scheduler behavior (one lock per whole transfer).
+    sched_enabled: bool = True
+    # grant granularity: a gather is executed as slices of this many bytes,
+    # one engine grant each, so a concurrent tenant's op queues behind at
+    # most one slice instead of a whole epoch gather. -1 = auto (4x the
+    # engine in-flight budget, queue_depth * block_size); 0 = no slicing
+    # (whole-gather grants, the old lock scope under fair queueing).
+    sched_slice_bytes: int = -1
+    # slab-pool admission high-water mark (fraction of slab_pool_bytes):
+    # BACKGROUND-class allocations (readahead warm slabs) queue while the
+    # pool sits above it instead of OOM-ing demand tenants out of slabs.
+    # 0 disables admission control.
+    sched_high_water: float = 0.9
+
     # NUMA affinity (multi-socket hosts): pin submitting threads to the NVMe's
     # home node, mbind staging slabs there, optionally steer the device IRQs
     # (needs root). Off by default; no-op on UMA boxes (strom/utils/numa.py).
@@ -270,6 +290,11 @@ class StromConfig:
                              "multiple of 4096")
         if self.readahead_window_batches < 0:
             raise ValueError("readahead_window_batches must be >= 0 (0 = off)")
+        if self.sched_slice_bytes < -1:
+            raise ValueError("sched_slice_bytes must be >= 0 (0 = no "
+                             "slicing) or exactly -1 (auto)")
+        if not 0.0 <= self.sched_high_water <= 1.0:
+            raise ValueError("sched_high_water must be in [0, 1] (0 = off)")
 
     @property
     def resolved_stripe_window_bytes(self) -> int:

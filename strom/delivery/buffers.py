@@ -135,6 +135,33 @@ class SlabPool:
         self.mlocked_bytes = 0
         self.hits = 0
         self.misses = 0
+        # outstanding acquired-not-released bytes (class-rounded, the same
+        # unit the budget is billed): the occupancy signal the multi-tenant
+        # scheduler's admission control gates on, mirrored into the global
+        # registry as the slab_pool_bytes_in_use gauge so admission
+        # decisions are observable on /metrics. A slab the caller drops
+        # without release() counts as in-use until its GC — honest, since
+        # its pages really are committed until the munmap.
+        self.in_use_bytes = 0
+        # change hooks (scheduler admission gate): poked after every
+        # acquire/release so queued background admits re-check occupancy
+        # without polling
+        self._change_hooks: list = []
+
+    def add_change_hook(self, fn) -> None:
+        """Register a no-arg callable invoked (outside the pool lock) after
+        every occupancy change."""
+        self._change_hooks.append(fn)
+
+    def _occupancy_changed(self) -> None:
+        from strom.utils.stats import global_stats
+
+        global_stats.set_gauge("slab_pool_bytes_in_use", self.in_use_bytes)
+        for fn in self._change_hooks:
+            try:
+                fn()
+            except Exception:
+                pass  # observability must never fail an allocation
 
     @staticmethod
     def _base(arr: np.ndarray) -> np.ndarray:
@@ -156,42 +183,74 @@ class SlabPool:
             if bucket:
                 self.hits += 1
                 self._cached_bytes -= cls
-                return bucket.pop()[:nbytes]
-            self.misses += 1
-            # reserve under the lock: concurrent misses (prefetch workers +
-            # the stream reader share one pool) must not both pass a
-            # check-then-act cap test and pin past max_mlock_bytes
-            reserve = self.pin and \
-                self.mlocked_bytes + cls <= self.max_mlock_bytes
-            if reserve:
-                self.mlocked_bytes += cls
-        base = self._base(alloc_aligned(cls, populate=True, huge=self.huge))
-        if reserve:
-            mm = base.base
-            if isinstance(mm, mmap.mmap) and _mlock_mm(mm):
-                # exactly-once release of the reservation, tied to the mmap's
-                # own lifetime: slabs that are dropped, leaked by a failing
-                # caller, or GC'd all reach munmap, which munlocks
-                weakref.finalize(mm, self._unpin, cls)
+                self.in_use_bytes += cls
+                slab = bucket.pop()[:nbytes]
             else:
-                with self._lock:
+                slab = None
+                self.misses += 1
+                self.in_use_bytes += cls
+                # reserve under the lock: concurrent misses (prefetch
+                # workers + the stream reader share one pool) must not both
+                # pass a check-then-act cap test and pin past
+                # max_mlock_bytes
+                reserve = self.pin and \
+                    self.mlocked_bytes + cls <= self.max_mlock_bytes
+                if reserve:
+                    self.mlocked_bytes += cls
+        self._occupancy_changed()
+        if slab is not None:
+            return slab
+        # past here the reservation is settled either by the finalizer
+        # (mlock succeeded — munmap munlocks) or immediately (mlock
+        # refused); until then a failure must hand it back
+        mlock_settled = not reserve
+        try:
+            base = self._base(
+                alloc_aligned(cls, populate=True, huge=self.huge))
+            if reserve:
+                mm = base.base
+                if isinstance(mm, mmap.mmap) and _mlock_mm(mm):
+                    # exactly-once release of the reservation, tied to the
+                    # mmap's own lifetime: slabs that are dropped, leaked by
+                    # a failing caller, or GC'd all reach munmap, which
+                    # munlocks
+                    weakref.finalize(mm, self._unpin, cls)
+                else:
+                    with self._lock:
+                        self.mlocked_bytes -= cls
+                mlock_settled = True
+            if self.on_alloc is not None:
+                self.on_alloc(base)
+        except Exception:
+            # the caller never gets a slab it could release(): roll the
+            # occupancy charge back, or it would permanently inflate
+            # slab_pool_bytes_in_use and wedge the admission gate past the
+            # high-water mark on phantom bytes
+            with self._lock:
+                self.in_use_bytes -= cls
+                if not mlock_settled:
                     self.mlocked_bytes -= cls
-        if self.on_alloc is not None:
-            self.on_alloc(base)
+            self._occupancy_changed()
+            raise
         return base[:nbytes]
 
     def release(self, arr: np.ndarray) -> None:
         base = self._base(arr)
         cls = base.nbytes
         with self._lock:
-            if self._cached_bytes + cls > self.max_bytes:
-                return  # let it drop; GC unmaps (finalizer settles mlock)
-            self._free.setdefault(cls, []).append(base)
-            self._cached_bytes += cls
+            # in-use drops whether the slab recycles or falls to GC: either
+            # way the caller is done with it (admission headroom returns)
+            self.in_use_bytes -= cls
+            if self._cached_bytes + cls <= self.max_bytes:
+                self._free.setdefault(cls, []).append(base)
+                self._cached_bytes += cls
+            # else: let it drop; GC unmaps (finalizer settles mlock)
+        self._occupancy_changed()
 
     def stats(self) -> dict:
         with self._lock:
             return {"cached_bytes": self._cached_bytes,
+                    "slab_in_use_bytes": self.in_use_bytes,
                     "huge": self.huge,
                     "mlocked_bytes": self.mlocked_bytes,
                     "mlock_cap_bytes": self.max_mlock_bytes,

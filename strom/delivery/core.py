@@ -316,6 +316,21 @@ class StromContext:
             huge=self.config.huge_pages,
             on_alloc=self._on_slab_alloc) \
             if self.config.slab_pool_bytes > 0 else None
+        # multi-tenant I/O scheduler (ISSUE 7 tentpole, strom/sched): the
+        # shared arbiter that replaces the per-transfer engine lock —
+        # per-tenant queues with priority classes, weighted fair drain at
+        # slice granularity, byte/IOPS budgets, slab-pool admission
+        # control. Every scheduled gather below (_read_segments slices,
+        # StreamingGather grants, the readahead's background-class warm
+        # reads) routes through it; sched_enabled=False keeps the
+        # pre-scheduler lock-per-transfer behavior.
+        self._scheduler = None
+        if self.config.sched_enabled:
+            from strom.sched.scheduler import IoScheduler
+
+            self._scheduler = IoScheduler(self.engine, self.config,
+                                          pool=self._slab_pool,
+                                          scope=self.scope)
         # hot-set host cache (ISSUE 4 tentpole, strom/delivery/hotcache.py):
         # repeat traffic serves from RAM instead of re-gathering from NVMe.
         # Buffers come from the slab pool (NUMA-placed, engine-registered);
@@ -388,6 +403,52 @@ class StromContext:
     def hot_cache(self) -> HotCache | None:
         """The hot-set cache when ``hot_cache_bytes > 0``, else None."""
         return self._hot_cache
+
+    @property
+    def scheduler(self):
+        """The multi-tenant I/O scheduler when ``sched_enabled``, else
+        None (strom/sched/scheduler.py)."""
+        return self._scheduler
+
+    def register_tenant(self, name: str, *, priority: str = "training",
+                        weight: int = 1, byte_rate: float = 0,
+                        byte_burst: float | None = None, iops: float = 0,
+                        hot_cache_bytes: int = 0):
+        """Register a tenant with the scheduler (priority class, fair-drain
+        weight, byte/IOPS budgets) and, when the context has a hot cache,
+        carve its per-tenant cache partition. Returns the Tenant handle;
+        raises when the scheduler is disabled. Pipelines reference the
+        tenant by labeling their scope: ``scope={"pipeline": "resnet",
+        "tenant": name}``."""
+        if self._scheduler is None:
+            raise RuntimeError("sched_enabled=False: no scheduler to "
+                               "register tenants with")
+        if self._scheduler.is_registered(name):
+            # re-register returns the live handle UNCHANGED (scheduler
+            # contract: queue state and budget balances survive) — so the
+            # cache partition must not silently resize either; applying
+            # only the hot_cache_bytes of a new config would diverge
+            # scheduler and cache state with no indication
+            return self._scheduler.tenant(name)
+        t = self._scheduler.register(
+            name, priority=priority, weight=weight, byte_rate=byte_rate,
+            byte_burst=byte_burst, iops=iops,
+            hot_cache_bytes=hot_cache_bytes)
+        if hot_cache_bytes and self._hot_cache is not None:
+            self._hot_cache.set_partition(name, hot_cache_bytes)
+        return t
+
+    @contextlib.contextmanager
+    def engine_exclusive(self, nbytes: int = 0, tenant: str | None = None):
+        """Exclusive use of the engine's transfer path for a raw
+        engine-level caller (the stress harness, tooling): a scheduler
+        grant when one exists, the legacy engine lock otherwise."""
+        if self._scheduler is not None:
+            with self._scheduler.grant(tenant, nbytes):
+                yield
+        else:
+            with self._engine_lock:
+                yield
 
     @contextlib.contextmanager
     def _demand_gate(self):
@@ -694,7 +755,8 @@ class StromContext:
     def _read_segments(self, source: "Source",
                        segments: Sequence[Segment],
                        dest: "np.ndarray | None",
-                       base_offset: int = 0, *, _warm: bool = False) -> int:
+                       base_offset: int = 0, *, _warm: bool = False,
+                       tenant: str | None = None) -> int:
         """Read (file_offset+base_offset → dest_offset) segments, chunked at
         block_size, pipelined at queue_depth. Returns total bytes read.
         Raises EngineError on any failed or short chunk.
@@ -728,22 +790,41 @@ class StromContext:
                 cache, chunks, idx_paths, dflat, warm=_warm)
 
         if _warm:
-            return self._warm_read_chunks(chunks, dest, idx_paths)
+            return self._warm_read_chunks(chunks, dest, idx_paths, tenant)
 
+        return self._demand_read_chunks(chunks, dest, idx_paths, cache,
+                                        dflat, cache_hit, tenant)
+
+    def _demand_read_chunks(self, chunks, dest, idx_paths, cache, dflat,
+                            cache_hit: int, tenant: str | None) -> int:
+        """Demand half of :meth:`_read_segments` after planning + cache
+        consult: execute the miss chunks on the engine (scheduler-arbitrated
+        when one exists, billed to *tenant*'s queue/budgets), verify byte
+        accounting, offer admissions."""
         # The engine executes the whole gather (block_size chunking, queue
         # -depth pipelining, per-chunk retry, EOF topup): ONE boundary
         # crossing per transfer on the C++ engine (SURVEY.md §3.3 hot loop).
+        # Under the multi-tenant scheduler (ISSUE 7) the gather runs as
+        # fair-drained slices — one engine grant per ~sched_slice_bytes —
+        # so a concurrent tenant's op queues behind at most one slice of
+        # this transfer; without it, the legacy whole-transfer lock.
+        cfg = self.config
         planned = sum(ln for (_, _, _, ln) in chunks)
         total = 0
         if chunks:
             with self._demand_gate(), \
                     _events_ring.span("strom.read_segments", cat="read",
                                       args={"ops": len(chunks),
-                                            "bytes": planned}), \
-                    self._engine_lock:
+                                            "bytes": planned}):
                 try:
-                    total = self.engine.read_vectored(chunks, dest,
-                                                      retries=cfg.io_retries)
+                    if self._scheduler is not None:
+                        total = self._scheduler.read_chunks(
+                            chunks, dest, tenant=tenant,
+                            retries=cfg.io_retries)
+                    else:
+                        with self._engine_lock:
+                            total = self.engine.read_vectored(
+                                chunks, dest, retries=cfg.io_retries)
                 except EngineError as e:
                     raise EngineError(e.errno,
                                       f"ssd2tpu {e.strerror}") from None
@@ -764,7 +845,8 @@ class StromContext:
                     path = idx_paths.get(fi)
                     if path is not None:
                         admitted += cache.admit(path, fo, fo + ln,
-                                                dflat[do: do + ln])
+                                                dflat[do: do + ln],
+                                                tenant=tenant)
                 if admitted:
                     _events_ring.complete(t0a, _events_ring.now_us() - t0a,
                                           "cache", "cache.admit",
@@ -773,7 +855,8 @@ class StromContext:
         return total + cache_hit
 
     def _warm_read_chunks(self, chunks: list[tuple[int, int, int, int]],
-                          dest: np.ndarray, idx_paths: dict[int, str]) -> int:
+                          dest: np.ndarray, idx_paths: dict[int, str],
+                          tenant: "str | None" = None) -> int:
         """Readahead engine path: read miss chunks in slices of the
         in-flight budget (queue_depth x block_size), force-admitting each
         slice, yielding to demand gathers between slices — a demand read
@@ -790,6 +873,13 @@ class StromContext:
         acquired: np.ndarray | None = None
         if dest is None:
             span = max(do + ln for (_, _, do, ln) in chunks)
+            if self._scheduler is not None:
+                # slab-pool admission control (ISSUE 7): a warm slab is
+                # BACKGROUND-class memory — under high-water pressure it
+                # queues (bounded; a failed admit skips this warm pass)
+                # instead of crowding demand tenants out of the pool
+                if not self._scheduler.admission.admit(span, timeout_s=5.0):
+                    return 0
             dest = acquired = self._slab_pool.acquire(span) \
                 if self._slab_pool is not None else alloc_aligned(span)
         try:
@@ -810,9 +900,17 @@ class StromContext:
                     i += 1
                 t0 = _events_ring.now_us()
                 try:
-                    with self._engine_lock:
-                        n = self.engine.read_vectored(batch, dest,
-                                                      retries=cfg.io_retries)
+                    if self._scheduler is not None:
+                        # readahead demotes to the lowest class
+                        # automatically: a demand gather of ANY tenant
+                        # outranks every warm slice in the fair drain
+                        n = self._scheduler.read_chunks(
+                            batch, dest, tenant="readahead",
+                            retries=cfg.io_retries, priority="background")
+                    else:
+                        with self._engine_lock:
+                            n = self.engine.read_vectored(
+                                batch, dest, retries=cfg.io_retries)
                 except EngineError:
                     break
                 _events_ring.complete(t0, _events_ring.now_us() - t0, "cache",
@@ -822,8 +920,12 @@ class StromContext:
                 for fi, fo, do, ln in batch:
                     path = idx_paths.get(fi)
                     if path is not None:
+                        # admitted bytes charge the OWNING pipeline's
+                        # partition (the engine read rode the shared
+                        # background "readahead" tenant) — warming must not
+                        # bypass the per-tenant cache carve-outs
                         cache.admit(path, fo, fo + ln, dflat[do: do + ln],
-                                    force=True)
+                                    force=True, tenant=tenant)
                 total += n
         finally:
             if acquired is not None and self._slab_pool is not None:
@@ -845,7 +947,7 @@ class StromContext:
     # -- completion-driven streaming gather (ISSUE 5 tentpole) --------------
     def stream_segments(self, source: "Source", segments: Sequence[Segment],
                         dest: np.ndarray, base_offset: int = 0, *,
-                        scope=None):
+                        scope=None, tenant: str | None = None):
         """Begin a completion-driven gather of *segments* into *dest*: the
         same plan ``_read_segments`` would execute (striped aliases,
         coalescing, stripe windows, extent-aware ordering, hot-cache
@@ -860,10 +962,10 @@ class StromContext:
         if self._closed:
             raise RuntimeError("StromContext is closed")
         return StreamingGather(self, source, segments, dest, base_offset,
-                               scope=scope)
+                               scope=scope, tenant=tenant)
 
     def warm(self, source: "Source", segments: Sequence[Segment],
-             base_offset: int = 0) -> int:
+             base_offset: int = 0, *, tenant: "str | None" = None) -> int:
         """Readahead entry point (strom.delivery.hotcache.Readahead): make
         the given ranges cache-resident. Serves nothing — already-cached
         ranges are skipped without a copy, misses are engine-read into a
@@ -882,7 +984,7 @@ class StromContext:
             # misses to read (a fully-warm window costs a consult, nothing
             # else — see _warm_read_chunks)
             warmed = self._read_segments(source, segments, None, base_offset,
-                                         _warm=True)
+                                         _warm=True, tenant=tenant)
         except (EngineError, OSError, ValueError):
             warmed = 0  # advisory: never turn readahead into a crash
         if warmed:
@@ -893,7 +995,7 @@ class StromContext:
     def _deliver_streamed(self, source: "Source", segments: Sequence[Segment],
                           base_offset: int, nbytes: int, np_dtype: np.dtype,
                           local_shape: tuple, devices: Sequence[Any],
-                          pool) -> list:
+                          pool, tenant: str | None = None) -> list:
         """Pipeline one transfer internally: the engine reads piece k+1 from
         disk while piece k streams host->HBM, then the pieces are concatenated
         on-device. This is the intra-transfer half of the overlap story —
@@ -938,7 +1040,8 @@ class StromContext:
                         if self._numa is not None:
                             self._numa.bind(slab)
                     t = time.perf_counter()
-                    self._read_segments(source, piece_segs, slab, base_offset)
+                    self._read_segments(source, piece_segs, slab, base_offset,
+                                        tenant=tenant)
                     read_busy += time.perf_counter() - t
                     t = time.perf_counter()
                     ready.put((idx, slab))
@@ -1031,7 +1134,8 @@ class StromContext:
                        sharding: Any = None,
                        device: Any = None,
                        async_: bool = False,
-                       pin: bool = False) -> Any:
+                       pin: bool = False,
+                       tenant: str | None = None) -> Any:
         """Read bytes from *source* and deliver them as a jax.Array.
 
         - shape/dtype: array view of the bytes (row-major on disk). If shape is
@@ -1131,9 +1235,10 @@ class StromContext:
                     if stream_eligible(nbytes):
                         return self._deliver_streamed(
                             source, [Segment(0, 0, nbytes)], offset, nbytes,
-                            np_dtype, shape, [device], pool)[0]
+                            np_dtype, shape, [device], pool, tenant)[0]
                     dest = acquire(nbytes)
-                    self._read_segments(source, [Segment(0, 0, nbytes)], dest, offset)
+                    self._read_segments(source, [Segment(0, 0, nbytes)],
+                                        dest, offset, tenant=tenant)
                     arr_host = dest.view(np_dtype).reshape(shape)
                     with self._put_lock, \
                             trace_span("strom.device_put", cat="put",
@@ -1153,7 +1258,8 @@ class StromContext:
                     dest = acquire(group[0].nbytes)
                     out = []
                     try:
-                        self._read_segments(source, list(segs), dest, offset)
+                        self._read_segments(source, list(segs), dest, offset,
+                                            tenant=tenant)
                         arr_host = dest.view(np_dtype).reshape(group[0].local_shape)
                         for p in group:
                             with self._put_lock, \
@@ -1211,7 +1317,7 @@ class StromContext:
                             shards.extend(self._deliver_streamed(
                                 source, list(segs), offset, group[0].nbytes,
                                 np_dtype, group[0].local_shape,
-                                [p.device for p in group], pool))
+                                [p.device for p in group], pool, tenant))
                             continue
                         s, d = deliver_group(segs, group)
                         shards.extend(s)
@@ -1235,7 +1341,8 @@ class StromContext:
                         shape: Sequence[int] | None = None,
                         dtype: Any = np.uint8,
                         length: int | None = None,
-                        out: np.ndarray | None = None) -> np.ndarray:
+                        out: np.ndarray | None = None,
+                        tenant: str | None = None) -> np.ndarray:
         """Everything ``memcpy_ssd2tpu`` does UP TO (not including) the
         ``jax.device_put``: striped-alias resolution, extent-aware chunk
         planning, residency routing, and the engine gather — assembled
@@ -1275,12 +1382,14 @@ class StromContext:
             if flat.nbytes < nbytes:
                 raise ValueError(f"out holds {flat.nbytes} bytes, need {nbytes}")
             dest = flat[:nbytes]
-        self._read_segments(source, [Segment(0, 0, nbytes)], dest, offset)
+        self._read_segments(source, [Segment(0, 0, nbytes)], dest, offset,
+                            tenant=tenant)
         return dest.view(np_dtype).reshape(shape)
 
     # -- host-side range read (format readers: indexes, footers, members) ---
     def pread(self, source: "Source", offset: int = 0,
-              length: int | None = None) -> np.ndarray:
+              length: int | None = None, *,
+              tenant: str | None = None) -> np.ndarray:
         """Read bytes from *source* into a fresh aligned host slab (no device
         transfer). The staging path format readers use for metadata and member
         payloads before decode."""
@@ -1295,7 +1404,8 @@ class StromContext:
         if self._numa is not None and \
                 self._numa.resolve(self._numa_path(source)) is not None:
             self._numa.bind(dest)
-        self._read_segments(source, [Segment(0, 0, length)], dest, offset)
+        self._read_segments(source, [Segment(0, 0, length)], dest, offset,
+                            tenant=tenant)
         return dest
 
     # -- introspection (≙ LIST/INFO_GPU_MEMORY, /proc stats) ----------------
@@ -1309,7 +1419,7 @@ class StromContext:
         never recomputes the expensive stall-attribution section (ISSUE 6
         satellite). None = every section (the pre-existing contract).
         Known sections: context, decode, stream, steps, cache, slab_pool,
-        engine, scopes."""
+        engine, sched, scopes."""
         want = None if sections is None else set(sections)
 
         def wanted(name: str) -> bool:
@@ -1422,6 +1532,11 @@ class StromContext:
             out["slab_pool"] = self._slab_pool.stats()
         if wanted("engine"):
             out["engine"] = self.engine.stats()
+        # multi-tenant scheduler (ISSUE 7): aggregate queue/grant/admission
+        # state — per-tenant series reach /metrics as labeled samples via
+        # the registry scopes; the /tenants route renders the full rows
+        if wanted("sched") and self._scheduler is not None:
+            out["sched"] = self._scheduler.stats()
         # scoped telemetry (ISSUE 6 tentpole): every label scope's series as
         # {label-string: snapshot} — the JSON twin of the labeled samples
         # /metrics renders; the sections exposition skips it (nested dicts),

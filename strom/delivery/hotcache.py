@@ -86,10 +86,11 @@ class _Entry:
     2MiB-rounded under huge pages), not the logical length, so resident
     memory actually respects ``hot_cache_bytes``."""
 
-    __slots__ = ("skey", "lo", "hi", "buf", "refs", "dead", "charge")
+    __slots__ = ("skey", "lo", "hi", "buf", "refs", "dead", "charge",
+                 "tenant")
 
     def __init__(self, skey: Any, lo: int, hi: int, buf: np.ndarray,
-                 charge: int):
+                 charge: int, tenant: "str | None" = None):
         self.skey = skey
         self.lo = lo
         self.hi = hi
@@ -97,6 +98,9 @@ class _Entry:
         self.refs = 0
         self.dead = False
         self.charge = charge
+        # owning tenant for partition accounting (ISSUE 7): None = charged
+        # to the shared budget only (single-tenant behavior unchanged)
+        self.tenant = tenant
 
     @property
     def nbytes(self) -> int:
@@ -142,6 +146,13 @@ class HotCache:
         self._touched: "OrderedDict[tuple, None]" = OrderedDict()
         self._touch_cap = touch_capacity
         self.bytes = 0
+        # per-tenant partitions (ISSUE 7 tentpole): tenant -> byte cap
+        # within the shared budget, charged at admit time. A tenant at its
+        # cap evicts ITS OWN unpinned LRU entries first; only if that
+        # frees nothing is the admission dropped — one tenant's working
+        # set can never displace every other tenant's.
+        self._partitions: dict[str, int] = {}
+        self._tenant_bytes: dict[str, int] = {}
         # telemetry scope (ISSUE 6): the owning context's label scope, so a
         # tenant's cache traffic is distinguishable on /metrics; None = the
         # global registry (single-tenant behavior unchanged)
@@ -299,8 +310,27 @@ class HotCache:
             self._touched.popitem(last=False)
         return seen
 
+    def set_partition(self, tenant: str, max_bytes: int) -> None:
+        """Cap *tenant*'s resident bytes at *max_bytes* (0 removes the
+        partition; the tenant then shares the global budget unpartitioned).
+        Existing entries keep their charge — enforcement applies from the
+        next admission."""
+        with self._lock:
+            if max_bytes <= 0:
+                self._partitions.pop(tenant, None)
+            else:
+                self._partitions[tenant] = int(max_bytes)
+
+    def partitions(self) -> dict:
+        """{tenant: {"max_bytes", "bytes"}} — the /tenants route's cache
+        column."""
+        with self._lock:
+            return {t: {"max_bytes": m,
+                        "bytes": self._tenant_bytes.get(t, 0)}
+                    for t, m in self._partitions.items()}
+
     def admit(self, skey: Any, lo: int, hi: int, data: np.ndarray, *,
-              force: bool = False) -> int:
+              force: bool = False, tenant: "str | None" = None) -> int:
         """Offer file bytes [lo, hi) of *skey* (``data`` holds them) for
         admission. Subject to the admission policy (unless *force*), the
         byte budget (LRU eviction of unpinned entries makes room) and
@@ -320,29 +350,50 @@ class HotCache:
         admitted = 0
         for g_lo, g_hi in gaps:
             admitted += self._insert(skey, g_lo, g_hi,
-                                     data[g_lo - lo: g_hi - lo])
+                                     data[g_lo - lo: g_hi - lo],
+                                     tenant=tenant)
         if admitted:
             with self._lock:
                 self.admitted_bytes += admitted
             self._scope.add("cache_admitted_bytes", admitted)
         return admitted
 
-    def _insert(self, skey: Any, lo: int, hi: int, data: np.ndarray) -> int:
+    def _insert(self, skey: Any, lo: int, hi: int, data: np.ndarray, *,
+                tenant: "str | None" = None) -> int:
         n = hi - lo
         charge = self._charge(n)
         buf = self._alloc(n)
         buf[:n] = data[:n]
         with self._lock:
-            # make room (skip pinned entries: never free a slab with an
-            # in-flight reader/put)
-            while self.bytes + charge > self.max_bytes:
+            # partition enforcement (ISSUE 7): a tenant over its carve-out
+            # first evicts its OWN unpinned entries (self-displacement —
+            # other tenants' hot sets are untouchable via this path), and
+            # admission is refused if its cap still can't fit the entry
+            refused = False
+            cap = self._partitions.get(tenant) if tenant is not None else None
+            if cap is not None:
+                if charge > cap:
+                    refused = True
+                else:
+                    while self._tenant_bytes.get(tenant, 0) + charge > cap:
+                        victim = next(
+                            (e for e in self._lru.values()
+                             if e.refs == 0 and e.tenant == tenant), None)
+                        if victim is None:
+                            break
+                        self._evict_locked(victim)
+                    if self._tenant_bytes.get(tenant, 0) + charge > cap:
+                        refused = True
+            # make room in the shared budget (skip pinned entries: never
+            # free a slab with an in-flight reader/put)
+            while not refused and self.bytes + charge > self.max_bytes:
                 victim = next((e for e in self._lru.values() if e.refs == 0),
                               None)
                 if victim is None:
                     break
                 self._evict_locked(victim)
-            if self.bytes + charge > self.max_bytes:
-                drop = buf  # everything left is pinned: skip admission
+            if refused or self.bytes + charge > self.max_bytes:
+                drop = buf  # over partition / everything left pinned
             else:
                 # a concurrent admit may have covered part of this gap
                 # between our lookup and now; keep entries disjoint
@@ -353,10 +404,13 @@ class HotCache:
                 if not (prev_ok and next_ok):
                     drop = buf
                 else:
-                    e = _Entry(skey, lo, hi, buf, charge)
+                    e = _Entry(skey, lo, hi, buf, charge, tenant)
                     entries.insert(i, e)
                     self._lru[id(e)] = e
                     self.bytes += charge
+                    if tenant is not None:
+                        self._tenant_bytes[tenant] = \
+                            self._tenant_bytes.get(tenant, 0) + charge
                     drop = None
         if drop is not None:
             self._free(drop)
@@ -375,6 +429,12 @@ class HotCache:
             if not entries:
                 del self._index[e.skey]
         self.bytes -= e.charge
+        if e.tenant is not None:
+            left = self._tenant_bytes.get(e.tenant, 0) - e.charge
+            if left > 0:
+                self._tenant_bytes[e.tenant] = left
+            else:
+                self._tenant_bytes.pop(e.tenant, None)
         self.evictions += 1
         self.evicted_bytes += e.nbytes
         self._scope.add("cache_evictions")
@@ -468,10 +528,14 @@ class Readahead:
     """
 
     def __init__(self, ctx, window_fn: Callable[[], Iterable[tuple]], *,
-                 interval_s: float = 0.02):
+                 interval_s: float = 0.02, tenant: "str | None" = None):
         self._ctx = ctx
         self._window_fn = window_fn
         self._interval = interval_s
+        # the pipeline this thread warms FOR: admitted entries charge that
+        # tenant's cache partition (the ENGINE reads still ride the shared
+        # background "readahead" tenant — ownership and scheduling differ)
+        self._tenant = tenant
         self._stop = threading.Event()
         self._thread = threading.Thread(target=self._run, daemon=True,
                                         name="strom-readahead")
@@ -491,7 +555,8 @@ class Readahead:
                 for source, segments, base_offset in self._window_fn():
                     if self._stop.is_set():
                         break
-                    warmed += self._ctx.warm(source, segments, base_offset)
+                    warmed += self._ctx.warm(source, segments, base_offset,
+                                             tenant=self._tenant)
             except Exception:
                 # advisory path: a racing pipeline/context close (or a
                 # transient engine error) must neither kill the thread nor

@@ -84,12 +84,19 @@ class StreamingGather:
     """
 
     def __init__(self, ctx, source, segments: Sequence[Segment],
-                 dest: np.ndarray, base_offset: int = 0, *, scope=None):
+                 dest: np.ndarray, base_offset: int = 0, *, scope=None,
+                 tenant: str | None = None):
         self._ctx = ctx
         # telemetry scope (ISSUE 6): pipelines pass their label scope so two
         # tenants' streamed gathers surface distinguishable stream_* series;
         # default: the context's scope (single-tenant behavior unchanged)
         self._scope = scope if scope is not None else ctx.scope
+        # scheduler tenant (ISSUE 7): explicit name wins, else the scope's
+        # tenant label — so a pipeline built with scope={"tenant": "t0"}
+        # queues (and bills cache partitions) as t0 with no extra plumbing
+        if tenant is None:
+            tenant = getattr(self._scope, "labels", {}).get("tenant")
+        self._tenant = tenant
         self._dflat = dest if dest.ndim == 1 and dest.dtype == np.uint8 \
             else dest.reshape(-1).view(np.uint8)
         self._closed = False
@@ -99,10 +106,16 @@ class StreamingGather:
         self.t0_us = _events_ring.now_us()
         self._first_c_us: int | None = None
         self._last_c_us: int | None = None
-        # resources held for the gather's lifetime: demand gate (readahead
-        # yields to us) + the delivery engine lock (a live token owns the
-        # engine's gather path exactly like a blocking read_vectored call)
+        # engine-path resources: demand gate (readahead yields to us) +
+        # the engine arbiter — a scheduler grant for the miss bytes when a
+        # scheduler exists (queued/budgeted like any tenant op), else the
+        # legacy delivery engine lock. Held while the token is live and
+        # RELEASED AT GATHER DRAIN (the moment the last piece retires, in
+        # poll/finish/close — ISSUE 7 satellite): finish-side bookkeeping
+        # and admission offers never extend engine ownership, matching the
+        # release point the streamed pipeline path already had.
         self._stack = contextlib.ExitStack()
+        self._engine_released = False
         try:
             chunks, idx_paths = ctx._plan_chunks(source, segments,
                                                  base_offset)
@@ -124,7 +137,11 @@ class StreamingGather:
                 self._scope.add("stream_instant_bytes", hit_bytes)
             if chunks:
                 self._stack.enter_context(ctx._demand_gate())
-                self._stack.enter_context(ctx._engine_lock)
+                if ctx.scheduler is not None:
+                    self._stack.enter_context(
+                        ctx.scheduler.grant(self._tenant, self._miss_planned))
+                else:
+                    self._stack.enter_context(ctx._engine_lock)
                 self._token = ctx.engine.submit_vectored(
                     chunks, dest, retries=ctx.config.io_retries)
             self._scope.add("stream_batches")
@@ -175,7 +192,13 @@ class StreamingGather:
                 path = self._idx_paths.get(fi)
                 if path is not None:
                     self._admitted += self._cache.admit(
-                        path, fo, fo + ln, self._dflat[do: do + ln])
+                        path, fo, fo + ln, self._dflat[do: do + ln],
+                        tenant=self._tenant)
+        if self._token.done:
+            # gather drained: hand the engine back NOW — the caller may
+            # keep polling instants / defer finish() without holding the
+            # arbiter against other tenants (ISSUE 7 satellite)
+            self._release_engine()
         return out
 
     def finish(self) -> int:
@@ -193,6 +216,7 @@ class StreamingGather:
         except EngineError as e:
             self._release()
             raise EngineError(e.errno, f"ssd2tpu {e.strerror}") from None
+        self._release_engine()
         if total != self._miss_planned:
             # cheap insurance, same as _read_segments: any engine
             # accounting bug surfaces loudly, not as a zero-tailed batch
@@ -203,6 +227,17 @@ class StreamingGather:
         self._release()
         self._scope.add("ssd2tpu_bytes", self.total_bytes)
         return self.total_bytes
+
+    def _release_engine(self) -> None:
+        """Drop the engine-path resources (demand gate + scheduler grant /
+        engine lock). Idempotent; called the moment the token drains —
+        every in-flight piece retired — so the stats/span/admission tail
+        of finish() runs with the engine already handed to the next
+        tenant in the fair drain."""
+        if self._engine_released:
+            return
+        self._engine_released = True
+        self._stack.close()
 
     def _release(self) -> None:
         if self._finished:
@@ -238,7 +273,7 @@ class StreamingGather:
                               {"bytes": self.total_bytes,
                                "instant_bytes": self.instant_bytes,
                                "ops": len(self._chunks)})
-        self._stack.close()
+        self._release_engine()
 
     def close(self) -> None:
         """Idempotent teardown. A live token is CANCELLED: every in-flight
@@ -250,6 +285,7 @@ class StreamingGather:
         if self._token is not None and not self._token.done:
             with contextlib.suppress(Exception):
                 self._ctx.engine.cancel(self._token)
+        self._release_engine()
         self._release()
 
     def __enter__(self) -> "StreamingGather":

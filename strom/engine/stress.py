@@ -93,7 +93,11 @@ def run_stress(variant: str = "", *, seconds: float = 3.0,
                     try:
                         off = int(rng.integers(0, size - slab.nbytes)) & ~4095
                         fi = ctx.file_index(path)
-                        with ctx._engine_lock:
+                        # engine_exclusive: a scheduler grant when the
+                        # multi-tenant arbiter owns the engine, the legacy
+                        # lock otherwise — either way this raw gather never
+                        # interleaves with a delivery transfer's tag space
+                        with ctx.engine_exclusive(slab.nbytes):
                             n = ctx.engine.read_vectored(
                                 [(fi, off, 0, slab.nbytes)], slab)
                         if n != slab.nbytes or not np.array_equal(

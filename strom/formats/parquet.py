@@ -502,7 +502,8 @@ class ParquetShard:
         return ExtentList([Extent(self.path, fsize - flen, flen)])
 
     def read_row_group(self, ctx: "StromContext", row_group: int,
-                       columns: Sequence[str] | None = None) -> "pa.Table":
+                       columns: Sequence[str] | None = None, *,
+                       tenant: str | None = None) -> "pa.Table":
         """Engine-read the selected chunks + footer, decode to a pyarrow
         Table. Everything pyarrow touches was prefetched through strom."""
         import pyarrow.parquet as pq
@@ -511,8 +512,12 @@ class ParquetShard:
         footer_ext = self.footer_extent()
         with self._footer_lock:
             if self._footer_bytes is None:
-                self._footer_bytes = ctx.pread(footer_ext)  # immutable: once
-        buf = ctx.pread(chunk_ext)
+                # immutable, read once — but billed to the REQUESTING
+                # tenant: an interactive tenant's cold-start metadata read
+                # must ride its own (priority) queue, not the default
+                # tenant's training-class FIFO
+                self._footer_bytes = ctx.pread(footer_ext, tenant=tenant)
+        buf = ctx.pread(chunk_ext, tenant=tenant)
         cache = _RangeCache()
         cache.insert(footer_ext.extents[0].offset, self._footer_bytes)
         pos = 0
@@ -533,7 +538,8 @@ class ParquetShard:
         return table
 
     def read_row_group_arrays(self, ctx: "StromContext", row_group: int,
-                              columns: Sequence[str]) -> dict:
+                              columns: Sequence[str], *,
+                              tenant: str | None = None) -> dict:
         """Selected columns of one row group as host numpy arrays — the scan
         pipeline's read unit.
 
@@ -563,7 +569,7 @@ class ParquetShard:
                 break
         if eligible:
             chunk_ext = self.column_chunk_extents(row_group, columns)
-            buf = ctx.pread(chunk_ext)
+            buf = ctx.pread(chunk_ext, tenant=tenant)
             out = {}
             pos = 0
             try:
@@ -577,7 +583,8 @@ class ParquetShard:
             else:
                 global_stats.add("parquet_plain_bytes", int(buf.nbytes))
                 return out
-        table = self.read_row_group(ctx, row_group, columns=columns)
+        table = self.read_row_group(ctx, row_group, columns=columns,
+                                    tenant=tenant)
         out = {c: np.ascontiguousarray(table[c].to_numpy(zero_copy_only=False))
                for c in columns}
         global_stats.add("parquet_decode_bytes",

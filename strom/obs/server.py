@@ -18,6 +18,15 @@ same way — four routes, no dependencies beyond ``http.server``:
   included), for humans and dashboards that want structure.
 - ``GET /trace``   — the event ring as Trace Event JSON: ``curl -o
   trace.json localhost:<port>/trace`` mid-run, load in Perfetto.
+- ``GET /tenants`` — the multi-tenant scheduler's state (ISSUE 7): one
+  row per registered tenant (priority class, weight, queue depth/bytes,
+  budget balances, grant totals) plus the slab-pool admission gate.
+  ``POST /tenants`` with a JSON body drives the daemon-mode lifecycle:
+  ``{"op": "register", "name": "t0", "priority": "interactive",
+  "byte_rate": 1e8, ...}`` registers (or fetches) a tenant;
+  ``{"op": "drain", "name": "t0"}`` blocks until its queue and active
+  grants empty (``timeout_s`` optional). 404 when the owning context has
+  no scheduler.
 - ``GET /flight``  — an on-demand flight capture (strom/obs/flight.py):
   per-thread stacks, stats snapshot, event-ring trace, and — when a
   FlightRecorder is attached — its watchdog sample history.
@@ -104,6 +113,16 @@ class MetricsServer:
                         doc = trace_document(server._ring.snapshot())
                         self._send(200, json.dumps(doc).encode(),
                                    "application/json")
+                    elif path == "/tenants":
+                        sched = server._sched()
+                        if sched is None:
+                            self._send(404, b"no scheduler on this "
+                                            b"context\n", "text/plain")
+                        else:
+                            self._send(200,
+                                       json.dumps(sched.tenants_info(),
+                                                  default=str).encode(),
+                                       "application/json")
                     elif path == "/flight":
                         dump = q.get("dump", ["0"])[0] not in ("0", "", "no")
                         self._send(200,
@@ -112,8 +131,50 @@ class MetricsServer:
                                    "application/json")
                     else:
                         self._send(404, b"not found: try /metrics /stats "
-                                        b"/trace /flight\n", "text/plain")
+                                        b"/trace /flight /tenants\n",
+                                   "text/plain")
                 except Exception as e:  # a scrape must never kill the server
+                    with contextlib.suppress(Exception):
+                        self._send(500, repr(e).encode(), "text/plain")
+
+            def do_POST(self) -> None:  # noqa: N802 (http.server API)
+                path, _, _ = self.path.partition("?")
+                try:
+                    if path != "/tenants":
+                        self._send(404, b"POST supports /tenants only\n",
+                                   "text/plain")
+                        return
+                    sched = server._sched()
+                    if sched is None:
+                        self._send(404, b"no scheduler on this context\n",
+                                   "text/plain")
+                        return
+                    n = int(self.headers.get("Content-Length") or 0)
+                    try:
+                        body = json.loads(self.rfile.read(n) or b"{}")
+                        if not isinstance(body, dict):
+                            raise ValueError("body must be a JSON object")
+                    except (ValueError, json.JSONDecodeError) as e:
+                        self._send(400, f"bad body: {e}\n".encode(),
+                                   "text/plain")
+                        return
+                    try:
+                        out = server._tenants_op(sched, body)
+                    except (ValueError, TypeError) as e:
+                        # malformed FIELDS (empty name, weight:'abc',
+                        # byte_burst:null) are the client's fault — 400,
+                        # same as a malformed body, not a 500 server fault
+                        self._send(400, f"bad field: {e}\n".encode(),
+                                   "text/plain")
+                        return
+                    if out is None:
+                        self._send(400, b"op must be 'register' or "
+                                        b"'drain'\n", "text/plain")
+                    else:
+                        self._send(200, json.dumps(out,
+                                                   default=str).encode(),
+                                   "application/json")
+                except Exception as e:  # same 500-survival contract as GET
                     with contextlib.suppress(Exception):
                         self._send(500, repr(e).encode(), "text/plain")
 
@@ -191,6 +252,40 @@ class MetricsServer:
                 "global": global_stats.snapshot(),
                 "scopes": global_stats.scopes_snapshot(),
                 "events_dropped": self._ring.events_dropped}
+
+    def _sched(self):
+        """The owning context's IoScheduler, if any (the /tenants routes)."""
+        return getattr(self._ctx, "scheduler", None)
+
+    def _tenants_op(self, sched, body: dict) -> "dict | None":
+        """Execute one POST /tenants op; None = unknown op (→ 400).
+        ``register`` goes through the context when one is attached so
+        hot-cache partitions are carved too."""
+        op = body.get("op")
+        if op == "register":
+            name = str(body.get("name") or "")
+            if not name:
+                raise ValueError("register needs a non-empty 'name'")
+            kw = {k: body[k] for k in ("priority", "weight", "byte_rate",
+                                       "byte_burst", "iops",
+                                       "hot_cache_bytes") if k in body}
+            cast = {k: (int(v) if k in ("weight", "hot_cache_bytes")
+                        else float(v) if k in ("byte_rate", "byte_burst",
+                                               "iops")
+                        else str(v))
+                    for k, v in kw.items()}
+            if self._ctx is not None \
+                    and hasattr(self._ctx, "register_tenant"):
+                t = self._ctx.register_tenant(name, **cast)
+            else:
+                t = sched.register(name, **cast)
+            return t.info()
+        if op == "drain":
+            name = body.get("name")  # None = the default tenant
+            timeout = float(body.get("timeout_s", 30.0))
+            return {"tenant": name or "default",
+                    "drained": sched.drain(name, timeout_s=timeout)}
+        return None
 
     def _flight_doc(self, dump: bool = False) -> dict:
         if self._flight is not None:

@@ -152,13 +152,17 @@ def parquet_scan_aggregate(ctx: StromContext, paths: Sequence[str],
     local_units = [units[i] for i in bins[idx]]
     devs = list(devices) if devices is not None else jax.local_devices()
 
+    # scheduler tenant (ISSUE 7): a tenant-labeled scope queues this
+    # scan's chunk gathers under that tenant; resolved once up front
+    tname = (scope or {}).get("tenant")
+
     def read_unit(shard: ParquetShard, rg: int) -> dict:
         # direct PLAIN decode when the chunks allow it (frombuffer views into
         # the engine slab + one join copy — the I/O-bound path; a per-page
         # zero-copy variant was measured 25x SLOWER here: ~80KB pages make
         # the per-operand device dispatch cost dwarf the saved memcpy),
         # pyarrow decode otherwise
-        return shard.read_row_group_arrays(ctx, rg, columns)
+        return shard.read_row_group_arrays(ctx, rg, columns, tenant=tname)
 
     if unit_batch < 1:
         raise ValueError(f"unit_batch must be >= 1, got {unit_batch}")

@@ -45,7 +45,8 @@ Transform = Callable[..., np.ndarray]
 
 
 def _make_readahead(ctx: StromContext, sampler: EpochShuffleSampler,
-                    extents_for_batch: Callable[[np.ndarray], Any]):
+                    extents_for_batch: Callable[[np.ndarray], Any],
+                    tenant: "str | None" = None):
     """Epoch-aware readahead for a vision pipeline (ISSUE 4): a background
     thread that pulls the sampler's upcoming-batch window (``peek`` crosses
     the epoch boundary, so next epoch's head warms while this one drains),
@@ -67,7 +68,7 @@ def _make_readahead(ctx: StromContext, sampler: EpochShuffleSampler,
                 out.append((el, [Segment(0, 0, el.size)], 0))
         return out
 
-    return Readahead(ctx, window)
+    return Readahead(ctx, window, tenant=tenant)
 
 
 def _chain_close(*closers) -> Callable[[], None] | None:
@@ -425,6 +426,11 @@ def make_wds_vision_pipeline(ctx: StromContext, paths: Sequence[str], *,
     pool = DecodePool(decode_workers)
     pscope = ctx.scope.scoped(**(scope if scope is not None
                                  else {"pipeline": "vision"}))
+    # scheduler tenant (ISSUE 7): a tenant-labeled scope routes every
+    # gather this pipeline issues into that tenant's queue (priority,
+    # fair-drain weight, budgets, cache partition) — unlabeled pipelines
+    # ride the context's default tenant, single-tenant behavior unchanged
+    tname = getattr(pscope, "labels", {}).get("tenant")
     label_sharding = NamedSharding(
         sharding.mesh,
         P(sharding.spec[0] if len(sharding.spec) else None))
@@ -474,7 +480,7 @@ def make_wds_vision_pipeline(ctx: StromContext, paths: Sequence[str], *,
                 (batch,), label_sharding, lbl_shards)
             return imgs, lbls
 
-        buf = ctx.pread(el)
+        buf = ctx.pread(el, tenant=tname)
         # split the concatenated buffer back into per-sample members
         blobs, labels, pos = [], [], 0
         for isz, lsz in sizes:
@@ -523,7 +529,8 @@ def make_wds_vision_pipeline(ctx: StromContext, paths: Sequence[str], *,
     ra = _make_readahead(
         ctx, sampler,
         lambda indices: ss.batch_extents([int(indices[r]) for r in local_rows],
-                                         [image_ext, label_ext]))
+                                         [image_ext, label_ext]),
+        tenant=tname)
     return Pipeline(sampler, make_batch, depth=depth, auto_depth=auto,
                     max_depth=max_depth, fingerprint=fp,
                     on_close=_chain_close(ra.close if ra else None, pool.close),
@@ -576,12 +583,13 @@ def make_predecoded_vision_pipeline(ctx: StromContext, paths: Sequence[str], *,
         P(sharding.spec[0] if len(sharding.spec) else None))
     pscope = ctx.scope.scoped(**(scope if scope is not None
                                  else {"pipeline": "predecoded"}))
+    tname = getattr(pscope, "labels", {}).get("tenant")
     shape = (batch, image_size, image_size, 3)
 
     def make_batch(indices: np.ndarray, serial: int) -> tuple[Any, Any]:
         el = shards.extents([int(i) for i in indices])
         imgs = ctx.memcpy_ssd2tpu(el, shape=shape, dtype=np.uint8,
-                                  sharding=sharding)
+                                  sharding=sharding, tenant=tname)
         lbls = jax.device_put(shards.labels(indices), label_sharding)
         return imgs, lbls
 
@@ -592,7 +600,8 @@ def make_predecoded_vision_pipeline(ctx: StromContext, paths: Sequence[str], *,
     # record extents turns epoch 2+ into RAM memcpys end to end
     ra = _make_readahead(
         ctx, sampler,
-        lambda indices: shards.extents([int(i) for i in indices]))
+        lambda indices: shards.extents([int(i) for i in indices]),
+        tenant=tname)
     return Pipeline(sampler, make_batch, depth=depth, auto_depth=auto,
                     max_depth=max_depth, fingerprint=fp,
                     on_close=ra.close if ra else None, scope=pscope)

@@ -1,0 +1,16 @@
+"""Multi-tenant I/O scheduler (ISSUE 7 tentpole).
+
+One engine fleet, many consumers: per-tenant queues with priority
+classes, weighted fair drain at engine-slice granularity, byte/IOPS
+budgets, and slab-pool admission control. See
+:mod:`strom.sched.scheduler` for the arbiter,
+:mod:`strom.sched.budget` for the enforcement primitives, and
+:mod:`strom.sched.tenant` for the tenant handle.
+"""
+
+from strom.sched.budget import AdmissionGate, TokenBucket
+from strom.sched.scheduler import SCHED_FIELDS, IoScheduler
+from strom.sched.tenant import PRIORITIES, Tenant
+
+__all__ = ["AdmissionGate", "IoScheduler", "PRIORITIES", "SCHED_FIELDS",
+           "Tenant", "TokenBucket"]

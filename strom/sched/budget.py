@@ -1,0 +1,179 @@
+"""Per-tenant budgets + slab-pool admission control (ISSUE 7 tentpole).
+
+Two enforcement primitives the shared I/O scheduler
+(:mod:`strom.sched.scheduler`) applies at grant time:
+
+- :class:`TokenBucket` — the classic rate limiter, one per budgeted axis
+  (bytes/s, IOPS). The scheduler *peeks* a bucket while choosing the next
+  grant (a throttled tenant is simply skipped this pass, its earliest
+  ready time bounding the dispatch retry wait) and *takes* only when the
+  grant is actually issued — peek-then-take keeps a tenant that lost the
+  fairness race from being billed for work it never ran. Oversized ops
+  (larger than the burst) are allowed through a debt balance: the take
+  drives the bucket negative and later ops wait for recovery, so the
+  long-run rate holds for any op size instead of deadlocking on ops that
+  could never fit the burst.
+
+- :class:`AdmissionGate` — slab-pool admission control. The pool is the
+  shared staging memory every tenant's gathers (and the hot cache) live
+  in; a BACKGROUND-class allocation that would push occupancy past the
+  high-water mark queues here instead of OOM-ing the demand tenants out
+  of slabs. Demand classes are never gated (their dest slabs are already
+  allocated by the time the gather reaches the scheduler — gating them
+  would deadlock on their own allocation), which is exactly the paper's
+  asymmetry: opportunistic work yields, foreground work proceeds.
+
+Both take an injectable clock/sleep so the fairness tests run
+deterministically (tests/test_sched.py).
+
+Observability (satellite): ``slab_pool_bytes_in_use`` (gauge, written by
+the pool itself on every acquire/release) and ``slab_pool_admission_waits``
+(counter, one per wait episode here) land in the global registry →
+/metrics, so the scheduler's admission decisions are scrapeable.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable
+
+
+class TokenBucket:
+    """Token bucket over an arbitrary unit (bytes, ops).
+
+    ``rate`` units/second refill, ``burst`` units capacity. ``rate <= 0``
+    means unlimited (every ``peek`` is 0, ``take`` is free) so callers can
+    construct one unconditionally. Thread-safe.
+    """
+
+    def __init__(self, rate: float, burst: float | None = None, *,
+                 clock: Callable[[], float] = time.monotonic):
+        self.rate = float(rate)
+        # default burst: one second's worth — deep enough that steady
+        # traffic at the configured rate never stutters, shallow enough
+        # that a cold bucket can't front-load multiples of the budget
+        self.burst = float(burst) if burst is not None else max(self.rate, 1.0)
+        self._clock = clock
+        self._tokens = self.burst
+        self._t = clock()
+        self._lock = threading.Lock()
+
+    @property
+    def unlimited(self) -> bool:
+        return self.rate <= 0
+
+    def _refill_locked(self) -> None:
+        now = self._clock()
+        if now > self._t:
+            self._tokens = min(self.burst,
+                               self._tokens + (now - self._t) * self.rate)
+        self._t = now
+
+    def peek(self, n: float) -> float:
+        """Seconds until *n* units could be taken (0.0 = now). Never
+        consumes. Ops larger than the burst are ready as soon as the
+        balance is non-negative (see class docstring: debt model)."""
+        if self.unlimited or n <= 0:
+            return 0.0
+        with self._lock:
+            self._refill_locked()
+            need = min(float(n), self.burst)
+            if self._tokens >= need:
+                return 0.0
+            return (need - self._tokens) / self.rate
+
+    def take(self, n: float) -> None:
+        """Unconditionally charge *n* units (may drive the balance
+        negative — the debt future takes wait out). Callers peek first;
+        the scheduler only takes for the grant it actually issues."""
+        if self.unlimited or n <= 0:
+            return
+        with self._lock:
+            self._refill_locked()
+            self._tokens -= float(n)
+
+    @property
+    def tokens(self) -> float:
+        with self._lock:
+            self._refill_locked()
+            return self._tokens
+
+    def state(self) -> dict:
+        """Introspection for the /tenants route."""
+        return {"rate": self.rate, "burst": self.burst,
+                "tokens": round(self.tokens, 1),
+                "unlimited": self.unlimited}
+
+
+class AdmissionGate:
+    """Slab-pool high-water admission for opportunistic allocations.
+
+    ``admit(nbytes)`` returns immediately while the pool (plus the
+    request) stays at or under ``high_water * pool.max_bytes``; above it,
+    the caller queues on a condition the pool's release hook notifies —
+    one ``slab_pool_admission_waits`` tick per wait episode, so pressure
+    queueing is visible on /metrics rather than showing up only as
+    mystery latency. A pool of None (or ``high_water <= 0``) disables the
+    gate entirely.
+    """
+
+    def __init__(self, pool, high_water: float = 0.9, *, scope=None,
+                 clock: Callable[[], float] = time.monotonic):
+        from strom.utils.stats import global_stats
+
+        self._pool = pool
+        self.high_water = float(high_water)
+        self._scope = scope if scope is not None else global_stats
+        self._clock = clock
+        self._cond = threading.Condition()
+        self.waits = 0
+        if pool is not None:
+            # the pool pokes the gate on every release so queued admits
+            # re-check occupancy without polling
+            pool.add_change_hook(self._on_pool_change)
+
+    @property
+    def enabled(self) -> bool:
+        return self._pool is not None and self.high_water > 0
+
+    def _limit(self) -> int:
+        return int(self.high_water * self._pool.max_bytes)
+
+    def has_room(self, nbytes: int) -> bool:
+        if not self.enabled:
+            return True
+        return self._pool.in_use_bytes + max(int(nbytes), 0) <= self._limit()
+
+    def _on_pool_change(self) -> None:
+        with self._cond:
+            self._cond.notify_all()
+
+    def admit(self, nbytes: int, *, timeout_s: float | None = None) -> bool:
+        """Block until *nbytes* of pool headroom exists below the
+        high-water mark (True) or *timeout_s* elapses (False). A request
+        larger than the whole high-water budget is admitted once the pool
+        is otherwise idle — never deadlocks on its own size."""
+        if self.has_room(nbytes):
+            return True
+        deadline = None if timeout_s is None else self._clock() + timeout_s
+        self.waits += 1
+        self._scope.add("slab_pool_admission_waits")
+        with self._cond:
+            while True:
+                if self.has_room(nbytes) or \
+                        self._pool.in_use_bytes == 0:
+                    return True
+                wait = 0.05 if deadline is None \
+                    else min(0.05, deadline - self._clock())
+                if wait <= 0:
+                    return False
+                self._cond.wait(wait)
+
+    def state(self) -> dict:
+        if not self.enabled:
+            return {"enabled": False, "waits": self.waits}
+        return {"enabled": True, "high_water": self.high_water,
+                "limit_bytes": self._limit(),
+                "in_use_bytes": self._pool.in_use_bytes,
+                "waits": self.waits}

@@ -1,0 +1,425 @@
+"""Shared I/O scheduler: many tenants, one engine fleet (ISSUE 7 tentpole).
+
+Before this module, a `StromContext` assumed one consumer: every gather
+took the delivery engine lock for its whole duration (`StreamingGather`
+held it construction→finish), so a second pipeline's 2KB metadata read
+queued behind a first pipeline's 100MB epoch gather. The paper frames the
+DMA engine as a *shared, kernel-managed* resource — per-process locks are
+exactly what it replaces — and PR 5's async `submit_vectored`/`poll` plus
+PR 6's tenant-labeled telemetry are the substrate a shared arbiter needs.
+
+:class:`IoScheduler` is that arbiter. The per-transfer engine lock stops
+existing for scheduled contexts; in its place:
+
+- **Per-tenant queues, priority classes.** Tenants register (or are
+  auto-registered on first use); each grant request enters its tenant's
+  FIFO. Classes are strict among budget-ready work — ``interactive`` >
+  ``training`` > ``background`` — so a live client's op never waits out
+  training backlog, and readahead (always ``background``) never delays
+  either. A class whose every queued tenant is budget-throttled yields
+  the engine to lower classes rather than idling it (work conservation);
+  it is picked first again the moment its budget refills.
+
+- **Weighted fair drain (deficit round-robin over queued ops).** Within
+  a class, the tenant furthest *behind* its weighted fair share drains
+  next: every grant charges ``nbytes / weight`` of virtual service time,
+  and ``_pick_locked`` always picks the queued tenant with the minimum.
+  A newly-active tenant joins at the current service baseline (no
+  infinite catch-up), which is DRR with byte quanta in its
+  limit: a weight-2 tenant gets 2 bytes drained for every 1 of a
+  weight-1 tenant, and a light tenant's deficit keeps it at the head.
+
+- **Engine queue-depth slots as the shared currency.** Exclusive grants
+  hand the engine's whole in-flight window to one request at a time, and
+  the delivery layer splits big gathers into slices of a few in-flight
+  budgets (``sched_slice_bytes``, see :meth:`read_chunks`) so ownership
+  turns over every few queue-depth windows — a greedy tenant's gather is
+  preemptible at slice boundaries, bounding any other tenant's queue
+  wait at ~one slice instead of one epoch. Engines that already
+  arbitrate internally (``concurrent_gathers``: the multi-ring engine's
+  per-ring locks) keep their concurrency: grants there are
+  non-exclusive — budgets and accounting still apply, queueing doesn't.
+
+- **Budgets + admission control** (:mod:`strom.sched.budget`): byte/IOPS
+  token buckets peeked while picking (a throttled tenant is skipped, not
+  billed) and taken at grant; slab-pool admission queues background
+  allocations while the pool is past the high-water mark.
+
+Observability: every grant lands ``sched_granted_ops/bytes`` and a
+``sched_queue_wait_us`` histogram in the tenant's scope (labeled on
+/metrics, PR 6) plus the unlabeled aggregate; ``sched_throttle_waits``
+counts throttled grant episodes (one per grant that waited on budget
+refill); the live server's ``/tenants`` route renders
+:meth:`tenants_info`.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+import time
+from typing import Any, Callable, Sequence
+
+from strom.sched.budget import AdmissionGate
+from strom.sched.tenant import PRIORITIES, PRIORITY_ORDER, Tenant
+
+# bench-JSON column suffixes the multitenant bench arm emits per tenant
+# (cli.py bench_multitenant, prefixed mt_<tenant>_), single-sourced so the
+# driver's copy loop (bench.py) and the compare_rounds "multi-tenant"
+# section cannot drift from the producer — the same contract STALL_FIELDS /
+# CACHE_BENCH_FIELDS / STREAM_FIELDS enforce.
+SCHED_FIELDS = (
+    "items_per_s",
+    "vs_solo",
+    "sched_queue_wait_p50_us",
+    "sched_queue_wait_p99_us",
+    "sched_granted_ops",
+    "sched_granted_bytes",
+    "sched_throttle_waits",
+    "engine_op_lat_p99_us",
+)
+
+_DEFAULT_TENANT = "default"
+
+
+class _Waiter:
+    """One queued grant request (scheduler-lock-owned)."""
+
+    __slots__ = ("tenant", "nbytes", "prio", "enq_t", "granted", "wait_s",
+                 "throttled")
+
+    def __init__(self, tenant: Tenant, nbytes: int, prio: int, enq_t: float):
+        self.tenant = tenant
+        self.nbytes = nbytes
+        self.prio = prio
+        self.enq_t = enq_t
+        self.granted = False
+        self.wait_s = 0.0
+        # one sched_throttle_waits tick per throttled grant EPISODE: the
+        # flag keeps repeated dispatch passes / poll ticks over the same
+        # still-throttled head-of-queue from re-counting it
+        self.throttled = False
+
+
+class IoScheduler:
+    """Fair arbiter over one engine's transfer path.
+
+    *clock* / throttle waiting are injectable for deterministic tests.
+    """
+
+    def __init__(self, engine, config, *, pool=None, scope=None,
+                 clock: Callable[[], float] = time.monotonic):
+        from strom.utils.stats import global_stats
+
+        self.engine = engine
+        self.config = config
+        self._scope = scope if scope is not None else global_stats
+        self._clock = clock
+        # engines with internal per-ring arbitration keep their concurrency:
+        # grants are non-exclusive there (budgets/accounting still apply)
+        self.exclusive = not getattr(engine, "concurrent_gathers", False)
+        self._cond = threading.Condition()
+        self._tenants: dict[str, Tenant] = {}
+        self._current: _Waiter | None = None
+        # service baseline: a tenant going active joins at this vtime, so
+        # an idle tenant can't bank unbounded credit (classic WFQ rule)
+        self._vbase = 0.0
+        self.admission = AdmissionGate(
+            pool, getattr(config, "sched_high_water", 0.9),
+            scope=self._scope, clock=clock)
+        self._default = self.register(_DEFAULT_TENANT, _label=False)
+
+    # -- tenant registry ----------------------------------------------------
+    def register(self, name: str, *, priority: str = "training",
+                 weight: int = 1, byte_rate: float = 0,
+                 byte_burst: float | None = None, iops: float = 0,
+                 hot_cache_bytes: int = 0, _label: bool = True) -> Tenant:
+        """Register (or fetch) tenant *name*. Re-registering an existing
+        name returns the live handle unchanged — queue state and budget
+        balances survive, so a daemon client reconnecting can't zero a
+        tenant's debt. ``_label=False`` keeps the context's own scope
+        (the default tenant: single-tenant metrics stay unlabeled)."""
+        with self._cond:
+            t = self._tenants.get(name)
+            if t is not None:
+                return t
+            scope = self._scope.scoped(tenant=name) if _label else self._scope
+            t = Tenant(name, priority=priority, weight=weight, scope=scope,
+                       byte_rate=byte_rate, byte_burst=byte_burst, iops=iops,
+                       hot_cache_bytes=hot_cache_bytes, clock=self._clock)
+            t.vtime = self._vbase
+            self._tenants[name] = t
+            return t
+
+    def is_registered(self, name: str) -> bool:
+        with self._cond:
+            return name in self._tenants
+
+    def tenant(self, name: str | None = None) -> Tenant:
+        if name is None:
+            return self._default
+        with self._cond:
+            t = self._tenants.get(name)
+        # auto-register on first use: a pipeline labeled tenant="t7" just
+        # works (default class/weight, no budgets); explicit register()
+        # beforehand is how budgets/priorities are customized
+        return t if t is not None else self.register(name)
+
+    def resolve(self, tenant: "Tenant | str | None") -> Tenant:
+        if isinstance(tenant, Tenant):
+            return tenant
+        return self.tenant(tenant)
+
+    def tenants_info(self) -> dict:
+        """{name: row} for every registered tenant plus the admission
+        gate's state — the /tenants route body."""
+        with self._cond:
+            tenants = list(self._tenants.values())
+        return {"tenants": {t.name: t.info() for t in tenants},
+                "admission": self.admission.state(),
+                "exclusive": self.exclusive,
+                "engine": getattr(self.engine, "name", "?")}
+
+    # -- the fair-drain core ------------------------------------------------
+    def _enqueue_locked(self, w: _Waiter) -> None:
+        """Append a waiter to its tenant's queue. A tenant (re)activating
+        from idle joins at the current service baseline — idle time banks
+        no credit (the WFQ start-time rule): deficit accrues only while
+        queued, so a long-idle tenant can't return and monopolize."""
+        t = w.tenant
+        if not t.queue and not t.active and t.vtime < self._vbase:
+            t.vtime = self._vbase
+        t.queue.append(w)
+        t.queued_bytes += w.nbytes
+
+    def _pick_locked(self) -> tuple[_Waiter | None, float | None]:
+        """(next grantable waiter, earliest budget-ready delay). Strict
+        priority between classes; min virtual service time (weighted fair /
+        deficit) within one. Budgets are PEEKED here — a throttled tenant
+        is skipped this pass and its ready time bounds the retry wait —
+        and taken only by the caller for the waiter actually granted."""
+        min_delay: float | None = None
+        for cls in range(len(PRIORITIES)):
+            cand = [t for t in self._tenants.values()
+                    if t.queue and t.queue[0].prio == cls]
+            # furthest behind its weighted share first
+            for t in sorted(cand, key=lambda t: (t.vtime, t.name)):
+                w = t.queue[0]
+                d = max(t.byte_bucket.peek(w.nbytes),
+                        t.iops_bucket.peek(1))
+                if d > 0:
+                    self._note_throttled_locked(w)
+                    min_delay = d if min_delay is None else min(min_delay, d)
+                    continue
+                return w, min_delay
+            # every queued tenant of this class is budget-throttled: fall
+            # through to the next class. Strict priority orders RUNNABLE
+            # work; a budget-exhausted class must not idle the engine while
+            # ready lower-class work queues (work conservation). min_delay
+            # bounds the dispatch retry, so the moment the budget refills
+            # the higher class is picked first again.
+        return None, min_delay
+
+    @staticmethod
+    def _note_throttled_locked(w: _Waiter) -> None:
+        """Count a throttled grant episode exactly once per waiter —
+        sched_throttle_waits is a bench column (SCHED_FIELDS) compared
+        round-over-round, so it must measure budget pressure, not how many
+        dispatch passes happened to observe it."""
+        if w.throttled:
+            return
+        w.throttled = True
+        w.tenant.throttle_waits += 1
+        w.tenant.scope.add("sched_throttle_waits")
+
+    def _commit_grant_locked(self, w: _Waiter) -> None:
+        """Grant bookkeeping shared by the exclusive dispatcher and the
+        non-exclusive (internally-arbitrated engine) path: dequeue, take
+        the budgets peeked earlier, charge weighted virtual service (the
+        global baseline tracks the max so newly-active tenants join behind
+        nobody), count."""
+        t = w.tenant
+        t.queue.popleft()
+        t.queued_bytes -= w.nbytes
+        t.byte_bucket.take(w.nbytes)
+        t.iops_bucket.take(1)
+        t.vtime += w.nbytes / t.weight
+        if t.vtime > self._vbase:
+            self._vbase = t.vtime
+        t.active += 1
+        t.granted_ops += 1
+        t.granted_bytes += w.nbytes
+        w.granted = True
+
+    def _dispatch_locked(self) -> float | None:
+        """Grant the next waiter if the engine is free. Returns the retry
+        delay when everything grantable is budget-throttled."""
+        if self._current is not None:
+            return None
+        w, delay = self._pick_locked()
+        if w is None:
+            return delay
+        self._commit_grant_locked(w)
+        self._current = w
+        self._cond.notify_all()
+        return None
+
+    def acquire(self, tenant: "Tenant | str | None" = None,
+                nbytes: int = 0, *, priority: str | None = None) -> _Waiter:
+        """Queue for (and block until) an engine grant. Returns the waiter
+        handle to pass to :meth:`release`. Non-exclusive engines grant
+        immediately (budgets still charged, waits still possible)."""
+        t = self.resolve(tenant)
+        prio = PRIORITY_ORDER[priority] if priority is not None \
+            else PRIORITY_ORDER[t.priority]
+        w = _Waiter(t, max(int(nbytes), 0), prio, self._clock())
+        with self._cond:
+            self._enqueue_locked(w)
+            t.scope.set_gauge("sched_queue_depth", len(t.queue))
+            if not self.exclusive:
+                # internal-arbitration engines: charge budgets in queue
+                # order but don't serialize — budget throttles still wait
+                while t.queue[0] is not w or \
+                        max(t.byte_bucket.peek(w.nbytes),
+                            t.iops_bucket.peek(1)) > 0:
+                    if t.queue[0] is w:
+                        d = max(t.byte_bucket.peek(w.nbytes),
+                                t.iops_bucket.peek(1))
+                        self._note_throttled_locked(w)
+                        self._cond.wait(min(d, 0.05))
+                    else:
+                        self._cond.wait(0.01)
+                self._commit_grant_locked(w)
+                self._cond.notify_all()
+            else:
+                delay = self._dispatch_locked()
+                while self._current is not w:
+                    self._cond.wait(delay if delay is not None else None)
+                    delay = self._dispatch_locked()
+            t.scope.set_gauge("sched_queue_depth", len(t.queue))
+        w.wait_s = max(self._clock() - w.enq_t, 0.0)
+        t.scope.observe_us("sched_queue_wait", w.wait_s * 1e6)
+        t.scope.add("sched_granted_ops")
+        if w.nbytes:
+            t.scope.add("sched_granted_bytes", w.nbytes)
+        if self.exclusive and t.scope is not self._scope:
+            # exclusive ownership means no concurrent submitter: steer the
+            # engine's per-op accounting (engine_op_lat_us histogram,
+            # engine_inflight gauge — PR 6) through the TENANT's scope for
+            # the grant, so per-tenant engine latency lands labeled on
+            # /metrics with zero per-op plumbing; restored at release
+            self.engine.set_scope(t.scope)
+        return w
+
+    def release(self, w: _Waiter) -> None:
+        if self.exclusive:
+            self.engine.set_scope(self._scope)
+        with self._cond:
+            w.tenant.active -= 1
+            if self.exclusive and self._current is w:
+                self._current = None
+                self._dispatch_locked()
+            self._cond.notify_all()
+
+    @contextlib.contextmanager
+    def grant(self, tenant: "Tenant | str | None" = None, nbytes: int = 0,
+              *, priority: str | None = None):
+        """``with sched.grant(tenant, nbytes):`` — the scheduler-era
+        spelling of ``with ctx._engine_lock:``."""
+        w = self.acquire(tenant, nbytes, priority=priority)
+        try:
+            yield w
+        finally:
+            self.release(w)
+
+    # -- sliced gather execution (the delivery hot path) --------------------
+    def _slice_bytes(self) -> int:
+        sb = getattr(self.config, "sched_slice_bytes", -1)
+        if sb >= 0:
+            return sb
+        # auto: a few in-flight budgets per grant — deep enough that the
+        # queue-depth pipeline amortizes the grant handoff, shallow enough
+        # that engine ownership turns over at interactive timescales
+        return 4 * self.config.queue_depth * self.config.block_size
+
+    def iter_slices(self, chunks: Sequence[tuple[int, int, int, int]]):
+        """Split a gather's chunk list into slices of ~``sched_slice_bytes``
+        (grant granularity). Chunk order is preserved and chunks are never
+        split, so the engine sees the exact ops the plan produced — only
+        the lock-ownership boundaries move."""
+        limit = self._slice_bytes()
+        if limit <= 0:
+            yield list(chunks)
+            return
+        batch: list[tuple[int, int, int, int]] = []
+        b = 0
+        for c in chunks:
+            batch.append(c)
+            b += c[3]
+            if b >= limit:
+                yield batch
+                batch, b = [], 0
+        if batch:
+            yield batch
+
+    def read_chunks(self, chunks: Sequence[tuple[int, int, int, int]],
+                    dest, *, tenant: "Tenant | str | None" = None,
+                    retries: int = 1, priority: str | None = None) -> int:
+        """Execute a planned gather under fair scheduling: one engine
+        grant per slice, so a concurrent tenant's op queues behind at most
+        ~``sched_slice_bytes`` of this gather instead of all of it.
+        Byte-identical to ``engine.read_vectored(chunks, dest)`` (slices
+        preserve chunk order; dest ranges are disjoint)."""
+        t = self.resolve(tenant)
+        total = 0
+        for sl in self.iter_slices(chunks):
+            nbytes = sum(ln for (_, _, _, ln) in sl)
+            with self.grant(t, nbytes, priority=priority):
+                total += self.engine.read_vectored(sl, dest, retries=retries)
+        return total
+
+    # -- drain (daemon shutdown / tenant teardown) --------------------------
+    def drain(self, tenant: "Tenant | str | None" = None,
+              timeout_s: float = 30.0) -> bool:
+        """Wait until *tenant* has no queued requests and no active
+        grants. True when drained, False on timeout."""
+        t = self.resolve(tenant)
+        deadline = self._clock() + timeout_s
+        with self._cond:
+            while t.queue or t.active:
+                left = deadline - self._clock()
+                if left <= 0:
+                    return False
+                self._cond.wait(min(left, 0.05))
+        return True
+
+    def drain_all(self, timeout_s: float = 30.0) -> list[str]:
+        """Drain every registered tenant; returns the names that did NOT
+        drain in time (empty = clean). The daemon's graceful-shutdown
+        path runs this before the flight recorder's handler chain."""
+        with self._cond:
+            names = list(self._tenants)
+        deadline = self._clock() + timeout_s
+        stuck = []
+        for name in names:
+            left = max(deadline - self._clock(), 0.01)
+            if not self.drain(name, timeout_s=left):
+                stuck.append(name)
+        return stuck
+
+    def stats(self) -> dict:
+        """Flat numeric leaves for the ``sched`` section of
+        ``StromContext.stats()`` (→ /metrics via sections_prometheus)."""
+        with self._cond:
+            tenants = list(self._tenants.values())
+        return {
+            "sched_tenants": len(tenants),
+            "sched_queued_ops": sum(len(t.queue) for t in tenants),
+            "sched_queued_bytes": sum(t.queued_bytes for t in tenants),
+            "sched_active_grants": sum(t.active for t in tenants),
+            "sched_granted_ops": sum(t.granted_ops for t in tenants),
+            "sched_granted_bytes": sum(t.granted_bytes for t in tenants),
+            "sched_throttle_waits": sum(t.throttle_waits for t in tenants),
+            "sched_exclusive": self.exclusive,
+            "slab_pool_admission_waits": self.admission.waits,
+        }

@@ -47,6 +47,11 @@ NEW_ROUND = {  # r5-era shape: binding + context + audit arrays + headline
     "resnet_stream_batches": 14,
     "resnet_stream_samples_early": 301,
     "resnet_nostream_data_stalls": 6,
+    # r7+: multi-tenant scheduler arm (strom/sched)
+    "mt_vs_solo_mean": 0.913,
+    "mt_pq_sched_queue_wait_p99_us": 65536.0,
+    "mt_pq_items_per_s": 134358.2,
+    "mt_vis0_vs_solo": 0.971,
     "binding": {"vs_baseline_host": 1.0315, "vs_baseline_host_raid": 0.9708,
                 "train_data_stalls": 0, "some_future_key": 0.5},
     "context": {"raw_gbps": 3.49},
@@ -165,6 +170,47 @@ def test_stream_keys_match_producers():
         assert suffix in produced, \
             f"compare_rounds consumes {key!r} but the bench arms produce " \
             f"no {suffix!r} (renamed column?)"
+
+
+def test_sched_section_renders(artifacts, capsys):
+    """r7+ artifacts get the multi-tenant section with the no-starvation
+    row (light tenant queue-wait p99)."""
+    assert compare_rounds.main(artifacts) == 0
+    out = capsys.readouterr().out
+    assert "multi-tenant" in out
+    assert "mt_vs_solo_mean" in out
+    assert "mt_pq_sched_queue_wait_p99_us" in out
+    assert "0.913" in out
+
+
+def test_sched_section_hidden_without_sched_keys(tmp_path, capsys):
+    """Rounds predating the scheduler don't get an all-dash section."""
+    p = tmp_path / "BENCH_r02.json"
+    p.write_text(json.dumps(OLD_ROUND))
+    assert compare_rounds.main([str(p)]) == 0
+    assert "multi-tenant" not in capsys.readouterr().out
+
+
+def test_sched_keys_match_producers():
+    """Producer↔report key parity for the multi-tenant section (ISSUE 7
+    satellite, the decode/stall/cache/stream pattern): every mt_<tenant>_*
+    column must be a tenant prefix plus a suffix the multitenant bench arm
+    actually emits (single-sourced in strom.sched.scheduler.SCHED_FIELDS,
+    plus the solo baseline column); mt_vs_solo_mean is the one aggregate
+    column."""
+    from strom.sched.scheduler import SCHED_FIELDS
+
+    prefixes = ("mt_vis0", "mt_vis1", "mt_pq")
+    produced = set(SCHED_FIELDS) | {"solo_items_per_s"}
+    for key in compare_rounds.SCHED_KEYS:
+        if key == "mt_vs_solo_mean":
+            continue
+        prefix = next((p for p in prefixes if key.startswith(p + "_")), None)
+        assert prefix is not None, key
+        suffix = key[len(prefix) + 1:]
+        assert suffix in produced, \
+            f"compare_rounds consumes {key!r} but the multitenant arm " \
+            f"produces no {suffix!r} (renamed column?)"
 
 
 def test_stall_section_hidden_without_stall_keys(tmp_path, capsys):

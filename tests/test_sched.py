@@ -1,0 +1,863 @@
+"""Multi-tenant I/O scheduler (ISSUE 7 tentpole — strom/sched/).
+
+Fairness and starvation contracts, deterministically:
+
+- the weighted fair drain (deficit round-robin / min-virtual-time) is
+  white-box-sequenced without threads, so the grant ORDER is asserted,
+  not sampled;
+- a greedy tenant (deep queue, large sliced ops) vs a light interactive
+  tenant on one exclusive engine: the light tenant's queue wait is
+  BOUNDED by ~a slice, never by the greedy tenant's whole backlog;
+- budgets (token buckets: fake-clock unit tests + a real-time
+  enforcement pass through the scheduler), slab-pool admission control,
+  hot-cache partitions, /tenants HTTP lifecycle, the
+  release-at-gather-drain engine-lock fix, and concurrent-pipeline
+  bit-identity against solo runs.
+"""
+
+from __future__ import annotations
+
+import importlib.util
+import json
+import os
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from strom.config import StromConfig
+from strom.sched.budget import AdmissionGate, TokenBucket
+from strom.sched.scheduler import SCHED_FIELDS, IoScheduler, _Waiter
+from strom.sched.tenant import PRIORITY_ORDER
+
+
+class FakeClock:
+    def __init__(self):
+        self.t = 1000.0
+
+    def __call__(self) -> float:
+        return self.t
+
+    def advance(self, dt: float) -> None:
+        self.t += dt
+
+
+# ------------------------------------------------------------ token bucket
+class TestTokenBucket:
+    def test_rate_enforced(self):
+        clk = FakeClock()
+        b = TokenBucket(100.0, 50.0, clock=clk)  # 100/s, burst 50
+        assert b.peek(50) == 0.0
+        b.take(50)
+        # empty: 30 units need 0.3s
+        assert b.peek(30) == pytest.approx(0.3)
+        clk.advance(0.3 + 1e-9)
+        assert b.peek(30) == 0.0
+
+    def test_burst_caps_refill(self):
+        clk = FakeClock()
+        b = TokenBucket(100.0, 50.0, clock=clk)
+        clk.advance(100)  # long idle: tokens cap at burst, not 10k
+        assert b.tokens == pytest.approx(50.0)
+
+    def test_oversized_op_runs_on_debt(self):
+        """An op larger than the burst must not deadlock: it waits for a
+        full bucket, then drives the balance negative (debt) so the
+        long-run rate still holds."""
+        clk = FakeClock()
+        b = TokenBucket(100.0, 50.0, clock=clk)
+        assert b.peek(500) == 0.0  # full bucket: a jumbo op may start
+        b.take(500)
+        assert b.tokens == pytest.approx(-450.0)
+        # the debt gates the NEXT op until the bucket recovers
+        assert b.peek(1) > 4.0
+        clk.advance(5.0)
+        assert b.peek(1) == 0.0
+
+    def test_unlimited(self):
+        b = TokenBucket(0)
+        assert b.unlimited and b.peek(1 << 40) == 0.0
+        b.take(1 << 40)  # no-op
+
+
+# --------------------------------------------------------- admission gate
+class FakePool:
+    def __init__(self, max_bytes=1000):
+        self.max_bytes = max_bytes
+        self.in_use_bytes = 0
+        self.hooks = []
+
+    def add_change_hook(self, fn):
+        self.hooks.append(fn)
+
+    def set_in_use(self, n):
+        self.in_use_bytes = n
+        for fn in self.hooks:
+            fn()
+
+
+class TestAdmissionGate:
+    def test_room_below_high_water(self):
+        pool = FakePool(1000)
+        g = AdmissionGate(pool, 0.9)
+        pool.set_in_use(800)
+        assert g.admit(100)  # 900 == limit: fits
+        assert g.waits == 0
+
+    def test_queues_under_pressure_until_release(self):
+        from strom.utils.stats import global_stats
+
+        pool = FakePool(1000)
+        g = AdmissionGate(pool, 0.9)
+        pool.set_in_use(850)
+        waits0 = global_stats.counter("slab_pool_admission_waits").value
+        done = threading.Event()
+        ok = []
+
+        def admit():
+            ok.append(g.admit(100, timeout_s=10.0))
+            done.set()
+
+        t = threading.Thread(target=admit, daemon=True)
+        t.start()
+        assert not done.wait(0.15), "over-high-water admit must queue"
+        pool.set_in_use(100)  # release: the pool hook wakes the gate
+        assert done.wait(5.0)
+        assert ok == [True]
+        assert g.waits == 1
+        assert global_stats.counter(
+            "slab_pool_admission_waits").value == waits0 + 1
+
+    def test_timeout_returns_false(self):
+        pool = FakePool(1000)
+        g = AdmissionGate(pool, 0.9)
+        pool.set_in_use(950)
+        t0 = time.monotonic()
+        assert not g.admit(200, timeout_s=0.2)
+        assert time.monotonic() - t0 < 2.0
+
+    def test_disabled_without_pool(self):
+        g = AdmissionGate(None, 0.9)
+        assert g.admit(1 << 40)
+
+
+# ------------------------------------------------------ fair drain (order)
+class StubEngine:
+    """Engine stand-in for scheduler-order tests: read_vectored sleeps
+    per byte so service time is controllable."""
+
+    name = "stub"
+    concurrent_gathers = False
+
+    def __init__(self, s_per_byte=0.0):
+        self.s_per_byte = s_per_byte
+        self.calls: list = []
+
+    def read_vectored(self, chunks, dest, *, retries=1):
+        n = sum(ln for (_, _, _, ln) in chunks)
+        if self.s_per_byte:
+            time.sleep(n * self.s_per_byte)
+        self.calls.append(n)
+        return n
+
+    def set_scope(self, scope):
+        pass
+
+
+def _mk_sched(engine=None, **cfg_kw) -> IoScheduler:
+    cfg = StromConfig(sched_enabled=True, **cfg_kw)
+    return IoScheduler(engine or StubEngine(), cfg)
+
+
+def _enqueue(sched, tenant, nbytes, priority=None):
+    """White-box: queue a waiter without blocking a thread on it (the
+    scheduler's own enqueue path, so the vtime-baseline rule applies)."""
+    t = sched.resolve(tenant)
+    prio = PRIORITY_ORDER[priority or t.priority]
+    w = _Waiter(t, nbytes, prio, sched._clock())
+    with sched._cond:
+        sched._enqueue_locked(w)
+    return w
+
+
+def _drain_order(sched) -> list:
+    """Repeatedly dispatch + release, recording tenant grant order."""
+    order = []
+    with sched._cond:
+        while True:
+            sched._dispatch_locked()
+            w = sched._current
+            if w is None:
+                break
+            order.append(w.tenant.name)
+            w.tenant.active -= 1
+            sched._current = None
+    return order
+
+
+class TestFairDrain:
+    def test_strict_priority_classes(self):
+        """interactive > training > background, regardless of enqueue
+        order or deficit state."""
+        s = _mk_sched()
+        s.register("bg", priority="background")
+        s.register("train", priority="training")
+        s.register("live", priority="interactive")
+        _enqueue(s, "bg", 100)
+        _enqueue(s, "train", 100)
+        _enqueue(s, "live", 100)
+        _enqueue(s, "bg", 100)
+        _enqueue(s, "live", 100)
+        order = _drain_order(s)
+        assert order == ["live", "live", "train", "bg", "bg"]
+
+    def test_weighted_fair_within_class(self):
+        """DRR in its min-virtual-time form: a weight-2 tenant drains ~2
+        bytes for every 1 of a weight-1 tenant when both stay backlogged."""
+        s = _mk_sched()
+        s.register("heavy", weight=2)
+        s.register("light", weight=1)
+        for _ in range(8):
+            _enqueue(s, "heavy", 100)
+        for _ in range(4):
+            _enqueue(s, "light", 100)
+        order = _drain_order(s)
+        # by the time light's 4 ops drained, heavy must have ~2x served
+        cut = max(i for i, n in enumerate(order) if n == "light")
+        heavy_before = sum(1 for n in order[:cut] if n == "heavy")
+        assert 6 <= heavy_before <= 8, order
+
+    def test_light_tenant_never_waits_out_backlog(self):
+        """The queued-op deficit keeps a light tenant at the head: after
+        every grant of the greedy tenant, a queued light op goes next."""
+        s = _mk_sched()
+        s.register("greedy")
+        s.register("light")
+        for _ in range(6):
+            _enqueue(s, "greedy", 1000)
+        _enqueue(s, "light", 10)
+        order = _drain_order(s)
+        # the light op drains within the first two grants, not after 6
+        assert "light" in order[:2], order
+
+    def test_idle_tenant_joins_at_baseline(self):
+        """A tenant idle through N grants must not bank unbounded credit
+        and then monopolize (the vtime baseline rule)."""
+        s = _mk_sched()
+        s.register("a")
+        s.register("b")
+        for _ in range(4):
+            _enqueue(s, "a", 100)
+        assert _drain_order(s) == ["a"] * 4
+        # b was idle the whole time; now both enqueue — b must not get
+        # 4 back-to-back catch-up grants
+        for _ in range(3):
+            _enqueue(s, "a", 100)
+            _enqueue(s, "b", 100)
+        order = _drain_order(s)
+        assert order[:2] != ["b", "b"], order
+
+    def test_throttled_class_yields_engine_to_lower_class(self):
+        """Strict priority orders RUNNABLE work: when every queued tenant
+        of the top class is budget-throttled, ready lower-class work
+        drains instead of the engine idling (work conservation) — and the
+        throttled class is picked first again once its budget refills."""
+        clk = FakeClock()
+        s = IoScheduler(StubEngine(), StromConfig(sched_enabled=True),
+                        clock=clk)
+        s.register("live", priority="interactive",
+                   byte_rate=1_000_000, byte_burst=100)
+        s.register("bg", priority="background")
+        for _ in range(3):
+            _enqueue(s, "live", 100)
+        for _ in range(4):
+            _enqueue(s, "bg", 100)
+        order = _drain_order(s)
+        # live's first op rides the burst; its refill window (the fake
+        # clock is frozen = forever) must not stall bg's ready ops
+        assert order == ["live"] + ["bg"] * 4, order
+        assert len(s.tenant("live").queue) == 2
+        # budget refilled: higher class leads again (the burst covers one
+        # op per refill window)
+        for _ in range(2):
+            clk.advance(1.0)
+            assert _drain_order(s) == ["live"]
+        assert not s.tenant("live").queue
+
+
+# ------------------------------------------- starvation bound (integration)
+class TestStarvationBound:
+    def test_interactive_bounded_behind_greedy_slices(self):
+        """A greedy tenant drains a deep queue of large sliced gathers;
+        a light INTERACTIVE tenant's ops must each wait ~one slice, not
+        the greedy backlog. This is the tentpole's acceptance shape on a
+        stub engine with deterministic service time."""
+        eng = StubEngine(s_per_byte=0.002 / 1000)  # 2ms per 1000-byte slice
+        s = _mk_sched(eng, sched_slice_bytes=1000)
+        s.register("greedy", priority="training")
+        s.register("live", priority="interactive")
+        greedy_chunks = [(0, 0, i * 1000, 1000) for i in range(120)]
+        stop = threading.Event()
+        waits: list[float] = []
+
+        def greedy():
+            while not stop.is_set():
+                s.read_chunks(greedy_chunks, None, tenant="greedy")
+
+        g = threading.Thread(target=greedy, daemon=True)
+        g.start()
+        time.sleep(0.02)  # greedy is mid-backlog
+        try:
+            for _ in range(10):
+                t0 = time.monotonic()
+                with s.grant("live", 10):
+                    pass
+                waits.append(time.monotonic() - t0)
+                time.sleep(0.005)
+        finally:
+            stop.set()
+            g.join(timeout=10)
+        # greedy's full gather is 120 slices x 2ms = 240ms; a light op may
+        # wait out the slice in flight (~2ms) plus scheduling jitter, but
+        # NEVER a whole gather. 60ms is a >10x jitter margin that still
+        # proves slice-granular preemption.
+        assert max(waits) < 0.06, waits
+        live = s.tenant("live")
+        assert live.granted_ops == 10
+
+    def test_exclusive_grants_serialize(self):
+        """Two grants never overlap on an exclusive engine (the scheduler
+        IS the engine lock now — this is its mutual-exclusion contract)."""
+        s = _mk_sched()
+        inside = []
+        overlap = []
+
+        def worker(name):
+            for _ in range(20):
+                with s.grant(name, 10):
+                    inside.append(name)
+                    if len(inside) > 1:
+                        overlap.append(tuple(inside))
+                    time.sleep(0.0005)
+                    inside.remove(name)
+
+        ts = [threading.Thread(target=worker, args=(n,), daemon=True)
+              for n in ("a", "b")]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join(timeout=30)
+        assert not overlap
+
+
+# ------------------------------------------------------------------ budgets
+class TestBudgetEnforcement:
+    def test_byte_budget_throttles_and_counts(self):
+        """A tenant with a byte/s budget: the first grant rides the burst,
+        later grants wait for refill — elapsed time reflects the rate and
+        sched_throttle_waits ticks."""
+        s = _mk_sched()
+        s.register("metered", byte_rate=1_000_000, byte_burst=50_000)
+        t0 = time.monotonic()
+        for _ in range(3):
+            with s.grant("metered", 50_000):
+                pass
+        dt = time.monotonic() - t0
+        # grants 2 and 3 each wait ~50ms of refill
+        assert dt >= 0.08, dt
+        assert s.tenant("metered").throttle_waits >= 2
+
+    def test_unbudgeted_tenant_not_throttled_by_neighbor(self):
+        s = _mk_sched()
+        s.register("metered", byte_rate=1000, byte_burst=100)
+        s.register("free")
+        with s.grant("metered", 100):
+            pass
+        t0 = time.monotonic()
+        for _ in range(5):
+            with s.grant("free", 10_000):
+                pass
+        assert time.monotonic() - t0 < 0.5
+        assert s.tenant("free").throttle_waits == 0
+
+    def test_throttle_waits_counts_episodes_not_passes(self):
+        """sched_throttle_waits is a SCHED_FIELDS bench column compared
+        round-over-round: it must count throttled grant EPISODES, not how
+        many dispatch passes (or 50ms poll ticks) happened to observe the
+        same waiting op — otherwise its value scales with unrelated
+        tenants' grant rates instead of budget pressure."""
+        clk = FakeClock()
+        s = IoScheduler(StubEngine(), StromConfig(sched_enabled=True),
+                        clock=clk)
+        s.register("metered", byte_rate=1_000_000, byte_burst=100)
+        _enqueue(s, "metered", 100)
+        _enqueue(s, "metered", 100)
+        with s._cond:
+            s._dispatch_locked()  # grant 1 rides the burst
+            s._current.tenant.active -= 1
+            s._current = None
+            for _ in range(10):  # many passes observe one episode
+                s._dispatch_locked()
+        assert s.tenant("metered").throttle_waits == 1
+
+    def test_iops_budget(self):
+        s = _mk_sched()
+        s.register("m", iops=50)  # burst 50 ops, 50/s refill
+        t0 = time.monotonic()
+        for _ in range(52):
+            with s.grant("m", 1):
+                pass
+        assert time.monotonic() - t0 >= 0.03  # ops 51+ waited on refill
+
+
+# --------------------------------------------------- scheduler-context glue
+class TestContextIntegration:
+    def test_read_through_scheduler_bit_identical(self, tmp_path):
+        """sched on vs off: byte-identical pread results (slicing moves
+        lock boundaries, never bytes)."""
+        from strom.delivery.core import StromContext
+
+        data = np.random.default_rng(0).integers(
+            0, 256, 2 * 1024 * 1024 + 123, dtype=np.uint8)
+        p = str(tmp_path / "f.bin")
+        data.tofile(p)
+        outs = []
+        for on in (True, False):
+            cfg = StromConfig(engine="python", sched_enabled=on,
+                              sched_slice_bytes=256 * 1024)
+            ctx = StromContext(cfg)
+            try:
+                outs.append(bytes(ctx.pread(p)))
+            finally:
+                ctx.close()
+        assert outs[0] == outs[1] == data.tobytes()
+
+    def test_tenant_accounting_lands_scoped(self, tmp_path):
+        """A tenant-labeled read surfaces sched_granted_bytes in the
+        tenant's labeled series AND the unlabeled aggregate (PR 6 rule)."""
+        from strom.delivery.core import StromContext
+        from strom.utils.stats import global_stats
+
+        data = np.zeros(512 * 1024, dtype=np.uint8)
+        p = str(tmp_path / "z.bin")
+        data.tofile(p)
+        ctx = StromContext(StromConfig(engine="python"))
+        try:
+            before = global_stats.scoped(
+                tenant="acct").counter("sched_granted_bytes").value
+            ctx.register_tenant("acct", priority="interactive")
+            ctx.pread(p, tenant="acct")
+            scoped = global_stats.scoped(
+                tenant="acct").counter("sched_granted_bytes").value
+            assert scoped - before >= data.nbytes
+        finally:
+            ctx.close()
+
+    def test_engine_exclusive_helper(self, tmp_path):
+        from strom.delivery.core import StromContext
+
+        ctx = StromContext(StromConfig(engine="python"))
+        try:
+            with ctx.engine_exclusive(123):
+                pass
+            assert ctx.scheduler.tenant().granted_ops >= 1
+        finally:
+            ctx.close()
+
+
+# --------------------------------------- engine-lock release at drain (sat.)
+class TestReleaseAtDrain:
+    def test_streaming_gather_releases_engine_at_drain(self, tmp_path):
+        """ISSUE 7 satellite: once every piece of a streamed gather has
+        retired (token drained), the engine grant releases IMMEDIATELY —
+        a concurrent blocking read must proceed while the gather sits
+        un-finish()ed, matching the streamed pipeline path's release
+        point."""
+        from strom.delivery.core import StromContext
+        from strom.delivery.shard import Segment
+
+        data = np.random.default_rng(3).integers(
+            0, 256, 1024 * 1024, dtype=np.uint8)
+        p = str(tmp_path / "g.bin")
+        data.tofile(p)
+        ctx = StromContext(StromConfig(engine="python"))
+        try:
+            dest = np.empty(data.nbytes, dtype=np.uint8)
+            g = ctx.stream_segments(p, [Segment(0, 0, data.nbytes)], dest)
+            while not g.done:
+                g.poll(min_completions=1, timeout_s=5.0)
+            # token drained, finish() NOT yet called: the engine must be
+            # free for another tenant right now
+            done = threading.Event()
+
+            def other():
+                ctx.pread(p, length=4096)
+                done.set()
+
+            threading.Thread(target=other, daemon=True).start()
+            assert done.wait(5.0), \
+                "engine grant still held after gather drain"
+            assert g.finish() == data.nbytes
+            np.testing.assert_array_equal(dest, data)
+        finally:
+            ctx.close()
+
+
+# ---------------------------------------------------- hot-cache partitions
+class TestCachePartitions:
+    def _cache(self, budget=1 << 20):
+        from strom.delivery.hotcache import HotCache
+
+        return HotCache(budget, admit="always", block_bytes=4096)
+
+    def test_partition_caps_tenant(self):
+        c = self._cache()
+        c.set_partition("a", 8192)
+        blob = np.zeros(4096, dtype=np.uint8)
+        assert c.admit("k", 0, 4096, blob, tenant="a") == 4096
+        assert c.admit("k", 4096, 8192, blob, tenant="a") == 4096
+        # third admit: over the partition — evicts a's OWN oldest entry
+        assert c.admit("k", 8192, 12288, blob, tenant="a") == 4096
+        assert c.partitions()["a"]["bytes"] <= 8192
+        # the evicted range misses now; the newest two still hit
+        hits, misses, pins = c.lookup("k", 0, 12288)
+        c.unpin(pins)
+        assert (0, 4096) in misses
+
+    def test_partition_never_displaces_other_tenant(self):
+        c = self._cache()
+        c.set_partition("a", 4096)
+        blob = np.zeros(4096, dtype=np.uint8)
+        assert c.admit("kb", 0, 4096, blob, tenant="b") == 4096
+        assert c.admit("ka", 0, 4096, blob, tenant="a") == 4096
+        # a over-cap: must self-evict or refuse, b's entry stays
+        c.admit("ka", 4096, 8192, blob, tenant="a")
+        hits, _, pins = c.lookup("kb", 0, 4096)
+        c.unpin(pins)
+        assert hits, "tenant b's entry was displaced by tenant a"
+
+    def test_oversized_entry_refused(self):
+        c = self._cache()
+        c.set_partition("a", 4096)
+        blob = np.zeros(64 * 1024, dtype=np.uint8)
+        assert c.admit("k", 0, blob.nbytes, blob, tenant="a") == 0
+
+    def test_register_tenant_carves_partition(self, tmp_path):
+        from strom.delivery.core import StromContext
+
+        cfg = StromConfig(engine="python", hot_cache_bytes=1 << 20)
+        ctx = StromContext(cfg)
+        try:
+            ctx.register_tenant("carved", hot_cache_bytes=64 * 1024)
+            assert ctx.hot_cache.partitions()["carved"]["max_bytes"] \
+                == 64 * 1024
+            # re-registering returns the live handle UNCHANGED and must
+            # not half-apply the new config (scheduler keeps the old
+            # priority/budgets, so the cache partition stays too)
+            t = ctx.register_tenant("carved", priority="interactive",
+                                    hot_cache_bytes=1 << 20)
+            assert t.priority == "training"
+            assert ctx.hot_cache.partitions()["carved"]["max_bytes"] \
+                == 64 * 1024
+        finally:
+            ctx.close()
+
+    def test_warm_admits_charge_owning_tenant(self, tmp_path):
+        """Readahead warming must charge the OWNING pipeline's cache
+        partition — a force-admit with no tenant would bypass the
+        carve-outs and displace other tenants' hot sets through the
+        shared-budget LRU."""
+        from strom.delivery.core import StromContext
+        from strom.delivery.shard import Segment
+
+        path = str(tmp_path / "warm.bin")
+        data = os.urandom(128 * 1024)
+        with open(path, "wb") as f:
+            f.write(data)
+        cfg = StromConfig(engine="python", hot_cache_bytes=1 << 20,
+                          hot_cache_admit="always")
+        ctx = StromContext(cfg)
+        try:
+            ctx.register_tenant("owner", hot_cache_bytes=512 * 1024)
+            warmed = ctx.warm(path, [Segment(0, 0, len(data))],
+                              tenant="owner")
+            assert warmed == len(data)
+            assert ctx.hot_cache.partitions()["owner"]["bytes"] \
+                == len(data)
+        finally:
+            ctx.close()
+
+
+# ------------------------------------------------- occupancy gauges (sat.)
+class TestSlabGauges:
+    def test_in_use_tracks_acquire_release(self):
+        from strom.delivery.buffers import SlabPool
+        from strom.utils.stats import global_stats
+
+        pool = SlabPool(4 << 20)
+        a = pool.acquire(100_000)
+        assert pool.in_use_bytes > 0
+        assert pool.stats()["slab_in_use_bytes"] == pool.in_use_bytes
+        assert global_stats.gauge("slab_pool_bytes_in_use").value \
+            == pool.in_use_bytes
+        pool.release(a)
+        assert pool.in_use_bytes == 0
+        assert global_stats.gauge("slab_pool_bytes_in_use").value == 0
+
+    def test_alloc_failure_rolls_back_occupancy(self, monkeypatch):
+        """A failed allocation must hand its occupancy charge back: a
+        leaked charge would permanently inflate slab_pool_bytes_in_use and
+        wedge the admission gate past high-water on phantom bytes."""
+        from strom.delivery import buffers
+        from strom.utils.stats import global_stats
+
+        pool = buffers.SlabPool(4 << 20)
+
+        def boom(*a, **k):
+            raise MemoryError("mmap ENOMEM")
+
+        monkeypatch.setattr(buffers, "alloc_aligned", boom)
+        with pytest.raises(MemoryError):
+            pool.acquire(100_000)
+        assert pool.in_use_bytes == 0
+        assert pool.mlocked_bytes == 0
+        assert global_stats.gauge("slab_pool_bytes_in_use").value == 0
+        monkeypatch.undo()
+        a = pool.acquire(100_000)  # pool still serviceable
+        assert pool.in_use_bytes > 0
+        pool.release(a)
+
+    def test_gauges_reach_metrics_exposition(self, tmp_path):
+        """ISSUE 7 satellite: the admission-control gauges are scrapeable
+        — slab_pool_bytes_in_use on the global registry, admission waits
+        and grant counters via the sched section."""
+        from strom.delivery.core import StromContext
+
+        data = np.zeros(256 * 1024, dtype=np.uint8)
+        p = str(tmp_path / "x.bin")
+        data.tofile(p)
+        ctx = StromContext(StromConfig(engine="python"), metrics_port=0)
+        try:
+            ctx.pread(p)  # slab + grant activity
+            port = ctx.metrics_server.port
+            body = urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/metrics").read().decode()
+            assert "strom_slab_pool_bytes_in_use" in body
+            assert "strom_sched_sched_granted_ops" in body
+            assert "strom_sched_slab_pool_admission_waits" in body
+        finally:
+            ctx.close()
+
+
+# ----------------------------------------------- concurrent pipelines (acc.)
+class TestConcurrentPipelines:
+    @pytest.fixture(scope="class")
+    def wds(self, tmp_path_factory):
+        cv2 = pytest.importorskip("cv2")
+        from tests.test_formats import make_wds_shard
+
+        rng = np.random.default_rng(77)
+        td = tmp_path_factory.mktemp("mtwds")
+        samples = []
+        for i in range(16):
+            img = rng.integers(0, 256, (48, 56, 3), dtype=np.uint8)
+            ok, buf = cv2.imencode(".jpg", img)
+            assert ok
+            samples.append((f"s{i:04d}", {"jpg": buf.tobytes(),
+                                          "cls": str(i % 10).encode()}))
+        p = str(td / "mt.tar")
+        make_wds_shard(p, samples)
+        return [p]
+
+    def test_concurrent_tenants_bit_identical_to_solo(self, wds):
+        """The fairness-demo acceptance: two tenant-labeled vision
+        pipelines on ONE scheduled context, run CONCURRENTLY, produce
+        batches bit-identical to their solo runs (the scheduler moves
+        lock boundaries and queue order, never bytes)."""
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from strom.delivery.core import StromContext
+        from strom.parallel.mesh import make_mesh
+        from strom.pipelines import make_wds_vision_pipeline
+
+        mesh = make_mesh({"dp": 2}, devices=jax.devices()[:2])
+        sharding = NamedSharding(mesh, P("dp", None, None, None))
+
+        def batches(ctx, tenant, n=3):
+            pipe = make_wds_vision_pipeline(
+                ctx, wds, batch=4, image_size=32, sharding=sharding,
+                seed=5, decode_workers=2,
+                scope={"pipeline": "resnet", "tenant": tenant})
+            try:
+                return [np.asarray(next(pipe)[0]) for _ in range(n)]
+            finally:
+                pipe.close()
+
+        ctx = StromContext(StromConfig(engine="python",
+                                       sched_slice_bytes=64 * 1024))
+        try:
+            solo = {t: batches(ctx, t) for t in ("t0", "t1")}
+            got: dict = {}
+            errs: list = []
+
+            def run(t):
+                try:
+                    got[t] = batches(ctx, t)
+                except BaseException as e:
+                    errs.append(e)
+
+            ts = [threading.Thread(target=run, args=(t,), daemon=True)
+                  for t in ("t0", "t1")]
+            for t in ts:
+                t.start()
+            for t in ts:
+                t.join(timeout=120)
+            assert not errs, errs
+            for t in ("t0", "t1"):
+                assert len(got[t]) == len(solo[t])
+                for a, b in zip(got[t], solo[t]):
+                    np.testing.assert_array_equal(a, b)
+            # per-tenant series visible on the scoped registry
+            from strom.utils.stats import global_stats
+
+            scopes = global_stats.scopes_snapshot()
+            assert any('tenant="t0"' in k for k in scopes)
+            assert any('tenant="t1"' in k for k in scopes)
+        finally:
+            ctx.close()
+
+
+# ------------------------------------------------------- /tenants lifecycle
+class TestTenantsRoute:
+    def test_get_register_drain(self, tmp_path):
+        from strom.delivery.core import StromContext
+
+        ctx = StromContext(StromConfig(engine="python"), metrics_port=0)
+        try:
+            port = ctx.metrics_server.port
+            base = f"http://127.0.0.1:{port}/tenants"
+            doc = json.load(urllib.request.urlopen(base))
+            assert "default" in doc["tenants"]
+            req = urllib.request.Request(base, data=json.dumps(
+                {"op": "register", "name": "web", "priority": "interactive",
+                 "byte_rate": 1e9, "weight": 2}).encode())
+            row = json.load(urllib.request.urlopen(req))
+            assert row["priority"] == "interactive" and row["weight"] == 2
+            doc = json.load(urllib.request.urlopen(base))
+            assert doc["tenants"]["web"]["byte_budget"]["rate"] == 1e9
+            req = urllib.request.Request(base, data=json.dumps(
+                {"op": "drain", "name": "web"}).encode())
+            assert json.load(urllib.request.urlopen(req))["drained"] is True
+            # bad op → 400, server survives
+            req = urllib.request.Request(base, data=b'{"op": "nope"}')
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                urllib.request.urlopen(req)
+            assert ei.value.code == 400
+            # malformed FIELDS are the client's fault too: 400, not 500
+            for bad in ({"op": "register", "name": ""},
+                        {"op": "register", "name": "x", "weight": "abc"},
+                        {"op": "register", "name": "x",
+                         "byte_burst": None}):
+                req = urllib.request.Request(
+                    base, data=json.dumps(bad).encode())
+                with pytest.raises(urllib.error.HTTPError) as ei:
+                    urllib.request.urlopen(req)
+                assert ei.value.code == 400, bad
+        finally:
+            ctx.close()
+
+
+# ------------------------------------------- daemon graceful shutdown (sat.)
+class TestDaemonShutdown:
+    def test_sigterm_drains_then_flight_chain_runs(self, tmp_path):
+        """ISSUE 7 satellite: SIGTERM on daemon mode (1) drains every
+        registered tenant (the 'drained' marker with no stuck names — no
+        leaked pins/in-flight tokens), (2) only THEN lets the flight
+        recorder's chained handler run (bundle on disk), and (3) the exit
+        status still says killed-by-SIGTERM (the recorder's re-raise
+        contract)."""
+        import signal
+        import subprocess
+        import sys
+
+        fdir = str(tmp_path / "flight")
+        env = dict(os.environ, JAX_PLATFORMS="cpu")
+        root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        p = subprocess.Popen(
+            [sys.executable, "-m", "strom.cli", "daemon",
+             "--metrics-port", "0", "--engine", "python",
+             "--flight-dir", fdir, "--drain-timeout", "5"],
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+            env=env, cwd=root)
+        try:
+            ready = p.stdout.readline()
+            assert "strom daemon ready" in ready, ready
+            port = int(ready.split("port=")[1].split()[0])
+            # a real external tenant registers over the daemon surface
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{port}/tenants",
+                data=json.dumps({"op": "register", "name": "ext",
+                                 "priority": "interactive"}).encode())
+            assert json.load(urllib.request.urlopen(req))["name"] == "ext"
+            p.send_signal(signal.SIGTERM)
+            out, _ = p.communicate(timeout=60)
+        finally:
+            if p.poll() is None:
+                p.kill()
+                p.communicate(timeout=10)
+        assert "strom daemon drained" in out, out
+        assert "stuck=[]" in out, out
+        assert p.returncode == -signal.SIGTERM, (p.returncode, out)
+        bundles = os.listdir(fdir)
+        assert any("sigterm" in b for b in bundles), bundles
+
+    def test_sigint_drains_and_exits_killed_by_signal(self, tmp_path):
+        """SIGINT follows the same supervisor contract as SIGTERM: drain
+        every tenant first, then die BY the signal (rc = -SIGINT) — not a
+        KeyboardInterrupt traceback's rc 1 and not a clean rc 0 that a
+        supervisor would read as a successful exit."""
+        import signal
+        import subprocess
+        import sys
+
+        env = dict(os.environ, JAX_PLATFORMS="cpu")
+        root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        p = subprocess.Popen(
+            [sys.executable, "-m", "strom.cli", "daemon",
+             "--metrics-port", "0", "--engine", "python",
+             "--drain-timeout", "5"],
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+            env=env, cwd=root)
+        try:
+            ready = p.stdout.readline()
+            assert "strom daemon ready" in ready, ready
+            p.send_signal(signal.SIGINT)
+            out, _ = p.communicate(timeout=60)
+        finally:
+            if p.poll() is None:
+                p.kill()
+                p.communicate(timeout=10)
+        assert "strom daemon drained" in out, out
+        assert "stuck=[]" in out, out
+        assert p.returncode == -signal.SIGINT, (p.returncode, out)
+
+
+# ------------------------------------------------ lint covers SCHED_FIELDS
+def test_lint_scans_sched_fields():
+    """ISSUE 7 satellite: the stats-name lint's *_FIELDS scan must cover
+    SCHED_FIELDS — a restyled per-tenant column would fork the bench/
+    report contract exactly like a restyled counter."""
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    spec = importlib.util.spec_from_file_location(
+        "lint_stats_names", os.path.join(root, "tools",
+                                         "lint_stats_names.py"))
+    lint = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(lint)
+    found, _ = lint.scan_sources(root)
+    for name in SCHED_FIELDS:
+        norm = name.replace("_", "").lower()
+        assert norm in found, f"lint does not scan SCHED_FIELDS ({name})"

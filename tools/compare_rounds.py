@@ -140,6 +140,31 @@ STREAM_KEYS = [
     "vit_stream_first_decode_lat_p50_us",
     "vit_stream_tail_extent_p50_us",
 ]
+# multi-tenant scheduler (ISSUE 7 tentpole): the 2-vision + 1-parquet
+# concurrency arm's per-tenant columns. mt_vs_solo_mean is the aggregate
+# multiplexing efficiency (mean of per-tenant concurrent/solo ratios —
+# same-run, weather-independent); mt_pq_* is the light INTERACTIVE tenant
+# whose bounded queue-wait p99 is the no-starvation evidence while the two
+# training tenants flood the engine. Suffixes single-sourced in
+# strom.sched.scheduler.SCHED_FIELDS (parity-tested in
+# tests/test_compare_rounds.py, same contract as the decode/stall/cache/
+# stream sections).
+SCHED_KEYS = [
+    "mt_vs_solo_mean",
+    "mt_pq_items_per_s",
+    "mt_pq_vs_solo",
+    "mt_pq_sched_queue_wait_p99_us",
+    "mt_vis0_items_per_s",
+    "mt_vis0_vs_solo",
+    "mt_vis0_sched_queue_wait_p50_us",
+    "mt_vis0_sched_queue_wait_p99_us",
+    "mt_vis0_sched_granted_bytes",
+    "mt_vis0_sched_throttle_waits",
+    "mt_vis0_engine_op_lat_p99_us",
+    "mt_vis1_items_per_s",
+    "mt_vis1_vs_solo",
+    "mt_vis1_sched_queue_wait_p99_us",
+]
 # per-attempt / per-pass audit arrays (VERDICT.md r4 next #3): printed so
 # the best-of selection's discards are visible in the comparison too
 AUDIT_SUFFIXES = ("_attempts", "_passes")
@@ -272,8 +297,11 @@ def main(argv: list[str]) -> int:
                      for k in CACHE_KEYS)
     have_stream = any(cell(d, k) != "-" for _, d in rounds
                       for k in STREAM_KEYS)
+    have_sched = any(cell(d, k) != "-" for _, d in rounds
+                     for k in SCHED_KEYS)
     name_w = max(len(k) for k in binding_keys + CONTEXT_KEYS + DECODE_KEYS
-                 + STALL_KEYS + CACHE_KEYS + STREAM_KEYS + audit_keys) + 2
+                 + STALL_KEYS + CACHE_KEYS + STREAM_KEYS + SCHED_KEYS
+                 + audit_keys) + 2
     # every rendered cell folds into ONE column width, or rows misalign
     col_w = max(max(len(n) for n, _ in rounds) + 2, 12,
                 *(len(c) + 2 for cs in audit_cells.values() for c in cs),
@@ -316,6 +344,12 @@ def main(argv: list[str]) -> int:
         print("streaming (completion-driven intra-batch dataflow; "
               "resnet vs resnet_nostream rows are the A/B):")
         for k in STREAM_KEYS:
+            print(k.ljust(name_w)
+                  + "".join(cell(d, k).rjust(col_w) for _, d in rounds))
+    if have_sched:
+        print("multi-tenant (2 vision + 1 parquet tenant concurrent; "
+              "bounded mt_pq queue-wait p99 = no starvation):")
+        for k in SCHED_KEYS:
             print(k.ljust(name_w)
                   + "".join(cell(d, k).rjust(col_w) for _, d in rounds))
     if audit_keys:

@@ -42,8 +42,11 @@ _CALL = re.compile(
 _SCOPED_CALL = re.compile(r"\.scoped\(\s*([^()]*)\)")
 _KWARG = re.compile(r"(?:^|,)\s*(\*\*)?([A-Za-z_]\w*)\s*=")
 
-# single-sourced metric-name tuples (STALL_FIELDS, CACHE_BENCH_FIELDS, the
-# compare_rounds *_KEYS column lists, cli _DECODE_COUNTERS, ...): their
+# single-sourced metric-name tuples (STALL_FIELDS, CACHE_BENCH_FIELDS,
+# STREAM_FIELDS, FLIGHT_FIELDS, SENTINEL_FIELDS, SCHED_FIELDS — the
+# multi-tenant bench arm's per-tenant column suffixes, coverage asserted in
+# tests/test_sched.py — the compare_rounds *_KEYS column lists, cli
+# _DECODE_COUNTERS, ...): their
 # literals name the SAME series the producers feed, so a restyled spelling
 # here forks a dashboard column exactly like a restyled call site — scan
 # every string literal inside the declaration's bracket (ISSUE 4 satellite:
