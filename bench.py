@@ -567,6 +567,15 @@ def main() -> int:
             for k in STREAM_FIELDS:
                 if k in res:
                     loader_res[f"{prefix}_{k}"] = res[k]
+            # request-latency / SLO columns (ISSUE 8): per-arm req_lat
+            # p50/p99 over the traced gather/batch requests plus the SLO
+            # verdict (single-sourced key list: strom.obs.slo
+            # .SLO_BENCH_FIELDS — same contract as STALL_FIELDS)
+            from strom.obs.slo import SLO_BENCH_FIELDS
+
+            for k in SLO_BENCH_FIELDS:
+                if k in res:
+                    loader_res[f"{prefix}_{k}"] = res[k]
             if res.get("warm_images_per_s") is not None:
                 print(f"{name} hot-cache epochs: cold "
                       f"{res.get('cold_images_per_s')} img/s -> warm "

@@ -734,6 +734,25 @@ def _stream_stats_delta(snap0: dict) -> dict:
     return out
 
 
+def _req_slo_delta(ctx, snap0: dict) -> dict:
+    """Per-arm request-latency / SLO columns (ISSUE 8 satellite): p50/p99
+    of the ``req_lat`` histogram over the arm's snapshot delta plus the
+    SLO verdict (1 = no tenant burning at arm end). Keys single-sourced in
+    ``strom.obs.slo.SLO_BENCH_FIELDS`` — the driver's copy loop and the
+    compare_rounds "request latency / SLO" section read the same tuple."""
+    from strom.utils.stats import global_stats, percentile_from_buckets
+
+    snap1 = global_stats.snapshot()
+    b0 = snap0.get("req_lat_hist") or []
+    b1 = snap1.get("req_lat_hist") or []
+    db = [a - b for a, b in zip(b1, b0)] if b0 else list(b1)
+    return {
+        "req_lat_p50_us": percentile_from_buckets(db, 0.50),
+        "req_lat_p99_us": percentile_from_buckets(db, 0.99),
+        "slo_ok": int(ctx.slo.ok()),
+    }
+
+
 def _obs_config_kw(args: argparse.Namespace) -> dict:
     """StromConfig observability overrides: --metrics-port starts the live
     /metrics, /stats, /trace, /flight endpoint for the bench context's
@@ -986,6 +1005,7 @@ def bench_resnet(args: argparse.Namespace) -> dict:
         if not predecoded:
             out.update(_decode_stats_delta(_dec0))
             out.update(_stream_stats_delta(_dec0))
+        out.update(_req_slo_delta(ctx, _dec0))
     finally:
         ctx.close()
     return out
@@ -1132,6 +1152,7 @@ def bench_vit(args: argparse.Namespace) -> dict:
         if not predecoded:
             out.update(_decode_stats_delta(_dec0))
             out.update(_stream_stats_delta(_dec0))
+        out.update(_req_slo_delta(ctx, _dec0))
     finally:
         ctx.close()
     return out

@@ -252,6 +252,13 @@ class StromConfig:
                                        # seconds; <= 0 disables the stall
                                        # trigger (signal/exception dumps
                                        # stay armed)
+    # snapshot history (strom/obs/history.py — ISSUE 8 tentpole): when the
+    # live server is on, a background thread samples the global registry
+    # (scoped series included) every history_interval_s into a bounded
+    # ring served on /history — true rate() math (tools/strom_top.py)
+    # without an external TSDB. <= 0 disables the sampler (the /history
+    # route then 404s); no live server = no sampler either way.
+    history_interval_s: float = 2.0
 
     def __post_init__(self) -> None:
         if self.buffer_size == 0:

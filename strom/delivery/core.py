@@ -38,6 +38,7 @@ from strom.engine import make_engine
 from strom.engine.base import Engine, EngineError
 from strom.engine.raid0 import (count_stripe_windows, plan_stripe_reads,
                                 plan_stripe_windows)
+from strom.obs import request as _request
 from strom.obs.events import ring as _events_ring
 from strom.utils.stats import global_stats
 
@@ -325,12 +326,33 @@ class StromContext:
         # reads) routes through it; sched_enabled=False keeps the
         # pre-scheduler lock-per-transfer behavior.
         self._scheduler = None
+        self._tenant_reg_lock = threading.Lock()
         if self.config.sched_enabled:
             from strom.sched.scheduler import IoScheduler
 
             self._scheduler = IoScheduler(self.engine, self.config,
                                           pool=self._slab_pool,
                                           scope=self.scope)
+        # per-tenant SLO engine (ISSUE 8 tentpole, strom/obs/slo.py):
+        # every finished traced request feeds good/bad window accounting;
+        # burn rates surface on /slo, as slo_* gauges per tenant scope,
+        # and as the slo_burning flag on /tenants rows (scheduler hook).
+        from strom.obs.slo import SloEngine
+
+        self._slo = SloEngine(goodput_fn=self._current_goodput)
+        # requests minted by THIS context carry this token; the observer
+        # list is process-global, so without the filter two live contexts
+        # would feed each other's SLO engines (phantom tenant rows, a
+        # healthy context's slo_ok flipped by its neighbor's slow gathers)
+        self._req_owner: object = object()
+
+        def _observe(req, _slo=self._slo, _own=self._req_owner):
+            if req.owner is None or req.owner is _own:
+                _slo.observe_request(req)
+
+        self._slo_observer = _observe
+        if self._scheduler is not None:
+            self._scheduler.slo_hook = self._slo.burning
         # hot-set host cache (ISSUE 4 tentpole, strom/delivery/hotcache.py):
         # repeat traffic serves from RAM instead of re-gathering from NVMe.
         # Buffers come from the slab pool (NUMA-placed, engine-registered);
@@ -380,11 +402,34 @@ class StromContext:
                 self.config.flight_dir, ctx=self,
                 stall_s=self.config.flight_stall_s)
         port = self.config.metrics_port if metrics_port is None else metrics_port
+        self._history = None
         if port is not None and (port > 0 or metrics_port == 0):
             from strom.obs.server import MetricsServer
 
-            self._metrics_server = MetricsServer(self.stats, port=port,
-                                                 flight=self._flight, ctx=self)
+            # snapshot history (ISSUE 8 tentpole, strom/obs/history.py):
+            # rides with the live server — a process someone can scrape is
+            # a process someone will want rates from. Created first so the
+            # /history route is live the moment the port is.
+            if self.config.history_interval_s > 0:
+                from strom.obs.history import StatsHistory
+
+                self._history = StatsHistory(
+                    interval_s=self.config.history_interval_s)
+            try:
+                self._metrics_server = MetricsServer(
+                    self.stats, port=port, flight=self._flight, ctx=self)
+            except Exception:
+                # a failed bind must not leak the sampler/watchdog threads
+                # just started for a context that will never exist
+                if self._history is not None:
+                    self._history.close()
+                if self._flight is not None:
+                    self._flight.close()
+                raise
+        # registered LAST: a process-global observer pointing at a context
+        # whose __init__ failed would pin the half-built context (and feed
+        # its SLO engine from every later request) for the process lifetime
+        _request.add_observer(self._slo_observer)
         self._closed = False
 
     @property
@@ -405,6 +450,26 @@ class StromContext:
         return self._hot_cache
 
     @property
+    def slo(self):
+        """The per-tenant SLO engine (always on — targets default loose;
+        customize via ``ctx.slo.set_target(tenant, ...)``)."""
+        return self._slo
+
+    @property
+    def history(self):
+        """The snapshot-history ring when the live server is on (and
+        ``history_interval_s > 0``), else None."""
+        return self._history
+
+    def _current_goodput(self) -> "float | None":
+        """The stall-attribution goodput for SLO goodput targets (rides
+        the steps section's TTL cache, so /slo scrapes stay cheap)."""
+        try:
+            return self.stats(sections=["steps"])["steps"].get("goodput_pct")
+        except Exception:
+            return None
+
+    @property
     def scheduler(self):
         """The multi-tenant I/O scheduler when ``sched_enabled``, else
         None (strom/sched/scheduler.py)."""
@@ -423,20 +488,26 @@ class StromContext:
         if self._scheduler is None:
             raise RuntimeError("sched_enabled=False: no scheduler to "
                                "register tenants with")
-        if self._scheduler.is_registered(name):
-            # re-register returns the live handle UNCHANGED (scheduler
-            # contract: queue state and budget balances survive) — so the
-            # cache partition must not silently resize either; applying
-            # only the hot_cache_bytes of a new config would diverge
-            # scheduler and cache state with no indication
-            return self._scheduler.tenant(name)
-        t = self._scheduler.register(
-            name, priority=priority, weight=weight, byte_rate=byte_rate,
-            byte_burst=byte_burst, iops=iops,
-            hot_cache_bytes=hot_cache_bytes)
-        if hot_cache_bytes and self._hot_cache is not None:
-            self._hot_cache.set_partition(name, hot_cache_bytes)
-        return t
+        # serialized: two concurrent POST /tenants registers of one name
+        # must never interleave the is_registered check with the
+        # scheduler-register + cache-partition pair — the loser would carve
+        # a partition for a handle whose budgets the winner already
+        # customized (partial registration, ISSUE 8 satellite)
+        with self._tenant_reg_lock:
+            if self._scheduler.is_registered(name):
+                # re-register returns the live handle UNCHANGED (scheduler
+                # contract: queue state and budget balances survive) — so
+                # the cache partition must not silently resize either;
+                # applying only the hot_cache_bytes of a new config would
+                # diverge scheduler and cache state with no indication
+                return self._scheduler.tenant(name)
+            t = self._scheduler.register(
+                name, priority=priority, weight=weight, byte_rate=byte_rate,
+                byte_burst=byte_burst, iops=iops,
+                hot_cache_bytes=hot_cache_bytes)
+            if hot_cache_bytes and self._hot_cache is not None:
+                self._hot_cache.set_partition(name, hot_cache_bytes)
+            return t
 
     @contextlib.contextmanager
     def engine_exclusive(self, nbytes: int = 0, tenant: str | None = None):
@@ -747,9 +818,11 @@ class StromContext:
                 miss_chunks.append((fi, s, do + (s - fo), t - s))
         cache.unpin(pinned)
         if cache_hit and not warm:
-            _events_ring.complete(t0, _events_ring.now_us() - t0,
-                                  "cache", "cache.serve",
-                                  {"bytes": cache_hit})
+            # request-tagged (ISSUE 8): which request the RAM-served bytes
+            # belonged to — cache hits are why a "slow path" request isn't
+            _request.complete(t0, _events_ring.now_us() - t0,
+                              "cache", "cache.serve",
+                              {"bytes": cache_hit})
         return miss_chunks, cache_hit, hit_ranges
 
     def _read_segments(self, source: "Source",
@@ -775,25 +848,38 @@ class StromContext:
         gathers, every read byte is force-admitted, and a short pass
         returns quietly instead of raising."""
         cfg = self.config
-        chunks, idx_paths = self._plan_chunks(source, segments, base_offset)
-
-        cache = self._hot_cache
-        if cache is not None and not cache.enabled:
-            cache = None
-        cache_hit = 0
-        dflat: np.ndarray | None = None
-        if cache is not None and chunks:
-            if not _warm:  # warm mode never copies into dest (may be None)
-                dflat = dest if dest.ndim == 1 and dest.dtype == np.uint8 \
-                    else dest.reshape(-1).view(np.uint8)
-            chunks, cache_hit, _ = self._consult_cache(
-                cache, chunks, idx_paths, dflat, warm=_warm)
-
         if _warm:
+            chunks, idx_paths = self._plan_chunks(source, segments,
+                                                  base_offset)
+            cache = self._hot_cache
+            if cache is not None and not cache.enabled:
+                cache = None
+            if cache is not None and chunks:
+                chunks, _, _ = self._consult_cache(
+                    cache, chunks, idx_paths, None, warm=True)
             return self._warm_read_chunks(chunks, dest, idx_paths, tenant)
 
-        return self._demand_read_chunks(chunks, dest, idx_paths, cache,
-                                        dflat, cache_hit, tenant)
+        # causal request tracing (ISSUE 8): every demand gather is (or
+        # joins) a traced request — the span tree below (plan, cache
+        # serve, sched queue/grant, engine slices, admits) shares its
+        # req_id, and finish feeds req_lat / the exemplar store / the SLO
+        # engine. Nested mint sites (a streamed batch) reuse the
+        # enclosing request, so this adds one contextvar read there.
+        with _request.active("gather", tenant, owner=self._req_owner):
+            chunks, idx_paths = self._plan_chunks(source, segments,
+                                                  base_offset)
+            cache = self._hot_cache
+            if cache is not None and not cache.enabled:
+                cache = None
+            cache_hit = 0
+            dflat: np.ndarray | None = None
+            if cache is not None and chunks:
+                dflat = dest if dest.ndim == 1 and dest.dtype == np.uint8 \
+                    else dest.reshape(-1).view(np.uint8)
+                chunks, cache_hit, _ = self._consult_cache(
+                    cache, chunks, idx_paths, dflat)
+            return self._demand_read_chunks(chunks, dest, idx_paths, cache,
+                                            dflat, cache_hit, tenant)
 
     def _demand_read_chunks(self, chunks, dest, idx_paths, cache, dflat,
                             cache_hit: int, tenant: str | None) -> int:
@@ -813,9 +899,9 @@ class StromContext:
         total = 0
         if chunks:
             with self._demand_gate(), \
-                    _events_ring.span("strom.read_segments", cat="read",
-                                      args={"ops": len(chunks),
-                                            "bytes": planned}):
+                    _request.span("strom.read_segments", cat="read",
+                                  args={"ops": len(chunks),
+                                        "bytes": planned}):
                 try:
                     if self._scheduler is not None:
                         total = self._scheduler.read_chunks(
@@ -848,9 +934,9 @@ class StromContext:
                                                 dflat[do: do + ln],
                                                 tenant=tenant)
                 if admitted:
-                    _events_ring.complete(t0a, _events_ring.now_us() - t0a,
-                                          "cache", "cache.admit",
-                                          {"bytes": admitted})
+                    _request.complete(t0a, _events_ring.now_us() - t0a,
+                                      "cache", "cache.admit",
+                                      {"bytes": admitted})
         self.scope.add("ssd2tpu_bytes", total + cache_hit)
         return total + cache_hit
 
@@ -1419,7 +1505,7 @@ class StromContext:
         never recomputes the expensive stall-attribution section (ISSUE 6
         satellite). None = every section (the pre-existing contract).
         Known sections: context, decode, stream, steps, cache, slab_pool,
-        engine, sched, scopes."""
+        engine, sched, slo, exemplars, scopes."""
         want = None if sections is None else set(sections)
 
         def wanted(name: str) -> bool:
@@ -1537,6 +1623,16 @@ class StromContext:
         # the registry scopes; the /tenants route renders the full rows
         if wanted("sched") and self._scheduler is not None:
             out["sched"] = self._scheduler.stats()
+        # per-tenant SLO engine (ISSUE 8): aggregate burn-rate state —
+        # per-tenant rows live on /slo, labeled gauges on /metrics
+        if wanted("slo"):
+            out["slo"] = self._slo.stats()
+        # tail-sampling exemplar store (ISSUE 8): retention counters; the
+        # retained span trees themselves ride /flight and crash bundles
+        if wanted("exemplars"):
+            from strom.obs.exemplars import store as _exemplars
+
+            out["exemplars"] = _exemplars.stats()
         # scoped telemetry (ISSUE 6 tentpole): every label scope's series as
         # {label-string: snapshot} — the JSON twin of the labeled samples
         # /metrics renders; the sections exposition skips it (nested dicts),
@@ -1549,8 +1645,11 @@ class StromContext:
         if self._closed:
             return
         self._closed = True
+        _request.remove_observer(self._slo_observer)
         if self._metrics_server is not None:
             self._metrics_server.close()
+        if self._history is not None:
+            self._history.close()
         if self._flight is not None:
             self._flight.close()
         self._executor.shutdown(wait=True)

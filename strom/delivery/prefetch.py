@@ -244,10 +244,16 @@ class Prefetcher(Generic[T]):
 
     def close(self) -> None:
         with self._lock:
-            for f in self._queue:
-                f.cancel()
+            live = [f for f in self._queue if not f.cancel()]
             self._queue.clear()
             self._exhausted = True
+        # a thunk already RUNNING when close() lands keeps using the decode
+        # pool / engine the pipeline tears down right after this returns;
+        # give it a bounded window to retire so the shutdown race doesn't
+        # masquerade as a request failure (every such batch would otherwise
+        # mint a bogus "errored" exemplar — strom/obs/exemplars.py)
+        if live:
+            concurrent.futures.wait(live, timeout=30.0)
         self._shutdown()
 
 

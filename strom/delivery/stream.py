@@ -45,6 +45,7 @@ import numpy as np
 
 from strom.delivery.shard import Segment
 from strom.engine.base import EngineError
+from strom.obs import request as _request
 from strom.obs.events import ring as _events_ring
 
 # bench-JSON columns the streaming arms emit (cli.py _stream_stats_delta),
@@ -116,38 +117,53 @@ class StreamingGather:
         # release point the streamed pipeline path already had.
         self._stack = contextlib.ExitStack()
         self._engine_released = False
+        # causal request tracing (ISSUE 8): join the enclosing request
+        # (the streamed batch assembly mints one around make_batch) or
+        # mint our own for direct stream_segments callers — the sched
+        # grant, engine token, cache serve/admit and stream spans below
+        # all carry its req_id; an owned request finishes at release.
+        req = _request.current()
+        self._own_req = req is None
+        self.req = req if req is not None \
+            else _request.Request("gather", self._tenant)
         try:
-            chunks, idx_paths = ctx._plan_chunks(source, segments,
-                                                 base_offset)
-            self._idx_paths = idx_paths
-            cache = ctx._hot_cache
-            if cache is not None and not cache.enabled:
-                cache = None
-            self._cache = cache
-            self._instant: list[tuple[int, int]] = []
-            hit_bytes = 0
-            if cache is not None and chunks:
-                chunks, hit_bytes, self._instant = ctx._consult_cache(
-                    cache, chunks, idx_paths, self._dflat)
-            self._chunks = chunks
-            self._miss_planned = sum(ln for (_, _, _, ln) in chunks)
-            self.total_bytes = self._miss_planned + hit_bytes
-            self.instant_bytes = hit_bytes
-            if hit_bytes:
-                self._scope.add("stream_instant_bytes", hit_bytes)
-            if chunks:
-                self._stack.enter_context(ctx._demand_gate())
-                if ctx.scheduler is not None:
-                    self._stack.enter_context(
-                        ctx.scheduler.grant(self._tenant, self._miss_planned))
-                else:
-                    self._stack.enter_context(ctx._engine_lock)
-                self._token = ctx.engine.submit_vectored(
-                    chunks, dest, retries=ctx.config.io_retries)
-            self._scope.add("stream_batches")
-        except BaseException:
+            with _request.attach(self.req):
+                chunks, idx_paths = ctx._plan_chunks(source, segments,
+                                                     base_offset)
+                self._idx_paths = idx_paths
+                cache = ctx._hot_cache
+                if cache is not None and not cache.enabled:
+                    cache = None
+                self._cache = cache
+                self._instant: list[tuple[int, int]] = []
+                hit_bytes = 0
+                if cache is not None and chunks:
+                    chunks, hit_bytes, self._instant = ctx._consult_cache(
+                        cache, chunks, idx_paths, self._dflat)
+                self._chunks = chunks
+                self._miss_planned = sum(ln for (_, _, _, ln) in chunks)
+                self.total_bytes = self._miss_planned + hit_bytes
+                self.instant_bytes = hit_bytes
+                if hit_bytes:
+                    self._scope.add("stream_instant_bytes", hit_bytes)
+                if chunks:
+                    self._stack.enter_context(ctx._demand_gate())
+                    if ctx.scheduler is not None:
+                        self._stack.enter_context(
+                            ctx.scheduler.grant(self._tenant,
+                                                self._miss_planned))
+                    else:
+                        self._stack.enter_context(ctx._engine_lock)
+                    self._token = ctx.engine.submit_vectored(
+                        chunks, dest, retries=ctx.config.io_retries,
+                        req_id=self.req.id)
+                self._scope.add("stream_batches")
+        except BaseException as e:
             self._stack.close()
             self._closed = True
+            if self._own_req:
+                self.req.mark_error(e)
+                self.req.finish()
             raise
 
     @property
@@ -214,16 +230,19 @@ class StreamingGather:
             if self._token is not None:
                 total = self._ctx.engine.drain(self._token)
         except EngineError as e:
+            self.req.mark_error(e)
             self._release()
             raise EngineError(e.errno, f"ssd2tpu {e.strerror}") from None
         self._release_engine()
         if total != self._miss_planned:
             # cheap insurance, same as _read_segments: any engine
             # accounting bug surfaces loudly, not as a zero-tailed batch
-            self._release()
-            raise EngineError(
+            err = EngineError(
                 errno.EIO, f"ssd2tpu streamed read {total} bytes, "
                            f"planned {self._miss_planned}")
+            self.req.mark_error(err)
+            self._release()
+            raise err
         self._release()
         self._scope.add("ssd2tpu_bytes", self.total_bytes)
         return self.total_bytes
@@ -252,10 +271,10 @@ class StreamingGather:
             # instrumented wrappers, so the engine window is billed here
             end = self._last_c_us if self._last_c_us is not None \
                 else _events_ring.now_us()
-            _events_ring.complete(self.t0_us, max(end - self.t0_us, 0),
-                                  "read", "stream.read",
-                                  {"ops": len(self._chunks),
-                                   "bytes": self._miss_planned})
+            self.req.record("stream.read", "read", self.t0_us,
+                            max(end - self.t0_us, 0),
+                            {"ops": len(self._chunks),
+                             "bytes": self._miss_planned})
         if self._first_c_us is not None and self._last_c_us is not None:
             # the spread the old barrier serialized on: how long the
             # slowest extent lagged the first completion — with streaming,
@@ -263,17 +282,17 @@ class StreamingGather:
             self._scope.observe_us("stream_tail_extent",
                                    self._last_c_us - self._first_c_us)
         if self._admitted:
-            _events_ring.complete(self.t0_us,
-                                  _events_ring.now_us() - self.t0_us,
-                                  "cache", "cache.admit",
-                                  {"bytes": self._admitted})
-        _events_ring.complete(self.t0_us,
-                              _events_ring.now_us() - self.t0_us,
-                              "stream", "stream.gather",
-                              {"bytes": self.total_bytes,
-                               "instant_bytes": self.instant_bytes,
-                               "ops": len(self._chunks)})
+            self.req.record("cache.admit", "cache", self.t0_us,
+                            _events_ring.now_us() - self.t0_us,
+                            {"bytes": self._admitted})
+        self.req.record("stream.gather", "stream", self.t0_us,
+                        _events_ring.now_us() - self.t0_us,
+                        {"bytes": self.total_bytes,
+                         "instant_bytes": self.instant_bytes,
+                         "ops": len(self._chunks)})
         self._release_engine()
+        if self._own_req:
+            self.req.finish()
 
     def close(self) -> None:
         """Idempotent teardown. A live token is CANCELLED: every in-flight

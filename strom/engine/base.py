@@ -99,12 +99,18 @@ class StreamToken:
 
     __slots__ = ("chunks", "retries", "_d8", "_left", "_results", "_pending",
                  "_pieces", "_backlog", "_exhausted", "_ready", "bytes_done",
-                 "cancelled", "inflight_peak", "_err", "chunks_done")
+                 "cancelled", "inflight_peak", "_err", "chunks_done",
+                 "req_id")
 
     def __init__(self, chunks: Sequence[tuple[int, int, int, int]],
-                 dest: np.ndarray, block: int, retries: int):
+                 dest: np.ndarray, block: int, retries: int,
+                 req_id: "int | None" = None):
         self.chunks = list(chunks)
         self.retries = retries
+        # causal request tracing (ISSUE 8): the req_id of the request this
+        # gather belongs to, if traced — carried on the token so poll/drain
+        # telemetry and tools can attribute engine work to one request
+        self.req_id = req_id
         self._d8 = dest.view(np.uint8).reshape(-1)
         # bytes of each chunk not yet landed; a chunk retires when it hits 0
         self._left = [ln for (_, _, _, ln) in self.chunks]
@@ -390,13 +396,17 @@ class Engine(abc.ABC):
     # the multi engine). Exactly one thread drives poll/drain per token.
 
     def submit_vectored(self, chunks: Sequence[tuple[int, int, int, int]],
-                        dest: np.ndarray, *, retries: int = 1) -> StreamToken:
+                        dest: np.ndarray, *, retries: int = 1,
+                        req_id: "int | None" = None) -> StreamToken:
         """Begin an async gather of (file_index, file_offset, dest_offset,
         length) chunks into *dest*. Pieces are submitted up to queue_depth
         immediately; the rest flow in as :meth:`poll` reaps completions.
         The returned token must be driven to :meth:`drain` (or handed to
-        :meth:`cancel`) before the engine is used for another transfer."""
-        tok = StreamToken(chunks, dest, self.config.block_size, retries)
+        :meth:`cancel`) before the engine is used for another transfer.
+        *req_id* tags the token with the traced request it executes
+        (strom/obs/request.py), for attribution only."""
+        tok = StreamToken(chunks, dest, self.config.block_size, retries,
+                          req_id=req_id)
         self._track_token(tok)
         self._pump_token(tok)
         return tok

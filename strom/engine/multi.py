@@ -45,15 +45,17 @@ class _FanToken:
     StreamToken surface the delivery layer reads (done / cancelled /
     bytes_done / inflight_peak / chunks / error)."""
 
-    __slots__ = ("chunks", "parts", "locks", "cancelled", "chunks_done")
+    __slots__ = ("chunks", "parts", "locks", "cancelled", "chunks_done",
+                 "req_id")
 
-    def __init__(self, chunks, parts, locks):
+    def __init__(self, chunks, parts, locks, req_id=None):
         self.chunks = list(chunks)
         # [(ring_index, child_engine, child_token, [parent_chunk_idx]), ...]
         self.parts = parts
         self.locks = locks  # acquired ring locks, released exactly once
         self.cancelled = False
         self.chunks_done = 0
+        self.req_id = req_id  # traced-request tag (strom/obs/request.py)
 
     @property
     def done(self) -> bool:
@@ -284,7 +286,8 @@ class MultiRingEngine(Engine):
 
     # -- async vectored gather: fan tokens across member rings --------------
     def submit_vectored(self, chunks: Sequence[tuple[int, int, int, int]],
-                        dest: np.ndarray, *, retries: int = 1):
+                        dest: np.ndarray, *, retries: int = 1,
+                        req_id: "int | None" = None):
         """ISSUE 5: the async twin of read_vectored's routing — chunks fan
         per file onto member rings (member i → ring i mod N, stable) and
         each ring gets its own child StreamToken; completions map back to
@@ -323,7 +326,8 @@ class MultiRingEngine(Engine):
                 ch, imap = per_ring[r]
                 parts.append((r, self._children[r],
                               self._children[r].submit_vectored(
-                                  ch, dest, retries=retries), imap))
+                                  ch, dest, retries=retries,
+                                  req_id=req_id), imap))
         except BaseException:
             for _, child, ctok, _ in parts:
                 try:
@@ -333,7 +337,7 @@ class MultiRingEngine(Engine):
             for lk in locks:
                 lk.release()
             raise
-        tok = _FanToken(chunks, parts, locks)
+        tok = _FanToken(chunks, parts, locks, req_id=req_id)
         self._track_token(tok)
         if tok.done:  # empty gather
             tok._release_locks()

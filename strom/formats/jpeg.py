@@ -324,21 +324,35 @@ class DecodePool:
         self._err_lock = threading.Lock()
         self._closed = False
 
+    @staticmethod
+    def _worker_span(req):
+        """The per-sample decode span: request-linked when the submitter
+        was inside a traced request (ISSUE 8 — *req* is captured at SUBMIT
+        time, because the worker thread has no contextvar of its own),
+        else the plain ring span."""
+        if req is not None:
+            return req.span("decode.worker", cat="decode")
+        return ring.span("decode.worker", cat="decode")
+
     def map(self, fn: Callable[..., np.ndarray],
             items: Iterable, *extra: Sequence) -> list[np.ndarray]:
+        from strom.obs import request as _request
+
+        req = _request.current()
+
         def traced(*a) -> np.ndarray:
             # worker span on the shared timeline: per-sample decode+transform
             # (the legacy allocating path; the slot path traces in _one_into)
-            with ring.span("decode.worker", cat="decode"):
+            with self._worker_span(req):
                 return fn(*a)
 
         return list(self._pool.map(traced, items, *extra))
 
     # -- direct-to-slot mapping --------------------------------------------
     def _one_into(self, fn: Callable[..., np.ndarray], item,
-                  rng, row: np.ndarray) -> None:
+                  rng, row: np.ndarray, req=None) -> None:
         try:
-            with ring.span("decode.worker", cat="decode"):
+            with self._worker_span(req):
                 fn(item, rng, out=row)
         except ValueError:
             # per-sample failure policy: a truncated/corrupt member costs
@@ -353,7 +367,10 @@ class DecodePool:
         """One decode+transform job writing its result into *row* (the
         failure policy applied) — the unit the overlapped per-device
         delivery completes on."""
-        return self._pool.submit(self._one_into, fn, item, rng, row)
+        from strom.obs import request as _request
+
+        return self._pool.submit(self._one_into, fn, item, rng, row,
+                                 _request.current())
 
     def map_into(self, fn: Callable[..., np.ndarray], items: Sequence,
                  rngs: Sequence, out: np.ndarray) -> np.ndarray:

@@ -17,6 +17,16 @@ actually waiting on?":
 - :mod:`strom.obs.stall` — per-step stall attribution: split step wall time
   into ingest-wait / decode / put / compute buckets from the ring and report
   ``goodput_pct``.
+- :mod:`strom.obs.request` — causal request tracing (ISSUE 8): a ``req_id``
+  minted per gather/batch, propagated queue→grant→engine slice→cache→
+  decode→put as parent-linked spans + Chrome-trace flow events.
+- :mod:`strom.obs.exemplars` — tail-based sampling: full span trees
+  retained only for slow / throttled / errored requests.
+- :mod:`strom.obs.slo` — per-tenant SLO targets with fast/slow-window
+  burn-rate math, surfaced on ``/slo`` and as ``slo_*`` gauges.
+- :mod:`strom.obs.history` — a bounded ring of periodic stats snapshots
+  (``/history``): true ``rate()`` without an external TSDB.
+- :mod:`strom.obs.flight` — the always-on flight recorder (crash bundles).
 """
 
 from strom.obs.events import EventRing, ring  # noqa: F401

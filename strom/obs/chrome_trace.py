@@ -29,6 +29,13 @@ def to_trace_events(events: list[dict], *, pid: int | None = None
               "pid": pid, "tid": e["tid"], "cat": e.get("cat") or "strom"}
         if e["ph"] == "X":
             te["dur"] = e.get("dur_us", 0.0)
+        elif e["ph"] in ("s", "t", "f"):
+            # flow events: id connects the chain; bind to the ENCLOSING
+            # slice at this timestamp so the arrow lands on the request's
+            # span rather than a bare track position
+            te["id"] = e.get("id", 0)
+            if e["ph"] != "s":
+                te["bp"] = "e"
         else:
             te["s"] = "t"  # instant scope: thread
         if e.get("args"):
@@ -65,13 +72,15 @@ def load_events(path: str) -> list[dict]:
     tes = doc["traceEvents"] if isinstance(doc, dict) else doc
     out = []
     for te in tes:
-        if te.get("ph") not in ("X", "i"):
+        if te.get("ph") not in ("X", "i", "s", "t", "f"):
             continue
         e = {"ts_us": float(te.get("ts", 0.0)), "tid": te.get("tid", 0),
              "cat": te.get("cat", ""), "name": te.get("name", ""),
              "ph": te["ph"]}
         if te["ph"] == "X":
             e["dur_us"] = float(te.get("dur", 0.0))
+        if te["ph"] in ("s", "t", "f"):
+            e["id"] = te.get("id", 0)
         if te.get("args"):
             e["args"] = te["args"]
         out.append(e)

@@ -30,8 +30,12 @@ import threading
 import time
 
 # instant events use dur_us = -1 so snapshot() can tell them apart without a
-# second per-event field
+# second per-event field; flow events (the Chrome-trace s/t/f arrows that
+# connect one request's spans across threads — ISSUE 8) ride the same slot
+# with their own sentinels, keeping the hot tuple shape unchanged
 _INSTANT = -1.0
+_FLOW = {"s": -2.0, "t": -3.0, "f": -4.0}
+_FLOW_PH = {v: k for k, v in _FLOW.items()}
 
 
 class EventRing:
@@ -81,6 +85,16 @@ class EventRing:
             return
         self._append((self.now_us(), _INSTANT, threading.get_ident(), cat,
                       name, args))
+
+    def flow(self, phase: str, flow_id: int, name: str,
+             cat: str = "") -> None:
+        """Record a flow event (chrome 's'/'t'/'f'): consecutive events of
+        one *flow_id* render as arrows connecting the spans that enclose
+        them — the per-request causal chain (strom/obs/request.py)."""
+        if not self.enabled:
+            return
+        self._append((self.now_us(), _FLOW[phase], threading.get_ident(),
+                      cat, name, {"id": int(flow_id)}))
 
     @contextlib.contextmanager
     def span(self, name: str, cat: str = "", args: dict | None = None):
@@ -142,6 +156,12 @@ class EventRing:
             if ev is None:  # cleared ring / not yet wrapped
                 continue
             ts, dur, tid, cat, name, args = ev
+            if dur in _FLOW_PH:
+                d = {"ts_us": ts, "tid": tid, "cat": cat, "name": name,
+                     "ph": _FLOW_PH[dur],
+                     "id": (args or {}).get("id", 0)}
+                out.append(d)
+                continue
             d = {"ts_us": ts, "tid": tid, "cat": cat, "name": name,
                  "ph": "i" if dur == _INSTANT else "X"}
             if dur != _INSTANT:

@@ -57,6 +57,10 @@ FLIGHT_FIELDS = (
     "engine_inflight",      # engine queue occupancy at the sample instant
     "ring_events_written",  # event-ring total writes (activity rate)
     "ring_events_dropped",  # event-ring overwrites (history loss)
+    "exemplars_retained",   # tail-sampled slow/throttled/errored request
+                            # trees held by the exemplar store (ISSUE 8) —
+                            # a climbing delta during a stall episode says
+                            # the slowness is requests, not the consumer
 )
 
 # bundle members (atomic dir contents); flight.json is the manifest
@@ -64,6 +68,7 @@ BUNDLE_MANIFEST = "flight.json"
 BUNDLE_TRACE = "trace.json"
 BUNDLE_STATS = "stats.json"
 BUNDLE_STACKS = "stacks.txt"
+BUNDLE_EXEMPLARS = "exemplars.json"
 
 
 def thread_stacks() -> str:
@@ -92,6 +97,12 @@ def load_bundle(path: str) -> dict:
         out["stats"] = json.load(f)
     with open(os.path.join(path, BUNDLE_STACKS)) as f:
         out["stacks"] = f.read()
+    # exemplars joined the bundle in ISSUE 8; bundles dumped before then
+    # must still load (the whole point of a stable bundle format)
+    exp = os.path.join(path, BUNDLE_EXEMPLARS)
+    if os.path.exists(exp):
+        with open(exp) as f:
+            out["exemplars"] = json.load(f)
     return out
 
 
@@ -102,6 +113,7 @@ def capture_doc(*, ctx=None, ring: EventRing | None = None,
     /flight route serves this even when no FlightRecorder is configured;
     :meth:`FlightRecorder.capture` layers its sample history on top."""
     from strom.obs.chrome_trace import trace_document
+    from strom.obs.exemplars import store as _exemplars
     from strom.utils.stats import global_stats
 
     ring = ring or _global_ring
@@ -121,6 +133,9 @@ def capture_doc(*, ctx=None, ring: EventRing | None = None,
         "stats": stats,
         "stacks": thread_stacks(),
         "trace": trace_document(ring.snapshot()),
+        # the tail-sampled span trees (ISSUE 8 satellite): a crash/stall
+        # bundle now carries the slowest recent requests, whole
+        "exemplars": _exemplars.snapshot(),
     }
 
 
@@ -192,6 +207,8 @@ class FlightRecorder:
                     slab = int(pool.stats().get("slab_in_use_bytes", 0))
             with contextlib.suppress(Exception):
                 inflight = int(ctx.engine.in_flight())
+        from strom.obs.exemplars import store as _exemplars
+
         return {
             "ts_s": round(time.monotonic() - self._t0, 3),
             "pipeline_steps":
@@ -201,6 +218,7 @@ class FlightRecorder:
             "engine_inflight": inflight,
             "ring_events_written": self._ring.events_written,
             "ring_events_dropped": self._ring.events_dropped,
+            "exemplars_retained": _exemplars.retained,
         }
 
     def samples(self) -> list[dict]:
@@ -316,6 +334,8 @@ class FlightRecorder:
             json.dump(cap["stats"], f, default=str)
         with open(os.path.join(tmp, BUNDLE_STACKS), "w") as f:
             f.write(cap["stacks"])
+        with open(os.path.join(tmp, BUNDLE_EXEMPLARS), "w") as f:
+            json.dump(cap.get("exemplars", {}), f, default=str)
         if os.path.isdir(final):  # a previous half-life of this serial
             final = final + f"-{int(time.time())}"
         os.rename(tmp, final)

@@ -16,8 +16,21 @@ same way — four routes, no dependencies beyond ``http.server``:
   amortizes even the cheap ones.
 - ``GET /stats``   — the same sections as a JSON snapshot (scopes
   included), for humans and dashboards that want structure.
+  ``?sections=sched,cache`` restricts the section sweep exactly like
+  /metrics — the polling dashboard (tools/strom_top.py) never pays for
+  the ~170ms stall-attribution section.
 - ``GET /trace``   — the event ring as Trace Event JSON: ``curl -o
   trace.json localhost:<port>/trace`` mid-run, load in Perfetto.
+  ``?cat=read,sched`` and ``?since_us=<ring time>`` filter server-side so
+  a large ring no longer dumps wholesale on every scrape (request flow
+  events and ``req.done`` instants both live under cat=req). A malformed
+  numeric filter is the client's fault: 400, not 500.
+- ``GET /slo``     — the per-tenant SLO engine's report (ISSUE 8): one
+  row per tenant with targets, good%, fast/slow-window burn rates and
+  the burning verdict. 404 when the owning context has no SLO engine.
+- ``GET /history`` — the bounded snapshot-history ring
+  (strom/obs/history.py): ``?since_s=`` / ``?keys=a,b`` filter; true
+  ``rate()`` math without an external TSDB. 404 without a history.
 - ``GET /tenants`` — the multi-tenant scheduler's state (ISSUE 7): one
   row per registered tenant (priority class, weight, queue depth/bytes,
   budget balances, grant totals) plus the slab-pool admission gate.
@@ -57,6 +70,10 @@ from strom.obs.events import EventRing, ring as _global_ring
 _NON_EXPOSITION_SECTIONS = frozenset({"scopes"})
 
 
+class _BadQuery(ValueError):
+    """Malformed query parameter: the client's fault → 400, not 500."""
+
+
 class MetricsServer:
     """Background HTTP server over a stats callable and an event ring.
 
@@ -78,6 +95,8 @@ class MetricsServer:
         self._flight = flight
         self._ctx = ctx
         self._ttl = max(float(section_ttl_s), 0.0)
+        # last SloEngine.report() refresh driven by a /metrics scrape
+        self._slo_refreshed = float("-inf")
         # per-section rendered exposition cache: name -> (monotonic_t, text)
         self._sec_cache: dict[str, tuple[float, str]] = {}
         self._known_sections: list[str] = []
@@ -95,6 +114,18 @@ class MetricsServer:
                 self.end_headers()
                 self.wfile.write(body)
 
+            def _qfloat(self, q: dict, key: str) -> "float | None":
+                """Numeric query param, or None when absent. Junk is the
+                client's fault: 400 via _BadQuery, not the generic 500
+                (the same contract POST /tenants has for bad fields)."""
+                if key not in q:
+                    return None
+                try:
+                    return float(q[key][0])
+                except ValueError:
+                    raise _BadQuery(
+                        f"{key}={q[key][0]!r} is not a number") from None
+
             def do_GET(self) -> None:  # noqa: N802 (http.server API)
                 path, _, query = self.path.partition("?")
                 q = urllib.parse.parse_qs(query)
@@ -107,10 +138,25 @@ class MetricsServer:
                         self._send(200, server._metrics(only).encode(),
                                    "text/plain; version=0.0.4")
                     elif path == "/stats":
-                        self._send(200, json.dumps(server._stats()).encode(),
+                        only = None
+                        if "sections" in q:
+                            only = [s for part in q["sections"]
+                                    for s in part.split(",") if s]
+                        self._send(200,
+                                   json.dumps(server._stats(only)).encode(),
                                    "application/json")
                     elif path == "/trace":
-                        doc = trace_document(server._ring.snapshot())
+                        events = server._ring.snapshot()
+                        if "cat" in q:
+                            cats = {c for part in q["cat"]
+                                    for c in part.split(",") if c}
+                            events = [e for e in events
+                                      if e.get("cat") in cats]
+                        lo = self._qfloat(q, "since_us")
+                        if lo is not None:
+                            events = [e for e in events
+                                      if e["ts_us"] >= lo]
+                        doc = trace_document(events)
                         self._send(200, json.dumps(doc).encode(),
                                    "application/json")
                     elif path == "/tenants":
@@ -123,6 +169,29 @@ class MetricsServer:
                                        json.dumps(sched.tenants_info(),
                                                   default=str).encode(),
                                        "application/json")
+                    elif path == "/slo":
+                        slo = getattr(server._ctx, "slo", None)
+                        if slo is None:
+                            self._send(404, b"no SLO engine on this "
+                                            b"context\n", "text/plain")
+                        else:
+                            self._send(200,
+                                       json.dumps(slo.report(),
+                                                  default=str).encode(),
+                                       "application/json")
+                    elif path == "/history":
+                        hist = getattr(server._ctx, "history", None)
+                        if hist is None:
+                            self._send(404, b"no stats history on this "
+                                            b"context\n", "text/plain")
+                        else:
+                            since = self._qfloat(q, "since_s")
+                            keys = [k for part in q.get("keys", [])
+                                    for k in part.split(",") if k] or None
+                            self._send(200,
+                                       json.dumps(hist.snapshot(
+                                           since, keys)).encode(),
+                                       "application/json")
                     elif path == "/flight":
                         dump = q.get("dump", ["0"])[0] not in ("0", "", "no")
                         self._send(200,
@@ -131,7 +200,12 @@ class MetricsServer:
                                    "application/json")
                     else:
                         self._send(404, b"not found: try /metrics /stats "
-                                        b"/trace /flight /tenants\n",
+                                        b"/trace /flight /tenants /slo "
+                                        b"/history\n",
+                                   "text/plain")
+                except _BadQuery as e:
+                    with contextlib.suppress(Exception):
+                        self._send(400, f"bad query: {e}\n".encode(),
                                    "text/plain")
                 except Exception as e:  # a scrape must never kill the server
                     with contextlib.suppress(Exception):
@@ -240,15 +314,33 @@ class MetricsServer:
             return [self._sec_cache[s][1] for s in wanted
                     if s in self._sec_cache]
 
+    def _refresh_slo(self) -> None:
+        """The ``slo_*`` gauges are written by ``SloEngine.report()`` —
+        without this, only a ``/slo`` hit would refresh them, and the
+        documented /metrics contract (labeled burn-rate gauges) would show
+        stale zeros to a Prometheus-only deployment. TTL-guarded like the
+        section cache so rapid scrapes don't recompute the windows."""
+        slo = getattr(self._ctx, "slo", None)
+        if slo is None:
+            return
+        now = time.monotonic()
+        with self._cache_lock:
+            if now - self._slo_refreshed < self._ttl:
+                return
+            self._slo_refreshed = now
+        with contextlib.suppress(Exception):
+            slo.report()
+
     def _metrics(self, only: "list[str] | None" = None) -> str:
         from strom.utils.stats import global_stats
 
+        self._refresh_slo()
         return global_stats.prometheus() + "".join(self._section_texts(only))
 
-    def _stats(self) -> dict:
+    def _stats(self, only: "list[str] | None" = None) -> dict:
         from strom.utils.stats import global_stats
 
-        return {"sections": self._call_stats(),
+        return {"sections": self._call_stats(only),
                 "global": global_stats.snapshot(),
                 "scopes": global_stats.scopes_snapshot(),
                 "events_dropped": self._ring.events_dropped}

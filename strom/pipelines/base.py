@@ -17,6 +17,7 @@ from typing import Any, Callable, Iterator
 import numpy as np
 
 from strom.delivery.prefetch import Prefetcher
+from strom.obs import request as _request
 from strom.obs.events import ring
 from strom.pipelines.sampler import (EpochShuffleSampler, SamplerState,
                                      dataset_fingerprint, load_loader_state,
@@ -37,10 +38,14 @@ class Pipeline:
                  on_close: Callable[[], None] | None = None,
                  decode_pool: Any | None = None,
                  epoch_sync: bool = False,
-                 scope: Any | None = None):
+                 scope: Any | None = None,
+                 req_owner: Any | None = None):
         self.sampler = sampler
         self.fingerprint = fingerprint or {}
         self._on_close = on_close
+        # the owning context's request-owner token (ISSUE 8): step requests
+        # minted here carry it so only that context's SLO engine ingests them
+        self._req_owner = req_owner
         # telemetry scope (ISSUE 6): label-scoped stats view the pipeline's
         # step/prefetch accounting writes through, so concurrent pipelines
         # on one context surface distinguishable per-scope series. None =
@@ -96,9 +101,15 @@ class Pipeline:
         # the consumer-blocked window: everything the consumer spends inside
         # the data loader (stall attribution's ingest_wait bucket — the
         # decode/put/read spans overlapping THIS window are what the step
-        # was actually waiting on)
-        with ring.span("pipeline.next", cat="ingest_wait",
-                       args={"step": self._consumed}):
+        # was actually waiting on). Each __next__ is a traced "step"
+        # request (ISSUE 8): the wait span carries its req_id, and the
+        # request feeds the exemplar store so an outlier step's tree is
+        # retained — the batch-build requests themselves are minted where
+        # the work happens (make_batch, on the prefetcher's threads).
+        tname = getattr(self.scope, "labels", {}).get("tenant")
+        with _request.active("step", tname, owner=self._req_owner), \
+                _request.span("pipeline.next", cat="ingest_wait",
+                              args={"step": self._consumed}):
             batch = next(self._prefetcher)
         self._consumed += 1
         # step-progress heartbeat: the flight recorder's watchdog

@@ -34,6 +34,7 @@ import numpy as np
 from strom.delivery.core import StromContext
 from strom.formats.jpeg import (DecodePool, decode_jpeg,
                                 make_train_transform, random_resized_crop)
+from strom.obs import request as _request
 from strom.formats.wds import WdsShardSet
 from strom.pipelines.base import Pipeline, _auto_depth_bounds, resolve_state
 from strom.pipelines.sampler import EpochShuffleSampler, SamplerState
@@ -258,6 +259,10 @@ def _decode_put_streamed(ctx: StromContext, pool: DecodePool, tf: Transform,
 
     scope = scope or global_stats
     g = ctx.stream_segments(el, [Segment(0, 0, el.size)], buf, scope=scope)
+    # the batch's traced request (ISSUE 8): minted by the make_batch
+    # wrapper on THIS thread; the pump thread re-enters it so the poll
+    # loop's scheduler/cache/decode-dispatch work shares the req_id
+    req = _request.current()
 
     def submit_sample(i: int) -> None:
         isz, lsz = sizes[i]
@@ -279,6 +284,10 @@ def _decode_put_streamed(ctx: StromContext, pool: DecodePool, tf: Transform,
         f.add_done_callback(lambda fut, p=i: events.put(("decoded", p, fut)))
 
     def pump() -> None:
+        with _request.attach(req):
+            _pump()
+
+    def _pump() -> None:
         try:
             # degenerate rows (0-byte image+label members) have no extents
             # to wait for: dispatch them up front, or their countdown never
@@ -521,6 +530,13 @@ def make_wds_vision_pipeline(ctx: StromContext, paths: Sequence[str], *,
             (batch,), label_sharding, lbl_shards)
         return imgs, lbls
 
+    def traced_make_batch(indices: np.ndarray, serial: int):
+        # one traced request per batch build (ISSUE 8): the gather (pread
+        # or streamed), scheduler waits, decode jobs and device_puts below
+        # all join this request's lane — nested mint sites reuse it
+        with _request.active("batch", tname, owner=ctx._req_owner):
+            return make_batch(indices, serial)
+
     depth = prefetch_depth if prefetch_depth is not None else ctx.config.prefetch_depth
     auto, max_depth = _auto_depth_bounds(
         ctx, auto_prefetch, len(local_rows) * image_size * image_size * 3)
@@ -531,10 +547,11 @@ def make_wds_vision_pipeline(ctx: StromContext, paths: Sequence[str], *,
         lambda indices: ss.batch_extents([int(indices[r]) for r in local_rows],
                                          [image_ext, label_ext]),
         tenant=tname)
-    return Pipeline(sampler, make_batch, depth=depth, auto_depth=auto,
+    return Pipeline(sampler, traced_make_batch, depth=depth, auto_depth=auto,
                     max_depth=max_depth, fingerprint=fp,
                     on_close=_chain_close(ra.close if ra else None, pool.close),
-                    decode_pool=pool, scope=pscope)
+                    decode_pool=pool, scope=pscope,
+                    req_owner=ctx._req_owner)
 
 
 def make_predecoded_vision_pipeline(ctx: StromContext, paths: Sequence[str], *,
@@ -587,11 +604,12 @@ def make_predecoded_vision_pipeline(ctx: StromContext, paths: Sequence[str], *,
     shape = (batch, image_size, image_size, 3)
 
     def make_batch(indices: np.ndarray, serial: int) -> tuple[Any, Any]:
-        el = shards.extents([int(i) for i in indices])
-        imgs = ctx.memcpy_ssd2tpu(el, shape=shape, dtype=np.uint8,
-                                  sharding=sharding, tenant=tname)
-        lbls = jax.device_put(shards.labels(indices), label_sharding)
-        return imgs, lbls
+        with _request.active("batch", tname, owner=ctx._req_owner):
+            el = shards.extents([int(i) for i in indices])
+            imgs = ctx.memcpy_ssd2tpu(el, shape=shape, dtype=np.uint8,
+                                      sharding=sharding, tenant=tname)
+            lbls = jax.device_put(shards.labels(indices), label_sharding)
+            return imgs, lbls
 
     depth = prefetch_depth if prefetch_depth is not None else ctx.config.prefetch_depth
     auto, max_depth = _auto_depth_bounds(
@@ -604,7 +622,8 @@ def make_predecoded_vision_pipeline(ctx: StromContext, paths: Sequence[str], *,
         tenant=tname)
     return Pipeline(sampler, make_batch, depth=depth, auto_depth=auto,
                     max_depth=max_depth, fingerprint=fp,
-                    on_close=ra.close if ra else None, scope=pscope)
+                    on_close=ra.close if ra else None, scope=pscope,
+                    req_owner=ctx._req_owner)
 
 
 def make_imagenet_resnet_pipeline(ctx: StromContext, paths: Sequence[str], *,

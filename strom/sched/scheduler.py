@@ -127,6 +127,10 @@ class IoScheduler:
         self.admission = AdmissionGate(
             pool, getattr(config, "sched_high_water", 0.9),
             scope=self._scope, clock=clock)
+        # SLO hook (ISSUE 8): a callable name -> bool set by the owning
+        # context (ctx.slo.burning) so /tenants rows flag tenants that are
+        # burning their error budget — the scheduler stays SLO-agnostic
+        self.slo_hook: "Callable[[str], bool] | None" = None
         self._default = self.register(_DEFAULT_TENANT, _label=False)
 
     # -- tenant registry ----------------------------------------------------
@@ -175,7 +179,16 @@ class IoScheduler:
         gate's state — the /tenants route body."""
         with self._cond:
             tenants = list(self._tenants.values())
-        return {"tenants": {t.name: t.info() for t in tenants},
+        rows = {}
+        for t in tenants:
+            row = t.info()
+            if self.slo_hook is not None:
+                # burn-rate flag from the SLO engine (ISSUE 8): a throttled
+                # / slow tenant is visible where the operator already looks
+                with contextlib.suppress(Exception):
+                    row["slo_burning"] = bool(self.slo_hook(t.name))
+            rows[t.name] = row
+        return {"tenants": rows,
                 "admission": self.admission.state(),
                 "exclusive": self.exclusive,
                 "engine": getattr(self.engine, "name", "?")}
@@ -269,10 +282,14 @@ class IoScheduler:
         """Queue for (and block until) an engine grant. Returns the waiter
         handle to pass to :meth:`release`. Non-exclusive engines grant
         immediately (budgets still charged, waits still possible)."""
+        from strom.obs import request as _request
+        from strom.obs.events import ring as _ring
+
         t = self.resolve(tenant)
         prio = PRIORITY_ORDER[priority] if priority is not None \
             else PRIORITY_ORDER[t.priority]
         w = _Waiter(t, max(int(nbytes), 0), prio, self._clock())
+        enq_us = _ring.now_us()
         with self._cond:
             self._enqueue_locked(w)
             t.scope.set_gauge("sched_queue_depth", len(t.queue))
@@ -302,6 +319,18 @@ class IoScheduler:
         t.scope.add("sched_granted_ops")
         if w.nbytes:
             t.scope.add("sched_granted_bytes", w.nbytes)
+        # causal request tracing (ISSUE 8): the queue wait becomes a span
+        # on the request's lane (throttled verdict included — the exemplar
+        # store and SLO engine key off it), billed to the request that
+        # queued, not just the tenant aggregate
+        req = _request.current()
+        if req is not None:
+            req.note_queue_wait(w.wait_s * 1e6, throttled=w.throttled)
+            req.record("sched.queue", "sched", enq_us,
+                       _ring.now_us() - enq_us,
+                       args={"tenant": t.name, "bytes": w.nbytes,
+                             "throttled": w.throttled},
+                       parent=req.parent_of())
         if self.exclusive and t.scope is not self._scope:
             # exclusive ownership means no concurrent submitter: steer the
             # engine's per-op accounting (engine_op_lat_us histogram,
@@ -326,11 +355,29 @@ class IoScheduler:
               *, priority: str | None = None):
         """``with sched.grant(tenant, nbytes):`` — the scheduler-era
         spelling of ``with ctx._engine_lock:``."""
+        from strom.obs import request as _request
+        from strom.obs.events import ring as _ring
+
+        # request AND parent captured at ENTRY: the exit may run on another
+        # thread (a streamed gather releases at drain, on the pump side)
+        # where the contextvar isn't set and parent_of() would read the
+        # wrong thread's open-span stack
+        req = _request.current()
+        parent = req.parent_of() if req is not None else None
         w = self.acquire(tenant, nbytes, priority=priority)
+        grant_us = _ring.now_us()
         try:
             yield w
         finally:
             self.release(w)
+            if req is not None:
+                # the engine-ownership window on the request's lane: how
+                # long this request held (its share of) the arbiter
+                req.record("sched.grant", "sched", grant_us,
+                           _ring.now_us() - grant_us,
+                           args={"tenant": w.tenant.name,
+                                 "bytes": w.nbytes},
+                           parent=parent)
 
     # -- sliced gather execution (the delivery hot path) --------------------
     def _slice_bytes(self) -> int:
@@ -370,11 +417,16 @@ class IoScheduler:
         ~``sched_slice_bytes`` of this gather instead of all of it.
         Byte-identical to ``engine.read_vectored(chunks, dest)`` (slices
         preserve chunk order; dest ranges are disjoint)."""
+        from strom.obs import request as _request
+
         t = self.resolve(tenant)
         total = 0
-        for sl in self.iter_slices(chunks):
+        for si, sl in enumerate(self.iter_slices(chunks)):
             nbytes = sum(ln for (_, _, _, ln) in sl)
-            with self.grant(t, nbytes, priority=priority):
+            with self.grant(t, nbytes, priority=priority), \
+                    _request.span("engine.slice", cat="read",
+                                  args={"slice": si, "ops": len(sl),
+                                        "bytes": nbytes}):
                 total += self.engine.read_vectored(sl, dest, retries=retries)
         return total
 

@@ -44,4 +44,13 @@ def trace_span(name: str, *, enabled: bool = True, cat: str = "",
             yield
     finally:
         if ring.enabled:
-            ring.complete(t0, ring.now_us() - t0, cat, name, args)
+            # request-linked when inside a traced request (ISSUE 8): the
+            # device_put spans riding this helper join the batch's lane
+            from strom.obs import request as _request
+
+            req = _request.current()
+            if req is not None:
+                req.record(name, cat, t0, ring.now_us() - t0, args,
+                           parent=req.parent_of())
+            else:
+                ring.complete(t0, ring.now_us() - t0, cat, name, args)

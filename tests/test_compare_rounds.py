@@ -172,6 +172,25 @@ def test_stream_keys_match_producers():
             f"no {suffix!r} (renamed column?)"
 
 
+def test_slo_keys_match_producers():
+    """Producer↔report key parity for the request-latency / SLO section
+    (ISSUE 8, the decode/stall/cache/stream/sched pattern): every
+    <arm>_req_lat_* / <arm>_slo_ok column must be an arm prefix plus a
+    key the vision bench arms actually emit (single-sourced in
+    strom.obs.slo.SLO_BENCH_FIELDS)."""
+    from strom.obs.slo import SLO_BENCH_FIELDS
+
+    prefixes = ("resnet", "vit")
+    produced = set(SLO_BENCH_FIELDS)
+    for key in compare_rounds.SLO_KEYS:
+        prefix = next((p for p in prefixes if key.startswith(p + "_")), None)
+        assert prefix is not None, key
+        suffix = key[len(prefix) + 1:]
+        assert suffix in produced, \
+            f"compare_rounds consumes {key!r} but the bench arms produce " \
+            f"no {suffix!r} (renamed column?)"
+
+
 def test_sched_section_renders(artifacts, capsys):
     """r7+ artifacts get the multi-tenant section with the no-starvation
     row (light tenant queue-wait p99)."""

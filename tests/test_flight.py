@@ -88,6 +88,15 @@ class TestWatchdog:
 
 class TestBundle:
     def test_dump_round_trip(self, tmp_path):
+        # seed the global exemplar store: a crash bundle must carry the
+        # tail-sampled span trees of the slowest recent requests (ISSUE 8)
+        from strom.obs.exemplars import store
+        from strom.obs.request import Request
+
+        store.clear()
+        req = Request("gather", "flight-t0")
+        req.note_queue_wait(123.0, throttled=True)
+        req.finish()
         ring = EventRing(capacity=64)
         ring.complete(0.0, 5.0, "read", "t.read", {"bytes": 3})
         with mk_recorder(tmp_path, ring=ring) as rec:
@@ -101,6 +110,14 @@ class TestBundle:
                    for ev in b["trace"]["traceEvents"])
         assert "global" in b["stats"] and "scopes" in b["stats"]
         assert "thread" in b["stacks"]
+        # exemplars member round-trips, throttled request tree included,
+        # and the watchdog samples carry the retention counter
+        exs = b["exemplars"]["tenants"]["flight-t0"]
+        assert any(e["req"] == req.id and e["throttled"] for e in exs)
+        assert b["exemplars"]["exemplars_retained"] >= 1
+        assert all("exemplars_retained" in s
+                   for s in b["manifest"]["samples"])
+        store.clear()
 
     def test_dump_atomic_no_tmp_left(self, tmp_path):
         with mk_recorder(tmp_path) as rec:

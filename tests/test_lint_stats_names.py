@@ -135,3 +135,39 @@ def test_repo_flight_and_sentinel_tuples_seen():
     assert "vsbaselinehost" in found      # SENTINEL_FIELDS via binding set
     # the repo actually uses scoped labels (pipeline=, tenant= in tests)
     assert "pipeline" in labels
+
+
+def test_repo_slo_and_exemplar_tuples_seen():
+    """SLO_FIELDS / SLO_BENCH_FIELDS (strom/obs/slo.py) and
+    EXEMPLAR_FIELDS (strom/obs/exemplars.py) ride the *_FIELDS scan
+    (ISSUE 8 satellite) so the burn-rate gauges, bench columns and
+    retention counters can't fork spellings from their producers."""
+    found, _labels = lint.scan_sources(_ROOT)
+    assert "sloburnfast" in found         # SLO_FIELDS
+    assert "reqlatp99us" in found         # SLO_BENCH_FIELDS
+    assert "exemplarsretained" in found   # EXEMPLAR_FIELDS + FLIGHT_FIELDS
+
+
+def test_route_doc_lint_repo_clean():
+    """Every do_GET/do_POST route literal in strom/obs/server.py must be
+    documented in README.md (ISSUE 8 satellite) — and the scan must
+    actually see the known routes, so clean means 'all documented', not
+    'nothing scanned'."""
+    routes, missing = lint.scan_routes(_ROOT)
+    assert {"/metrics", "/stats", "/trace", "/tenants", "/flight",
+            "/slo", "/history"} <= routes
+    assert missing == []
+
+
+def test_route_doc_lint_catches_undocumented(tmp_path):
+    """An undocumented route fails the lint with a pointed message."""
+    srv = tmp_path / "strom" / "obs"
+    os.makedirs(srv)
+    (srv / "server.py").write_text(
+        'if path == "/metrics":\n    pass\n'
+        'elif path == "/secret_route":\n    pass\n')
+    (tmp_path / "README.md").write_text("only /metrics documented here\n")
+    routes, missing = lint.scan_routes(str(tmp_path))
+    assert routes == {"/metrics", "/secret_route"}
+    assert missing == ["/secret_route"]
+    assert lint.main([str(tmp_path)]) == 1

@@ -46,7 +46,7 @@ _HERE = os.path.dirname(os.path.abspath(__file__))
 sys.path.insert(0, _HERE)  # runnable as a script from anywhere
 
 from compare_rounds import (BINDING_ORDER, CACHE_KEYS, DECODE_KEYS,  # noqa: E402
-                            STALL_KEYS, STREAM_KEYS, unwrap)
+                            SLO_KEYS, STALL_KEYS, STREAM_KEYS, unwrap)
 
 # The gated metric set: (metric, direction) over the single-sourced
 # comparison tuples, where direction is "up" (bigger is better) or "down"
@@ -78,6 +78,15 @@ SENTINEL_FIELDS = (
     ("resnet_warm_vs_cold", "up"),
     ("vit_warm_vs_cold", "up"),
     ("resnet_stream_samples_early", "up"),
+    # request-level latency (ISSUE 8): the traced-request p99 per vision
+    # arm — the end-to-end "how long did one batch's data take" clock the
+    # per-op engine histograms can't see (queue + cache + decode + put
+    # included). Host-CPU-bound on the fixture, so gated like the decode
+    # img/s trend; slo_ok is the burn-rate verdict (1 = no tenant burning)
+    ("resnet_req_lat_p99_us", "down"),
+    ("vit_req_lat_p99_us", "down"),
+    ("resnet_slo_ok", "up"),
+    ("vit_slo_ok", "up"),
 )
 
 # absolute slack for count-like "down" metrics around small values: going
@@ -86,7 +95,8 @@ SENTINEL_FIELDS = (
 ABS_SLACK = 2.0
 
 TABLE_KEYS = list(dict.fromkeys(
-    BINDING_ORDER + DECODE_KEYS + STALL_KEYS + CACHE_KEYS + STREAM_KEYS))
+    BINDING_ORDER + DECODE_KEYS + STALL_KEYS + CACHE_KEYS + STREAM_KEYS
+    + SLO_KEYS))
 
 
 def load_round(path: str) -> dict:

@@ -165,6 +165,21 @@ SCHED_KEYS = [
     "mt_vis1_vs_solo",
     "mt_vis1_sched_queue_wait_p99_us",
 ]
+# request latency / SLO (ISSUE 8 tentpole): per-arm request-level latency
+# percentiles over the traced gather/batch requests (req_lat — the
+# causal-tracing req_id lane, not the per-op engine clock) and the SLO
+# verdict (slo_ok = no tenant burning its error budget at arm end).
+# Suffixes single-sourced in strom.obs.slo.SLO_BENCH_FIELDS
+# (parity-tested in tests/test_compare_rounds.py, same contract as the
+# decode/stall/cache/stream/sched sections).
+SLO_KEYS = [
+    "resnet_req_lat_p50_us",
+    "resnet_req_lat_p99_us",
+    "resnet_slo_ok",
+    "vit_req_lat_p50_us",
+    "vit_req_lat_p99_us",
+    "vit_slo_ok",
+]
 # per-attempt / per-pass audit arrays (VERDICT.md r4 next #3): printed so
 # the best-of selection's discards are visible in the comparison too
 AUDIT_SUFFIXES = ("_attempts", "_passes")
@@ -299,9 +314,11 @@ def main(argv: list[str]) -> int:
                       for k in STREAM_KEYS)
     have_sched = any(cell(d, k) != "-" for _, d in rounds
                      for k in SCHED_KEYS)
+    have_slo = any(cell(d, k) != "-" for _, d in rounds
+                   for k in SLO_KEYS)
     name_w = max(len(k) for k in binding_keys + CONTEXT_KEYS + DECODE_KEYS
                  + STALL_KEYS + CACHE_KEYS + STREAM_KEYS + SCHED_KEYS
-                 + audit_keys) + 2
+                 + SLO_KEYS + audit_keys) + 2
     # every rendered cell folds into ONE column width, or rows misalign
     col_w = max(max(len(n) for n, _ in rounds) + 2, 12,
                 *(len(c) + 2 for cs in audit_cells.values() for c in cs),
@@ -350,6 +367,12 @@ def main(argv: list[str]) -> int:
         print("multi-tenant (2 vision + 1 parquet tenant concurrent; "
               "bounded mt_pq queue-wait p99 = no starvation):")
         for k in SCHED_KEYS:
+            print(k.ljust(name_w)
+                  + "".join(cell(d, k).rjust(col_w) for _, d in rounds))
+    if have_slo:
+        print("request latency / SLO (traced request p50/p99 per arm; "
+              "slo_ok=1 = no tenant burning):")
+        for k in SLO_KEYS:
             print(k.ljust(name_w)
                   + "".join(cell(d, k).rjust(col_w) for _, d in rounds))
     if audit_keys:

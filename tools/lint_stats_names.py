@@ -59,6 +59,35 @@ _STR_LIT = re.compile(r"""["']([^"'\n]+)["']""")
 # source roots that feed the global registry
 DEFAULT_ROOTS = ("strom", "tools", "bench.py")
 
+# HTTP route literals in the live server's handlers: `path == "/metrics"`
+# comparisons inside do_GET/do_POST (strom/obs/server.py). Every one must
+# be documented in README.md — an undocumented route is an API nobody can
+# find until they read the handler (ISSUE 8 satellite).
+_ROUTE_LIT = re.compile(r"""path\s*(?:==|!=)\s*["'](/[a-z_]*)["']""")
+SERVER_SOURCE = os.path.join("strom", "obs", "server.py")
+ROUTE_DOC = "README.md"
+
+
+def scan_routes(root_dir: str) -> tuple[set[str], list[str]]:
+    """(documented routes needed, missing-from-README routes). Routes come
+    from path-comparison literals in the server source; README.md is
+    matched on the literal route string."""
+    src = os.path.join(root_dir, SERVER_SOURCE)
+    doc = os.path.join(root_dir, ROUTE_DOC)
+    try:
+        with open(src) as f:
+            routes = set(_ROUTE_LIT.findall(f.read()))
+    except OSError:
+        return set(), []
+    routes.discard("/")  # a bare-root comparison is not an API surface
+    try:
+        with open(doc) as f:
+            readme = f.read()
+    except OSError:
+        readme = ""
+    missing = sorted(r for r in routes if r not in readme)
+    return routes, missing
+
 
 def _normalize(name: str) -> str:
     return name.replace("_", "").lower()
@@ -142,10 +171,11 @@ def main(argv: list[str] | None = None) -> int:
     found, labels = scan_sources(root)
     bad = collisions(found)
     bad_labels = collisions(labels)
-    if not bad and not bad_labels:
+    routes, undocumented = scan_routes(root)
+    if not bad and not bad_labels and not undocumented:
         print(f"lint_stats_names: {len(found)} distinct metric names + "
               f"{len(labels)} scope label keys, no case/underscore "
-              "collisions")
+              f"collisions; {len(routes)} server routes all documented")
         return 0
     for norm, uses in bad:
         print(f"metric name collision (normalized '{norm}'):",
@@ -157,9 +187,17 @@ def main(argv: list[str] | None = None) -> int:
               file=sys.stderr)
         for lit, where in sorted(uses):
             print(f"  {lit!r} at {where}", file=sys.stderr)
-    print(f"lint_stats_names: {len(bad) + len(bad_labels)} collision "
-          "group(s) — pick ONE spelling per metric/label",
-          file=sys.stderr)
+    for r in undocumented:
+        print(f"undocumented server route: {r!r} handled in "
+              f"{SERVER_SOURCE} but absent from {ROUTE_DOC}",
+              file=sys.stderr)
+    n_bad = len(bad) + len(bad_labels)
+    if n_bad:
+        print(f"lint_stats_names: {n_bad} collision group(s) — pick ONE "
+              "spelling per metric/label", file=sys.stderr)
+    if undocumented:
+        print(f"lint_stats_names: {len(undocumented)} undocumented "
+              f"route(s) — add them to {ROUTE_DOC}", file=sys.stderr)
     return 1
 
 

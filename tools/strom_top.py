@@ -1,0 +1,237 @@
+#!/usr/bin/env python
+"""strom_top — a live per-tenant dashboard over the strom HTTP surface.
+
+``top`` for the data plane: polls a running strom process (a daemon, a
+bench with ``--metrics-port``, any StromContext serving /metrics) and
+renders one row per tenant — queue depth and wait, granted byte rate,
+cache hit ratio, engine inflight, SLO burn rate — plus a global header.
+
+Usage:
+    python tools/strom_top.py --port 9000               # curses live view
+    python tools/strom_top.py --port 9000 --once        # one plain table
+    python tools/strom_top.py --url http://host:9000 --interval 1
+
+Data sources (all server-side-filtered so a poll never pays for the
+expensive stall-attribution section):
+- ``/stats?sections=sched,cache`` — scheduler/cache sections + the scoped
+  (per-tenant labeled) registry snapshots;
+- ``/tenants`` — per-tenant queue/budget rows + the slo_burning flag;
+- ``/slo``     — burn rates per tenant.
+
+Byte/step rates are computed from deltas between consecutive polls (the
+server-side ``/history`` ring exists for external scrapers; strom_top
+keeps its own two-sample window instead of depending on it).
+
+Needs nothing beyond the stdlib; curses degrades to a repainted plain
+table when unavailable (``--once`` never touches curses at all).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import re
+import sys
+import time
+import urllib.error
+import urllib.request
+
+# columns of the per-tenant table, in render order
+COLUMNS = ("tenant", "prio", "queued", "active", "wait_p99_ms",
+           "granted_mb_s", "hit_pct", "burn_fast", "burn_slow", "slo")
+
+_TENANT_LABEL = re.compile(r'tenant="([^"]+)"')
+
+
+def fetch_json(base: str, route: str, timeout: float = 5.0):
+    """GET one route; None on 404 (feature off) — anything else raises."""
+    try:
+        with urllib.request.urlopen(base + route, timeout=timeout) as r:
+            return json.loads(r.read())
+    except urllib.error.HTTPError as e:
+        if e.code == 404:
+            return None
+        raise
+
+
+def _scope_tenants(scopes: dict) -> dict[str, dict]:
+    """{tenant: scoped snapshot} from the /stats scopes map (label strings
+    like ``pipeline="resnet",tenant="t0"`` — tenant-only scopes win over
+    refined ones so counters aren't double-read)."""
+    out: dict[str, dict] = {}
+    for lbl, snap in scopes.items():
+        m = _TENANT_LABEL.search(lbl)
+        if not m:
+            continue
+        name = m.group(1)
+        # prefer the pure tenant scope (exact label) over refined ones
+        if lbl == f'tenant="{name}"' or name not in out:
+            out[name] = snap
+    return out
+
+
+def sample(base: str) -> dict:
+    """One poll: everything the table needs, already tenant-keyed."""
+    stats = fetch_json(base, "/stats?sections=sched,cache") or {}
+    tenants = fetch_json(base, "/tenants") or {}
+    slo = fetch_json(base, "/slo") or {}
+    return {
+        "t": time.monotonic(),
+        "global": stats.get("global", {}),
+        "sections": stats.get("sections", {}),
+        "scopes": _scope_tenants(stats.get("scopes", {})),
+        "tenants": tenants.get("tenants", {}),
+        "admission": tenants.get("admission", {}),
+        "slo": slo.get("tenants", {}),
+    }
+
+
+def rows(cur: dict, prev: "dict | None") -> list[dict]:
+    """Per-tenant table rows from one (or two, for rates) samples."""
+    names = sorted(set(cur["tenants"]) | set(cur["scopes"]))
+    dt = (cur["t"] - prev["t"]) if prev else 0.0
+    out = []
+    for name in names:
+        trow = cur["tenants"].get(name, {})
+        scope = cur["scopes"].get(name, {})
+        srow = cur["slo"].get(name, {})
+        granted = None
+        if prev and dt > 0:
+            b1 = scope.get("sched_granted_bytes")
+            b0 = prev["scopes"].get(name, {}).get("sched_granted_bytes")
+            if b1 is not None and b0 is not None:
+                granted = max(b1 - b0, 0) / dt / 1e6
+        hit = miss = None
+        hb, mb = scope.get("cache_hit_bytes"), scope.get("cache_miss_bytes")
+        if hb is not None or mb is not None:
+            hit, miss = hb or 0, mb or 0
+        out.append({
+            "tenant": name,
+            "prio": trow.get("priority", "-"),
+            "queued": trow.get("queued_ops", 0),
+            "active": trow.get("active_grants", 0),
+            "wait_p99_ms": (scope.get("sched_queue_wait_p99_us") or 0) / 1e3,
+            "granted_mb_s": granted,
+            "hit_pct": (100.0 * hit / (hit + miss)
+                        if hit is not None and (hit + miss) else None),
+            "burn_fast": srow.get("slo_burn_fast"),
+            "burn_slow": srow.get("slo_burn_slow"),
+            "slo": ("BURNING" if (srow.get("slo_burning")
+                                  or trow.get("slo_burning")) else "ok"),
+        })
+    return out
+
+
+def _fmt(v, nd: int = 1) -> str:
+    if v is None:
+        return "-"
+    if isinstance(v, float):
+        return f"{v:.{nd}f}"
+    return str(v)
+
+
+def render(cur: dict, prev: "dict | None") -> str:
+    """The whole screen as text (shared by --once, plain loop and curses)."""
+    g = cur["global"]
+    sched = cur["sections"].get("sched", {})
+    lines = [
+        f"strom_top  pipeline_steps={g.get('pipeline_steps', 0)}"
+        f"  ssd2tpu_bytes={g.get('ssd2tpu_bytes', 0)}"
+        f"  inflight={sched.get('sched_active_grants', '-')}"
+        f"  queued={sched.get('sched_queued_ops', '-')}"
+        f"  admission_waits={sched.get('slab_pool_admission_waits', '-')}",
+        "",
+        (f"{'tenant':<14}{'prio':<13}{'queued':>7}{'active':>7}"
+         f"{'wait_p99_ms':>13}{'MB/s':>9}{'hit%':>7}"
+         f"{'burn_f':>8}{'burn_s':>8}  slo"),
+    ]
+    for r in rows(cur, prev):
+        lines.append(
+            f"{r['tenant']:<14}{r['prio']:<13}{r['queued']:>7}"
+            f"{r['active']:>7}{_fmt(r['wait_p99_ms']):>13}"
+            f"{_fmt(r['granted_mb_s']):>9}{_fmt(r['hit_pct']):>7}"
+            f"{_fmt(r['burn_fast'], 2):>8}{_fmt(r['burn_slow'], 2):>8}"
+            f"  {r['slo']}")
+    if len(lines) == 3:
+        lines.append("(no tenants registered — single-tenant context?)")
+    return "\n".join(lines)
+
+
+def run_once(base: str, settle_s: float = 0.5) -> int:
+    """Two quick polls (rates need a delta), one printed table."""
+    prev = sample(base)
+    time.sleep(settle_s)
+    cur = sample(base)
+    print(render(cur, prev))
+    return 0
+
+
+def run_plain(base: str, interval: float) -> int:
+    prev = None
+    try:
+        while True:
+            cur = sample(base)
+            sys.stdout.write("\x1b[2J\x1b[H" + render(cur, prev) + "\n")
+            sys.stdout.flush()
+            prev = cur
+            time.sleep(interval)
+    except KeyboardInterrupt:
+        return 0
+
+
+def run_curses(base: str, interval: float) -> int:
+    import curses
+
+    def loop(scr):
+        curses.curs_set(0)
+        scr.nodelay(True)
+        prev = None
+        while True:
+            cur = sample(base)
+            scr.erase()
+            for i, line in enumerate(render(cur, prev).split("\n")):
+                try:
+                    scr.addnstr(i, 0, line, max(scr.getmaxyx()[1] - 1, 1))
+                except curses.error:
+                    break  # terminal shorter than the table
+            scr.refresh()
+            prev = cur
+            t_end = time.monotonic() + interval
+            while time.monotonic() < t_end:
+                if scr.getch() in (ord("q"), 27):
+                    return
+                time.sleep(0.05)
+
+    curses.wrapper(loop)
+    return 0
+
+
+def main(argv: "list[str] | None" = None) -> int:
+    ap = argparse.ArgumentParser(prog="strom_top", description=__doc__)
+    ap.add_argument("--url", default=None,
+                    help="base URL (default http://127.0.0.1:<port>)")
+    ap.add_argument("--port", type=int, default=9000)
+    ap.add_argument("--host", default="127.0.0.1")
+    ap.add_argument("--interval", type=float, default=2.0)
+    ap.add_argument("--once", action="store_true",
+                    help="print one table and exit (no curses)")
+    args = ap.parse_args(argv)
+    base = args.url or f"http://{args.host}:{args.port}"
+    base = base.rstrip("/")
+    try:
+        if args.once:
+            return run_once(base)
+        try:
+            import curses  # noqa: F401
+        except ImportError:
+            return run_plain(base, args.interval)
+        if not sys.stdout.isatty():
+            return run_plain(base, args.interval)
+        return run_curses(base, args.interval)
+    except (urllib.error.URLError, ConnectionError, OSError) as e:
+        print(f"strom_top: cannot reach {base}: {e}", file=sys.stderr)
+        return 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
