@@ -893,6 +893,41 @@ def main() -> int:
                   file=sys.stderr)
             flush_partial(**loader_res)
 
+        # ISSUE 9: chaos resilience arm — the resnet JPEG loader run clean,
+        # then under the seeded 'chaos' fault plan (EIO + short reads +
+        # latency spikes injected into the engine op stream). chaos_ok=1
+        # means the faulted run COMPLETED with batches bit-identical to the
+        # clean pass (retries/failover/hedges absorbed every injected
+        # fault); chaos_slowdown is the bounded price paid (same-run ratio,
+        # weather-independent); the counter columns prove WHICH mechanism
+        # did the absorbing. Keys copy via the single-sourced
+        # CHAOS_BENCH_FIELDS tuple (parity-tested like the cache/sched
+        # sections); bench_sentinel gates chaos_ok up / chaos_slowdown down.
+        from strom.cli import bench_chaos
+        from strom.engine.resilience import CHAOS_BENCH_FIELDS
+
+        chargs = argparse.Namespace(
+            file=None, size=size, block=cfg.block_size, depth=32, iters=1,
+            engine="auto", tmpdir=args.tmpdir, json=True, batch=16,
+            image_size=64, steps=6, prefetch=2, decode_workers=4,
+            seed=0, fault_plan="", metrics_port=args.metrics_port)
+        chres = attempt("chaos", lambda: bench_chaos(chargs)) \
+            if phase_ok("chaos", 120) else None
+        if chres is not None:
+            for k in CHAOS_BENCH_FIELDS:
+                if k in chres:
+                    loader_res[k] = chres[k]
+            loader_res["chaos_fault_plan"] = chres.get("fault_plan")
+            print(f"chaos ({chres.get('fault_plan')}): ok="
+                  f"{chres.get('chaos_ok')} slowdown="
+                  f"{chres.get('chaos_slowdown')} over "
+                  f"{chres.get('chaos_faults_injected')} injected faults "
+                  f"({chres.get('chaos_chunk_retries')} retries, "
+                  f"{chres.get('chaos_failover_reads')} failovers, "
+                  f"{chres.get('chaos_hedges_fired')} hedges)",
+                  file=sys.stderr)
+            flush_partial(**loader_res)
+
     # --- numerator: one streamed memcpy_ssd2tpu ----------------------------
     # (engine reads piece k+1 while piece k streams host->HBM)
     # Capped at 512MiB: the relay link's token bucket holds ~0.5-1 GiB of

@@ -763,7 +763,30 @@ def _obs_config_kw(args: argparse.Namespace) -> dict:
     return {"metrics_port": int(getattr(args, "metrics_port", 0) or 0),
             "flight_dir": getattr(args, "flight_dir", "") or "",
             "flight_stall_s":
-                float(getattr(args, "flight_stall_s", 30.0) or 0.0)}
+                float(getattr(args, "flight_stall_s", 30.0) or 0.0),
+            # fault injection (ISSUE 9): --fault-plan wraps the engine in
+            # the FaultyEngine proxy — any bench arm runs under the plan's
+            # deterministic chaos (absent in driver-built Namespaces → off)
+            "fault_plan": getattr(args, "fault_plan", "") or ""}
+
+
+def _resil_delta(snap0: dict) -> dict:
+    """Resilience counter deltas since *snap0* (ISSUE 9 satellite): the
+    retry/hedge/breaker/failover columns, single-sourced in
+    ``strom.engine.resilience.RESILIENCE_FIELDS`` so the chaos arm, the
+    driver's copy loop and the compare_rounds "resilience" section read
+    one tuple. ``breaker_state`` is a live gauge (not delta'd)."""
+    from strom.engine.resilience import RESILIENCE_FIELDS
+    from strom.utils.stats import global_stats
+
+    snap1 = global_stats.snapshot()
+    out = {}
+    for k in RESILIENCE_FIELDS:
+        if k == "breaker_state":
+            out[k] = int(snap1.get(k, 0))
+        else:
+            out[k] = int(snap1.get(k, 0) - snap0.get(k, 0))
+    return out
 
 
 def _cache_config_kw(args: argparse.Namespace) -> dict:
@@ -1701,6 +1724,94 @@ def cmd_daemon(args: argparse.Namespace) -> dict:
             "tenants": n_tenants, "stuck": stuck, "signal": sig}
 
 
+def bench_chaos(args: argparse.Namespace) -> dict:
+    """Chaos arm (ISSUE 9 satellite): the resnet JPEG loader run twice over
+    one fixture — clean, then under a seeded fault plan (EIO + short reads
+    + latency spikes on the engine op stream). Every batch is hashed;
+    ``chaos_ok=1`` means the faulted run COMPLETED with batches
+    bit-identical to the clean pass (retries/failover/hedges absorbed the
+    injected chaos), ``chaos_slowdown`` is the bounded price paid, and the
+    resilience counter deltas say which mechanism did the absorbing. Keys
+    single-sourced in ``strom.engine.resilience.CHAOS_BENCH_FIELDS``."""
+    import hashlib
+
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from strom.config import StromConfig
+    from strom.delivery.core import StromContext
+    from strom.engine.resilience import CHAOS_BENCH_FIELDS  # noqa: F401 (contract)
+    from strom.parallel.mesh import make_mesh
+    from strom.pipelines import make_imagenet_resnet_pipeline
+    from strom.utils.stats import global_stats as _gs
+
+    path = args.file
+    if path is None:
+        path = _mk_wds_fixture(args.tmpdir, args.batch, args.image_size)
+    plan_spec = getattr(args, "fault_plan", "") or \
+        f"chaos:{int(getattr(args, 'seed', 0))}"
+    n_dev = _fit_dp_devices(args.batch)
+    mesh = make_mesh({"dp": n_dev}, devices=jax.devices()[:n_dev])
+    sharding = NamedSharding(mesh, P("dp", None, None, None))
+
+    def one_pass(fault_plan: str) -> tuple[float, list[str], int]:
+        # residency_hybrid off: the chaos pass must exercise the MEDIA op
+        # stream the plan's matchers see, not a page-cache memcpy
+        cfg = StromConfig(engine=args.engine, block_size=args.block,
+                          queue_depth=args.depth,
+                          num_buffers=max(args.depth * 2, 8),
+                          residency_hybrid=False, fault_plan=fault_plan)
+        _drop_cache_hint(path)
+        ctx = StromContext(cfg)
+        try:
+            with make_imagenet_resnet_pipeline(
+                    ctx, [path], batch=args.batch,
+                    image_size=args.image_size, sharding=sharding,
+                    prefetch_depth=args.prefetch,
+                    decode_workers=args.decode_workers) as pipe:
+                hashes = []
+                t0 = time.perf_counter()
+                for _ in range(args.steps):
+                    imgs, lbls = next(pipe)
+                    h = hashlib.sha256()
+                    h.update(np.asarray(imgs).tobytes())
+                    h.update(np.asarray(lbls).tobytes())
+                    hashes.append(h.hexdigest())
+                dt = time.perf_counter() - t0
+            injected = 0
+            plan = getattr(ctx.engine, "plan", None)
+            if plan is not None:
+                injected = plan.stats()["faults_injected"]
+            return (args.steps * args.batch / dt if dt else 0.0, hashes,
+                    injected)
+        finally:
+            ctx.close()
+
+    clean_rate, clean_hashes, _ = one_pass("")
+    snap0 = _gs.snapshot()
+    faulty_rate, faulty_hashes, injected = one_pass(plan_spec)
+    resil = _resil_delta(snap0)
+    out = {
+        "bench": "chaos",
+        "batch": args.batch, "image_size": args.image_size,
+        "steps": args.steps, "engine": args.engine,
+        "fault_plan": plan_spec,
+        "chaos_ok": int(bool(clean_hashes)
+                        and clean_hashes == faulty_hashes),
+        "chaos_slowdown": round(clean_rate / faulty_rate, 3)
+        if faulty_rate else None,
+        "chaos_clean_images_per_s": round(clean_rate, 1),
+        "chaos_faulty_images_per_s": round(faulty_rate, 1),
+        "chaos_faults_injected": injected,
+        "chaos_chunk_retries": resil["chunk_retries"],
+        "chaos_failover_reads": resil["failover_reads"],
+        "chaos_breaker_trips": resil["breaker_trips"],
+        "chaos_hedges_fired": resil["hedges_fired"],
+    }
+    out.update({k: v for k, v in resil.items() if k not in out})
+    return out
+
+
 def bench_all(args: argparse.Namespace) -> dict:
     """Every BASELINE config in one run (quick shapes): nvme raw baseline,
     ssd2host framework ratio, ssd2tpu delivered, resnet/vit/llama loaders
@@ -1858,6 +1969,13 @@ def main(argv: list[str] | None = None) -> int:
                             "seconds for the flight recorder's stall "
                             "trigger (<= 0 disables it; signal/exception "
                             "dumps stay armed)")
+        p.add_argument("--fault-plan", default="", dest="fault_plan",
+                       help="run under deterministic fault injection "
+                            "(strom/faults): a JSON plan file, an inline "
+                            "JSON object, or the preset 'chaos[:seed]' — "
+                            "the engine is wrapped in the FaultyEngine "
+                            "proxy and every read rides the plan's seeded "
+                            "errno/short-read/latency/stuck/death rules")
 
     p_nvme = sub.add_parser("nvme", help="config #1: O_DIRECT seq read -> host RAM")
     common(p_nvme)
@@ -2082,6 +2200,29 @@ def main(argv: list[str] | None = None) -> int:
     p_mt.add_argument("--pq-iters", type=int, default=2, dest="pq_iters",
                       help="full scans the parquet tenant runs")
     p_mt.set_defaults(fn=bench_multitenant)
+
+    p_chaos = sub.add_parser(
+        "chaos",
+        help="ISSUE 9 resilience arm: the resnet JPEG loader run clean, "
+             "then under a seeded fault plan (EIO + short reads + latency "
+             "spikes on the engine op stream); chaos_ok=1 = the faulted "
+             "run completed with batches bit-identical to the clean pass, "
+             "chaos_slowdown = the bounded price paid (chaos_* columns, "
+             "keys single-sourced in "
+             "strom.engine.resilience.CHAOS_BENCH_FIELDS)")
+    common(p_chaos)
+    p_chaos.add_argument("--batch", type=int, default=16)
+    p_chaos.add_argument("--image-size", type=int, default=64,
+                         dest="image_size")
+    p_chaos.add_argument("--steps", type=int, default=6)
+    p_chaos.add_argument("--prefetch", type=int, default=2)
+    p_chaos.add_argument("--decode-workers", type=int, default=4,
+                         dest="decode_workers")
+    p_chaos.add_argument("--seed", type=int, default=0,
+                         help="fault-plan seed when --fault-plan is unset "
+                              "(the arm then runs the 'chaos:<seed>' "
+                              "preset)")
+    p_chaos.set_defaults(fn=bench_chaos)
 
     p_daemon = sub.add_parser(
         "daemon",

@@ -220,9 +220,53 @@ class StromConfig:
 
     # failure handling: transparent per-chunk resubmits before erroring
     io_retries: int = 1
+    # retry backoff (ISSUE 9 tentpole, strom/engine/resilience.py): a
+    # failed transient piece waits base * 2^attempts (jittered, capped at
+    # max) before its resubmit instead of hammering the device that just
+    # failed it; the per-gather BUDGET bounds total resubmits per transfer
+    # so a sick device can never turn one gather into a retry storm.
+    io_retry_backoff_s: float = 0.005
+    io_retry_backoff_max_s: float = 0.2
+    io_retry_budget: int = 64
+    # engine wait watchdog: the generic gather paths (read_vectored wait
+    # loop, token drain, cancel reaps) bound any single completion wait at
+    # this and raise a diagnosable EngineStallError naming the stuck tags
+    # instead of blocking forever (was a hard-coded 30 s).
+    engine_wait_timeout_s: float = 30.0
+    # default request deadline in seconds (0 = none): every demand gather's
+    # traced Request carries it; scheduler queue waits, engine poll waits
+    # and retry backoffs stop at the deadline and the gather fails fast
+    # with DeadlineExceeded instead of blowing the tenant's SLO. Per-call
+    # deadlines (pread/memcpy deadline_s=) override.
+    request_deadline_s: float = 0.0
+    # per-engine circuit breaker (strom/delivery/resilient.py): trips OPEN
+    # on error rate >= breaker_error_rate over a rolling window holding
+    # >= breaker_min_events outcomes; while open, demand reads reroute to
+    # the python fallback engine; after breaker_cooldown_s, half-open
+    # probes (breaker_half_open_successes consecutive to close) recover.
+    breaker_enabled: bool = True
+    breaker_window_s: float = 10.0
+    breaker_min_events: int = 8
+    breaker_error_rate: float = 0.5
+    breaker_cooldown_s: float = 5.0
+    breaker_half_open_successes: int = 3
+    # hedged reads (streamed gathers): a gather quiet for longer than
+    # max(hedge_min_s, hedge_multiplier * rolling-p99 completion latency)
+    # re-reads its incomplete chunks on the fallback path; first
+    # completion wins, the loser is cancelled. 0 multiplier+min disables.
+    hedge_enabled: bool = True
+    hedge_min_s: float = 0.05
+    hedge_multiplier: float = 3.0
 
     # fault injection (tests/hardening; 0 = off)
     fault_every: int = 0
+    # seeded rule-based fault plan (ISSUE 9 tentpole, strom/faults/): a
+    # path to a JSON plan file, an inline JSON object string, or a named
+    # preset ("chaos[:seed]"). Non-empty wraps the engine in FaultyEngine
+    # at context construction, injecting deterministic errno / short-read
+    # / bit-flip / latency / stuck / engine-death faults per the plan's
+    # matchers — any bench arm or test runs under reproducible chaos.
+    fault_plan: str = ""
 
     # observability
     trace_annotations: bool = True     # jax.profiler traces around delivery.
@@ -302,6 +346,16 @@ class StromConfig:
                              "slicing) or exactly -1 (auto)")
         if not 0.0 <= self.sched_high_water <= 1.0:
             raise ValueError("sched_high_water must be in [0, 1] (0 = off)")
+        if self.io_retry_backoff_s < 0 or self.io_retry_backoff_max_s < 0:
+            raise ValueError("io_retry_backoff(_max)_s must be >= 0")
+        if self.io_retry_budget < 0:
+            raise ValueError("io_retry_budget must be >= 0")
+        if self.engine_wait_timeout_s <= 0:
+            raise ValueError("engine_wait_timeout_s must be > 0")
+        if self.request_deadline_s < 0:
+            raise ValueError("request_deadline_s must be >= 0 (0 = none)")
+        if not 0.0 < self.breaker_error_rate <= 1.0:
+            raise ValueError("breaker_error_rate must be in (0, 1]")
 
     @property
     def resolved_stripe_window_bytes(self) -> int:

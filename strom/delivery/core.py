@@ -35,7 +35,8 @@ from strom.delivery.handle import DMAHandle, deferred_handle
 from strom.delivery.hotcache import HotCache
 from strom.delivery.shard import DevicePlan, Segment, dedupe_plans, plan_sharded_read
 from strom.engine import make_engine
-from strom.engine.base import Engine, EngineError
+from strom.engine.base import (DeadlineExceeded, Engine, EngineError,
+                               EngineStallError)
 from strom.engine.raid0 import (count_stripe_windows, plan_stripe_reads,
                                 plan_stripe_windows)
 from strom.obs import request as _request
@@ -259,6 +260,16 @@ class StromContext:
                  scope: "dict | None | object" = None):
         self.config = config or StromConfig.from_env()
         self.engine = engine or make_engine(self.config)
+        # fault injection (ISSUE 9 tentpole, strom/faults): a configured
+        # fault plan wraps the engine in the FaultyEngine proxy BEFORE
+        # anything (scheduler, resilience, locks) binds it — every read
+        # this context issues runs under the plan's deterministic chaos
+        if self.config.fault_plan:
+            from strom.faults import FaultPlan, FaultyEngine
+
+            if not isinstance(self.engine, FaultyEngine):
+                self.engine = FaultyEngine(
+                    self.engine, FaultPlan.from_spec(self.config.fault_plan))
         # telemetry scope (ISSUE 6 tentpole): a dict of labels becomes a
         # label-scoped child view of the global registry — every delivery
         # counter/histogram written through it lands in BOTH the scoped
@@ -333,6 +344,19 @@ class StromContext:
             self._scheduler = IoScheduler(self.engine, self.config,
                                           pool=self._slab_pool,
                                           scope=self.scope)
+        # resilience layer (ISSUE 9 tentpole, strom/delivery/resilient.py):
+        # per-engine circuit breaker + python-engine failover + hedge
+        # control. Every demand gather's outcome feeds the breaker; while
+        # it is open, reads reroute to the fallback path and the degraded
+        # state is visible on /stats["resilience"], /tenants and the
+        # breaker_* gauges. The flight-recorder trip hook is attached
+        # after the recorder exists (below).
+        from strom.delivery.resilient import ResilientIo
+
+        self._resilience = ResilientIo(self.config, self.engine,
+                                       scope=self.scope)
+        if self._scheduler is not None:
+            self._scheduler.resilience_info = self._resilience.stats
         # per-tenant SLO engine (ISSUE 8 tentpole, strom/obs/slo.py):
         # every finished traced request feeds good/bad window accounting;
         # burn rates surface on /slo, as slo_* gauges per tenant scope,
@@ -401,6 +425,17 @@ class StromContext:
             self._flight = FlightRecorder(
                 self.config.flight_dir, ctx=self,
                 stall_s=self.config.flight_stall_s)
+        if self._flight is not None and self._resilience.breaker is not None:
+            # breaker trip → flight bundle (ISSUE 9 satellite): the moment
+            # the engine is declared sick is exactly the moment an operator
+            # wants the trace/stacks/counters that led up to it
+            flight = self._flight
+
+            def _dump_on_trip(note: str, _f=flight) -> None:
+                with contextlib.suppress(Exception):
+                    _f.dump("breaker_trip", note=note)
+
+            self._resilience.breaker.on_trip = _dump_on_trip
         port = self.config.metrics_port if metrics_port is None else metrics_port
         self._history = None
         if port is not None and (port > 0 or metrics_port == 0):
@@ -474,6 +509,11 @@ class StromContext:
         """The multi-tenant I/O scheduler when ``sched_enabled``, else
         None (strom/sched/scheduler.py)."""
         return self._scheduler
+
+    @property
+    def resilience(self):
+        """The breaker/failover/hedge layer (strom/delivery/resilient.py)."""
+        return self._resilience
 
     def register_tenant(self, name: str, *, priority: str = "training",
                         weight: int = 1, byte_rate: float = 0,
@@ -829,7 +869,8 @@ class StromContext:
                        segments: Sequence[Segment],
                        dest: "np.ndarray | None",
                        base_offset: int = 0, *, _warm: bool = False,
-                       tenant: str | None = None) -> int:
+                       tenant: str | None = None,
+                       deadline_s: "float | None" = None) -> int:
         """Read (file_offset+base_offset → dest_offset) segments, chunked at
         block_size, pipelined at queue_depth. Returns total bytes read.
         Raises EngineError on any failed or short chunk.
@@ -865,7 +906,18 @@ class StromContext:
         # req_id, and finish feeds req_lat / the exemplar store / the SLO
         # engine. Nested mint sites (a streamed batch) reuse the
         # enclosing request, so this adds one contextvar read there.
-        with _request.active("gather", tenant, owner=self._req_owner):
+        with _request.active("gather", tenant, owner=self._req_owner) as req:
+            # deadline propagation (ISSUE 9): explicit per-call deadline,
+            # else the config default — armed once on the request and read
+            # by the scheduler's queue waits, the engine's poll loops and
+            # the retry scheduler (first writer wins across nested mints).
+            # Gather-kind requests only: a pipeline's enclosing batch/step
+            # request must not inherit a per-GATHER bound measured from
+            # its first nested read (a long healthy batch would blow it),
+            # nor be poisoned by one nested pread's tight deadline.
+            if req is not None and req.kind == "gather":
+                req.set_deadline_s(deadline_s if deadline_s is not None
+                                   else (cfg.request_deadline_s or None))
             chunks, idx_paths = self._plan_chunks(source, segments,
                                                   base_offset)
             cache = self._hot_cache
@@ -902,15 +954,48 @@ class StromContext:
                     _request.span("strom.read_segments", cat="read",
                                   args={"ops": len(chunks),
                                         "bytes": planned}):
-                try:
+
+                def primary() -> int:
                     if self._scheduler is not None:
-                        total = self._scheduler.read_chunks(
+                        return self._scheduler.read_chunks(
                             chunks, dest, tenant=tenant,
                             retries=cfg.io_retries)
+                    with self._engine_lock:
+                        return self.engine.read_vectored(
+                            chunks, dest, retries=cfg.io_retries)
+
+                def arbitrate(read_slice):
+                    # failover reads stay under the tenant's arbitration —
+                    # budgets charged, fair-drain queued, and PRE-EMPTIBLE
+                    # at slice granularity like the primary path (PR 7's
+                    # starvation bound must hold exactly when the system
+                    # is degraded): the breaker reroutes the ENGINE, not
+                    # the multi-tenant contract
+                    if self._scheduler is None:
+                        return read_slice(chunks)
+                    total_fb = 0
+                    for sl in self._scheduler.iter_slices(chunks):
+                        nb = sum(ln for (_, _, _, ln) in sl)
+                        with self._scheduler.grant(tenant, nb):
+                            total_fb += read_slice(sl)
+                    return total_fb
+
+                try:
+                    # breaker + failover (ISSUE 9): outcomes feed the
+                    # per-engine circuit breaker; the gather that trips it
+                    # (and every gather while it is open) reroutes to the
+                    # python fallback engine instead of failing the caller
+                    if self._resilience is not None:
+                        total = self._resilience.execute(
+                            primary, chunks, dest, idx_paths,
+                            arbitrate=arbitrate)
                     else:
-                        with self._engine_lock:
-                            total = self.engine.read_vectored(
-                                chunks, dest, retries=cfg.io_retries)
+                        total = primary()
+                except (DeadlineExceeded, EngineStallError):
+                    # typed failures keep their class: callers (and tests)
+                    # distinguish a deadline miss / stall diagnosis from a
+                    # media error
+                    raise
                 except EngineError as e:
                     raise EngineError(e.errno,
                                       f"ssd2tpu {e.strerror}") from None
@@ -1475,10 +1560,16 @@ class StromContext:
     # -- host-side range read (format readers: indexes, footers, members) ---
     def pread(self, source: "Source", offset: int = 0,
               length: int | None = None, *,
-              tenant: str | None = None) -> np.ndarray:
+              tenant: str | None = None,
+              deadline_s: "float | None" = None) -> np.ndarray:
         """Read bytes from *source* into a fresh aligned host slab (no device
         transfer). The staging path format readers use for metadata and member
-        payloads before decode."""
+        payloads before decode. *deadline_s* arms a request deadline: the
+        read fails fast with DeadlineExceeded instead of waiting out a sick
+        engine (default: config ``request_deadline_s``; 0/None = none).
+        The deadline binds the gather-kind request this read mints; inside
+        an enclosing traced request (a pipeline batch/step) the enclosing
+        contract stands and *deadline_s* is not applied."""
         if self._closed:
             raise RuntimeError("StromContext is closed")
         source = self.resolve_source(source)
@@ -1491,7 +1582,7 @@ class StromContext:
                 self._numa.resolve(self._numa_path(source)) is not None:
             self._numa.bind(dest)
         self._read_segments(source, [Segment(0, 0, length)], dest, offset,
-                            tenant=tenant)
+                            tenant=tenant, deadline_s=deadline_s)
         return dest
 
     # -- introspection (≙ LIST/INFO_GPU_MEMORY, /proc stats) ----------------
@@ -1505,7 +1596,7 @@ class StromContext:
         never recomputes the expensive stall-attribution section (ISSUE 6
         satellite). None = every section (the pre-existing contract).
         Known sections: context, decode, stream, steps, cache, slab_pool,
-        engine, sched, slo, exemplars, scopes."""
+        engine, sched, slo, exemplars, resilience, scopes."""
         want = None if sections is None else set(sections)
 
         def wanted(name: str) -> bool:
@@ -1627,6 +1718,25 @@ class StromContext:
         # per-tenant rows live on /slo, labeled gauges on /metrics
         if wanted("slo"):
             out["slo"] = self._slo.stats()
+        # resilience (ISSUE 9 tentpole): retry/hedge/breaker/failover
+        # counters (single-sourced key list RESILIENCE_FIELDS) + the
+        # breaker's live state and the fault plan's injection tally when
+        # one is wired — the "is this context degraded" section
+        if wanted("resilience"):
+            from strom.engine.resilience import RESILIENCE_FIELDS
+
+            res: dict = {}
+            for k in RESILIENCE_FIELDS:
+                if k == "breaker_state":
+                    res[k] = global_stats.gauge(k).value
+                else:
+                    res[k] = global_stats.counter(k).value
+            res.update(self._resilience.stats())
+            plan_stats = getattr(getattr(self.engine, "plan", None),
+                                 "stats", None)
+            if plan_stats is not None:
+                res["fault_plan"] = plan_stats()
+            out["resilience"] = res
         # tail-sampling exemplar store (ISSUE 8): retention counters; the
         # retained span trees themselves ride /flight and crash bundles
         if wanted("exemplars"):
@@ -1654,4 +1764,5 @@ class StromContext:
             self._flight.close()
         self._executor.shutdown(wait=True)
         self._group_executor.shutdown(wait=True)
+        self._resilience.close()
         self.engine.close()

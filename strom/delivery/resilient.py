@@ -1,0 +1,217 @@
+"""Engine failover + hedged-read support (ISSUE 9 tentpole, delivery half).
+
+:class:`ResilientIo` is owned by a :class:`StromContext` and sits between
+the delivery read paths and the engine:
+
+- a per-engine :class:`~strom.engine.resilience.CircuitBreaker` records
+  every demand gather's outcome. While CLOSED, reads ride the primary
+  engine exactly as before; the gather that TRIPS it (and every gather
+  while OPEN) reroutes to a lazily-built ``python_engine`` fallback —
+  fresh fds over the same paths, the portable path that keeps serving
+  when the uring/native path wedges. HALF_OPEN probes ride real traffic.
+- the streamed path uses :meth:`read_chunk_fallback` for per-chunk
+  recovery (a failed chunk no longer kills the batch) and for hedged
+  reads (a chunk quiet past the adaptive threshold is re-read on the
+  fallback; first completion wins).
+
+The fallback engine is built on first use and serialized under its own
+lock (the python engine's gather path is single-driver); chunks are
+remapped path-wise, so failover works for exactly the reads the delivery
+layer planned — engine-level callers with untracked fds stay primary-only.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Callable, Sequence
+
+import numpy as np
+
+from strom.engine.base import DeadlineExceeded, EngineError
+from strom.engine.resilience import (CircuitBreaker, HedgeController,
+                                     classify_errno)
+
+
+class ResilientIo:
+    def __init__(self, config, engine, *, scope=None,
+                 on_trip: "Callable[[str], None] | None" = None):
+        from strom.utils.stats import global_stats
+
+        self.config = config
+        self.engine = engine
+        self.scope = scope if scope is not None else global_stats
+        self.breaker: "CircuitBreaker | None" = None
+        if getattr(config, "breaker_enabled", True):
+            self.breaker = CircuitBreaker(
+                window_s=config.breaker_window_s,
+                min_events=config.breaker_min_events,
+                error_rate=config.breaker_error_rate,
+                cooldown_s=config.breaker_cooldown_s,
+                half_open_successes=config.breaker_half_open_successes,
+                scope=self.scope, on_trip=on_trip,
+                name=getattr(engine, "name", "engine"))
+        self.hedge: "HedgeController | None" = None
+        # zero floor + zero multiplier is the documented OFF spelling (a
+        # 0-threshold controller would hedge every incomplete chunk on
+        # every poll — the opposite of disabled)
+        if getattr(config, "hedge_enabled", True) \
+                and (config.hedge_min_s > 0 or config.hedge_multiplier > 0):
+            self.hedge = HedgeController(
+                min_s=config.hedge_min_s,
+                multiplier=config.hedge_multiplier)
+        self._fb = None
+        self._fb_failed = False
+        self._fb_lock = threading.Lock()     # creation + fi map
+        self._fb_serial = threading.Lock()   # one fallback gather at a time
+        self._fb_fi: dict[str, int] = {}
+
+    # -- fallback engine -----------------------------------------------------
+    def fallback_engine(self):
+        """The python fallback engine, built on first use (None when it
+        cannot be built — failover then degrades to plain propagation)."""
+        with self._fb_lock:
+            if self._fb is not None or self._fb_failed:
+                return self._fb
+            try:
+                from strom.engine.python_engine import PythonEngine
+
+                self._fb = PythonEngine(self.config)
+            except Exception:
+                self._fb_failed = True
+            return self._fb
+
+    def _fb_index(self, path: str) -> int:
+        fb = self.fallback_engine()
+        with self._fb_lock:
+            fi = self._fb_fi.get(path)
+            if fi is None:
+                fi = fb.register_file(path,
+                                      o_direct=self.config.o_direct)
+                self._fb_fi[path] = fi
+            return fi
+
+    def can_fallback(self, chunks: Sequence[tuple[int, int, int, int]],
+                     idx_paths: dict[int, str]) -> bool:
+        """Failover needs a path per chunk (fallback fds are fresh opens).
+        Deliberately does NOT build the fallback engine: this runs on
+        every healthy demand gather, and the lifeboat (a second buffer
+        pool + worker threads) must cost nothing until a read actually
+        fails over."""
+        if not chunks or any(fi not in idx_paths for (fi, _, _, _) in chunks):
+            return False
+        return not self._fb_failed
+
+    def fallback_read(self, chunks: Sequence[tuple[int, int, int, int]],
+                      dest: np.ndarray, idx_paths: dict[int, str]) -> int:
+        """Execute a whole planned gather on the fallback engine (chunks
+        remapped path-wise). Serialized: the fallback is the lifeboat, not
+        a second fleet."""
+        fb = self.fallback_engine()
+        if fb is None:
+            raise EngineError(5, "failover requested but no fallback engine")
+        remapped = [(self._fb_index(idx_paths[fi]), fo, do, ln)
+                    for (fi, fo, do, ln) in chunks]
+        with self._fb_serial:
+            n = fb.read_vectored(remapped, dest,
+                                 retries=self.config.io_retries)
+        self.scope.add("failover_reads")
+        self.scope.add("failover_bytes", n)
+        return n
+
+    def read_chunk_fallback(self, path: str, file_off: int, length: int,
+                            out: np.ndarray) -> bool:
+        """One chunk on the fallback path (streamed recovery / hedges):
+        read file[file_off : file_off+length) into *out*. True on a full
+        read; False degrades quietly (the caller keeps its error)."""
+        fb = self.fallback_engine()
+        if fb is None:
+            return False
+        try:
+            fi = self._fb_index(path)
+            with self._fb_serial:
+                n = fb.read_vectored([(fi, file_off, 0, length)], out,
+                                     retries=self.config.io_retries)
+            return n == length
+        except (EngineError, OSError):
+            return False
+
+    # -- the demand-path wrapper --------------------------------------------
+    def execute(self, primary: Callable[[], int],
+                chunks: Sequence[tuple[int, int, int, int]],
+                dest: np.ndarray, idx_paths: dict[int, str],
+                arbitrate: "Callable[[Callable[[], int]], int] | None"
+                = None) -> int:
+        """Run a planned demand gather with breaker + failover semantics:
+
+        - breaker CLOSED (or allowing a half-open probe): run *primary*
+          (the scheduler-arbitrated / engine-locked gather). Success and
+          failure both feed the breaker. A TRANSIENT failure whose record
+          leaves the breaker OPEN (this gather tripped it, or re-failed a
+          probe) reroutes THIS gather to the fallback; otherwise the
+          error propagates — a lone failure is the caller's to see, same
+          as it ever was.
+        - breaker OPEN: straight to the fallback (primary never touched);
+          gathers that cannot fail over (untracked fds) still run primary.
+        - DeadlineExceeded always propagates: the deadline is the
+          contract, a slower lifeboat does not honor it.
+
+        *arbitrate* (the owning context's scheduler wrapper) runs every
+        fallback read: it receives a read-one-slice callable and drives
+        it under the tenant's arbitration — budgets charged, fair-drain
+        queued, slice-preemptible exactly like the primary path. The
+        breaker reroutes the ENGINE, not the multi-tenant contract.
+        """
+        br = self.breaker
+        can_fb = self.can_fallback(chunks, idx_paths)
+
+        def fallback() -> int:
+            read_slice = (lambda sl: self.fallback_read(sl, dest,
+                                                        idx_paths))
+            if arbitrate is not None:
+                return arbitrate(read_slice)
+            return read_slice(chunks)
+
+        # allow() is consulted whether or not THIS gather can fail over:
+        # it owns the OPEN -> HALF_OPEN cooldown transition, and with no
+        # fallback available the primary below doubles as the probe —
+        # otherwise an unfallbackable workload leaves the breaker OPEN
+        # (degraded on every surface) long after the engine recovered
+        if br is not None and not br.allow() and can_fb:
+            return fallback()
+        try:
+            n = primary()
+        except DeadlineExceeded:
+            raise
+        except EngineError as e:
+            if br is None:
+                raise
+            if classify_errno(e.errno or 5) == "permanent":
+                # a caller bug (EINVAL, EBADF, ...) fails identically on
+                # any engine — it is not evidence about THIS engine's
+                # health and must not trip a fleet-wide failover
+                raise
+            br.record_failure()
+            if br.state != CircuitBreaker.OPEN or not can_fb:
+                raise
+            return fallback()
+        if br is not None:
+            br.record_success()
+        return n
+
+    # -- observability / lifecycle ------------------------------------------
+    def stats(self) -> dict:
+        out = {}
+        if self.breaker is not None:
+            out.update(self.breaker.info())
+        out["failover_available"] = self._fb is not None
+        if self.hedge is not None:
+            out["hedge_threshold_us"] = round(
+                self.hedge.threshold_s() * 1e6, 1)
+        return out
+
+    def close(self) -> None:
+        with self._fb_lock:
+            fb, self._fb = self._fb, None
+            self._fb_failed = True
+        if fb is not None:
+            fb.close()

@@ -87,6 +87,32 @@ class EngineError(OSError):
     pass
 
 
+class EngineStallError(EngineError):
+    """The engine stopped answering: no completion arrived within the
+    configured ``engine_wait_timeout_s`` while ops were in flight. Carries
+    the stuck tags so the operator (and the flight bundle) can say WHICH
+    ops wedged instead of staring at a silent 30 s loop."""
+
+    def __init__(self, timeout_s: float, tags: Sequence[int], where: str):
+        self.stuck_tags = tuple(tags)
+        shown = ", ".join(str(t) for t in self.stuck_tags[:8])
+        if len(self.stuck_tags) > 8:
+            shown += f", ... ({len(self.stuck_tags)} total)"
+        super().__init__(
+            errno.ETIMEDOUT,
+            f"engine stall in {where}: no completion for {timeout_s:.1f}s "
+            f"with {len(self.stuck_tags)} op(s) in flight (tags: {shown})")
+
+
+class DeadlineExceeded(EngineError):
+    """The request's deadline expired mid-gather: retries stop, waits
+    stop, and the gather fails fast instead of blowing the tenant's SLO
+    budget on a read nobody is still waiting for."""
+
+    def __init__(self, msg: str):
+        super().__init__(errno.ETIMEDOUT, f"deadline exceeded: {msg}")
+
+
 class StreamToken:
     """Handle for one in-flight vectored gather (:meth:`Engine.submit_vectored`).
 
@@ -100,17 +126,30 @@ class StreamToken:
     __slots__ = ("chunks", "retries", "_d8", "_left", "_results", "_pending",
                  "_pieces", "_backlog", "_exhausted", "_ready", "bytes_done",
                  "cancelled", "inflight_peak", "_err", "chunks_done",
-                 "req_id")
+                 "req_id", "deadline", "fail_fast", "_delayed",
+                 "retries_used", "failed_chunks")
 
     def __init__(self, chunks: Sequence[tuple[int, int, int, int]],
                  dest: np.ndarray, block: int, retries: int,
-                 req_id: "int | None" = None):
+                 req_id: "int | None" = None,
+                 deadline: "float | None" = None, fail_fast: bool = True):
         self.chunks = list(chunks)
         self.retries = retries
         # causal request tracing (ISSUE 8): the req_id of the request this
         # gather belongs to, if traced — carried on the token so poll/drain
         # telemetry and tools can attribute engine work to one request
         self.req_id = req_id
+        # deadline (ISSUE 9): absolute time.monotonic() seconds; poll/drain
+        # waits and retry scheduling stop at it — the gather fails fast with
+        # DeadlineExceeded instead of retrying into a dead SLO window
+        self.deadline = deadline
+        # fail_fast=True (the read_vectored contract): the first exhausted
+        # chunk stops feeding the rest. False (the streamed/resilient path):
+        # a failed chunk surfaces as a negative ChunkCompletion and the
+        # REST of the gather keeps flowing, so one bad extent no longer
+        # kills a whole batch — the delivery layer re-reads just the
+        # failed chunk on the fallback path
+        self.fail_fast = fail_fast
         self._d8 = dest.view(np.uint8).reshape(-1)
         # bytes of each chunk not yet landed; a chunk retires when it hits 0
         self._left = [ln for (_, _, _, ln) in self.chunks]
@@ -123,6 +162,9 @@ class StreamToken:
         # pieces bounced by a full queue (EAGAIN / partial batch accept):
         # resubmitted before the iterator advances
         self._backlog: list[tuple[int, int, int, int, int, int]] = []
+        # retries waiting out their backoff: (ready_monotonic_s, piece) —
+        # _pump_token promotes due entries to the backlog (ISSUE 9)
+        self._delayed: list[tuple[float, tuple[int, int, int, int, int, int]]] = []
         self._exhausted = not self.chunks
         self._ready: list[ChunkCompletion] = []
         self.bytes_done = 0
@@ -130,11 +172,31 @@ class StreamToken:
         self.inflight_peak = 0
         self._err: EngineError | None = None
         self.chunks_done = 0
+        self.retries_used = 0   # per-gather retry-budget consumption
+        self.failed_chunks = 0  # chunks retired with a negative result
 
     @property
     def done(self) -> bool:
-        return (self._exhausted and not self._backlog and not self._pending) \
-            or self.cancelled
+        return (self._exhausted and not self._backlog and not self._pending
+                and not self._delayed) or self.cancelled
+
+    def next_retry_in_s(self) -> "float | None":
+        """Seconds until the earliest backoff retry is due (None: no
+        delayed retries pending)."""
+        if not self._delayed:
+            return None
+        return max(0.0, min(t for t, _ in self._delayed) - time.monotonic())
+
+    def pending_chunk_indices(self) -> set:
+        """Chunk indices with at least one piece IN FLIGHT right now —
+        the hedge-eligible set: a chunk the engine was never asked for
+        has nothing to race (strom/delivery/stream.py)."""
+        return {p[0] for p in self._pending.values()}
+
+    def deadline_remaining_s(self) -> "float | None":
+        if self.deadline is None:
+            return None
+        return self.deadline - time.monotonic()
 
     @property
     def error(self) -> EngineError | None:
@@ -273,6 +335,46 @@ class Engine(abc.ABC):
         except Exception:
             pass
 
+    # -- resilience policy (ISSUE 9) ----------------------------------------
+    @property
+    def retry_policy(self):
+        """The engine's retry policy (backoff + jitter + per-gather budget),
+        built lazily from config — shared by the blocking and async gather
+        paths so their retry behavior can never diverge."""
+        pol = getattr(self, "_retry_policy", None)
+        if pol is None:
+            from strom.engine.resilience import RetryPolicy
+
+            pol = self._retry_policy = RetryPolicy.from_config(self.config)
+        return pol
+
+    @property
+    def wait_timeout_s(self) -> float:
+        """Engine stall watchdog bound: the longest any generic gather path
+        waits on a single completion before raising EngineStallError
+        (config ``engine_wait_timeout_s``; was a hard-coded 30 s)."""
+        return getattr(self.config, "engine_wait_timeout_s", 30.0)
+
+    @staticmethod
+    def _request_deadline() -> "float | None":
+        """The current traced request's absolute deadline (monotonic
+        seconds), if one is active and carries one — how a caller-level
+        deadline reaches the engine's wait loops without threading a
+        parameter through every override."""
+        try:
+            from strom.obs import request as _request
+
+            req = _request.current()
+            return getattr(req, "deadline", None) if req is not None else None
+        except Exception:
+            return None
+
+    def _note_stall(self, where: str) -> None:
+        try:
+            self.op_scope.add("engine_stall_timeouts")
+        except Exception:
+            pass
+
     # -- optional registered-dest support (io_uring READ_FIXED) -------------
     def register_dest(self, arr: np.ndarray) -> int:
         """Register a caller slab so gathers into it can use pre-pinned
@@ -303,20 +405,40 @@ class Engine(abc.ABC):
         """
         block = self.config.block_size
         qd = self.config.queue_depth
+        policy = self.retry_policy
+        deadline = self._request_deadline()
+        stall_s = self.wait_timeout_s
         d8 = dest.view(np.uint8).reshape(-1)
         if not hasattr(self, "_vec_tag"):
             self._vec_tag = 0
         # tag -> (file_idx, file_off, dest_off, want, attempts)
         pending: dict[int, tuple[int, int, int, int, int]] = {}
+        # backoff retries waiting to become due: (ready_t, fi, fo, do, want,
+        # attempts) — resubmitted ahead of the fresh-piece iterator
+        delayed: list[tuple[float, int, int, int, int, int]] = []
         it = ((fi, fo + p, do + p, min(block, ln - p))
               for (fi, fo, do, ln) in chunks
               for p in range(0, ln, block))
         exhausted = False
         total = 0
         inflight_peak = 0
+        retries_used = 0
         err: EngineError | None = None
         try:
-            while not exhausted or pending:
+            while not exhausted or pending or delayed:
+                now = time.monotonic()
+                while delayed and len(pending) < qd and err is None:
+                    # due retries first (they were in flight before any
+                    # still-fresh piece); not-due ones wait their backoff
+                    delayed.sort()
+                    if delayed[0][0] > now:
+                        break
+                    _, fi, fo, do, want, attempts = delayed.pop(0)
+                    tag = self._vec_tag
+                    self._vec_tag += 1
+                    self.submit_raw([RawRead(fi, fo, want,
+                                             d8[do: do + want], tag)])
+                    pending[tag] = (fi, fo, do, want, attempts)
                 while not exhausted and len(pending) < qd and err is None:
                     try:
                         fi, fo, do, ln = next(it)
@@ -330,21 +452,84 @@ class Engine(abc.ABC):
                 if len(pending) > inflight_peak:
                     inflight_peak = len(pending)
                 if not pending:
+                    if delayed and err is None:
+                        # nothing in flight: sleep out the earliest backoff
+                        wake = min(d[0] for d in delayed)
+                        if deadline is not None and wake >= deadline:
+                            self.op_scope.add("deadline_exceeded")
+                            raise DeadlineExceeded(
+                                f"{len(delayed)} retrie(s) still backing "
+                                "off at deadline")
+                        time.sleep(max(0.0, wake - time.monotonic()))
+                        continue
                     break
-                for c in self.wait(min_completions=1):
+                wait_s = stall_s
+                if delayed:
+                    # wake for the earliest backoff retry: a due resubmit
+                    # must not wait behind an unrelated slow completion
+                    # (the async token path bounds with next_retry_in_s;
+                    # this is the blocking twin)
+                    wait_s = min(wait_s,
+                                 max(min(d[0] for d in delayed)
+                                     - time.monotonic(), 0.001))
+                if deadline is not None:
+                    left = deadline - time.monotonic()
+                    if left <= 0:
+                        self.op_scope.add("deadline_exceeded")
+                        raise DeadlineExceeded(
+                            f"{len(pending)} op(s) still in flight")
+                    wait_s = min(wait_s, left)
+                got = self.wait(min_completions=1, timeout_s=wait_s)
+                if not got:
+                    if err is None and deadline is not None \
+                            and time.monotonic() >= deadline:
+                        self.op_scope.add("deadline_exceeded")
+                        raise DeadlineExceeded(
+                            f"{len(pending)} op(s) still in flight")
+                    if wait_s >= stall_s:
+                        self._note_stall("read_vectored")
+                        if err is None:
+                            raise EngineStallError(stall_s, list(pending),
+                                                   "read_vectored")
+                        # engine wedged while draining after a chunk error:
+                        # bounded abandon (same contract as the exception
+                        # drain below) and surface the original error —
+                        # not an unbounded wait for completions that are
+                        # never coming
+                        break
+                for c in got:
                     entry = pending.pop(c.tag, None)
                     if entry is None:
                         continue  # foreign tag: not ours to account
                     fi, fo, do, want, attempts = entry
+                    failed_errno = -c.result if c.result < 0 else \
+                        (_ENODATA if c.result < want else 0)
+                    if failed_errno and err is None:
+                        within_deadline = deadline is None \
+                            or time.monotonic() < deadline
+                        if policy.should_retry(failed_errno, attempts,
+                                               retries, retries_used):
+                            if within_deadline:
+                                retries_used += 1
+                                self.op_scope.add("chunk_retries")
+                                delay = policy.delay_s(attempts)
+                                if delay > 0:
+                                    self.op_scope.add("retry_backoff_waits")
+                                delayed.append((time.monotonic() + delay,
+                                                fi, fo, do, want,
+                                                attempts + 1))
+                                continue
+                            # a retry the policy would take, denied by the
+                            # deadline: the typed failure (and its count)
+                            # — matching the token path's poll branch
+                            self.op_scope.add("deadline_exceeded")
+                            err = DeadlineExceeded(
+                                f"piece retry at +{fo} denied "
+                                f"({len(pending)} op(s) in flight)")
+                        elif attempts < retries and \
+                                retries_used >= policy.budget:
+                            self.op_scope.add("retry_budget_exhausted")
                     if c.result < 0:
-                        if attempts < retries and err is None:
-                            self.op_scope.add("chunk_retries")
-                            tag = self._vec_tag
-                            self._vec_tag += 1
-                            self.submit_raw(
-                                [RawRead(fi, fo, want, d8[do: do + want], tag)])
-                            pending[tag] = (fi, fo, do, want, attempts + 1)
-                            continue
                         if err is None:
                             err = EngineError(
                                 -c.result,
@@ -360,10 +545,20 @@ class Engine(abc.ABC):
                         total += c.result
                 if err is not None:
                     exhausted = True  # stop feeding; drain what's in flight
-        except BaseException:
+                    delayed.clear()
+        except BaseException as exc:
+            # a deadline miss drains with a short grace, not the full stall
+            # watchdog: fail-fast is the deadline's contract, and the ops a
+            # wedged engine will never complete are abandoned (and counted)
+            # either way
+            drain_s = min(self.wait_timeout_s, 1.0) \
+                if isinstance(exc, DeadlineExceeded) else self.wait_timeout_s
             while pending:
-                done = self.wait(min_completions=1, timeout_s=30.0)
+                done = self.wait(min_completions=1, timeout_s=drain_s)
                 if not done:
+                    # stuck in-flight ops: counted and abandoned (the
+                    # pre-existing bounded-drain contract), now diagnosable
+                    self._note_stall("read_vectored drain")
                     break
                 for c in done:
                     pending.pop(c.tag, None)
@@ -397,16 +592,27 @@ class Engine(abc.ABC):
 
     def submit_vectored(self, chunks: Sequence[tuple[int, int, int, int]],
                         dest: np.ndarray, *, retries: int = 1,
-                        req_id: "int | None" = None) -> StreamToken:
+                        req_id: "int | None" = None,
+                        deadline: "float | None" = None,
+                        fail_fast: bool = True) -> StreamToken:
         """Begin an async gather of (file_index, file_offset, dest_offset,
         length) chunks into *dest*. Pieces are submitted up to queue_depth
         immediately; the rest flow in as :meth:`poll` reaps completions.
         The returned token must be driven to :meth:`drain` (or handed to
         :meth:`cancel`) before the engine is used for another transfer.
         *req_id* tags the token with the traced request it executes
-        (strom/obs/request.py), for attribution only."""
+        (strom/obs/request.py), for attribution only. *deadline* (absolute
+        monotonic seconds; default: the active traced request's) bounds
+        poll/drain waits and retry scheduling; *fail_fast*=False lets the
+        rest of the gather continue past an exhausted chunk (it retires as
+        a negative ChunkCompletion instead of stopping the feed) — the
+        streamed delivery path recovers such chunks on the fallback
+        engine."""
+        if deadline is None:
+            deadline = self._request_deadline()
         tok = StreamToken(chunks, dest, self.config.block_size, retries,
-                          req_id=req_id)
+                          req_id=req_id, deadline=deadline,
+                          fail_fast=fail_fast)
         self._track_token(tok)
         self._pump_token(tok)
         return tok
@@ -424,17 +630,59 @@ class Engine(abc.ABC):
             time.monotonic() + timeout_s
         self._pump_token(token)
         while (len(token._ready) < max(min_completions, 1)
-               and token._pending and not token.cancelled):
+               and (token._pending or token._delayed)
+               and not token.cancelled):
             if min_completions <= 0:
                 wait_s = 0.0
             elif deadline is None:
                 wait_s = None
             else:
                 wait_s = max(0.0, deadline - time.monotonic())
+            # cap every blocking wait at the request deadline and at the
+            # next backoff-retry due time (a delayed retry with nothing in
+            # flight must not sleep a full caller timeout before its
+            # resubmit) — and at the stall watchdog, so a wedged engine
+            # raises a diagnosable EngineStallError instead of hanging
+            req_left = token.deadline_remaining_s()
+            if wait_s is None or wait_s > 0:
+                bound = self.wait_timeout_s
+                retry_in = token.next_retry_in_s()
+                if retry_in is not None:
+                    bound = min(bound, max(retry_in, 0.001))
+                if req_left is not None:
+                    bound = min(bound, max(req_left, 0.0))
+                wait_s = bound if wait_s is None else min(wait_s, bound)
+            if req_left is not None and req_left <= 0:
+                # close the token even when an earlier chunk error already
+                # set _err: the zero wait bound above would otherwise spin
+                # hot zero-timeout reaps until a caller watchdog fired,
+                # with the chunks never getting their deadline closure
+                if token._err is None:
+                    self.op_scope.add("deadline_exceeded")
+                    token._err = DeadlineExceeded(
+                        f"{len(token._pending)} op(s) in flight, "
+                        f"{len(token._delayed)} retrie(s) backing off")
+                token._exhausted = True
+                token._backlog.clear()
+                token._delayed.clear()
+                self._fail_pending_chunks(token)
+                break
+            wait_t0 = time.monotonic()
             got = self._reap_token(token, wait_s)
             self._pump_token(token)
             if min_completions <= 0:
                 break
+            # stall diagnosis needs the wait to have actually gone QUIET
+            # for the whole watchdog: under concurrent gathers the engine
+            # wait can return early with another token's completions
+            # (got == 0 for us), which is a busy engine, not a wedged one
+            if not got and not token._ready and token._pending \
+                    and wait_s is not None \
+                    and wait_s >= self.wait_timeout_s \
+                    and time.monotonic() - wait_t0 >= self.wait_timeout_s:
+                self._note_stall("poll")
+                raise EngineStallError(self.wait_timeout_s,
+                                       list(token._pending), "poll")
             if not got and deadline is not None \
                     and time.monotonic() >= deadline:
                 break
@@ -444,12 +692,40 @@ class Engine(abc.ABC):
             self._untrack_token(token)
         return out
 
+    def _fail_pending_chunks(self, token: StreamToken) -> None:
+        """Retire every not-yet-completed chunk with the token's error
+        (deadline expiry): the chunks get their negative ChunkCompletion
+        so chunk accounting closes, while still-in-flight PIECES keep
+        draining through poll/cancel — their dest writes stay owned by
+        the kernel/worker until each retires."""
+        e = token._err.errno if token._err is not None else errno.EIO
+        for ci, r in enumerate(token._results):
+            if r is None:
+                token._results[ci] = -(e or errno.EIO)
+                token.chunks_done += 1
+                token.failed_chunks += 1
+                token._ready.append(ChunkCompletion(ci, token._results[ci]))
+
     def drain(self, token: StreamToken) -> int:
         """Run the token to completion and return total bytes landed.
         Raises the first chunk error (retries exhausted, short read) AFTER
         every in-flight piece has retired — a caller reacting to the error
-        can never race live engine writes into its buffer."""
+        can never race live engine writes into its buffer. Two bounded
+        exceptions to "after every piece" (ISSUE 9): a DeadlineExceeded
+        token fails fast (the caller must :meth:`cancel` before touching
+        dest), and a completion wait past ``engine_wait_timeout_s`` with
+        zero progress raises EngineStallError naming the stuck tags
+        instead of looping silently."""
         while not token.done:
+            if isinstance(token._err, DeadlineExceeded):
+                # fail fast: still-in-flight pieces stay kernel-owned;
+                # cancel() reaps them before dest may be reused
+                raise token._err
+            # no caller timeout: poll's own stall watchdog owns the bound.
+            # (Passing timeout_s=wait_timeout_s would make poll's wait
+            # slices deadline-minus-now — an epsilon UNDER the watchdog,
+            # so the stall check could never fire and a wedged engine
+            # would loop here forever.)
             self.poll(token, min_completions=1)
         self._untrack_token(token)
         if token.cancelled:
@@ -458,7 +734,8 @@ class Engine(abc.ABC):
             raise token._err
         return token.bytes_done
 
-    def cancel(self, token: StreamToken, timeout_s: float = 30.0) -> None:
+    def cancel(self, token: StreamToken,
+               timeout_s: "float | None" = None) -> None:
         """Stop feeding the token and reap everything already in flight
         (the kernel/worker owns the dest bytes until each piece completes —
         abandoning them would leave writes landing into recycled memory).
@@ -466,13 +743,21 @@ class Engine(abc.ABC):
         driver (close() racing a live streamed gather) raises ECANCELED on
         its next call and stops competing for completions — then the
         remaining pieces are reaped in short wait slices, re-checking the
-        (possibly concurrently drained) pending set between slices."""
+        (possibly concurrently drained) pending set between slices.
+        *timeout_s* defaults to ``engine_wait_timeout_s``; expiry counts
+        an engine_stall_timeouts episode (the abandoned pieces are the
+        diagnosable stuck tags)."""
+        if timeout_s is None:
+            timeout_s = self.wait_timeout_s
         token.cancelled = True
         token._exhausted = True
         token._backlog.clear()
+        token._delayed.clear()
         deadline = time.monotonic() + timeout_s
         while token._pending and time.monotonic() < deadline:
             self._reap_token(token, 0.05)
+        if token._pending:
+            self._note_stall("cancel")
         self._untrack_token(token)
 
     # token bookkeeping for cancellation-on-close: engines call
@@ -501,8 +786,16 @@ class Engine(abc.ABC):
         io_uring_enter on the native engine). Partial accepts (a concurrent
         submitter raced us past the depth pre-check — uring's ``.accepted``
         contract) push the unaccepted tail back onto the backlog."""
-        if tok._err is not None or tok.cancelled:
+        if (tok._err is not None and tok.fail_fast) or tok.cancelled:
             return
+        if tok._delayed:
+            # promote due backoff retries to the backlog (ISSUE 9): they
+            # re-enter the submission queue ahead of fresh pieces
+            now = time.monotonic()
+            due = [p for t, p in tok._delayed if t <= now]
+            if due:
+                tok._delayed = [(t, p) for t, p in tok._delayed if t > now]
+                tok._backlog.extend(due)
         qd = self.config.queue_depth
         while len(tok._pending) < qd:
             batch: list[tuple[int, int, int, int, int, int]] = []
@@ -546,6 +839,7 @@ class Engine(abc.ABC):
                     tok._err = e
                     tok._exhausted = True
                     tok._backlog.clear()
+                    tok._delayed.clear()
                     return
                 # queue full: requests[accepted:] never entered the ring —
                 # back onto the backlog for the next refill
@@ -568,7 +862,9 @@ class Engine(abc.ABC):
             tok._err = tok._err or e
             tok._exhausted = True
             tok._backlog.clear()
+            tok._delayed.clear()
             return 0
+        policy = self.retry_policy
         n = 0
         for c in comps:
             piece = tok._pending.pop(c.tag, None)
@@ -576,11 +872,34 @@ class Engine(abc.ABC):
                 continue  # foreign tag: not ours to account
             n += 1
             ci, fi, fo, do, want, attempts = piece
-            if c.result < 0 and attempts < tok.retries \
-                    and tok._err is None and not tok.cancelled:
-                self.op_scope.add("chunk_retries")
-                tok._backlog.append((ci, fi, fo, do, want, attempts + 1))
-                continue
+            # transient failures AND injected/true short reads are
+            # retryable (ISSUE 9): a short-read retry re-reads the whole
+            # piece, so a flaky link's truncated transfer recovers to the
+            # full bytes while a genuine EOF still fails with ENODATA once
+            # the budget is spent
+            failed_errno = -c.result if c.result < 0 else \
+                (_ENODATA if c.result < want else 0)
+            chunk_already_failed = tok._results[ci] is not None \
+                and not tok.fail_fast
+            retry_eligible = tok._err is None or not tok.fail_fast
+            if failed_errno and retry_eligible and not tok.cancelled \
+                    and not chunk_already_failed:
+                left = tok.deadline_remaining_s()
+                if policy.should_retry(failed_errno, attempts, tok.retries,
+                                       tok.retries_used) \
+                        and (left is None or left > 0):
+                    tok.retries_used += 1
+                    self.op_scope.add("chunk_retries")
+                    delay = policy.delay_s(attempts)
+                    if delay > 0:
+                        self.op_scope.add("retry_backoff_waits")
+                    tok._delayed.append(
+                        (time.monotonic() + delay,
+                         (ci, fi, fo, do, want, attempts + 1)))
+                    continue
+                if attempts < tok.retries \
+                        and tok.retries_used >= policy.budget:
+                    self.op_scope.add("retry_budget_exhausted")
             if c.result < 0:
                 err = EngineError(
                     -c.result, f"read failed after {attempts + 1} attempts: "
@@ -596,11 +915,15 @@ class Engine(abc.ABC):
             if err is not None:
                 if tok._err is None:
                     tok._err = err
-                tok._exhausted = True  # stop feeding; drain what's in flight
-                tok._backlog.clear()
+                if tok.fail_fast:
+                    # stop feeding; drain what's in flight
+                    tok._exhausted = True
+                    tok._backlog.clear()
+                    tok._delayed.clear()
                 if tok._results[ci] is None:
                     tok._results[ci] = -(err.errno or errno.EIO)
                     tok.chunks_done += 1
+                    tok.failed_chunks += 1
                     tok._ready.append(
                         ChunkCompletion(ci, tok._results[ci]))
                 continue

@@ -35,7 +35,8 @@ import numpy as np
 
 from strom.config import StromConfig
 from strom.engine.base import (ChunkCompletion, Completion, Engine,
-                               EngineError, RawRead, ReadRequest, StreamToken)
+                               EngineError, EngineStallError, RawRead,
+                               ReadRequest, StreamToken)
 from strom.obs.events import ring as _events
 
 
@@ -46,7 +47,8 @@ class _FanToken:
     bytes_done / inflight_peak / chunks / error)."""
 
     __slots__ = ("chunks", "parts", "locks", "cancelled", "chunks_done",
-                 "req_id")
+                 "req_id", "rings_noted", "last_progress_t",
+                 "last_bytes_done")
 
     def __init__(self, chunks, parts, locks, req_id=None):
         self.chunks = list(chunks)
@@ -56,6 +58,17 @@ class _FanToken:
         self.cancelled = False
         self.chunks_done = 0
         self.req_id = req_id  # traced-request tag (strom/obs/request.py)
+        # rings already fed one quarantine outcome by THIS gather: ring
+        # health is judged per gather, not per chunk — one bad extent
+        # retiring 8 chunks must not equal 8 bad gathers (ISSUE 9)
+        self.rings_noted: set[int] = set()
+        # fan-level stall clock: child polls run in sub-watchdog slices,
+        # so the child stall check can never fire — the fan tracks quiet
+        # time across slices itself, and PIECE progress (bytes_done)
+        # resets it so one huge healthy chunk never reads as a stall
+        # (ISSUE 9)
+        self.last_progress_t = time.monotonic()
+        self.last_bytes_done = -1
 
     @property
     def done(self) -> bool:
@@ -75,6 +88,32 @@ class _FanToken:
     def error(self) -> EngineError | None:
         return next((p[2].error for p in self.parts
                      if p[2].error is not None), None)
+
+    # StreamingGather's resilience paths (ISSUE 9) read the StreamToken
+    # internals _err / _pending for typed-failure dispatch and stall
+    # diagnosis — mirror them over the child tokens so the streamed
+    # delivery layer treats a fan-out gather like any other
+    @property
+    def _err(self) -> EngineError | None:
+        return self.error
+
+    @property
+    def _pending(self) -> dict:
+        # keyed (ring, tag): per-child tag spaces collide (each child's
+        # _vec_tag starts at 0), and a flat merge would silently drop
+        # entries from the stall diagnosis / progress keys
+        out: dict = {}
+        for ring, _, ctok, _ in self.parts:
+            for tag, piece in getattr(ctok, "_pending", {}).items():
+                out[(ring, tag)] = piece
+        return out
+
+    def pending_chunk_indices(self) -> set:
+        out: set = set()
+        for _, _, ctok, imap in self.parts:
+            for ci in ctok.pending_chunk_indices():
+                out.add(imap[ci])
+        return out
 
     def _release_locks(self) -> None:
         locks, self.locks = self.locks, []
@@ -119,6 +158,15 @@ class MultiRingEngine(Engine):
         self._pool = concurrent.futures.ThreadPoolExecutor(
             max_workers=n, thread_name_prefix="strom-ring")
         self._closed = False
+        # member-ring quarantine (ISSUE 9): a ring whose gathers keep
+        # failing transiently is pulled from the rotation and the engine
+        # serves DEGRADED on the healthy members (visible in stats());
+        # sticky for the engine's lifetime — a flapping NVMe link is not
+        # something to round-robin back onto mid-epoch
+        self._ring_errors = [0] * n
+        self._quarantined: set[int] = set()
+        self._quarantine_after = max(
+            int(getattr(config, "breaker_min_events", 8)), 1)
 
     @property
     def num_rings(self) -> int:
@@ -234,35 +282,101 @@ class MultiRingEngine(Engine):
             c.unregister_dest_addr(addr)
 
     # -- the vectored hot path: route, fan out, join ------------------------
+    def _healthy_rings(self) -> list[int]:
+        """Rings still in the rotation; all of them when every ring is
+        quarantined (serving on a sick ring beats serving nothing)."""
+        h = [r for r in range(len(self._children))
+             if r not in self._quarantined]
+        return h if h else list(range(len(self._children)))
+
+    def _route(self, fi: int, healthy: list[int]) -> int:
+        """Stable per-file ring routing under quarantine: a file keeps
+        its fi % N home ring (fds, extent cache, READ_FIXED registrations
+        live there — stability matters) and only files whose home ring is
+        quarantined redirect to a survivor."""
+        ring = fi % len(self._children)
+        if ring not in self._quarantined:
+            return ring
+        return healthy[fi % len(healthy)]
+
+    def _note_ring_error(self, ring: int, err: EngineError) -> None:
+        """Count a transient ring failure; quarantine past the threshold
+        (ISSUE 9: only while at least one healthy peer remains — the
+        engine serves degraded on the survivors, visible in stats())."""
+        import errno as _errno
+
+        from strom.engine.base import DeadlineExceeded, EngineStallError
+        from strom.engine.resilience import classify_errno
+
+        if err.errno == _errno.ENODATA:
+            # a short read / EOF is data-dependent (truncated member,
+            # caller range past EOF) — it would fail identically on every
+            # ring, and counting it would quarantine healthy hardware
+            return
+        if err.errno == _errno.ETIMEDOUT \
+                and not isinstance(err, EngineStallError):
+            # -ETIMEDOUT chunk retirements are request-deadline expiry
+            # (the REQUEST's contract, says nothing about this ring); a
+            # stall watchdog trip (EngineStallError) IS ring evidence
+            return
+        if isinstance(err, DeadlineExceeded):
+            return
+        if classify_errno(err.errno or 5) != "transient":
+            return
+        self._ring_errors[ring] += 1
+        if ring not in self._quarantined \
+                and self._ring_errors[ring] >= self._quarantine_after \
+                and len(self._healthy_rings()) > 1:
+            self._quarantined.add(ring)
+            try:
+                self.op_scope.add("ring_quarantines")
+                self.op_scope.set_gauge("rings_quarantined",
+                                        len(self._quarantined))
+            except Exception:
+                pass
+
     def read_vectored(self, chunks: Sequence[tuple[int, int, int, int]],
                       dest: np.ndarray, *, retries: int = 1) -> int:
         if self._closed:
             raise EngineError(9, "engine closed")
         files = {c[0] for c in chunks}
         n = len(self._children)
+        healthy = self._healthy_rings()
         if n == 1 or len(files) == 1:
-            # single file (or single ring): the whole gather rides ONE ring,
-            # chosen round-robin so concurrent independent transfers spread
-            ring = next(self._rr) % n
+            # single file (or single ring): the whole gather rides ONE
+            # healthy ring, chosen round-robin so concurrent independent
+            # transfers spread
+            ring = healthy[next(self._rr) % len(healthy)]
             ch = [(self._child_index(ring, fi), fo, do, ln)
                   for (fi, fo, do, ln) in chunks]
-            with _events.span("engine.multi.read_vectored", cat="read",
-                              args={"ops": len(chunks), "ring": ring}), \
-                    self._ring_locks[ring]:
-                return self._children[ring].read_vectored(ch, dest,
-                                                          retries=retries)
+            try:
+                with _events.span("engine.multi.read_vectored", cat="read",
+                                  args={"ops": len(chunks), "ring": ring}), \
+                        self._ring_locks[ring]:
+                    return self._children[ring].read_vectored(ch, dest,
+                                                              retries=retries)
+            except EngineError as e:
+                self._note_ring_error(ring, e)
+                raise
         # multi-file gather: stable per-file ring (striped member i → ring
-        # i mod N), sub-gathers in parallel. Stability matters: a member's
-        # fd, extent cache and READ_FIXED registrations live on its ring.
+        # i mod N, quarantined home rings redirecting to a survivor —
+        # degraded but serving), sub-gathers in parallel. Stability
+        # matters: a member's fd, extent cache and READ_FIXED
+        # registrations live on its ring, so only the sick ring's files
+        # move (_route).
         per_ring: list[list[tuple[int, int, int, int]]] = [[] for _ in range(n)]
         for (fi, fo, do, ln) in chunks:
-            ring = fi % n
+            ring = self._route(fi, healthy)
             per_ring[ring].append((self._child_index(ring, fi), fo, do, ln))
 
         def run(ring: int) -> int:
-            with self._ring_locks[ring]:
-                return self._children[ring].read_vectored(
-                    per_ring[ring], dest, retries=retries)
+            try:
+                with self._ring_locks[ring]:
+                    return self._children[ring].read_vectored(
+                        per_ring[ring], dest, retries=retries)
+            except EngineError as e:
+                self._note_ring_error(ring, e)
+                raise
 
         live = [r for r in range(n) if per_ring[r]]
         if len(live) == 1:
@@ -287,7 +401,9 @@ class MultiRingEngine(Engine):
     # -- async vectored gather: fan tokens across member rings --------------
     def submit_vectored(self, chunks: Sequence[tuple[int, int, int, int]],
                         dest: np.ndarray, *, retries: int = 1,
-                        req_id: "int | None" = None):
+                        req_id: "int | None" = None,
+                        deadline: "float | None" = None,
+                        fail_fast: bool = True):
         """ISSUE 5: the async twin of read_vectored's routing — chunks fan
         per file onto member rings (member i → ring i mod N, stable) and
         each ring gets its own child StreamToken; completions map back to
@@ -299,16 +415,17 @@ class MultiRingEngine(Engine):
             raise EngineError(9, "engine closed")
         n = len(self._children)
         files = {c[0] for c in chunks}
+        healthy = self._healthy_rings()
         per_ring: dict[int, tuple[list, list]] = {}  # ring -> (chunks, imap)
         if chunks and (n == 1 or len(files) == 1):
-            ring = next(self._rr) % n
+            ring = healthy[next(self._rr) % len(healthy)]
             per_ring[ring] = (
                 [(self._child_index(ring, fi), fo, do, ln)
                  for (fi, fo, do, ln) in chunks],
                 list(range(len(chunks))))
         else:
             for i, (fi, fo, do, ln) in enumerate(chunks):
-                ring = fi % n
+                ring = self._route(fi, healthy)
                 ch, imap = per_ring.setdefault(ring, ([], []))
                 ch.append((self._child_index(ring, fi), fo, do, ln))
                 imap.append(i)
@@ -322,12 +439,15 @@ class MultiRingEngine(Engine):
             if len(live) > 1:
                 self.op_scope.add("multi_ring_fanout_gathers")
                 self.op_scope.gauge("multi_ring_fanout_width").max(len(live))
+            if deadline is None:
+                deadline = self._request_deadline()
             for r in live:
                 ch, imap = per_ring[r]
                 parts.append((r, self._children[r],
                               self._children[r].submit_vectored(
                                   ch, dest, retries=retries,
-                                  req_id=req_id), imap))
+                                  req_id=req_id, deadline=deadline,
+                                  fail_fast=fail_fast), imap))
         except BaseException:
             for _, child, ctok, _ in parts:
                 try:
@@ -354,17 +474,31 @@ class MultiRingEngine(Engine):
             raise EngineError(_errno.ECANCELED,
                               "token cancelled (engine closing?)")
         out: list[ChunkCompletion] = []
+
+        def land(ring: int, imap, c) -> None:
+            token.chunks_done += 1
+            token.last_progress_t = time.monotonic()
+            if c.result < 0 and ring not in token.rings_noted:
+                # the async path feeds quarantine too (ISSUE 9): a member
+                # whose streamed gathers keep failing transiently leaves
+                # the rotation exactly like one failing demand gathers —
+                # at most ONE outcome per gather per ring, so a single
+                # bad extent's chunk burst is one strike, not eight
+                token.rings_noted.add(ring)
+                self._note_ring_error(
+                    ring, EngineError(-c.result, "streamed chunk failed"))
+            out.append(ChunkCompletion(imap[c.index], c.result))
+
         deadline = None if timeout_s is None else \
             time.monotonic() + timeout_s
         block_rr = 0
         while True:
-            live = [(child, ctok, imap)
-                    for _, child, ctok, imap in token.parts
+            live = [(ring, child, ctok, imap)
+                    for ring, child, ctok, imap in token.parts
                     if not ctok.done]
-            for child, ctok, imap in live:
+            for ring, child, ctok, imap in live:
                 for c in child.poll(ctok, min_completions=0):
-                    token.chunks_done += 1
-                    out.append(ChunkCompletion(imap[c.index], c.result))
+                    land(ring, imap, c)
             if (len(out) >= min_completions or min_completions <= 0
                     or token.done):
                 break
@@ -372,19 +506,27 @@ class MultiRingEngine(Engine):
                 break
             # block briefly on ONE unfinished ring (rotating), so a quiet
             # ring can't starve completions sitting ready on another
-            live = [(child, ctok, imap)
-                    for _, child, ctok, imap in token.parts
+            live = [(ring, child, ctok, imap)
+                    for ring, child, ctok, imap in token.parts
                     if not ctok.done]
             if not live:
                 break
-            child, ctok, imap = live[block_rr % len(live)]
+            ring, child, ctok, imap = live[block_rr % len(live)]
             block_rr += 1
             wait_s = 0.005
             if deadline is not None:
                 wait_s = min(wait_s, max(0.0, deadline - time.monotonic()))
             for c in child.poll(ctok, min_completions=1, timeout_s=wait_s):
-                token.chunks_done += 1
-                out.append(ChunkCompletion(imap[c.index], c.result))
+                land(ring, imap, c)
+            now_bytes = token.bytes_done
+            if now_bytes != token.last_bytes_done:
+                token.last_bytes_done = now_bytes
+                token.last_progress_t = time.monotonic()
+            elif time.monotonic() - token.last_progress_t \
+                    >= self.wait_timeout_s and token._pending:
+                self._note_stall("multi.poll")
+                raise EngineStallError(self.wait_timeout_s,
+                                       list(token._pending), "multi.poll")
         if token.done:
             token._release_locks()
             self._untrack_token(token)
@@ -407,12 +549,24 @@ class MultiRingEngine(Engine):
             raise err
         return token.bytes_done
 
-    def cancel(self, token, timeout_s: float = 30.0) -> None:
+    def cancel(self, token, timeout_s: "float | None" = None) -> None:
+        """ISSUE 9 satellite: ONE overall deadline shared across the child
+        tokens — the old per-child timeout made a wedged N-member close
+        cost members x 30 s; now a slow child only eats into the shared
+        budget and the stragglers get bounded (floored) slices of what's
+        left, so close() is ~timeout_s worst case regardless of N."""
+        if timeout_s is None:
+            timeout_s = self.wait_timeout_s
         if isinstance(token, StreamToken):
             return super().cancel(token, timeout_s)
+        deadline = time.monotonic() + timeout_s
         for _, child, ctok, _ in token.parts:
             try:
-                child.cancel(ctok, timeout_s)
+                # floor at 50ms so the tail children still mark-cancelled
+                # and take one reap pass even when an earlier child spent
+                # the whole budget (mark-first is what stops a concurrent
+                # driver competing for their completions)
+                child.cancel(ctok, max(deadline - time.monotonic(), 0.05))
             except Exception:
                 pass
         token.cancelled = True
@@ -422,7 +576,11 @@ class MultiRingEngine(Engine):
     # -- observability ------------------------------------------------------
     def stats(self) -> dict:
         per_ring = [c.stats() for c in self._children]
-        out = {"engine": self.name, "rings": len(self._children)}
+        out = {"engine": self.name, "rings": len(self._children),
+               # degraded-state visibility (ISSUE 9): which member rings
+               # are quarantined and the per-ring transient error tally
+               "quarantined_rings": sorted(self._quarantined),
+               "ring_errors": list(self._ring_errors)}
         for key in ("ops_submitted", "ops_completed", "ops_errored",
                     "ops_faulted", "bytes_read", "unaligned_fallback_reads",
                     "eof_topup_reads", "chunk_retries", "ops_fixed",

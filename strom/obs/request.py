@@ -79,7 +79,7 @@ class Request:
     __slots__ = ("id", "kind", "tenant", "owner", "t0_us", "end_us",
                  "queue_wait_us", "throttled", "error", "spans",
                  "spans_dropped", "_open", "_lock", "_finished",
-                 "_flow_started")
+                 "_flow_started", "deadline")
 
     def __init__(self, kind: str, tenant: "str | None" = None,
                  owner: "object | None" = None):
@@ -102,6 +102,21 @@ class Request:
         self._lock = threading.Lock()
         self._finished = False
         self._flow_started = False
+        # deadline (ISSUE 9): absolute time.monotonic() seconds, or None.
+        # Set once at mint (per-call deadline_s / config request_deadline_s);
+        # the scheduler's queue waits, the engine's poll waits and the
+        # retry scheduler all stop at it — the gather fails fast with
+        # DeadlineExceeded instead of finishing into a dead SLO window.
+        self.deadline: "float | None" = None
+
+    def set_deadline_s(self, seconds: "float | None") -> None:
+        """Arm a deadline *seconds* from now (None / <=0 = leave unset).
+        First writer wins: a nested mint site must not shorten or extend
+        the enclosing request's contract."""
+        import time as _time
+
+        if seconds is not None and seconds > 0 and self.deadline is None:
+            self.deadline = _time.monotonic() + seconds
 
     # -- span emission -------------------------------------------------------
     def _flow(self, name: str, cat: str) -> None:

@@ -60,6 +60,7 @@ import threading
 import time
 from typing import Any, Callable, Sequence
 
+from strom.engine.base import DeadlineExceeded
 from strom.sched.budget import AdmissionGate
 from strom.sched.tenant import PRIORITIES, PRIORITY_ORDER, Tenant
 
@@ -131,6 +132,11 @@ class IoScheduler:
         # context (ctx.slo.burning) so /tenants rows flag tenants that are
         # burning their error budget — the scheduler stays SLO-agnostic
         self.slo_hook: "Callable[[str], bool] | None" = None
+        # resilience hook (ISSUE 9): a callable () -> dict set by the
+        # owning context (ctx.resilience.stats) so /tenants shows the
+        # breaker/failover degraded state next to the queue rows — the
+        # scheduler stays failure-policy-agnostic
+        self.resilience_info: "Callable[[], dict] | None" = None
         self._default = self.register(_DEFAULT_TENANT, _label=False)
 
     # -- tenant registry ----------------------------------------------------
@@ -188,10 +194,17 @@ class IoScheduler:
                 with contextlib.suppress(Exception):
                     row["slo_burning"] = bool(self.slo_hook(t.name))
             rows[t.name] = row
-        return {"tenants": rows,
-                "admission": self.admission.state(),
-                "exclusive": self.exclusive,
-                "engine": getattr(self.engine, "name", "?")}
+        out = {"tenants": rows,
+               "admission": self.admission.state(),
+               "exclusive": self.exclusive,
+               "engine": getattr(self.engine, "name", "?")}
+        if self.resilience_info is not None:
+            # degraded-state visibility (ISSUE 9): breaker state, failover
+            # availability and hedge threshold, on the page the operator
+            # already watches for tenant health
+            with contextlib.suppress(Exception):
+                out["resilience"] = self.resilience_info()
+        return out
 
     # -- the fair-drain core ------------------------------------------------
     def _enqueue_locked(self, w: _Waiter) -> None:
@@ -290,6 +303,29 @@ class IoScheduler:
             else PRIORITY_ORDER[t.priority]
         w = _Waiter(t, max(int(nbytes), 0), prio, self._clock())
         enq_us = _ring.now_us()
+        # deadline propagation (ISSUE 9): a queue wait that cannot grant
+        # before the request deadline dequeues and fails fast — a gather
+        # nobody is still waiting for must not consume a grant. Deadlines
+        # ride time.monotonic (the engine's clock), not the injectable
+        # scheduler clock — fake-clock tests don't mint deadlines.
+        req0 = _request.current()
+        req_deadline = getattr(req0, "deadline", None) \
+            if req0 is not None else None
+
+        def _expired() -> bool:
+            return req_deadline is not None \
+                and time.monotonic() >= req_deadline
+
+        def _abort_locked() -> None:
+            try:
+                t.queue.remove(w)
+                t.queued_bytes -= w.nbytes
+            except ValueError:
+                pass
+            t.scope.set_gauge("sched_queue_depth", len(t.queue))
+            t.scope.add("deadline_exceeded")
+            self._cond.notify_all()
+
         with self._cond:
             self._enqueue_locked(w)
             t.scope.set_gauge("sched_queue_depth", len(t.queue))
@@ -299,6 +335,10 @@ class IoScheduler:
                 while t.queue[0] is not w or \
                         max(t.byte_bucket.peek(w.nbytes),
                             t.iops_bucket.peek(1)) > 0:
+                    if _expired():
+                        _abort_locked()
+                        raise DeadlineExceeded(
+                            f"queued on tenant '{t.name}' (throttled)")
                     if t.queue[0] is w:
                         d = max(t.byte_bucket.peek(w.nbytes),
                                 t.iops_bucket.peek(1))
@@ -311,7 +351,17 @@ class IoScheduler:
             else:
                 delay = self._dispatch_locked()
                 while self._current is not w:
-                    self._cond.wait(delay if delay is not None else None)
+                    if _expired():
+                        _abort_locked()
+                        raise DeadlineExceeded(
+                            f"queued on tenant '{t.name}' behind "
+                            f"{len(t.queue)} op(s)")
+                    wait_s = delay
+                    if req_deadline is not None:
+                        left = max(req_deadline - time.monotonic(), 0.001)
+                        wait_s = left if wait_s is None \
+                            else min(wait_s, left)
+                    self._cond.wait(wait_s)
                     delay = self._dispatch_locked()
             t.scope.set_gauge("sched_queue_depth", len(t.queue))
         w.wait_s = max(self._clock() - w.enq_t, 0.0)
@@ -420,8 +470,21 @@ class IoScheduler:
         from strom.obs import request as _request
 
         t = self.resolve(tenant)
+        req = _request.current()
+        req_deadline = getattr(req, "deadline", None) \
+            if req is not None else None
         total = 0
         for si, sl in enumerate(self.iter_slices(chunks)):
+            if req_deadline is not None \
+                    and time.monotonic() >= req_deadline:
+                # deadline between slices (ISSUE 9): the gather stops at a
+                # slice boundary — it is never more than ~one slice late
+                # past its deadline, and the engine is handed straight to
+                # the next tenant in the fair drain
+                t.scope.add("deadline_exceeded")
+                raise DeadlineExceeded(
+                    f"gather stopped at slice {si} "
+                    f"({total} bytes landed)")
             nbytes = sum(ln for (_, _, _, ln) in sl)
             with self.grant(t, nbytes, priority=priority), \
                     _request.span("engine.slice", cat="read",

@@ -235,3 +235,16 @@ class TestRepoArtifacts:
         # the r05 invalidity is still REPORTED (grandfathered, not hidden)
         assert "BENCH_r05.json" in doc["invalid_rounds"]
         assert "BENCH_r05.json" in doc["grandfathered_invalid"]
+
+
+def test_chaos_slowdown_bands_relatively():
+    """RATIO_DOWN metrics (ISSUE 9): chaos_slowdown sits near 1.0, so the
+    count-sized ABS_SLACK (2.0) would let it reach ~3.2 before the gate
+    fired — it must band relatively instead, like the "up" direction."""
+    series = [("r1", 1.2), ("r2", 1.2), ("r3", 1.8)]
+    v = sentinel.check_metric("chaos_slowdown", "down", series, band=0.25)
+    assert v is not None, "1.2 -> 1.8 at band=0.25 must fire"
+    # a count-like "down" metric with the same numbers stays inside the
+    # absolute slack (0 -> 1 stall is jitter, the documented contract)
+    assert sentinel.check_metric("resnet_train_data_stalls", "down",
+                                 series, band=0.25) is None

@@ -52,6 +52,12 @@ NEW_ROUND = {  # r5-era shape: binding + context + audit arrays + headline
     "mt_pq_sched_queue_wait_p99_us": 65536.0,
     "mt_pq_items_per_s": 134358.2,
     "mt_vis0_vs_solo": 0.971,
+    # r9+: seeded-chaos resilience arm (strom/faults + strom/engine/
+    # resilience): bit-identical-under-faults bit + bounded slowdown
+    "chaos_ok": 1,
+    "chaos_slowdown": 1.173,
+    "chaos_faults_injected": 37,
+    "chaos_chunk_retries": 29,
     "binding": {"vs_baseline_host": 1.0315, "vs_baseline_host_raid": 0.9708,
                 "train_data_stalls": 0, "some_future_key": 0.5},
     "context": {"raw_gbps": 3.49},
@@ -189,6 +195,37 @@ def test_slo_keys_match_producers():
         assert suffix in produced, \
             f"compare_rounds consumes {key!r} but the bench arms produce " \
             f"no {suffix!r} (renamed column?)"
+
+
+def test_resil_keys_match_producers():
+    """Producer↔report key parity for the resilience section (ISSUE 9
+    satellite, the decode/stall/cache/stream/sched/slo pattern): the
+    compare_rounds chaos columns must be EXACTLY the keys the chaos bench
+    arm emits (single-sourced in
+    strom.engine.resilience.CHAOS_BENCH_FIELDS) — a rename on either side
+    is a silently dead column."""
+    from strom.engine.resilience import CHAOS_BENCH_FIELDS
+
+    assert list(compare_rounds.RESIL_KEYS) == list(CHAOS_BENCH_FIELDS)
+
+
+def test_resil_section_renders(artifacts, capsys):
+    """r9+ artifacts get the resilience section with the bit-identical
+    chaos bit and the absorbed-fault counters."""
+    assert compare_rounds.main(artifacts) == 0
+    out = capsys.readouterr().out
+    assert "resilience" in out
+    assert "chaos_ok" in out
+    assert "chaos_slowdown" in out
+    assert "1.173" in out
+
+
+def test_resil_section_hidden_without_chaos_keys(tmp_path, capsys):
+    """Rounds predating the chaos arm don't get an all-dash section."""
+    p = tmp_path / "BENCH_r02.json"
+    p.write_text(json.dumps(OLD_ROUND))
+    assert compare_rounds.main([str(p)]) == 0
+    assert "resilience" not in capsys.readouterr().out
 
 
 def test_sched_section_renders(artifacts, capsys):

@@ -46,7 +46,8 @@ _HERE = os.path.dirname(os.path.abspath(__file__))
 sys.path.insert(0, _HERE)  # runnable as a script from anywhere
 
 from compare_rounds import (BINDING_ORDER, CACHE_KEYS, DECODE_KEYS,  # noqa: E402
-                            SLO_KEYS, STALL_KEYS, STREAM_KEYS, unwrap)
+                            RESIL_KEYS, SLO_KEYS, STALL_KEYS, STREAM_KEYS,
+                            unwrap)
 
 # The gated metric set: (metric, direction) over the single-sourced
 # comparison tuples, where direction is "up" (bigger is better) or "down"
@@ -87,6 +88,12 @@ SENTINEL_FIELDS = (
     ("vit_req_lat_p99_us", "down"),
     ("resnet_slo_ok", "up"),
     ("vit_slo_ok", "up"),
+    # chaos arm (ISSUE 9): the run must keep completing bit-identical
+    # under the seeded fault plan (chaos_ok is 0/1 — any drop fails), and
+    # the slowdown paid for absorbing the injected faults stays bounded
+    # (same-run ratio, weather-independent)
+    ("chaos_ok", "up"),
+    ("chaos_slowdown", "down"),
 )
 
 # absolute slack for count-like "down" metrics around small values: going
@@ -94,9 +101,14 @@ SENTINEL_FIELDS = (
 # best-of-3 for exactly this reason); 0 -> above the slack still fails
 ABS_SLACK = 2.0
 
+# "down" metrics that are RATIOS near 1.0, not counts: the count-sized
+# ABS_SLACK would swamp them (chaos_slowdown ~1.2 could reach ~3.2 before
+# the gate fired) — they band relatively, like the "up" direction
+RATIO_DOWN = frozenset({"chaos_slowdown"})
+
 TABLE_KEYS = list(dict.fromkeys(
     BINDING_ORDER + DECODE_KEYS + STALL_KEYS + CACHE_KEYS + STREAM_KEYS
-    + SLO_KEYS))
+    + SLO_KEYS + RESIL_KEYS))
 
 
 def load_round(path: str) -> dict:
@@ -177,7 +189,8 @@ def check_metric(key: str, direction: str, series: list[tuple[str, float]],
     def worse_than(v: float, ref: float) -> bool:
         if direction == "up":
             return v < ref * (1.0 - band)
-        slack = max(abs(ref) * band, ABS_SLACK)
+        slack = abs(ref) * band if key in RATIO_DOWN \
+            else max(abs(ref) * band, ABS_SLACK)
         return v > ref + slack
 
     if worse_than(last, prev) and worse_than(last, best):

@@ -180,6 +180,25 @@ SLO_KEYS = [
     "vit_req_lat_p99_us",
     "vit_slo_ok",
 ]
+# resilience / chaos (ISSUE 9 tentpole): the seeded-fault-plan resnet arm.
+# chaos_ok = the run completed with batches bit-identical to fault-free
+# (the whole retry/failover/hedge story as one bit); chaos_slowdown is the
+# bounded price paid (same-run ratio, weather-independent); the counter
+# columns prove WHICH mechanism absorbed the injected faults. Keys are
+# single-sourced in strom.engine.resilience.CHAOS_BENCH_FIELDS
+# (parity-tested in tests/test_compare_rounds.py, same contract as the
+# decode/stall/cache/stream/sched/slo sections).
+RESIL_KEYS = [
+    "chaos_ok",
+    "chaos_slowdown",
+    "chaos_clean_images_per_s",
+    "chaos_faulty_images_per_s",
+    "chaos_faults_injected",
+    "chaos_chunk_retries",
+    "chaos_failover_reads",
+    "chaos_breaker_trips",
+    "chaos_hedges_fired",
+]
 # per-attempt / per-pass audit arrays (VERDICT.md r4 next #3): printed so
 # the best-of selection's discards are visible in the comparison too
 AUDIT_SUFFIXES = ("_attempts", "_passes")
@@ -316,9 +335,11 @@ def main(argv: list[str]) -> int:
                      for k in SCHED_KEYS)
     have_slo = any(cell(d, k) != "-" for _, d in rounds
                    for k in SLO_KEYS)
+    have_resil = any(cell(d, k) != "-" for _, d in rounds
+                     for k in RESIL_KEYS)
     name_w = max(len(k) for k in binding_keys + CONTEXT_KEYS + DECODE_KEYS
                  + STALL_KEYS + CACHE_KEYS + STREAM_KEYS + SCHED_KEYS
-                 + SLO_KEYS + audit_keys) + 2
+                 + SLO_KEYS + RESIL_KEYS + audit_keys) + 2
     # every rendered cell folds into ONE column width, or rows misalign
     col_w = max(max(len(n) for n, _ in rounds) + 2, 12,
                 *(len(c) + 2 for cs in audit_cells.values() for c in cs),
@@ -373,6 +394,12 @@ def main(argv: list[str]) -> int:
         print("request latency / SLO (traced request p50/p99 per arm; "
               "slo_ok=1 = no tenant burning):")
         for k in SLO_KEYS:
+            print(k.ljust(name_w)
+                  + "".join(cell(d, k).rjust(col_w) for _, d in rounds))
+    if have_resil:
+        print("resilience (seeded chaos arm: chaos_ok=1 = completed "
+              "bit-identical under injected faults):")
+        for k in RESIL_KEYS:
             print(k.ljust(name_w)
                   + "".join(cell(d, k).rjust(col_w) for _, d in rounds))
     if audit_keys:
