@@ -269,7 +269,8 @@ def main() -> int:
 
             FlightRecorder(flight_dir, stall_s=args.flight_stall_s)
         except Exception as e:  # the bench must run even with a bad dir
-            print(f"flight recorder disabled: {e}", file=sys.stderr)
+            print(f"flight recorder failed to start ({e!r}); continuing "
+                  "without one", file=sys.stderr)
 
     def remaining() -> float:
         return args.budget - (time.monotonic() - t_start)

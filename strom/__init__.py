@@ -31,11 +31,12 @@ from strom.delivery.handle import DMAHandle  # noqa: F401
 from strom.delivery.prefetch import Prefetcher  # noqa: F401
 from strom.probe.check import FileReport, PathTier  # noqa: F401
 from strom.probe.check import check_file as _probe_check_file
+from strom.utils.locks import make_lock as _make_lock
 
 __version__ = "0.1.0"
 
 _ctx: StromContext | None = None
-_ctx_lock = threading.Lock()
+_ctx_lock = _make_lock("app.ctx")
 
 
 def check_file(path, **kwargs) -> FileReport:

@@ -7,10 +7,11 @@ from __future__ import annotations
 import os
 import subprocess
 import threading
+from strom.utils.locks import make_lock
 
 _DIR = os.path.dirname(os.path.abspath(__file__))
 _SRC = os.path.join(_DIR, "strom_core.cpp")
-_LOCK = threading.Lock()
+_LOCK = make_lock("app.core_build")
 
 
 def lib_path(variant: str = "") -> str:
@@ -31,6 +32,10 @@ def ensure_built(variant: str = "") -> str:
         if os.path.exists(so) and os.path.getmtime(so) >= os.path.getmtime(_SRC):
             return so
         lock_file = so + ".lock"
+        # stromlint: ignore[blocking-under-lock] -- the build lock exists
+        # to serialize exactly this one-time compile + flock + rename; a
+        # thread blocking here is a thread correctly waiting for the
+        # native engine to exist
         with open(lock_file, "w") as lf:
             fcntl.flock(lf, fcntl.LOCK_EX)
             try:

@@ -767,7 +767,13 @@ def _obs_config_kw(args: argparse.Namespace) -> dict:
             # fault injection (ISSUE 9): --fault-plan wraps the engine in
             # the FaultyEngine proxy — any bench arm runs under the plan's
             # deterministic chaos (absent in driver-built Namespaces → off)
-            "fault_plan": getattr(args, "fault_plan", "") or ""}
+            "fault_plan": getattr(args, "fault_plan", "") or "",
+            # lock-order witness (ISSUE 11): --debug-locks turns every
+            # make_lock site into a WitnessLock for this run — inversions
+            # raise LockOrderError + dump a flight bundle instead of
+            # deadlocking in production later (absent → off; the chaos
+            # arm forces it on regardless)
+            "debug_locks": bool(getattr(args, "debug_locks", False))}
 
 
 def _resil_delta(snap0: dict) -> dict:
@@ -1613,7 +1619,8 @@ def bench_multitenant(args: argparse.Namespace) -> dict:
             except BaseException as e:  # surfaced after join
                 errs.append((name, e))
 
-        threads = [threading.Thread(target=run, args=w, daemon=True)
+        threads = [threading.Thread(target=run, args=w, daemon=True,
+                                    name=f"strom-mt-{w[0]}")
                    for w in workloads]
         t0 = time.perf_counter()
         for t in threads:
@@ -1760,7 +1767,13 @@ def bench_chaos(args: argparse.Namespace) -> dict:
         cfg = StromConfig(engine=args.engine, block_size=args.block,
                           queue_depth=args.depth,
                           num_buffers=max(args.depth * 2, 8),
-                          residency_hybrid=False, fault_plan=fault_plan)
+                          residency_hybrid=False, fault_plan=fault_plan,
+                          # the chaos arm runs with the lock-order witness
+                          # on (ISSUE 11): the seeded-fault op stream
+                          # exercises retry/failover/hedge lock paths the
+                          # clean arms never enter, so every round
+                          # cross-validates the static hierarchy at runtime
+                          debug_locks=True)
         _drop_cache_hint(path)
         ctx = StromContext(cfg)
         try:
@@ -1976,6 +1989,15 @@ def main(argv: list[str] | None = None) -> int:
                             "the engine is wrapped in the FaultyEngine "
                             "proxy and every read rides the plan's seeded "
                             "errno/short-read/latency/stuck/death rules")
+        p.add_argument("--debug-locks", action="store_true",
+                       dest="debug_locks",
+                       help="run with the lock-order witness on "
+                            "(strom/utils/locks.py): every make_lock site "
+                            "records acquisition order into a process-wide "
+                            "graph and an inversion raises LockOrderError "
+                            "+ dumps a flight bundle instead of deadlocking "
+                            "later (also STROM_DEBUG_LOCKS=1; the chaos "
+                            "arm forces it on)")
 
     p_nvme = sub.add_parser("nvme", help="config #1: O_DIRECT seq read -> host RAM")
     common(p_nvme)

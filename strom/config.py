@@ -296,6 +296,17 @@ class StromConfig:
                                        # seconds; <= 0 disables the stall
                                        # trigger (signal/exception dumps
                                        # stay armed)
+    # lock-order witness (ISSUE 11, strom/utils/locks.py): every lock the
+    # engine/sched/delivery/obs subsystems construct through make_lock
+    # becomes a WitnessLock that records per-thread acquisition order into
+    # a process-wide graph and raises LockOrderError (plus a flight-bundle
+    # dump) the moment two locks are ever taken in both orders — the
+    # runtime cross-check of the static hierarchy tools/stromlint
+    # enforces. Off = plain threading.Lock, zero overhead. Enable via
+    # STROM_DEBUG_LOCKS=1 (covers module-level locks created at import)
+    # or this flag (enabled before the context constructs its subsystems;
+    # the chaos bench arm runs with it on).
+    debug_locks: bool = False
     # snapshot history (strom/obs/history.py — ISSUE 8 tentpole): when the
     # live server is on, a background thread samples the global registry
     # (scoped series included) every history_interval_s into a bounded

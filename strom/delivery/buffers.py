@@ -15,6 +15,7 @@ import threading
 import weakref
 
 import numpy as np
+from strom.utils.locks import make_lock
 
 _libc = ctypes.CDLL(None, use_errno=True)
 
@@ -131,7 +132,7 @@ class SlabPool:
         self.on_alloc = on_alloc
         self._free: dict[int, list[np.ndarray]] = {}  # class size -> base arrays
         self._cached_bytes = 0
-        self._lock = threading.Lock()
+        self._lock = make_lock("slab.pool")
         self.mlocked_bytes = 0
         self.hits = 0
         self.misses = 0
@@ -160,8 +161,12 @@ class SlabPool:
         for fn in self._change_hooks:
             try:
                 fn()
+            # stromlint: ignore[swallowed-exceptions] -- a poke hook (the
+            # admission gate's occupancy re-check) failing must never fail
+            # the allocation it rides on; the gate re-polls on a timeout
+            # anyway, so a lost poke degrades latency, not correctness
             except Exception:
-                pass  # observability must never fail an allocation
+                pass
 
     @staticmethod
     def _base(arr: np.ndarray) -> np.ndarray:

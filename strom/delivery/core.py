@@ -41,6 +41,7 @@ from strom.engine.raid0 import (count_stripe_windows, plan_stripe_reads,
                                 plan_stripe_windows)
 from strom.obs import request as _request
 from strom.obs.events import ring as _events_ring
+from strom.utils.locks import make_lock
 from strom.utils.stats import global_stats
 
 
@@ -259,6 +260,23 @@ class StromContext:
                  metrics_port: int | None = None,
                  scope: "dict | None | object" = None):
         self.config = config or StromConfig.from_env()
+        self._witness_enabled_here = False
+        if self.config.debug_locks:
+            # enable BEFORE the engine and every subsystem lock below is
+            # constructed, so their make_lock calls return WitnessLocks
+            # (ISSUE 11; module-level locks created at import time need
+            # STROM_DEBUG_LOCKS=1 instead). close() reverts — a
+            # diagnostic context must not leave every later context in
+            # the process paying witness overhead it never asked for.
+            from strom.utils import locks as _locks
+
+            self._witness_enabled_here = not _locks.witness_enabled()
+            _locks.enable_witness(True)
+            if self.config.flight_dir:
+                # a cycle's bundle lands where the operator already asked
+                # crash bundles to go (env STROM_FLIGHT_DIR still wins
+                # for recorder-less runs — it seeded locks at import)
+                _locks.set_flight_dir(self.config.flight_dir)
         self.engine = engine or make_engine(self.config)
         # fault injection (ISSUE 9 tentpole, strom/faults): a configured
         # fault plan wraps the engine in the FaultyEngine proxy BEFORE
@@ -292,7 +310,7 @@ class StromContext:
         # FIEMAP extent map per registered file: list[Extent] when mapped,
         # None when the fs can't say (tmpfs, old kernels) — probed once
         self._extent_maps: dict[str, list | None] = {}
-        self._files_lock = threading.Lock()
+        self._files_lock = make_lock("app.files")
         self._executor = concurrent.futures.ThreadPoolExecutor(
             max_workers=max(2, self.config.delivery_workers),
             thread_name_prefix="strom-delivery")
@@ -310,7 +328,7 @@ class StromContext:
         # transfers the rings exist to interleave.
         self._engine_lock = contextlib.nullcontext() \
             if getattr(self.engine, "concurrent_gathers", False) \
-            else threading.Lock()
+            else make_lock("engine.transfer")
         # process-lifetime unique tags: stale completions from a failed
         # transfer can never alias a later transfer's ops
         self._tag_counter = 0
@@ -337,7 +355,7 @@ class StromContext:
         # reads) routes through it; sched_enabled=False keeps the
         # pre-scheduler lock-per-transfer behavior.
         self._scheduler = None
-        self._tenant_reg_lock = threading.Lock()
+        self._tenant_reg_lock = make_lock("app.tenant_reg")
         if self.config.sched_enabled:
             from strom.sched.scheduler import IoScheduler
 
@@ -391,10 +409,10 @@ class StromContext:
         # in-flight DEMAND gathers (not readahead): the readahead thread
         # checks this between engine-budget-sized slices and yields, so a
         # consumer's read never queues behind more than one warming slice
-        self._demand_lock = threading.Lock()
+        self._demand_lock = make_lock("app.demand")
         self._demand_reads = 0
         # one host->HBM stream at a time (see StromConfig.serialize_device_put)
-        self._put_lock = threading.Lock() if self.config.serialize_device_put \
+        self._put_lock = make_lock("app.put") if self.config.serialize_device_put \
             else contextlib.nullcontext()
         # live observability endpoint (strom/obs/server.py): /metrics,
         # /stats, /trace on 127.0.0.1 for the context's lifetime. Explicit
@@ -410,7 +428,7 @@ class StromContext:
         # 1-core box, so a scraper polling /metrics must not pay (and
         # steal from decode workers) more than once per TTL
         self._steps_cache: tuple[float, dict] | None = None
-        self._steps_cache_lock = threading.Lock()
+        self._steps_cache_lock = make_lock("app.steps_cache")
         # flight recorder (ISSUE 6 tentpole, strom/obs/flight.py): with a
         # flight_dir configured, a watchdog samples progress/pressure for
         # the context's lifetime and dumps an atomic crash bundle on
@@ -501,6 +519,9 @@ class StromContext:
         the steps section's TTL cache, so /slo scrapes stay cheap)."""
         try:
             return self.stats(sections=["steps"])["steps"].get("goodput_pct")
+        # stromlint: ignore[swallowed-exceptions] -- None IS the documented
+        # 'goodput unknown' value (the SLO engine skips goodput targets on
+        # it); a closing context mid-scrape is a legal way to not know
         except Exception:
             return None
 
@@ -1766,3 +1787,12 @@ class StromContext:
         self._group_executor.shutdown(wait=True)
         self._resilience.close()
         self.engine.close()
+        if self._witness_enabled_here:
+            # revert the witness THIS context turned on: locks already
+            # constructed as WitnessLocks keep witnessing (the graph is
+            # always live), but later contexts' make_lock sites go back
+            # to plain threading.Lock. A context created while this one
+            # was open keeps its witnessed locks — edges stay valid.
+            from strom.utils.locks import enable_witness
+
+            enable_witness(False)

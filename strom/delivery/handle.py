@@ -19,6 +19,7 @@ import time
 from typing import Any, Callable
 
 from strom.utils.stats import global_stats
+from strom.utils.locks import make_lock
 
 
 class DMAHandle:
@@ -31,7 +32,7 @@ class DMAHandle:
         self.label = label
         self.submitted_at = time.monotonic()
         self._done_at: float | None = None
-        self._lock = threading.Lock()
+        self._lock = make_lock("app.handle")
         future.add_done_callback(self._on_done)
 
     def _on_done(self, _f) -> None:

@@ -59,6 +59,7 @@ from typing import Any, Callable, Iterable
 import numpy as np
 
 from strom.delivery.buffers import HUGE_PAGE, alloc_aligned, size_class
+from strom.utils.locks import make_lock
 
 ADMIT_POLICIES = ("second_touch", "always")
 
@@ -136,7 +137,7 @@ class HotCache:
         # (flat-out img/s, train stalls, stall attribution) keep their
         # round-over-round meaning; library contexts stay always-on.
         self.enabled = True
-        self._lock = threading.Lock()
+        self._lock = make_lock("cache.meta")
         # skey -> entries sorted by lo (disjoint ranges per skey)
         self._index: dict[Any, list[_Entry]] = {}
         # LRU: oldest first; value is the entry (key is its id())
@@ -364,6 +365,12 @@ class HotCache:
         charge = self._charge(n)
         buf = self._alloc(n)
         buf[:n] = data[:n]
+        # evicted-but-unpinned slabs collected under the lock, returned to
+        # the pool AFTER it releases: pool.release takes the slab-pool
+        # lock, which ranks BEFORE the cache lock in the canonical
+        # hierarchy (scheduler -> engine -> slab pool -> hot cache ->
+        # stats/ring) — the same free-outside-the-lock shape unpin() has
+        to_free: list[np.ndarray] = []
         with self._lock:
             # partition enforcement (ISSUE 7): a tenant over its carve-out
             # first evicts its OWN unpinned entries (self-displacement —
@@ -381,7 +388,7 @@ class HotCache:
                              if e.refs == 0 and e.tenant == tenant), None)
                         if victim is None:
                             break
-                        self._evict_locked(victim)
+                        to_free.extend(self._evict_locked(victim))
                     if self._tenant_bytes.get(tenant, 0) + charge > cap:
                         refused = True
             # make room in the shared budget (skip pinned entries: never
@@ -391,7 +398,7 @@ class HotCache:
                               None)
                 if victim is None:
                     break
-                self._evict_locked(victim)
+                to_free.extend(self._evict_locked(victim))
             if refused or self.bytes + charge > self.max_bytes:
                 drop = buf  # over partition / everything left pinned
             else:
@@ -412,14 +419,19 @@ class HotCache:
                         self._tenant_bytes[tenant] = \
                             self._tenant_bytes.get(tenant, 0) + charge
                     drop = None
+        for victim_buf in to_free:
+            self._free(victim_buf)
         if drop is not None:
             self._free(drop)
             return 0
         return n
 
-    def _evict_locked(self, e: _Entry) -> None:
-        """Remove *e* from the index/LRU (lock held). The slab returns to
-        the pool now when unpinned, else on the last unpin."""
+    def _evict_locked(self, e: _Entry) -> list:
+        """Remove *e* from the index/LRU (lock held). Returns the slabs to
+        hand back to the pool — the CALLER frees them after releasing the
+        cache lock (pool.release takes the slab-pool lock, which the
+        hierarchy orders before this one). A still-pinned entry returns
+        nothing here; its last unpin frees."""
         self._lru.pop(id(e), None)
         entries = self._index.get(e.skey)
         if entries is not None:
@@ -441,21 +453,22 @@ class HotCache:
         self._scope.add("cache_evicted_bytes", e.nbytes)
         if e.refs == 0:
             buf, e.buf = e.buf, None  # type: ignore[assignment]
-            # pool.release takes its own lock; safe under ours (no inverse
-            # ordering exists), but keep the critical section honest anyway
-            self._free(buf)
-        else:
-            e.dead = True  # last unpin frees
+            return [buf]
+        e.dead = True  # last unpin frees
+        return []
 
     def clear(self) -> None:
         """Drop every entry AND the touch ledger (a cleared cache forgets
         its observations too — the cold/warm bench pair depends on this).
         Pinned entries leave the index immediately (no new lookup can hit
         them) but their slabs free on the last unpin."""
+        to_free: list[np.ndarray] = []
         with self._lock:
             for e in list(self._lru.values()):
-                self._evict_locked(e)
+                to_free.extend(self._evict_locked(e))
             self._touched.clear()
+        for buf in to_free:
+            self._free(buf)
 
     # -- readahead accounting ----------------------------------------------
     def note_readahead(self, nbytes: int) -> None:

@@ -28,6 +28,7 @@ import time
 
 from strom.obs.events import ring
 from strom.utils.stats import StatsRegistry, global_stats
+from strom.utils.locks import make_lock
 
 T = TypeVar("T")
 
@@ -102,7 +103,7 @@ class Prefetcher(Generic[T]):
             max_workers=max_depth if auto_depth else depth,
             thread_name_prefix="strom-prefetch")
         self._queue: deque[concurrent.futures.Future] = deque()
-        self._lock = threading.Lock()
+        self._lock = make_lock("app.prefetch")
         self.stats = stats or StatsRegistry("prefetch")
         # telemetry scope (ISSUE 6): the pipeline's label scope, so two
         # pipelines' depth/stall series are distinguishable on /metrics;

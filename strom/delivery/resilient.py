@@ -30,6 +30,7 @@ import numpy as np
 from strom.engine.base import DeadlineExceeded, EngineError
 from strom.engine.resilience import (CircuitBreaker, HedgeController,
                                      classify_errno)
+from strom.utils.locks import make_lock
 
 
 class ResilientIo:
@@ -61,8 +62,8 @@ class ResilientIo:
                 multiplier=config.hedge_multiplier)
         self._fb = None
         self._fb_failed = False
-        self._fb_lock = threading.Lock()     # creation + fi map
-        self._fb_serial = threading.Lock()   # one fallback gather at a time
+        self._fb_lock = make_lock("resil.fallback")    # creation + fi map
+        self._fb_serial = make_lock("resil.fallback_serial")  # one fallback gather at a time
         self._fb_fi: dict[str, int] = {}
 
     # -- fallback engine -----------------------------------------------------

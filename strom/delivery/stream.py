@@ -174,6 +174,13 @@ class StreamingGather:
                             ctx.scheduler.grant(self._tenant,
                                                 self._miss_planned))
                     else:
+                        # stromlint: ignore[lock-order] -- engine ownership
+                        # intentionally spans the token's lifetime (the
+                        # gather owns the transfer path construction ->
+                        # drain); released at _release_engine the moment
+                        # the last piece retires, and every wait under it
+                        # is bounded by the gather watchdog
+                        # (EngineStallError in poll/finish)
                         self._stack.enter_context(ctx._engine_lock)
                     self._token = ctx.engine.submit_vectored(
                         chunks, dest, retries=ctx.config.io_retries,
@@ -406,6 +413,10 @@ class StreamingGather:
         # tail on the fallback (the primary will still submit all of it)
         try:
             live = self._token.pending_chunk_indices()
+        # stromlint: ignore[swallowed-exceptions] -- a token without
+        # pending-index support just disables hedge TARGETING this round
+        # (zero chunks hedge, visible as hedges_fired staying flat); it is
+        # a capability probe, not an error channel
         except Exception:
             live = set()
         for ci in self._unaccounted():

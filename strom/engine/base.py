@@ -24,6 +24,7 @@ fallback).
 from __future__ import annotations
 
 import abc
+import contextlib
 import dataclasses
 import errno
 import os
@@ -315,10 +316,9 @@ class Engine(abc.ABC):
         t = time.perf_counter()
         for r in requests:
             m[r.tag] = t
-        try:
+        # accounting must never fail a submission
+        with contextlib.suppress(Exception):
             self.op_scope.set_gauge("engine_inflight", self.in_flight())
-        except Exception:
-            pass  # accounting must never fail a submission
 
     def _note_completed(self, completions: Sequence[Completion]) -> None:
         m = getattr(self, "_op_submit_t", None)
@@ -330,10 +330,8 @@ class Engine(abc.ABC):
                 t0 = m.pop(c.tag, None)
                 if t0 is not None:
                     h.observe_us((t - t0) * 1e6)
-        try:
+        with contextlib.suppress(Exception):
             sc.set_gauge("engine_inflight", self.in_flight())
-        except Exception:
-            pass
 
     # -- resilience policy (ISSUE 9) ----------------------------------------
     @property
@@ -366,14 +364,15 @@ class Engine(abc.ABC):
 
             req = _request.current()
             return getattr(req, "deadline", None) if req is not None else None
+        # stromlint: ignore[swallowed-exceptions] -- no traced request (or
+        # an uninitialized tracing import during teardown) legitimately
+        # means 'no deadline'; there is nothing to count
         except Exception:
             return None
 
     def _note_stall(self, where: str) -> None:
-        try:
+        with contextlib.suppress(Exception):
             self.op_scope.add("engine_stall_timeouts")
-        except Exception:
-            pass
 
     # -- optional registered-dest support (io_uring READ_FIXED) -------------
     def register_dest(self, arr: np.ndarray) -> int:
@@ -775,10 +774,10 @@ class Engine(abc.ABC):
 
     def _cancel_live_tokens(self) -> None:
         for tok in list(getattr(self, "_live_tokens", ())):
-            try:
+            # best-effort reap at close: a child that cannot cancel anymore
+            # is already past the point where its completions could land
+            with contextlib.suppress(Exception):
                 self.cancel(tok)
-            except Exception:
-                pass
 
     def _pump_token(self, tok: StreamToken) -> None:
         """Refill the submission queue from the backlog + piece iterator up

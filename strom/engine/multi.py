@@ -26,6 +26,7 @@ are distinct NVMe devices. Default stays 1 ring (``StromConfig.engine_rings``).
 from __future__ import annotations
 
 import concurrent.futures
+import contextlib
 import itertools
 import threading
 import time
@@ -38,6 +39,7 @@ from strom.engine.base import (ChunkCompletion, Completion, Engine,
                                EngineError, EngineStallError, RawRead,
                                ReadRequest, StreamToken)
 from strom.obs.events import ring as _events
+from strom.utils.locks import make_lock
 
 
 class _FanToken:
@@ -149,11 +151,11 @@ class MultiRingEngine(Engine):
         self._files: dict[int, tuple[str, bool | None]] = {}
         self._next_fi = 0
         self._child_fi: list[dict[int, int]] = [dict() for _ in range(n)]
-        self._reg_lock = threading.Lock()
+        self._reg_lock = make_lock("engine.multi_reg")
         # per-ring transfer locks: child read_vectored is documented
         # non-concurrent; concurrent MultiRing gathers serialize only where
         # they land on the same ring
-        self._ring_locks = [threading.Lock() for _ in range(n)]
+        self._ring_locks = [make_lock("engine.multi_ring") for _ in range(n)]
         self._rr = itertools.count()
         self._pool = concurrent.futures.ThreadPoolExecutor(
             max_workers=n, thread_name_prefix="strom-ring")
@@ -328,12 +330,10 @@ class MultiRingEngine(Engine):
                 and self._ring_errors[ring] >= self._quarantine_after \
                 and len(self._healthy_rings()) > 1:
             self._quarantined.add(ring)
-            try:
+            with contextlib.suppress(Exception):
                 self.op_scope.add("ring_quarantines")
                 self.op_scope.set_gauge("rings_quarantined",
                                         len(self._quarantined))
-            except Exception:
-                pass
 
     def read_vectored(self, chunks: Sequence[tuple[int, int, int, int]],
                       dest: np.ndarray, *, retries: int = 1) -> int:
@@ -434,6 +434,11 @@ class MultiRingEngine(Engine):
         parts = []
         try:
             for r in live:
+                # stromlint: ignore[lock-order] -- token-lifetime ring
+                # ownership: rings are locked in SORTED order (no ABBA
+                # against a concurrent fan-out) and released at token
+                # drain/cancel (_release_locks), the same lifetime the
+                # engine grant has on the delivery side
                 self._ring_locks[r].acquire()
                 locks.append(self._ring_locks[r])
             if len(live) > 1:
@@ -450,10 +455,8 @@ class MultiRingEngine(Engine):
                                   fail_fast=fail_fast), imap))
         except BaseException:
             for _, child, ctok, _ in parts:
-                try:
+                with contextlib.suppress(Exception):
                     child.cancel(ctok)
-                except Exception:
-                    pass
             for lk in locks:
                 lk.release()
             raise
@@ -567,7 +570,7 @@ class MultiRingEngine(Engine):
                 # the whole budget (mark-first is what stops a concurrent
                 # driver competing for their completions)
                 child.cancel(ctok, max(deadline - time.monotonic(), 0.05))
-            except Exception:
+            except Exception:  # stromlint: ignore[swallowed-exceptions] -- best-effort cancel during token teardown: the child may already be closed, and the mark-first contract above is what actually stops completion theft
                 pass
         token.cancelled = True
         token._release_locks()

@@ -23,6 +23,7 @@ from strom.engine.base import Completion, Engine, EngineError, RawRead, ReadRequ
 from strom.obs.events import ring as _events_ring
 from strom.probe.odirect import probe_dio
 from strom.probe.residency import cached_pages, range_fully_cached
+from strom.utils.locks import make_lock
 from strom.utils.stats import StatsRegistry
 
 _libc = ctypes.CDLL(None, use_errno=True)
@@ -63,7 +64,7 @@ class PythonEngine(Engine):
         self._submit_q: queue.SimpleQueue[ReadRequest | None] = queue.SimpleQueue()
         self._done_q: queue.SimpleQueue[Completion] = queue.SimpleQueue()
         self._in_flight = 0
-        self._lock = threading.Lock()
+        self._lock = make_lock("engine.python")
         self._stats = StatsRegistry("engine.python")
         self._fault_counter = 0
         self._closed = False

@@ -33,12 +33,15 @@ its counters through a PR-6 telemetry scope (labeled + aggregate).
 
 from __future__ import annotations
 
+import contextlib
 import errno as _errno
 import random
 import threading
 import time
 from collections import deque
 from typing import Callable
+
+from strom.utils.locks import make_lock
 
 # Counters the resilience layer feeds (single-sourced, same contract as
 # STALL_FIELDS / STREAM_FIELDS / SCHED_FIELDS): the ctx.stats()
@@ -180,7 +183,7 @@ class CircuitBreaker:
         self._clock = clock
         self.on_trip = on_trip
         self._scope = scope
-        self._lock = threading.Lock()
+        self._lock = make_lock("resil.breaker")
         self._events: deque[tuple[float, bool]] = deque()
         self._state = self.CLOSED
         self._opened_at = 0.0
@@ -192,10 +195,9 @@ class CircuitBreaker:
 
     def _gauge(self, state: int) -> None:
         if self._scope is not None:
-            try:
+            # telemetry must never fail breaker state math
+            with contextlib.suppress(Exception):
                 self._scope.set_gauge("breaker_state", state)
-            except Exception:
-                pass
 
     @property
     def state(self) -> int:
@@ -224,10 +226,8 @@ class CircuitBreaker:
             # HALF_OPEN: probe with real traffic
             self.probes += 1
             if self._scope is not None:
-                try:
+                with contextlib.suppress(Exception):
                     self._scope.add("breaker_probes")
-                except Exception:
-                    pass
             return True
 
     def record_success(self) -> None:
@@ -243,10 +243,8 @@ class CircuitBreaker:
                     self.recoveries += 1
                     self._gauge(self.CLOSED)
                     if self._scope is not None:
-                        try:
+                        with contextlib.suppress(Exception):
                             self._scope.add("breaker_recoveries")
-                        except Exception:
-                            pass
 
     def record_failure(self) -> None:
         tripped = False
@@ -273,16 +271,14 @@ class CircuitBreaker:
                 self._gauge(self.OPEN)
         if tripped:
             if self._scope is not None:
-                try:
+                with contextlib.suppress(Exception):
                     self._scope.add("breaker_trips")
-                except Exception:
-                    pass
             if self.on_trip is not None:
-                try:
+                # the flight-dump hook is advisory: a failed dump must not
+                # turn a breaker trip into a read-path crash
+                with contextlib.suppress(Exception):
                     self.on_trip(f"circuit breaker '{self.name}' tripped "
                                  f"(trip #{self.trips})")
-                except Exception:
-                    pass
 
     def info(self) -> dict:
         with self._lock:
@@ -318,7 +314,7 @@ class HedgeController:
         self.min_s = float(min_s)
         self.multiplier = float(multiplier)
         self._window = deque(maxlen=max(int(window), 8))
-        self._lock = threading.Lock()
+        self._lock = make_lock("resil.hedge")
         self._n = 0
         self._p99 = 0.0
 

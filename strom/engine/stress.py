@@ -116,10 +116,13 @@ def run_stress(variant: str = "", *, seconds: float = 3.0,
         # daemon: the leak-and-report path below must be able to EXIT with a
         # wedged thread still alive; non-daemon threads would hang the
         # interpreter in threading._shutdown and eat the diagnostic exit code
-        threads = [threading.Thread(target=reader, args=(i,), daemon=True)
+        threads = [threading.Thread(target=reader, args=(i,), daemon=True,
+                                    name=f"strom-stress-reader-{i}")
                    for i in range(readers)]
-        threads.append(threading.Thread(target=poller, daemon=True))
-        threads.append(threading.Thread(target=registrar, daemon=True))
+        threads.append(threading.Thread(target=poller, daemon=True,
+                                        name="strom-stress-poller"))
+        threads.append(threading.Thread(target=registrar, daemon=True,
+                                        name="strom-stress-registrar"))
         for t in threads:
             t.start()
         time.sleep(seconds)

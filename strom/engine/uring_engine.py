@@ -9,6 +9,7 @@ land in the engine-owned pool and are exposed as zero-copy numpy views.
 
 from __future__ import annotations
 
+import contextlib
 import ctypes
 import errno as _errno
 import os
@@ -22,6 +23,7 @@ from strom.config import StromConfig
 from strom.engine.base import (Completion, DeadlineExceeded, Engine,
                                EngineError, RawRead, ReadRequest)
 from strom.utils.stats import StatsRegistry
+from strom.utils.locks import make_lock
 
 _HIST_BUCKETS = 24
 
@@ -104,7 +106,7 @@ def _split_chunks(chunks, limit: int = _MAX_SEG):
 
 
 _lib = None
-_lib_lock = threading.Lock()
+_lib_lock = make_lock("app.uring_lib")
 
 
 def _load_lib(variant: str = ""):
@@ -209,7 +211,7 @@ class UringEngine(Engine):
         # GC finalizer may call unregister_dest_addr from any thread while
         # the main thread tears the ring down.
         self._dest_regs: dict[int, tuple[int, int]] = {}
-        self._dest_lock = threading.Lock()
+        self._dest_lock = make_lock("engine.uring_dest")
 
     def register_file(self, path: str, *, o_direct: bool | None = None) -> int:
         want = self.config.o_direct if o_direct is None else o_direct
@@ -563,8 +565,8 @@ class UringEngine(Engine):
         self._h = None
 
     def __del__(self) -> None:
-        try:
+        # GC-time close must never raise (interpreter teardown ordering is
+        # arbitrary); an explicit close() reports its own failures
+        with contextlib.suppress(Exception):
             if not self._closed and self._h:
                 self.close()
-        except Exception:
-            pass

@@ -23,6 +23,7 @@ import os
 import random
 import threading
 from typing import Sequence
+from strom.utils.locks import make_lock
 
 FAULT_KINDS = ("errno", "short_read", "bit_flip", "latency", "stuck",
                "engine_death")
@@ -104,7 +105,7 @@ class FaultPlan:
         self.rules = list(rules)
         self.seed = int(seed)
         self._rng = random.Random(self.seed)
-        self._lock = threading.Lock()
+        self._lock = make_lock("faults.plan")
         self._op_index = 0
         self._matches = [0] * len(self.rules)
         self._injected = [0] * len(self.rules)

@@ -30,6 +30,7 @@ it (chaos runs trade a little concurrency for determinism).
 
 from __future__ import annotations
 
+import contextlib
 import errno as _errno
 import threading
 import time
@@ -40,6 +41,7 @@ import numpy as np
 from strom.engine.base import (Completion, Engine, EngineError, RawRead,
                                ReadRequest)
 from strom.faults.plan import Fault, FaultPlan
+from strom.utils.locks import make_lock
 
 
 class FaultyEngine(Engine):
@@ -53,7 +55,7 @@ class FaultyEngine(Engine):
         self.name = f"faulty+{inner.name}"
         if scope is not None:
             self.set_scope(scope)
-        self._lock = threading.Lock()
+        self._lock = make_lock("faults.proxy")
         self._paths: dict[int, str] = {}
         # synthetic completions ready for the next wait (errno / death)
         self._synth: list[Completion] = []
@@ -119,6 +121,9 @@ class FaultyEngine(Engine):
 
             req = _request.current()
             return req.tenant if req is not None else None
+        # stromlint: ignore[swallowed-exceptions] -- no traced request
+        # means 'no tenant', the matcher's documented wildcard; a tenant
+        # probe must never fail the op it decorates
         except Exception:
             return None
 
@@ -128,10 +133,8 @@ class FaultyEngine(Engine):
         f = self.plan.decide(path=path, offset=req.offset,
                              length=req.length, tenant=self._tenant())
         if f is not None:
-            try:
+            with contextlib.suppress(Exception):
                 self.op_scope.add("faults_injected")
-            except Exception:
-                pass
         return f
 
     def _submit_some(self, requests: Sequence) -> int:
@@ -195,10 +198,8 @@ class FaultyEngine(Engine):
                 for f in unwound:
                     self.plan.unwind(f)
                 if unwound:
-                    try:
+                    with contextlib.suppress(Exception):
                         self.op_scope.add("faults_injected", -len(unwound))
-                    except Exception:
-                        pass
                 e.accepted = caller_acc
                 raise
         return len(requests)
@@ -220,8 +221,12 @@ class FaultyEngine(Engine):
                 view = self.inner.buffer(req.buf_index)
                 off = req.buf_offset + min(f.flip_offset, req.length - 1)
             view[off] ^= f.flip_mask
+        # stromlint: ignore[swallowed-exceptions] -- a flip that cannot
+        # land (read-only view, zero-length op) must degrade to a no-op
+        # injection, not crash the completion path; the plan's per-rule
+        # injected tally already counted the decision
         except Exception:
-            pass  # a failed flip must never turn injection into a crash
+            pass
 
     def _transform(self, c: Completion) -> "Completion | None":
         """Apply a completion-time fault; None = held (not delivered)."""

@@ -43,11 +43,15 @@ import numpy as np
 
 from strom.obs.events import ring
 from strom.utils.stats import global_stats
+from strom.utils.locks import make_lock
 
 try:
     import cv2
 
     _HAVE_CV2 = True
+# stromlint: ignore[swallowed-exceptions] -- capability probe: cv2 can
+# fail to import with non-ImportError (missing libGL raises OSError);
+# either way the flag flips and every decode path branches on it
 except Exception:  # pragma: no cover - cv2 is present in the target image
     _HAVE_CV2 = False
 
@@ -56,6 +60,8 @@ try:
     import io
 
     _HAVE_PIL = True
+# stromlint: ignore[swallowed-exceptions] -- capability probe, same
+# contract as the cv2 probe above: the flag is the observable outcome
 except Exception:  # pragma: no cover
     _HAVE_PIL = False
 
@@ -321,7 +327,7 @@ class DecodePool:
         self._pool = concurrent.futures.ThreadPoolExecutor(
             max_workers=workers, thread_name_prefix="strom-decode")
         self.decode_errors = 0
-        self._err_lock = threading.Lock()
+        self._err_lock = make_lock("app.jpeg_errs")
         self._closed = False
 
     @staticmethod

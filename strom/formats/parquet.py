@@ -19,6 +19,7 @@ from typing import TYPE_CHECKING, Sequence
 import numpy as np
 
 from strom.delivery.extents import Extent, ExtentList
+from strom.utils.locks import make_lock
 
 if TYPE_CHECKING:
     import pyarrow as pa
@@ -445,7 +446,7 @@ class ParquetShard:
         # lock keeps "read once" true under that concurrency
         import threading
 
-        self._footer_lock = threading.Lock()
+        self._footer_lock = make_lock("app.parquet_footer")
         self._col_index = {
             self.metadata.schema.column(i).path: i
             for i in range(self.metadata.num_columns)

@@ -28,6 +28,7 @@ from __future__ import annotations
 import contextlib
 import threading
 import time
+from strom.utils.locks import make_lock
 
 # instant events use dur_us = -1 so snapshot() can tell them apart without a
 # second per-event field; flow events (the Chrome-trace s/t/f arrows that
@@ -53,7 +54,7 @@ class EventRing:
         self._slots: list[tuple | None] = [None] * capacity
         self._idx = 0          # total events ever written (monotonic)
         self._dropped = 0      # events overwritten after the first wrap
-        self._lock = threading.Lock()
+        self._lock = make_lock("ring.events")
         self._t0 = time.perf_counter()
         self.enabled = enabled
 

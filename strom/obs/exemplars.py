@@ -24,6 +24,7 @@ from __future__ import annotations
 
 import threading
 from collections import deque
+from strom.utils.locks import make_lock
 
 # flat numeric leaves for the ``exemplars`` stats section + flight samples
 # (single-sourced, same contract as FLIGHT_FIELDS / STALL_FIELDS)
@@ -48,7 +49,7 @@ class ExemplarStore:
         # yet: only throttled/errored requests are retained (a cold store
         # must not keep every warm-up request as "slow")
         self.min_window = int(min_window)
-        self._lock = threading.Lock()
+        self._lock = make_lock("obs.exemplars")
         self._kept: dict[str, deque] = {}       # tenant -> exemplar docs
         # latency windows are keyed (tenant, kind): a tenant's "step"
         # requests (consumer compute included) must not define "slow" for

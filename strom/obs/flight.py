@@ -35,6 +35,7 @@ lifetime).
 from __future__ import annotations
 
 import contextlib
+import itertools
 import json
 import os
 import signal
@@ -45,6 +46,7 @@ import traceback
 from typing import Callable
 
 from strom.obs.events import EventRing, ring as _global_ring
+from strom.utils.locks import make_lock
 
 # one flight sample per watchdog tick, single-sourced (the lint and the
 # bundle loader read this tuple, same contract as STALL_FIELDS /
@@ -139,6 +141,55 @@ def capture_doc(*, ctx=None, ring: EventRing | None = None,
     }
 
 
+def _write_bundle(flight_dir: str, cap: dict, reason: str,
+                  serial: int) -> str:
+    """Write one capture document as an atomic bundle dir under
+    *flight_dir* and return its path. Contents land in a ``.tmp-`` dir
+    first and rename into place LAST, so readers never see a partial
+    bundle (the same atomicity contract bench.py's partial-JSON flush
+    has). Shared by :meth:`FlightRecorder.dump` and the recorder-less
+    :func:`dump_capture` (the lock-order witness's cycle dump)."""
+    name = f"flight-{os.getpid()}-{reason}-{serial:03d}"
+    final = os.path.join(flight_dir, name)
+    tmp = os.path.join(flight_dir, f".tmp-{name}")
+    os.makedirs(tmp, exist_ok=True)
+    manifest = {k: cap[k] for k in
+                ("reason", "note", "pid", "fields", "samples",
+                 "stall_s", "interval_s")}
+    with open(os.path.join(tmp, BUNDLE_MANIFEST), "w") as f:
+        json.dump(manifest, f)
+    with open(os.path.join(tmp, BUNDLE_TRACE), "w") as f:
+        json.dump(cap["trace"], f)
+    with open(os.path.join(tmp, BUNDLE_STATS), "w") as f:
+        json.dump(cap["stats"], f, default=str)
+    with open(os.path.join(tmp, BUNDLE_STACKS), "w") as f:
+        f.write(cap["stacks"])
+    with open(os.path.join(tmp, BUNDLE_EXEMPLARS), "w") as f:
+        json.dump(cap.get("exemplars", {}), f, default=str)
+    if os.path.isdir(final):  # a previous half-life of this serial
+        final = final + f"-{int(time.time())}"
+    os.rename(tmp, final)
+    return final
+
+
+# thread-safe ad-hoc serial: two simultaneous dumps (e.g. two threads
+# tripping the lock witness at once) must not share a bundle dir
+_adhoc_serial = itertools.count(1)
+
+
+def dump_capture(flight_dir: str, *, reason: str = "on_demand",
+                 note: str = "", ctx=None) -> str:
+    """One-shot bundle dump with no recorder: a point-in-time
+    :func:`capture_doc` written atomically under *flight_dir*. The
+    lock-order witness (strom/utils/locks.py) dumps through this when a
+    cycle is detected, so the inversion arrives with stacks, stats and
+    the event-ring trace attached."""
+    os.makedirs(flight_dir, exist_ok=True)
+    return _write_bundle(flight_dir, capture_doc(ctx=ctx, reason=reason,
+                                                 note=note),
+                         reason, next(_adhoc_serial))
+
+
 class FlightRecorder:
     """Watchdog + sample ring + crash-bundle dumper.
 
@@ -163,7 +214,7 @@ class FlightRecorder:
         self.interval_s = max(float(interval_s), 0.01)
         self._samples: list[dict] = []
         self._max_samples = max(int(max_samples), 8)
-        self._lock = threading.Lock()
+        self._lock = make_lock("obs.flight")
         self._t0 = time.monotonic()
         self._progress_fn = progress_fn or self._default_progress
         self._last_progress_val: float | None = None
@@ -234,6 +285,10 @@ class FlightRecorder:
         now = time.monotonic()
         try:
             prog = float(self._progress_fn())
+        # stromlint: ignore[swallowed-exceptions] -- a failing progress
+        # probe skips THIS tick and the next tick retries; counting it
+        # through the stats registry could recurse into the very probe
+        # that failed (the default probe reads the registry)
         except Exception:
             return
         if self._last_progress_val is None or prog != self._last_progress_val:
@@ -319,27 +374,7 @@ class FlightRecorder:
         with self._lock:
             self._dumps += 1
             serial = self._dumps
-        name = f"flight-{os.getpid()}-{reason}-{serial:03d}"
-        final = os.path.join(self.flight_dir, name)
-        tmp = os.path.join(self.flight_dir, f".tmp-{name}")
-        os.makedirs(tmp, exist_ok=True)
-        manifest = {k: cap[k] for k in
-                    ("reason", "note", "pid", "fields", "samples",
-                     "stall_s", "interval_s")}
-        with open(os.path.join(tmp, BUNDLE_MANIFEST), "w") as f:
-            json.dump(manifest, f)
-        with open(os.path.join(tmp, BUNDLE_TRACE), "w") as f:
-            json.dump(cap["trace"], f)
-        with open(os.path.join(tmp, BUNDLE_STATS), "w") as f:
-            json.dump(cap["stats"], f, default=str)
-        with open(os.path.join(tmp, BUNDLE_STACKS), "w") as f:
-            f.write(cap["stacks"])
-        with open(os.path.join(tmp, BUNDLE_EXEMPLARS), "w") as f:
-            json.dump(cap.get("exemplars", {}), f, default=str)
-        if os.path.isdir(final):  # a previous half-life of this serial
-            final = final + f"-{int(time.time())}"
-        os.rename(tmp, final)
-        return final
+        return _write_bundle(self.flight_dir, cap, reason, serial)
 
     # -- lifecycle ----------------------------------------------------------
     def close(self) -> None:

@@ -22,6 +22,7 @@ from __future__ import annotations
 
 import threading
 import time
+from strom.utils.locks import make_lock
 
 # keys every sample carries beyond the registry mirror
 HISTORY_META_KEYS = ("ts_s",)
@@ -43,8 +44,12 @@ class StatsHistory:
         self.capacity = max(int(capacity), 2)
         self._clock = clock
         self._t0 = clock()
-        self._lock = threading.Lock()
+        self._lock = make_lock("obs.history")
         self._samples: list[dict] = []
+        # failed ticks: 'sampler silently broken' must stay
+        # distinguishable from 'nothing changed' (the *_errors counter
+        # convention the swallowed-exceptions lint enforces)
+        self.sample_errors = 0
         self._closed = threading.Event()
         self._thread: threading.Thread | None = None
         if start:
@@ -78,7 +83,9 @@ class StatsHistory:
             try:
                 self.sample()
             except Exception:
-                pass  # a failed tick must never kill the sampler
+                # a failed tick must never kill the sampler — but it is
+                # COUNTED, and surfaced in the /history body
+                self.sample_errors += 1
 
     # -- reads ---------------------------------------------------------------
     def samples(self, since_s: "float | None" = None,
@@ -97,6 +104,7 @@ class StatsHistory:
         """The ``/history`` route body."""
         return {"interval_s": self.interval_s,
                 "capacity": self.capacity,
+                "sample_errors": self.sample_errors,
                 "samples": self.samples(since_s, keys)}
 
     def rate(self, key: str, window_s: "float | None" = None,

@@ -44,6 +44,7 @@ import threading
 from typing import Callable
 
 from strom.obs.events import ring
+from strom.utils.locks import make_lock
 
 # spans retained per request tree: enough for a batch-sized gather
 # (sched slices + per-sample decode + per-device puts) without letting a
@@ -58,7 +59,7 @@ _current: "contextvars.ContextVar[Request | None]" = \
 # finish-time observers (the SLO engine registers per-context): called with
 # the finished Request under no locks. Guarded copy-on-write.
 _observers: list[Callable] = []
-_observers_lock = threading.Lock()
+_observers_lock = make_lock("obs.request_observers")
 
 
 def add_observer(fn: Callable) -> None:
@@ -99,7 +100,7 @@ class Request:
         self.spans: list[tuple] = []
         self.spans_dropped = 0
         self._open: dict[int, list[str]] = {}   # tid -> open-span name stack
-        self._lock = threading.Lock()
+        self._lock = make_lock("obs.request")
         self._finished = False
         self._flow_started = False
         # deadline (ISSUE 9): absolute time.monotonic() seconds, or None.

@@ -63,6 +63,7 @@ from typing import Callable
 
 from strom.obs.chrome_trace import trace_document
 from strom.obs.events import EventRing, ring as _global_ring
+from strom.utils.locks import make_lock
 
 # sections that are nested maps (not flat numeric leaves): excluded from
 # the Prometheus section sweep — their data reaches /metrics another way
@@ -100,7 +101,7 @@ class MetricsServer:
         # per-section rendered exposition cache: name -> (monotonic_t, text)
         self._sec_cache: dict[str, tuple[float, str]] = {}
         self._known_sections: list[str] = []
-        self._cache_lock = threading.Lock()
+        self._cache_lock = make_lock("app.server_cache")
         server = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -207,6 +208,9 @@ class MetricsServer:
                     with contextlib.suppress(Exception):
                         self._send(400, f"bad query: {e}\n".encode(),
                                    "text/plain")
+                # stromlint: ignore[swallowed-exceptions] -- the exception
+                # IS surfaced: repr(e) becomes the HTTP 500 body (the
+                # scrape-never-kills-the-server contract)
                 except Exception as e:  # a scrape must never kill the server
                     with contextlib.suppress(Exception):
                         self._send(500, repr(e).encode(), "text/plain")
@@ -248,6 +252,8 @@ class MetricsServer:
                         self._send(200, json.dumps(out,
                                                    default=str).encode(),
                                    "application/json")
+                # stromlint: ignore[swallowed-exceptions] -- surfaced as
+                # the HTTP 500 body, same contract as the GET handler
                 except Exception as e:  # same 500-survival contract as GET
                     with contextlib.suppress(Exception):
                         self._send(500, repr(e).encode(), "text/plain")

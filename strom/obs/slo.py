@@ -31,6 +31,7 @@ import dataclasses
 import threading
 import time
 from collections import deque
+from strom.utils.locks import make_lock
 
 # per-tenant gauge names the engine writes into tenant scopes (labeled on
 # /metrics) — single-sourced for the lint, same contract as FLIGHT_FIELDS
@@ -90,7 +91,7 @@ class SloEngine:
         # attribution goodput_pct (None = unknown) for goodput targets
         self._goodput_fn = goodput_fn
         self._targets: dict[str, SloTarget] = {}
-        self._lock = threading.Lock()
+        self._lock = make_lock("obs.slo")
         # tenant -> deque of [bucket_index, good, bad], oldest first,
         # trimmed to the slow window
         self._buckets: dict[str, deque] = {}
@@ -189,6 +190,9 @@ class SloEngine:
         if self._goodput_fn is not None:
             try:
                 goodput = self._goodput_fn()
+            # stromlint: ignore[swallowed-exceptions] -- None is the
+            # documented 'goodput unknown' report state; the fn rides
+            # ctx.stats(), which a closing context may legally refuse
             except Exception:
                 goodput = None
         rows: dict[str, dict] = {}

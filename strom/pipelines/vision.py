@@ -39,6 +39,7 @@ from strom.formats.wds import WdsShardSet
 from strom.pipelines.base import Pipeline, _auto_depth_bounds, resolve_state
 from strom.pipelines.sampler import EpochShuffleSampler, SamplerState
 from strom.utils.stats import global_stats
+from strom.utils.locks import make_lock
 
 # transform(jpeg_bytes, rng[, out=row]) -> HWC uint8; transforms accepting
 # an `out=` keyword get direct-to-slot decode (see make_train_transform)
@@ -254,7 +255,7 @@ def _decode_put_streamed(ctx: StromContext, pool: DecodePool, tf: Transform,
     events: "_queue.SimpleQueue" = _queue.SimpleQueue()
     stop = threading.Event()
     futs: list = []
-    futs_lock = threading.Lock()
+    futs_lock = make_lock("app.vision_futs")
     t_decode0: list[float | None] = [None]
 
     scope = scope or global_stats

@@ -61,6 +61,7 @@ import time
 from typing import Any, Callable, Sequence
 
 from strom.engine.base import DeadlineExceeded
+from strom.utils.locks import make_condition
 from strom.sched.budget import AdmissionGate
 from strom.sched.tenant import PRIORITIES, PRIORITY_ORDER, Tenant
 
@@ -119,7 +120,7 @@ class IoScheduler:
         # engines with internal per-ring arbitration keep their concurrency:
         # grants are non-exclusive there (budgets/accounting still apply)
         self.exclusive = not getattr(engine, "concurrent_gathers", False)
-        self._cond = threading.Condition()
+        self._cond = make_condition("sched.arbiter")
         self._tenants: dict[str, Tenant] = {}
         self._current: _Waiter | None = None
         # service baseline: a tenant going active joins at this vtime, so

@@ -14,6 +14,8 @@ import contextlib
 import threading
 import time
 import weakref
+
+from strom.utils.locks import make_lock
 from typing import Iterable, Sequence
 
 
@@ -22,7 +24,7 @@ class _Counter:
 
     def __init__(self) -> None:
         self.value = 0
-        self._lock = threading.Lock()
+        self._lock = make_lock("stats.series")
 
     def add(self, n: int = 1) -> None:
         with self._lock:
@@ -38,7 +40,7 @@ class _Gauge:
 
     def __init__(self) -> None:
         self.value = 0
-        self._lock = threading.Lock()
+        self._lock = make_lock("stats.series")
 
     def set(self, v: float) -> None:
         with self._lock:
@@ -61,7 +63,7 @@ class _Histogram:
         self.buckets = [0] * self.N_BUCKETS
         self.count = 0
         self.total_us = 0.0
-        self._lock = threading.Lock()
+        self._lock = make_lock("stats.series")
 
     def observe_us(self, us: float) -> None:
         # bucket i holds [2^i, 2^(i+1)) — the same convention as the C
@@ -277,7 +279,7 @@ class StatsRegistry:
         self._counters: dict[str, _Counter] = {}
         self._hists: dict[str, _Histogram] = {}
         self._gauges: dict[str, _Gauge] = {}
-        self._lock = threading.Lock()
+        self._lock = make_lock("stats.registry")
         # label-tuple -> child StatsRegistry holding that scope's series
         # (created by scoped(); see ScopedStats)
         self._scopes: dict[tuple, "StatsRegistry"] = {}
@@ -565,6 +567,6 @@ def sections_prometheus(sections: dict, prefix: str = "strom",
 # (per-pipeline prefetcher stats) don't accumulate forever. Adds are
 # serialized against iteration by the lock (see all_counter_names).
 _registries: "weakref.WeakSet[StatsRegistry]" = weakref.WeakSet()
-_registries_lock = threading.Lock()
+_registries_lock = make_lock("stats.registries")
 
 global_stats = StatsRegistry("strom")

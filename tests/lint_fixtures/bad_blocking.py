@@ -1,0 +1,24 @@
+"""stromlint fixture: blocking calls under a held lock."""
+
+import time
+
+from strom.utils.locks import make_lock
+
+_LOCK = make_lock("cache.meta")
+
+
+def bad(cond, q, fut, engine, tok):
+    with _LOCK:
+        time.sleep(0.1)
+        cond.wait()
+        q.get()
+        fut.result()
+        open("/tmp/x")
+        engine.poll(tok)
+
+
+def fine(cond, q, engine, tok):
+    with _LOCK:
+        cond.wait(0.05)
+        q.get(timeout=1.0)
+        engine.poll(tok, 1, 0.5)

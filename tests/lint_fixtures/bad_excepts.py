@@ -1,0 +1,22 @@
+"""stromlint fixture: swallowed exceptions."""
+
+
+def swallow(work):
+    try:
+        work()
+    except Exception:
+        pass
+
+
+def counted(work, stats):
+    try:
+        work()
+    except Exception:
+        stats.add("fixture_errors")
+
+
+def reraised(work):
+    try:
+        work()
+    except Exception:
+        raise RuntimeError("wrapped") from None

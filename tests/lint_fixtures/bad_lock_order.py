@@ -1,0 +1,39 @@
+"""stromlint fixture: every shape the lock-order pass must flag.
+
+NOT imported by anything — the linter parses it as a file. Uses REAL
+hierarchy names so the fixture exercises the real rank table.
+"""
+
+import threading
+
+from strom.utils.locks import make_lock
+
+
+class Bad:
+    def __init__(self):
+        self._cache_lock = make_lock("cache.meta")
+        self._pool_lock = make_lock("slab.pool")
+        self._mystery_lock = threading.Lock()  # not declared via make_lock
+
+    def inverted(self):
+        # slab pool ranks BEFORE hot cache: acquiring it under the cache
+        # lock is the canonical inversion
+        with self._cache_lock:
+            with self._pool_lock:
+                pass
+
+    def undeclared_pair(self):
+        with self._mystery_lock:
+            with self._cache_lock:
+                pass
+
+    def unscoped(self):
+        self._cache_lock.acquire()
+
+    def helper_inversion(self):
+        with self._cache_lock:
+            self._frees_a_slab()
+
+    def _frees_a_slab(self):
+        with self._pool_lock:
+            pass

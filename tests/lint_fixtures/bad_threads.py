@@ -1,0 +1,14 @@
+"""stromlint fixture: anonymous / unreclaimed threads."""
+
+import threading
+
+
+def spawn():
+    t = threading.Thread(target=print)  # no name, not daemon, never joined
+    t.start()
+    return t
+
+
+def good():
+    t = threading.Thread(target=print, name="fixture-good", daemon=True)
+    t.start()

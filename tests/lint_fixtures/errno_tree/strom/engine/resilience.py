@@ -1,0 +1,6 @@
+"""stromlint errno fixture: the classification tables."""
+
+import errno as _errno
+
+TRANSIENT_ERRNOS = frozenset({_errno.EIO, _errno.ETIMEDOUT})
+PERMANENT_ERRNOS = frozenset({_errno.EBADF})

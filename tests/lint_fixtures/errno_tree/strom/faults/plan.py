@@ -1,0 +1,8 @@
+"""stromlint errno fixture: a fault plan referencing an errno the
+resilience tables never classified."""
+
+import errno as _errno
+
+DEFAULT_ERR = _errno.EIO
+SNEAKY_ERR = _errno.EOWNERDEAD  # classified by neither table
+NAMED_ERR = "ETIMEDOUT"

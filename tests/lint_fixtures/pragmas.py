@@ -1,0 +1,18 @@
+"""stromlint fixture: pragma handling — one unexplained (itself a
+finding), one justified (suppresses cleanly)."""
+
+
+def unexplained(work):
+    try:
+        work()
+    except Exception:  # stromlint: ignore[swallowed-exceptions]
+        pass
+
+
+def justified(work):
+    try:
+        work()
+    # stromlint: ignore[swallowed-exceptions] -- fixture: the caller
+    # re-runs this work and counts failures itself
+    except Exception:
+        pass

@@ -1,0 +1,208 @@
+"""The runtime half of the lock discipline (ISSUE 11): WitnessLock's
+process-wide acquisition graph raises a typed LockOrderError naming both
+sites the moment two locks are ever taken in both orders, dumps a
+loadable flight bundle at the cycle, and keeps a truthful held-stack
+across Condition.wait. The static half is tests/test_stromlint.py."""
+
+import threading
+
+import pytest
+
+from strom.obs.flight import load_bundle
+from strom.utils import locks
+
+
+@pytest.fixture
+def witness_on():
+    prev_enabled = locks.witness_enabled()
+    locks.witness.reset()
+    locks.enable_witness(True)
+    try:
+        yield
+    finally:
+        locks.enable_witness(prev_enabled)
+        locks.witness.reset()
+        locks.set_flight_dir(None)
+
+
+def _seed_inversion(a, b):
+    """Take a→b, then attempt b→a; returns the raised LockOrderError."""
+    with a:
+        with b:
+            pass
+    with pytest.raises(locks.LockOrderError) as ei:
+        with b:
+            with a:
+                pass
+    return ei.value
+
+
+def test_inversion_raises_typed_error(witness_on):
+    a = locks.WitnessLock("t.a")
+    b = locks.WitnessLock("t.b")
+    err = _seed_inversion(a, b)
+    assert err.edge == ("t.b", "t.a")
+    # both directions of the cycle carry their first-observed sites
+    assert set(err.sites) == {"t.a -> t.b", "t.b -> t.a"}
+    assert all("test_locks.py" in site for site in err.sites.values())
+
+
+def test_three_lock_cycle_detected(witness_on):
+    """A cycle through 3+ locks (A→B, B→C, then C→A) deadlocks just as
+    surely as a direct inversion; the witness checks REACHABILITY, not
+    just the direct reverse edge, and names every edge of the cycle."""
+    a = locks.WitnessLock("t3.a")
+    b = locks.WitnessLock("t3.b")
+    c = locks.WitnessLock("t3.c")
+    with a:
+        with b:
+            pass
+    with b:
+        with c:
+            pass
+    with pytest.raises(locks.LockOrderError) as ei:
+        with c:
+            with a:
+                pass
+    err = ei.value
+    assert err.edge == ("t3.c", "t3.a")
+    assert set(err.sites) == {"t3.c -> t3.a", "t3.a -> t3.b",
+                              "t3.b -> t3.c"}
+    assert "3-lock cycle" in str(err)
+
+
+def test_witness_enable_reverts_on_ctx_close(witness_on):
+    """StromContext(debug_locks=True) must not leave the process-global
+    witness on for every later context (close() reverts exactly what
+    __init__ enabled)."""
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    from strom.config import StromConfig
+    from strom.delivery.core import StromContext
+
+    locks.enable_witness(False)
+    ctx = StromContext(StromConfig(debug_locks=True, sched_enabled=False,
+                                   slab_pool_bytes=0))
+    try:
+        assert locks.witness_enabled()
+    finally:
+        ctx.close()
+    assert not locks.witness_enabled()
+
+
+def test_cycle_check_fires_before_acquiring(witness_on):
+    """The raise happens BEFORE the inner lock is taken: the offending
+    lock must remain free (a held leak here would convert every caught
+    inversion into a later deadlock)."""
+    a = locks.WitnessLock("t.a")
+    b = locks.WitnessLock("t.b")
+    _seed_inversion(a, b)
+    assert not a.locked()
+    assert not b.locked()
+
+
+def test_same_name_never_self_cycles(witness_on):
+    """Two instances of one ROLE (every _Counter shares 'stats.series')
+    may nest without tripping the witness — the graph is keyed by role."""
+    a1 = locks.WitnessLock("t.same")
+    a2 = locks.WitnessLock("t.same")
+    with a1:
+        with a2:
+            pass
+    with a2:
+        with a1:
+            pass  # opposite instance order, same role: fine
+
+
+def test_cycle_dumps_loadable_flight_bundle(witness_on, tmp_path):
+    locks.set_flight_dir(str(tmp_path))
+    a = locks.WitnessLock("t.a")
+    b = locks.WitnessLock("t.b")
+    _seed_inversion(a, b)
+    bundles = [d for d in tmp_path.iterdir()
+               if d.name.startswith("flight-")]
+    assert len(bundles) == 1
+    doc = load_bundle(str(bundles[0]))
+    assert doc["manifest"]["reason"] == "lock_order"
+    assert "lock order inversion" in doc["manifest"]["note"]
+    assert "stacks" in doc and doc["stacks"]
+
+
+def test_condition_wait_keeps_held_stack_truthful(witness_on):
+    """Condition.wait releases through WitnessLock.release and re-acquires
+    through acquire: during the wait the role is NOT held, so another
+    lock taken by the woken thread sees the right stack."""
+    cond = locks.make_condition("t.cond")
+    other = locks.WitnessLock("t.other")
+    with cond:
+        cond.wait(0.01)
+    # wait() ran release→acquire; the held stack must be balanced now
+    with other:
+        with cond:
+            pass
+    assert ("t.other -> t.cond") in locks.witness.edges()
+
+
+def test_factory_is_plain_when_disabled():
+    prev = locks.witness_enabled()
+    locks.enable_witness(False)
+    try:
+        lk = locks.make_lock("t.plain")
+        assert type(lk) is type(threading.Lock())
+        cond = locks.make_condition("t.plain_cond")
+        assert isinstance(cond, threading.Condition)
+        assert not isinstance(cond._lock, locks.WitnessLock)
+    finally:
+        locks.enable_witness(prev)
+
+
+def test_factory_is_witnessed_when_enabled(witness_on):
+    lk = locks.make_lock("t.w")
+    assert isinstance(lk, locks.WitnessLock)
+    cond = locks.make_condition("t.wc")
+    assert isinstance(cond._lock, locks.WitnessLock)
+
+
+def test_graph_survives_threads(witness_on):
+    """Edges recorded on one thread convict the opposite order on
+    another — the graph is process-wide, the held stack per-thread."""
+    a = locks.WitnessLock("t.a")
+    b = locks.WitnessLock("t.b")
+
+    def fwd():
+        with a:
+            with b:
+                pass
+
+    t = threading.Thread(target=fwd, name="witness-fwd")
+    t.start()
+    t.join()
+    with pytest.raises(locks.LockOrderError):
+        with b:
+            with a:
+                pass
+
+
+def test_hot_cache_eviction_respects_hierarchy(witness_on):
+    """Integration: the HotCache eviction path (the audited hot spot —
+    slab frees now happen OUTSIDE the cache lock) plus pool recycling
+    runs clean under the witness. Seeding the legal pool→cache order
+    first makes any regression to free-under-lock an immediate raise."""
+    from strom.delivery.buffers import SlabPool
+    from strom.delivery.hotcache import HotCache
+
+    pool = SlabPool(1 << 22)
+    cache = HotCache(1 << 16, pool=pool, admit="always",
+                     block_bytes=4096)
+    import numpy as np
+
+    data = np.zeros(1 << 15, dtype=np.uint8)
+    # several admissions over one budget force evictions (and pool
+    # releases) on the admit path; lookups pin/unpin around them
+    for i in range(6):
+        cache.admit(f"f{i}", 0, data.nbytes, data)
+        hits, misses, pinned = cache.lookup(f"f{i}", 0, 4096)
+        cache.unpin(pinned)
+    cache.clear()
+    assert cache.entries == 0

@@ -1,0 +1,150 @@
+"""stromlint: AST-based concurrency-discipline analyzer (ISSUE 11).
+
+Five tier-1-wired passes over one shared AST core
+(tools/stromlint/core.py):
+
+- ``lock-order`` — every statically visible nested acquisition checked
+  against the canonical hierarchy ``scheduler → engine → slab pool →
+  hot cache → stats/ring`` (tools/stromlint/hierarchy.py); inversions,
+  undeclared lock pairs, and unscoped acquisitions fail.
+- ``blocking-under-lock`` — time.sleep, timeout-less waits/joins/gets,
+  file/socket I/O, unbounded poll/drain inside a held-lock body.
+- ``thread-lifecycle`` — every ``threading.Thread(...)`` carries
+  ``name=`` (flight-recorder stack dumps key on it) and is daemonized
+  or joined.
+- ``errno-exhaustiveness`` — every errno the fault plan can inject is
+  classified by ``resilience.classify_errno``'s tables.
+- ``swallowed-exceptions`` — broad handlers must re-raise or mark the
+  error (the repo's ``*_errors`` counter convention).
+
+Suppressions: ``# stromlint: ignore[rule] -- reason`` — the reason is
+mandatory (an unexplained pragma is a finding of rule ``pragma``).
+
+CLI::
+
+    python -m tools.stromlint --check [--json] [--select R[,R..]]
+        [--ignore R[,R..]] [--paths FILE..] [ROOT]
+
+Exit 0 = clean, 1 = findings, 2 = usage error. The dynamic complement is
+``strom.utils.locks.WitnessLock`` (``STROM_DEBUG_LOCKS=1``): the static
+hierarchy and the runtime lock-order witness cross-validate each other.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+from tools.stromlint.core import (DEFAULT_ROOTS, RULES, Finding, LockModel,
+                                  Module, load_modules)
+
+__all__ = ["main", "run_rules", "RULES", "Finding"]
+
+
+def run_rules(root: str, *, select: "list[str] | None" = None,
+              ignore: "list[str] | None" = None,
+              paths: "list[str] | None" = None) -> dict:
+    """Run the selected passes; returns the findings document:
+    ``{"findings": [...], "suppressed": n, "files": n, "ok": bool}``.
+    Findings covered by a justified pragma are dropped (counted in
+    ``suppressed``); pragmas missing their ``-- reason`` surface as
+    rule ``pragma`` findings, which cannot be suppressed."""
+    from tools.stromlint.passes import ALL_PASSES
+
+    wanted = set(select) if select else set(RULES)
+    wanted -= set(ignore or ())
+    bad = wanted - set(RULES)
+    if bad:
+        raise ValueError(f"unknown rule(s): {sorted(bad)} "
+                         f"(rules: {', '.join(RULES)})")
+    modules = load_modules(root, DEFAULT_ROOTS, paths=paths)
+    by_rel = {m.rel: m for m in modules}
+    model = LockModel()
+    model.scan(modules)
+    findings: list[Finding] = []
+    suppressed = 0
+    for p in ALL_PASSES:
+        if p.RULE not in wanted:
+            continue
+        for f in p.run(modules, root, model):
+            m = by_rel.get(f.path)
+            if m is not None and m.suppressed(f.rule, f.line):
+                suppressed += 1
+                continue
+            findings.append(f)
+    if "pragma" in wanted:
+        for m in modules:
+            for line, rules in sorted(m.pragmas.items()):
+                for rule, reason in sorted(rules.items()):
+                    if reason is None:
+                        findings.append(Finding(
+                            "pragma", m.rel, line,
+                            f"suppression of [{rule}] without a reason: "
+                            f"write '# stromlint: ignore[{rule}] -- why'"))
+    findings.sort(key=lambda f: (f.path, f.line, f.rule))
+    return {"findings": findings, "suppressed": suppressed,
+            "files": len(modules), "locks": len(model.sites),
+            "ok": not findings}
+
+
+def main(argv: "list[str] | None" = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="stromlint",
+        description="AST concurrency-discipline analyzer for strom")
+    ap.add_argument("root", nargs="?", default=None,
+                    help="repo root (default: this checkout)")
+    ap.add_argument("--check", action="store_true",
+                    help="exit non-zero on findings (the default behavior; "
+                         "the flag exists for explicit CI spelling)")
+    ap.add_argument("--json", action="store_true", dest="as_json",
+                    help="machine-readable findings document on stdout")
+    ap.add_argument("--select", default=None,
+                    help="comma-separated rules to run (default: all)")
+    ap.add_argument("--ignore", default=None,
+                    help="comma-separated rules to skip")
+    ap.add_argument("--paths", nargs="*", default=None,
+                    help="scan exactly these files/dirs instead of the "
+                         "default roots (fixture tests use this)")
+    ap.add_argument("--list-rules", action="store_true",
+                    help="print the rule slugs and exit")
+    try:
+        args = ap.parse_args(argv)
+    except SystemExit as e:
+        return 2 if e.code not in (0, None) else 0
+    if args.list_rules:
+        print("\n".join(RULES))
+        return 0
+    root = args.root or os.path.dirname(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))))
+    if not os.path.isdir(root):
+        print(f"stromlint: not a directory: {root}", file=sys.stderr)
+        return 2
+    select = [s.strip() for s in args.select.split(",")] \
+        if args.select else None
+    ignore = [s.strip() for s in args.ignore.split(",")] \
+        if args.ignore else None
+    try:
+        doc = run_rules(root, select=select, ignore=ignore,
+                        paths=args.paths)
+    except ValueError as e:
+        print(f"stromlint: {e}", file=sys.stderr)
+        return 2
+    if args.as_json:
+        print(json.dumps({
+            "ok": doc["ok"], "files": doc["files"], "locks": doc["locks"],
+            "suppressed": doc["suppressed"],
+            "findings": [f.doc() for f in doc["findings"]],
+        }, indent=2))
+    else:
+        for f in doc["findings"]:
+            print(f.render(), file=sys.stderr)
+        if doc["ok"]:
+            print(f"stromlint: {doc['files']} files, {doc['locks']} "
+                  f"declared locks, {doc['suppressed']} justified "
+                  f"suppression(s), 0 findings")
+        else:
+            print(f"stromlint: {len(doc['findings'])} finding(s)",
+                  file=sys.stderr)
+    return 0 if doc["ok"] else 1

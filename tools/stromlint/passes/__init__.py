@@ -1,0 +1,7 @@
+"""stromlint rule passes. Each module exposes ``RULE`` (its slug) and
+``run(modules, root, model) -> list[Finding]``."""
+
+from tools.stromlint.passes import (blocking, errnos, excepts, lock_order,
+                                    threads)
+
+ALL_PASSES = (lock_order, blocking, threads, errnos, excepts)

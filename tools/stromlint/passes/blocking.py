@@ -1,0 +1,88 @@
+"""blocking-under-lock: no unbounded waits inside a held-lock body.
+
+A blocking call under a held lock turns one slow/wedged operation into a
+pile-up behind the mutex — the exact shape the flight recorder keeps
+finding in stall bundles. Inside any statically visible held-lock body
+(with-statement over a declared or lock-like object, including the
+``sched.grant`` ownership pseudo-lock), flag:
+
+- ``time.sleep(...)``
+- ``.wait()`` with no timeout (Condition/Event/thread waits)
+- ``.join()`` with no timeout
+- ``.get()`` / ``.result()`` with no arguments (queue/future blocking
+  reads; ``dict.get`` always has arguments, so zero-arg ``.get()`` is a
+  queue)
+- file/socket I/O: ``open``, blocking ``os.*`` reads/writes/syncs,
+  ``socket.*``, ``.recv``/``.accept``/``.connect``/``.sendall``
+- unbounded ``.poll(...)`` (no timeout argument) and ``.drain(...)``
+  without a timeout keyword
+
+``Condition.wait(timeout)`` and friends with explicit bounds pass; a
+site whose wait is bounded by a different mechanism (an engine watchdog)
+carries a pragma saying so.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from tools.stromlint import hierarchy
+from tools.stromlint.core import Finding, LockModel, Module, dotted, scan_locks
+
+RULE = "blocking-under-lock"
+
+_OS_BLOCKING = {"read", "write", "pread", "pwrite", "preadv", "pwritev",
+                "fsync", "fdatasync", "sendfile", "open"}
+_SOCK_METHODS = {"recv", "recvfrom", "recv_into", "accept", "connect",
+                 "sendall", "makefile"}
+
+
+def _has_timeout_kw(call: ast.Call) -> bool:
+    return any(kw.arg and "timeout" in kw.arg for kw in call.keywords)
+
+
+def run(modules: "list[Module]", root: str,
+        model: LockModel) -> "list[Finding]":
+    out: list[Finding] = []
+    for m in modules:
+        scan = scan_locks(m, model, hierarchy.CM_HOLDS)
+        for held, call, _cls in scan.calls_under:
+            msg = _blocking_reason(call)
+            if msg is None:
+                continue
+            held_names = ", ".join(h.name or h.text for h in held)
+            out.append(Finding(
+                RULE, m.rel, call.lineno,
+                f"{msg} while holding [{held_names}]"))
+    return out
+
+
+def _blocking_reason(call: ast.Call) -> "str | None":
+    fn = call.func
+    if isinstance(fn, ast.Name):
+        if fn.id == "open":
+            return "file open()"
+        return None
+    if not isinstance(fn, ast.Attribute):
+        return None
+    recv = dotted(fn.value) or ""
+    meth = fn.attr
+    if recv == "time" and meth == "sleep":
+        return "time.sleep()"
+    if recv == "os" and meth in _OS_BLOCKING:
+        return f"os.{meth}() I/O"
+    if recv.startswith("socket") or meth in _SOCK_METHODS:
+        if meth in _SOCK_METHODS or meth == "socket":
+            return f"socket I/O (.{meth})"
+    if meth == "wait" and not call.args and not _has_timeout_kw(call):
+        return f"unbounded {recv}.wait()"
+    if meth == "join" and not call.args and not _has_timeout_kw(call):
+        return f"unbounded {recv}.join()"
+    if meth in ("get", "result") and not call.args \
+            and not _has_timeout_kw(call):
+        return f"blocking {recv}.{meth}() with no timeout"
+    if meth == "poll" and len(call.args) < 3 and not _has_timeout_kw(call):
+        return f"unbounded {recv}.poll()"
+    if meth == "drain" and not _has_timeout_kw(call):
+        return f"unbounded {recv}.drain()"
+    return None

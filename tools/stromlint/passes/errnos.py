@@ -1,0 +1,87 @@
+"""errno-exhaustiveness: every injectable errno is classified.
+
+``strom/faults/plan.py`` is the source of injected errnos (rule
+defaults, the chaos preset, and any errno literal a plan spelling can
+reach); ``strom.engine.resilience.classify_errno`` decides transient vs
+permanent from two frozensets. An errno the fault plan can inject but
+neither set names falls into classify_errno's "unknown → transient"
+default — which is a POLICY for errnos the real world produces, not a
+license for the repo's own chaos source to inject errnos nobody
+classified. This pass statically collects every errno referenced in the
+fault-plan module (``errno.EXXX`` attributes and ``"EXXX"`` string
+literals) and fails unless each appears in TRANSIENT_ERRNOS or
+PERMANENT_ERRNOS.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+
+from tools.stromlint.core import Finding, LockModel, Module
+
+RULE = "errno-exhaustiveness"
+
+PLAN_REL = "strom/faults/plan.py"
+RESIL_REL = "strom/engine/resilience.py"
+_SETS = ("TRANSIENT_ERRNOS", "PERMANENT_ERRNOS")
+_ERRNO_STR = re.compile(r"^E[A-Z0-9]{1,12}$")
+
+
+def _errno_attrs(tree: ast.AST) -> "dict[str, int]":
+    """{errno name: first line} for every ``errno.EXXX``/``_errno.EXXX``
+    attribute in *tree*."""
+    out: dict[str, int] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Attribute) \
+                and isinstance(node.value, ast.Name) \
+                and node.value.id in ("errno", "_errno") \
+                and _ERRNO_STR.match(node.attr):
+            out.setdefault(node.attr, node.lineno)
+    return out
+
+
+def injectable_errnos(plan_mod: Module) -> "dict[str, int]":
+    """Every errno the fault-plan module references: attribute spellings
+    plus ``"EIO"``-style string literals (FaultRule accepts both)."""
+    out = _errno_attrs(plan_mod.tree)
+    for node in ast.walk(plan_mod.tree):
+        if isinstance(node, ast.Constant) and isinstance(node.value, str) \
+                and _ERRNO_STR.match(node.value):
+            out.setdefault(node.value, node.lineno)
+    return out
+
+
+def classified_errnos(resil_mod: Module) -> "set[str]":
+    """Names inside the TRANSIENT_ERRNOS / PERMANENT_ERRNOS frozensets."""
+    out: set[str] = set()
+    for node in ast.walk(resil_mod.tree):
+        if isinstance(node, ast.Assign):
+            names = [t.id for t in node.targets if isinstance(t, ast.Name)]
+            if any(n in _SETS for n in names):
+                out.update(_errno_attrs(node.value))
+    return out
+
+
+def run(modules: "list[Module]", root: str,
+        model: LockModel) -> "list[Finding]":
+    by_rel = {m.rel: m for m in modules}
+    plan = by_rel.get(PLAN_REL)
+    resil = by_rel.get(RESIL_REL)
+    if plan is None:
+        return []  # nothing to audit in this scan set (fixture runs)
+    if resil is None:
+        return [Finding(RULE, PLAN_REL, 1,
+                        f"fault plan present but {RESIL_REL} (the "
+                        f"classify_errno tables) is not in the scan set")]
+    classified = classified_errnos(resil)
+    out = []
+    for name, line in sorted(injectable_errnos(plan).items()):
+        if name not in classified:
+            out.append(Finding(
+                RULE, plan.rel, line,
+                f"errno {name} is injectable by the fault plan but "
+                f"appears in neither TRANSIENT_ERRNOS nor "
+                f"PERMANENT_ERRNOS ({RESIL_REL}): classify it explicitly "
+                f"instead of riding the unknown-errno default"))
+    return out

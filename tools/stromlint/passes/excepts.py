@@ -1,0 +1,87 @@
+"""swallowed-exceptions: a broad handler must re-raise or count.
+
+The repo's convention since PR 4: an advisory path that eats an
+exception increments an ``*_errors`` counter (cache_readahead_errors,
+decode_errors, ...) so "silently broken" stays distinguishable from
+"nothing happened". This pass flags every broad handler —
+``except Exception`` / ``except BaseException`` / bare ``except:`` —
+whose body neither
+
+- re-raises (any ``raise`` in the handler body, nested defs excluded),
+  nor
+- marks the error somewhere observable: a call or reference whose
+  identifier mentions errors (``note_error``, ``mark_error``,
+  ``logger.error``, ``self._pending_error``, ``errs.append``) or a
+  string literal naming an error channel (``events.put(("error", e))``,
+  ``scope.add("..._errors")``).
+
+``contextlib.suppress(...)`` blocks are out of scope: that spelling is
+an explicit, greppable statement of intent; the silent killer is the
+handler that LOOKS like handling.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+
+from tools.stromlint.core import Finding, LockModel, Module
+
+RULE = "swallowed-exceptions"
+
+_BROAD = ("Exception", "BaseException")
+_ERRORISH = re.compile(
+    r"(error|errors|errored|fail(ed|ure|s)?\b|\berr\b|^errs?$|_errs?$)",
+    re.IGNORECASE)
+
+
+def _is_broad(handler: ast.ExceptHandler) -> bool:
+    t = handler.type
+    if t is None:
+        return True
+    if isinstance(t, ast.Name):
+        return t.id in _BROAD
+    if isinstance(t, ast.Tuple):
+        return any(isinstance(e, ast.Name) and e.id in _BROAD
+                   for e in t.elts)
+    return False
+
+
+def _marks_error(body: "list[ast.stmt]") -> bool:
+    for stmt in body:
+        for node in ast.walk(stmt):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            if isinstance(node, ast.Raise):
+                return True
+            if isinstance(node, ast.Name) and _ERRORISH.search(node.id):
+                return True
+            if isinstance(node, ast.Attribute) \
+                    and _ERRORISH.search(node.attr):
+                return True
+            if isinstance(node, ast.Constant) \
+                    and isinstance(node.value, str) \
+                    and _ERRORISH.search(node.value):
+                return True
+    return False
+
+
+def run(modules: "list[Module]", root: str,
+        model: LockModel) -> "list[Finding]":
+    out: list[Finding] = []
+    for m in modules:
+        for node in ast.walk(m.tree):
+            if not isinstance(node, ast.ExceptHandler):
+                continue
+            if not _is_broad(node):
+                continue
+            if _marks_error(node.body):
+                continue
+            what = "bare except:" if node.type is None else \
+                f"except {getattr(node.type, 'id', 'Exception')}"
+            out.append(Finding(
+                RULE, m.rel, node.lineno,
+                f"{what} neither re-raises nor marks the error (the "
+                f"repo convention is an *_errors counter / note_error "
+                f"call) — a failure here is invisible"))
+    return out

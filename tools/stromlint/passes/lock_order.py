@@ -1,0 +1,113 @@
+"""lock-order: nested acquisitions vs the canonical hierarchy.
+
+Checks, per module:
+
+- every statically visible nested acquisition (``with a: ... with b:``,
+  multi-item withs, and the call summaries in hierarchy.CALL_ACQUIRES)
+  must go strictly DOWN the declared hierarchy — acquiring an
+  equal-or-earlier-ranked lock while holding a later one is an
+  inversion;
+- a lock participating in a nested acquisition must be DECLARED (built
+  via ``make_lock``/``make_condition`` with a name the hierarchy table
+  ranks) — an undeclared pair is a finding on its own, because an
+  unnamed lock is invisible to both the table and the runtime witness;
+- every ``make_lock`` name must exist in the hierarchy table (the table
+  stays exhaustive by construction);
+- acquisitions whose lifetime is not a with-scope
+  (``stack.enter_context(lock)``, bare ``lock.acquire()``) are flagged:
+  the analyzer cannot bound what runs under them, so each such site
+  carries a pragma with its justification (e.g. StreamingGather's
+  token-lifetime engine ownership).
+"""
+
+from __future__ import annotations
+
+import ast
+
+from tools.stromlint import hierarchy
+from tools.stromlint.core import Finding, LockModel, Module, dotted, scan_locks
+
+RULE = "lock-order"
+
+
+def run(modules: "list[Module]", root: str,
+        model: LockModel) -> "list[Finding]":
+    out: list[Finding] = []
+    seen_undeclared_names = set()
+    # 1. table exhaustiveness: every make_lock name must be ranked
+    for rel, line, name in model.sites:
+        if hierarchy.rank(name) is None and name not in seen_undeclared_names:
+            seen_undeclared_names.add(name)
+            out.append(Finding(
+                RULE, rel, line,
+                f"lock name '{name}' is not in the declared hierarchy "
+                f"(tools/stromlint/hierarchy.py LOCK_RANKS) — add it with "
+                f"a rank, or rename it to an existing role"))
+    for m in modules:
+        scan = scan_locks(m, model, hierarchy.CM_HOLDS,
+                          call_summary=hierarchy.call_summary)
+        for outer, inner in scan.pairs:
+            out.extend(_check_pair(m, outer.text, outer.name,
+                                   inner.text, inner.name, inner.line))
+        for held, call, cls in scan.calls_under:
+            fn = call.func
+            recv = meth = None
+            if isinstance(fn, ast.Attribute):
+                recv, meth = dotted(fn.value), fn.attr
+            elif isinstance(fn, ast.Name):
+                meth = fn.id
+            acquired: dict[str, str] = {}
+            direct = hierarchy.call_summary(m.rel, recv, meth)
+            if direct is not None:
+                acquired[direct] = f"{recv}.{meth}()"
+            if meth is not None and (recv in (None, "self")):
+                # same-module helper: it acquires what its body acquires
+                for name in scan.func_acquires.get((cls, meth), ()):
+                    acquired.setdefault(
+                        name, f"{(recv + '.') if recv else ''}{meth}() "
+                              f"(helper acquires it)")
+            for acq, via in acquired.items():
+                for h in held:
+                    out.extend(_check_pair(
+                        m, h.text, h.name, via, acq,
+                        call.lineno, transient=True))
+        for ref in scan.unscoped:
+            out.append(Finding(
+                RULE, m.rel, ref.line,
+                f"acquisition of '{ref.name or ref.text}' outside a "
+                f"with-statement: its scope is not statically bounded, so "
+                f"the lock-order analysis cannot see what runs under it"))
+    return out
+
+
+def _check_pair(m: Module, outer_text: str, outer_name: "str | None",
+                inner_text: str, inner_name: "str | None", line: int,
+                transient: bool = False) -> "list[Finding]":
+    chain = " -> ".join(hierarchy.CANONICAL)
+    if outer_name is None or inner_name is None:
+        missing = outer_text if outer_name is None else inner_text
+        return [Finding(
+            RULE, m.rel, line,
+            f"undeclared lock pair: '{outer_text}' -> '{inner_text}' — "
+            f"'{missing}' is not built via make_lock, so the hierarchy "
+            f"({chain}) cannot rank it")]
+    if outer_name == inner_name:
+        if transient:
+            return []  # re-entering a role through a summary: not a pair
+        return [Finding(
+            RULE, m.rel, line,
+            f"same-role nesting: '{inner_text}' acquired while already "
+            f"holding a '{outer_name}' lock — two instances of one role "
+            f"have no defined order")]
+    ro, ri = hierarchy.rank(outer_name), hierarchy.rank(inner_name)
+    if ro is None or ri is None:
+        return []  # unranked names already reported at the declaration
+    if ro >= ri:
+        what = "call into" if transient else "acquisition of"
+        return [Finding(
+            RULE, m.rel, line,
+            f"lock-order inversion: {what} '{inner_name}' "
+            f"(rank {ri}, via {inner_text}) while holding '{outer_name}' "
+            f"(rank {ro}, via {outer_text}); the canonical hierarchy is "
+            f"{chain}")]
+    return []

@@ -1,0 +1,75 @@
+"""thread-lifecycle: every spawned thread is named and reclaimed.
+
+The flight recorder's per-thread stack dumps (``thread_stacks``) key on
+``Thread.name`` — an anonymous ``Thread-12`` in a stall bundle is a
+diagnosis dead end. And a non-daemon thread nobody joins wedges
+interpreter shutdown (threading._shutdown waits on it forever), which is
+exactly the rc=124 shape the recorder exists to explain. So every
+``threading.Thread(...)`` construction must:
+
+- carry a stable ``name=`` (f-strings are fine — the stable prefix is
+  what the stack dump needs), and
+- either be daemonized (``daemon=True``) or be joined somewhere in the
+  module (a close/finally path) — approximated as the module containing
+  a ``.join(`` call.
+
+Executors are covered by their own ``thread_name_prefix`` convention and
+are not this pass's business.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from tools.stromlint.core import Finding, LockModel, Module, dotted
+
+RULE = "thread-lifecycle"
+
+
+def _is_thread_join(call: ast.Call) -> bool:
+    """A ``Thread.join``-shaped call: ``t.join()``, ``t.join(5)``,
+    ``t.join(timeout=...)`` — NOT ``", ".join(parts)`` (str.join always
+    takes exactly one iterable positional, never zero args, a numeric
+    constant, or a timeout kwarg)."""
+    fn = call.func
+    if not isinstance(fn, ast.Attribute) or fn.attr != "join":
+        return False
+    if isinstance(fn.value, ast.Constant):  # "sep".join(...)
+        return False
+    if any(kw.arg == "timeout" for kw in call.keywords):
+        return True
+    if not call.args and not call.keywords:
+        return True
+    return (len(call.args) == 1
+            and isinstance(call.args[0], ast.Constant)
+            and isinstance(call.args[0].value, (int, float)))
+
+
+def run(modules: "list[Module]", root: str,
+        model: LockModel) -> "list[Finding]":
+    out: list[Finding] = []
+    for m in modules:
+        module_joins = any(isinstance(n, ast.Call) and _is_thread_join(n)
+                           for n in ast.walk(m.tree))
+        for node in ast.walk(m.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            text = dotted(node.func)
+            if text is None or not (text == "Thread"
+                                    or text.endswith("threading.Thread")):
+                continue
+            kwargs = {kw.arg: kw.value for kw in node.keywords if kw.arg}
+            if "name" not in kwargs:
+                out.append(Finding(
+                    RULE, m.rel, node.lineno,
+                    "threading.Thread(...) without name=: the flight "
+                    "recorder's stack dumps key on thread names"))
+            daemon = kwargs.get("daemon")
+            is_daemon = isinstance(daemon, ast.Constant) \
+                and daemon.value is True
+            if not is_daemon and not module_joins:
+                out.append(Finding(
+                    RULE, m.rel, node.lineno,
+                    "thread is neither daemon=True nor joined anywhere in "
+                    "this module: it can wedge interpreter shutdown"))
+    return out
