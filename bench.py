@@ -556,6 +556,29 @@ def main() -> int:
             for k in CACHE_BENCH_FIELDS:
                 if k in res:
                     loader_res[f"{prefix}_{k}"] = res[k]
+            # decode-v2 columns (ISSUE 12): native-vs-cv2 same-run ratio,
+            # fused/ROI counters, and the decoded-cache cold/warm pair
+            # (single-sourced key list: strom.formats.jpeg.DECODE2_FIELDS)
+            from strom.formats.jpeg import DECODE2_FIELDS
+
+            for k in DECODE2_FIELDS:
+                if k in res:
+                    loader_res[f"{prefix}_{k}"] = res[k]
+            if res.get("decode_native_img_per_s") is not None:
+                line = (f"{name} decode v2: native "
+                        f"{res.get('decode_native_img_per_s')} img/s vs "
+                        f"cv2 {res.get('decode_cv2_img_per_s')} "
+                        f"({res.get('decode_native_vs_cv2')}x; roi rows "
+                        f"skipped {res.get('decode_roi_rows_skipped')})")
+                # the decoded-cache pair only runs with a hot cache to
+                # admit into — don't render "warm None img/s" without one
+                if res.get("decode_cache_warm_img_per_s") is not None:
+                    line += (f"; decoded-cache warm "
+                             f"{res.get('decode_cache_warm_img_per_s')} "
+                             f"img/s "
+                             f"({res.get('decode_cache_warm_vs_cold')}x "
+                             f"cold)")
+                print(line, file=sys.stderr)
             # intra-batch streaming columns (ISSUE 5): batches on the
             # completion-driven path, samples decoded while later extents
             # were in flight, first-decode latency and tail-extent spread
@@ -607,9 +630,13 @@ def main() -> int:
         # cold/warm epoch pair, and readahead follows cache.enabled), so
         # the A/B is cache-clean; hot_cache_bytes=0 here just skips the
         # nostream arm's (A/B-irrelevant) epoch pair to save budget.
+        # no_decode2: the nostream arm exists for the streaming A/B only —
+        # re-running the decode-v2 phases there would double their cost
+        # without adding information (the columns are arm-independent)
         nsargs = argparse.Namespace(**{**vars(rargs), "no_stream": True,
                                        "hot_cache_bytes": 0,
-                                       "readahead_window": 0})
+                                       "readahead_window": 0,
+                                       "no_decode2": True})
         vision_arm("resnet NO-STREAM", bench_resnet, nsargs,
                    "resnet_nostream", "resnet_nostream_data_stalls")
 

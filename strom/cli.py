@@ -700,6 +700,14 @@ def _decode_config_kw(args: argparse.Namespace) -> dict:
         # an explicit --stream wins over --no-stream
         "stream_intra_batch": bool(getattr(args, "stream", False))
         or not getattr(args, "no_stream", False),
+        # decode path v2 (ISSUE 12): native turbo binding, fused-run
+        # dispatch, ROI decode (default on — each --no-* flag restores the
+        # pre-v2 path bit-identically); the decoded-output cache is
+        # opt-in (--decode-cache), same capacity reasoning as --hot-cache
+        "decode_native": not getattr(args, "no_native_decode", False),
+        "decode_fuse_runs": not getattr(args, "no_fuse_decode", False),
+        "decode_roi": not getattr(args, "no_roi_decode", False),
+        "decode_cache": bool(getattr(args, "decode_cache", False)),
     }
 
 
@@ -809,6 +817,36 @@ def _cache_config_kw(args: argparse.Namespace) -> dict:
     }
 
 
+def _flat_epoch(pipe_factory, batch: int, drop_paths, *,
+                steady: bool = False, **pkw) -> tuple[float, int]:
+    """ONE flat-out epoch's (img/s, steps) — the shared measurement
+    protocol of the cache and decode-v2 phase pairs (drop page cache,
+    iterate batches_per_epoch, block, arrival-force the last batch): a
+    timing fix here applies to every epoch-pair column at once. *steady*
+    runs one unmeasured epoch first so the timed one excludes pipeline
+    construction + compile warmup (the flat-out phases' warmup-batch
+    exclusion, epoch-shaped); *pkw* are per-pipeline knob overrides."""
+    for p in drop_paths:
+        _drop_cache_hint(p)
+    with pipe_factory(**pkw) as pipe:
+        spe = pipe.sampler.batches_per_epoch
+        imgs = None
+        if steady:
+            for _ in range(spe):
+                imgs, _ = next(pipe)
+                imgs.block_until_ready()
+            if imgs is not None:
+                _fetch_one(imgs)
+        t0 = time.perf_counter()
+        for _ in range(spe):
+            imgs, _ = next(pipe)
+            imgs.block_until_ready()
+        if imgs is not None:
+            _fetch_one(imgs)  # arrival-forced, not dispatch-rate-bound
+        dt = time.perf_counter() - t0
+    return (spe * batch / dt if dt else 0.0), spe
+
+
 def _bench_cache_scope(ctx) -> None:
     """Scope a bench context's hot cache to the cold/warm epoch pair: the
     flat-out, train-step and bounded phases predate the cache and their
@@ -848,19 +886,7 @@ def _cache_epoch_phases(ctx, pipe_factory, batch: int, drop_paths) -> dict:
         ctx.hot_cache.enabled = True
 
     def one_epoch() -> tuple[float, int]:
-        for p in drop_paths:
-            _drop_cache_hint(p)
-        with pipe_factory() as pipe:
-            spe = pipe.sampler.batches_per_epoch
-            t0 = time.perf_counter()
-            imgs = None
-            for _ in range(spe):
-                imgs, _ = next(pipe)
-                imgs.block_until_ready()
-            if imgs is not None:
-                _fetch_one(imgs)  # arrival-forced, not dispatch-rate-bound
-            dt = time.perf_counter() - t0
-        return (spe * batch / dt if dt else 0.0), spe
+        return _flat_epoch(pipe_factory, batch, drop_paths)
 
     try:
         snap0 = _gs.snapshot()
@@ -890,6 +916,104 @@ def _cache_epoch_phases(ctx, pipe_factory, batch: int, drop_paths) -> dict:
         "cache_readahead_bytes": delta("cache_readahead_bytes", snap0, snap2),
         "cache_epoch_steps": spe,
     }
+
+
+def _decode2_phases(ctx, pipe_factory, batch: int, drop_paths) -> dict:
+    """Decode-path v2 phase set (ISSUE 12 tentpole). Two measurements on
+    the SAME fixture and epoch protocol as the cache pair:
+
+    (1) **native-vs-cv2 A/B**: one flat-out epoch with the native turbo
+    binding + fused runs + ROI decode forced ON, one with all three forced
+    OFF (the pre-v2 cv2 path). ``decode_native_vs_cv2`` is a same-run
+    ratio — weather-independent, like ``warm_vs_cold`` — and the counter
+    deltas (native decodes, fused runs, ROI scanlines skipped) prove which
+    mechanism did the work. Both epochs run with the hot cache disabled
+    (the arm's ``_bench_cache_scope`` state), so the A/B prices decode
+    alone.
+
+    (2) **decoded-cache cold/warm pair** (hot cache present only): two
+    epochs with ``decode_cache`` on and the hot cache scoped to the pair
+    (cleared+enabled before, disabled+cleared after — the
+    ``_cache_epoch_phases`` contract). The cold epoch decodes full frames
+    and admits them; the warm epoch serves post-decode pixels from RAM and
+    pays only crop+resize — ``decode_cache_warm_img_per_s`` is the
+    predecoded-on-the-fly headline, read against the predecoded arm's
+    flat-out column. Decoded entries bill the shared cache budget: a
+    working set larger than ``--hot-cache`` evicts and the warm ratio
+    honestly shows it.
+
+    Keys single-sourced in ``strom.formats.jpeg.DECODE2_FIELDS`` (driver
+    copy loop, compare_rounds "decode v2" section and the bench_sentinel
+    gates all read that tuple)."""
+    from strom.formats.jpeg import native_available
+    from strom.utils.stats import global_stats as _gs
+
+    def one_epoch(steady: bool = False, **pkw) -> tuple[float, int]:
+        return _flat_epoch(pipe_factory, batch, drop_paths, steady=steady,
+                           **pkw)
+
+    def delta(key: str, a: dict, b: dict) -> int:
+        return int(b.get(key, 0) - a.get(key, 0))
+
+    out: dict = {}
+    if native_available():
+        # the A/B only prices something when the binding exists — on a
+        # host without libjpeg-turbo headers the "native" epoch would be
+        # a second cv2 epoch and decode_native_img_per_s would hand
+        # bench_sentinel a gated number that never exercised the native
+        # path; omitted keys render "-" and gate nothing
+        snap0 = _gs.snapshot()
+        native_rate, _ = one_epoch(decode_native=True,
+                                   decode_fuse_runs=True,
+                                   decode_roi=True, decode_cache=False)
+        snap1 = _gs.snapshot()
+        cv2_rate, _ = one_epoch(decode_native=False, decode_fuse_runs=False,
+                                decode_roi=False, decode_cache=False)
+        out["decode_native_img_per_s"] = round(native_rate, 1)
+        out["decode_cv2_img_per_s"] = round(cv2_rate, 1)
+        out["decode_native_vs_cv2"] = round(native_rate / cv2_rate, 3) \
+            if cv2_rate else None
+        for k in ("decode_native_imgs", "decode_native_fallbacks",
+                  "decode_fused_runs", "decode_fused_samples",
+                  "decode_roi_hits", "decode_roi_rows_skipped"):
+            out[k] = delta(k, snap0, snap1)
+
+    if ctx.hot_cache is not None:
+        ctx.hot_cache.clear()
+        ctx.hot_cache.enabled = True
+        try:
+            s0 = _gs.snapshot()
+            cold, _ = one_epoch(decode_cache=True)
+            s1 = _gs.snapshot()
+            # steady=True: the warm number is the acceptance ratio's
+            # numerator (read against the predecoded arm's flat-out
+            # column), so it must exclude construction/compile warmup
+            # like that column does — the COLD epoch can't have a warmup
+            # pass (it would stop being cold) and keeps the construction-
+            # included _cache_epoch_phases protocol. The hit counters
+            # below span both warm epochs (the unmeasured pass serves
+            # from cache too).
+            warm, _ = one_epoch(steady=True, decode_cache=True)
+            s2 = _gs.snapshot()
+        finally:
+            # same scoping rule as _cache_epoch_phases: later phases must
+            # not measure RAM-served traffic, and 100s of MiB of decoded
+            # frames must not shrink the slab pool under them
+            ctx.hot_cache.enabled = False
+            ctx.hot_cache.clear()
+        out["decode_cache_cold_img_per_s"] = round(cold, 1)
+        out["decode_cache_warm_img_per_s"] = round(warm, 1)
+        out["decode_cache_warm_vs_cold"] = round(warm / cold, 3) \
+            if cold else None
+        out["decode_cache_hits"] = delta("decode_cache_hits", s1, s2)
+        out["decode_cache_hit_bytes"] = delta("decode_cache_hit_bytes",
+                                              s1, s2)
+        # s0 -> s2: under second_touch the first epoch only OBSERVES and
+        # the admissions land during the warm pass — a cold-window-only
+        # delta would report 0 next to nonzero hits
+        out["decode_cache_admitted_bytes"] = \
+            delta("decode_cache_admitted_bytes", s0, s2)
+    return out
 
 
 def bench_resnet(args: argparse.Namespace) -> dict:
@@ -942,12 +1066,12 @@ def bench_resnet(args: argparse.Namespace) -> dict:
         else:
             data_paths = [path]
 
-            def pipe_factory(depth=args.prefetch, auto=False):
+            def pipe_factory(depth=args.prefetch, auto=False, **pkw):
                 return make_imagenet_resnet_pipeline(
                     ctx, [path], batch=args.batch,
                     image_size=args.image_size, sharding=sharding,
                     prefetch_depth=depth, auto_prefetch=auto,
-                    decode_workers=args.decode_workers)
+                    decode_workers=args.decode_workers, **pkw)
         for p in data_paths:
             _drop_cache_hint(p)
         with pipe_factory() as pipe:
@@ -977,7 +1101,10 @@ def bench_resnet(args: argparse.Namespace) -> dict:
             out.update({"decode_reduced_scale": cfg.decode_reduced_scale,
                         "decode_to_slot": cfg.decode_to_slot,
                         "decode_overlap_put": cfg.decode_overlap_put,
-                        "stream_intra_batch": cfg.stream_intra_batch})
+                        "stream_intra_batch": cfg.stream_intra_batch,
+                        "decode_native": cfg.decode_native,
+                        "decode_fuse_runs": cfg.decode_fuse_runs,
+                        "decode_roi": cfg.decode_roi})
         if cfg.hot_cache_bytes:
             # ISSUE 4 satellite: cold/warm epoch pair — repeat traffic must
             # serve from the hot cache, not NVMe (see _cache_epoch_phases)
@@ -985,6 +1112,11 @@ def bench_resnet(args: argparse.Namespace) -> dict:
             out["hot_cache_admit"] = cfg.hot_cache_admit
             out.update(_cache_epoch_phases(ctx, pipe_factory, args.batch,
                                            data_paths))
+        if not predecoded and not getattr(args, "no_decode2", False):
+            # ISSUE 12: native-vs-cv2 decode A/B + decoded-cache cold/warm
+            # pair on the same fixture (see _decode2_phases)
+            out.update(_decode2_phases(ctx, pipe_factory, args.batch,
+                                       data_paths))
 
         if getattr(args, "train_step", False):
             # north-star phase (BASELINE.json:5 "ResNet-50 input pipeline fully
@@ -1096,12 +1228,12 @@ def bench_vit(args: argparse.Namespace) -> dict:
                     image_size=args.image_size, sharding=sharding,
                     prefetch_depth=depth, auto_prefetch=auto)
         else:
-            def pipe_factory(depth=args.prefetch, auto=False):
+            def pipe_factory(depth=args.prefetch, auto=False, **pkw):
                 return make_vit_wds_pipeline(
                     ctx, [virt], batch=args.batch,
                     image_size=args.image_size, sharding=sharding,
                     prefetch_depth=depth, auto_prefetch=auto,
-                    decode_workers=args.decode_workers)
+                    decode_workers=args.decode_workers, **pkw)
         for m in members:
             _drop_cache_hint(m)
         with pipe_factory() as pipe:
@@ -1127,7 +1259,10 @@ def bench_vit(args: argparse.Namespace) -> dict:
             out.update({"decode_reduced_scale": cfg.decode_reduced_scale,
                         "decode_to_slot": cfg.decode_to_slot,
                         "decode_overlap_put": cfg.decode_overlap_put,
-                        "stream_intra_batch": cfg.stream_intra_batch})
+                        "stream_intra_batch": cfg.stream_intra_batch,
+                        "decode_native": cfg.decode_native,
+                        "decode_fuse_runs": cfg.decode_fuse_runs,
+                        "decode_roi": cfg.decode_roi})
         if cfg.hot_cache_bytes:
             # ISSUE 4 satellite: cold/warm epoch pair over the striped set —
             # the warm epoch's stripe gathers collapse into RAM memcpys
@@ -1135,6 +1270,10 @@ def bench_vit(args: argparse.Namespace) -> dict:
             out["hot_cache_admit"] = cfg.hot_cache_admit
             out.update(_cache_epoch_phases(ctx, pipe_factory, args.batch,
                                            members))
+        if not predecoded and not getattr(args, "no_decode2", False):
+            # ISSUE 12: native-vs-cv2 A/B + decoded-cache pair, striped
+            out.update(_decode2_phases(ctx, pipe_factory, args.batch,
+                                       members))
 
         if getattr(args, "train_step", False):
             # north-star phase: a REAL jitted ViT train step consumes the batches
@@ -1919,6 +2058,28 @@ def _add_decode_flags(p: argparse.ArgumentParser) -> None:
     p.add_argument("--stream", action="store_true", dest="stream",
                    help="explicitly enable intra-batch streaming (the "
                         "default; pairs with --no-stream for A/B scripts)")
+    p.add_argument("--no-native-decode", action="store_true",
+                   dest="no_native_decode",
+                   help="disable the libjpeg-turbo native binding (ISSUE "
+                        "12): decode through cv2, the pre-v2 path "
+                        "(bit-identical output)")
+    p.add_argument("--no-fuse-decode", action="store_true",
+                   dest="no_fuse_decode",
+                   help="disable fused-run decode dispatch: one pool task "
+                        "per sample, the pre-v2 shape (bit-identical)")
+    p.add_argument("--no-roi-decode", action="store_true",
+                   dest="no_roi_decode",
+                   help="disable ROI/partial-MCU decode: always decode the "
+                        "full (or reduced) frame before cropping")
+    p.add_argument("--decode-cache", action="store_true",
+                   dest="decode_cache",
+                   help="admit first-epoch decode OUTPUT into the hot "
+                        "cache (needs --hot-cache) so repeat epochs pay "
+                        "only crop+resize — predecoded-on-the-fly")
+    p.add_argument("--no-decode2-phases", action="store_true",
+                   dest="no_decode2",
+                   help="skip the decode-v2 bench phases (native-vs-cv2 "
+                        "A/B epochs + decoded-cache cold/warm pair)")
 
 
 def _add_cache_flags(p: argparse.ArgumentParser) -> None:

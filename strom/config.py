@@ -139,6 +139,34 @@ class StromConfig:
     # union then transferring serially. Implies decode_to_slot mechanics.
     decode_overlap_put: bool = True
 
+    # decode path v2 (ISSUE 12 tentpole — strom/formats/jpeg.py):
+    # decode_native: decode through the libjpeg-turbo binding in _core
+    # (sc_jpeg_decode) — no cv2 per-call setup, no BGR intermediate;
+    # bit-exact against the cv2 path for full/reduced decode and probed at
+    # build time (hosts without the headers silently keep cv2).
+    decode_native: bool = True
+    # decode_fuse_runs: one decode-pool task decodes a RUN of samples
+    # (auto-tuned length) instead of one task per sample, amortizing the
+    # per-task queue/contextvar/span overhead that dominates at ~1ms
+    # images. Off = the one-task-per-sample dispatch, bit-identical.
+    decode_fuse_runs: bool = True
+    # decode_roi: partial-MCU decode — since the RandomResizedCrop
+    # rectangle is fixed in full-res coordinates BEFORE decode, the native
+    # path decodes only the crop's scanlines/iMCU columns (turbo's
+    # jpeg_skip_scanlines/jpeg_crop_scanline), composing with the
+    # reduced_denom rule. Progressive members and frame-spanning crops
+    # ride the full decode; requires decode_native to engage.
+    decode_roi: bool = True
+    # decode_cache: predecoded-on-the-fly — admit first-epoch decode
+    # OUTPUT (full-frame RGB8, keyed by member extent + decode-params
+    # fingerprint) into the hot cache, so epoch >= 2 pays only
+    # crop+resize per sample. Needs hot_cache_bytes > 0 to do anything;
+    # entries bill the shared cache budget/slab pool and the owning
+    # tenant's partition like every other cache tenant. Off by default:
+    # the decoded working set is ~5x the compressed bytes, an explicit
+    # capacity decision.
+    decode_cache: bool = False
+
     # intra-batch streaming (strom/delivery/stream.py — ISSUE 5 tentpole):
     # the JPEG vision batch path submits its gather through the engine's
     # async vectored API and hands each sample to the decode pool the

@@ -1659,6 +1659,30 @@ class StromContext:
                 "decode_errors": global_stats.counter("decode_errors").value,
                 "decode_put_overlap_ms":
                     global_stats.counter("decode_put_overlap_ms").value,
+                # decode path v2 (ISSUE 12): native-binding decodes (and
+                # per-sample fallbacks to cv2), fused-run dispatch volume,
+                # ROI partial decodes with the scanlines they skipped, and
+                # decoded-output cache traffic
+                "decode_native_imgs":
+                    global_stats.counter("decode_native_imgs").value,
+                "decode_native_fallbacks":
+                    global_stats.counter("decode_native_fallbacks").value,
+                "decode_fused_runs":
+                    global_stats.counter("decode_fused_runs").value,
+                "decode_fused_samples":
+                    global_stats.counter("decode_fused_samples").value,
+                "decode_roi_hits":
+                    global_stats.counter("decode_roi_hits").value,
+                "decode_roi_rows_skipped":
+                    global_stats.counter("decode_roi_rows_skipped").value,
+                "decode_cache_hits":
+                    global_stats.counter("decode_cache_hits").value,
+                "decode_cache_misses":
+                    global_stats.counter("decode_cache_misses").value,
+                "decode_cache_hit_bytes":
+                    global_stats.counter("decode_cache_hit_bytes").value,
+                "decode_cache_admitted_bytes":
+                    global_stats.counter("decode_cache_admitted_bytes").value,
                 "decode_batch_p50_us": dh.percentile(0.50),
                 "decode_batch_mean_us": dh.mean_us,
                 "decode_batch_total_us": dh.total_us,

@@ -1,0 +1,116 @@
+"""Decoded-output cache (ISSUE 12 tentpole, front 4): predecoded-on-the-fly.
+
+The hot cache (strom/delivery/hotcache.py) serves repeat COMPRESSED bytes
+from RAM, but a JPEG pipeline still pays the full entropy-decode + IDCT on
+every epoch — the wall BENCH_r05 measured at ~6.5x the predecoded arm. This
+adapter admits first-epoch decode OUTPUT (post-decode, pre-transform
+full-frame RGB8 pixels) into the same :class:`~strom.delivery.hotcache.
+HotCache`, so epoch >= 2 pays only crop + resize per sample: the predecoded
+arm's economics without the offline staging pass.
+
+Design points:
+
+- **Keys.** ``("jpegdec", shard_path, member_lo, member_hi, fingerprint)``
+  — the member's PHYSICAL extent (stable across epochs, exactly like the
+  extent cache's keys) plus a decode-params fingerprint (decoder engine +
+  colorspace), so pixels decoded under different semantics can never serve
+  each other. The byte range within a key is ``[0, h*w*3)`` with h/w read
+  from the member's SOF header — self-describing at both admit and lookup
+  without a stored header.
+- **Fidelity.** Cached pixels are FULL-frame, full-resolution decodes: a
+  cache hit serves pixels identical to the ``reduced_scale=False`` path
+  (bit-identical to the full-decode transform), never the reduced-decode
+  approximation. The admitting pass therefore decodes full even where
+  ROI/reduced would have engaged — that one-epoch cost is what buys every
+  later epoch the RAM serve.
+- **Budget + partitions.** Entries ride the shared HotCache budget and
+  slab pool like every other tenant (slab-size-class billed), and charge
+  the owning pipeline's tenant partition (ISSUE 7) — a decode-cache-happy
+  tenant self-evicts before it can displace another tenant's hot set.
+  Admission follows the cache's policy (second-touch observes the first
+  epoch, admits the second; ``always`` admits on first decode — the bench
+  pair's mode).
+- **Pinning.** A served frame stays pinned for exactly the crop+resize
+  window (the caller releases), the same lifetime handshake every other
+  cache reader uses — eviction can never recycle a slab mid-transform.
+
+Counters (``decode_cache_*``) are kept separate from the extent cache's
+``cache_*`` set (lookups run ``record=False``): mixing them would distort
+the hit ratio the warm/cold epoch analysis reads.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import numpy as np
+
+from strom.utils.locks import make_lock
+from strom.utils.stats import global_stats
+
+
+class DecodedCache:
+    """Thin, counter-bearing adapter between the JPEG transform and a
+    :class:`~strom.delivery.hotcache.HotCache` partition holding decoded
+    frames. Thread-safe: the tally lock (``cache.decoded``) is a leaf
+    held only for counter updates, never across cache calls."""
+
+    def __init__(self, cache, *, tenant: "str | None" = None,
+                 fingerprint: str = "rgb8", scope=None):
+        self._hot_cache = cache
+        self._tenant = tenant
+        self._fp = fingerprint
+        self._lock = make_lock("cache.decoded")
+        self._scope = scope if scope is not None else global_stats
+        self.hits = 0
+        self.misses = 0
+        self.hit_bytes = 0
+        self.admitted_bytes = 0
+
+    @property
+    def enabled(self) -> bool:
+        """Follows the backing cache's phase gate: a disabled hot cache
+        serves/admits no decoded frames either (the bench arms scope both
+        to their epoch pairs through the one flag)."""
+        return self._hot_cache is not None and self._hot_cache.enabled
+
+    def key(self, path: str, lo: int, hi: int) -> tuple:
+        """Cache key for the member occupying file bytes [lo, hi) of
+        *path* — extent-stable across epochs, fingerprint-split across
+        decode semantics."""
+        return ("jpegdec", path, lo, hi, self._fp)
+
+    def get(self, ckey: Any, h: int, w: int):
+        """(pinned (h, w, 3) view, pin) on a hit, None on a miss. The
+        caller MUST :meth:`release` the pin once it stops reading the
+        view (after the crop+resize)."""
+        n = h * w * 3
+        got = self._hot_cache.view(ckey, 0, n, record=False)
+        if got is None:
+            with self._lock:
+                self.misses += 1
+            self._scope.add("decode_cache_misses")
+            return None
+        buf, entry = got
+        with self._lock:
+            self.hits += 1
+            self.hit_bytes += n
+        self._scope.add("decode_cache_hits")
+        self._scope.add("decode_cache_hit_bytes", n)
+        return buf.reshape(h, w, 3), entry
+
+    def release(self, pin) -> None:
+        self._hot_cache.unpin((pin,))
+
+    def offer(self, ckey: Any, img: np.ndarray) -> int:
+        """Offer a decoded full frame for admission (subject to the
+        cache's policy, budget, and the owning tenant's partition).
+        Returns bytes admitted (0 = refused/duplicate)."""
+        flat = np.ascontiguousarray(img).reshape(-1)
+        admitted = self._hot_cache.admit(ckey, 0, flat.size, flat,
+                                         tenant=self._tenant)
+        if admitted:
+            with self._lock:
+                self.admitted_bytes += admitted
+            self._scope.add("decode_cache_admitted_bytes", admitted)
+        return admitted

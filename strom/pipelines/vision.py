@@ -175,11 +175,15 @@ def _note_decode_overlap(scope, t_decode0: float | None,
 def _decode_put_overlapped(ctx: StromContext, pool: DecodePool, tf: Transform,
                            blobs: Sequence, rngs: Sequence,
                            images: np.ndarray, dev_items: Sequence,
-                           row_pos: dict, scope=None) -> list:
+                           row_pos: dict, scope=None,
+                           ckeys: "Sequence | None" = None) -> list:
     """Decode every row into its slot and `device_put` each device's row
     group the moment its rows finish (completion-ordered — the per-group
     analogue of `_deliver_streamed`'s read/transfer overlap: early groups
     ride the host->HBM link while late rows are still on the decode pool).
+    Contiguous rows fuse into one pool task each per ``pool.run_size``
+    (ISSUE 12): completion granularity coarsens to the run, output bytes
+    don't change.
 
     Returns one put shard per entry of *dev_items*, in order. Observability:
     `decode_batch` histogram (per-batch decode wall), `decode_put_overlap_ms`
@@ -187,8 +191,19 @@ def _decode_put_overlapped(ctx: StromContext, pool: DecodePool, tf: Transform,
     n = images.shape[0]
     pos_devs, pending, shards = _init_group_state(ctx, images, dev_items,
                                                   row_pos)
-    futs = {pool.submit_into(tf, blobs[i], rngs[i], images[i]): i
-            for i in range(n)}
+    run = pool.run_size(n)
+    futs: dict = {}
+    if run <= 1:
+        for i in range(n):
+            futs[pool.submit_into(tf, blobs[i], rngs[i], images[i],
+                                  None if ckeys is None else ckeys[i])] = (i,)
+    else:
+        for i in range(0, n, run):
+            grp = tuple(range(i, min(i + run, n)))
+            futs[pool.submit_run_into(
+                tf, [blobs[j] for j in grp], [rngs[j] for j in grp],
+                [images[j] for j in grp],
+                None if ckeys is None else [ckeys[j] for j in grp])] = grp
     t0 = time.perf_counter()
     t_first_put = None
     t_last_decode = t0
@@ -196,15 +211,16 @@ def _decode_put_overlapped(ctx: StromContext, pool: DecodePool, tf: Transform,
         f.result()  # decode ValueErrors are absorbed per-row by the pool;
         # anything else (a transform bug) must still abort the batch
         t_last_decode = time.perf_counter()
-        for di in pos_devs[futs[f]]:
-            pending[di] -= 1
-            if pending[di] == 0:
-                device, (lo, hi) = dev_items[di]
-                base = row_pos[lo]
-                if t_first_put is None:
-                    t_first_put = time.perf_counter()
-                shards[di] = ctx.device_put(images[base: base + hi - lo],
-                                            device)
+        for p in futs[f]:
+            for di in pos_devs[p]:
+                pending[di] -= 1
+                if pending[di] == 0:
+                    device, (lo, hi) = dev_items[di]
+                    base = row_pos[lo]
+                    if t_first_put is None:
+                        t_first_put = time.perf_counter()
+                    shards[di] = ctx.device_put(images[base: base + hi - lo],
+                                                device)
     _note_decode_overlap(scope or global_stats, t0, t_first_put,
                          t_last_decode)
     return shards
@@ -213,7 +229,8 @@ def _decode_put_overlapped(ctx: StromContext, pool: DecodePool, tf: Transform,
 def _decode_put_streamed(ctx: StromContext, pool: DecodePool, tf: Transform,
                          el, sizes: Sequence[tuple[int, int]],
                          rngs: Sequence, images: np.ndarray,
-                         dev_items: Sequence, row_pos: dict, scope=None
+                         dev_items: Sequence, row_pos: dict, scope=None,
+                         ckeys: "Sequence | None" = None
                          ) -> tuple[list, list[int]]:
     """Completion-driven batch assembly (ISSUE 5 tentpole): the member
     gather is submitted through ``ctx.stream_segments`` and each sample is
@@ -265,7 +282,15 @@ def _decode_put_streamed(ctx: StromContext, pool: DecodePool, tf: Transform,
     # loop's scheduler/cache/decode-dispatch work shares the req_id
     req = _request.current()
 
-    def submit_sample(i: int) -> None:
+    # fused-run dispatch (ISSUE 12): samples whose extents land together
+    # decode together — runs are flushed after EVERY poll drain (a lone
+    # early sample never waits for company; the streaming overlap is
+    # untouched), bounded at run_size so one task can't serialize a
+    # fully-instant cache-warm batch on one worker
+    run = pool.run_size(n)
+    ready: list[int] = []
+
+    def mark_ready(i: int) -> None:
         isz, lsz = sizes[i]
         s = starts[i]
         labels[i] = int(buf[s + isz: s + isz + lsz].tobytes() or b"0")
@@ -279,10 +304,28 @@ def _decode_put_streamed(ctx: StromContext, pool: DecodePool, tf: Transform,
             # dispatched while later extents were still in flight: the
             # intra-batch overlap, as a counter instead of a guess
             scope.add("stream_samples_early")
-        f = pool.submit_into(tf, buf[s: s + isz], rngs[i], images[i])
-        with futs_lock:
-            futs.append(f)
-        f.add_done_callback(lambda fut, p=i: events.put(("decoded", p, fut)))
+        ready.append(i)
+
+    def flush_ready() -> None:
+        while ready:
+            grp = tuple(ready[:run])
+            del ready[: run]
+            if len(grp) == 1:
+                i = grp[0]
+                f = pool.submit_into(tf, buf[starts[i]: starts[i]
+                                             + sizes[i][0]],
+                                     rngs[i], images[i],
+                                     None if ckeys is None else ckeys[i])
+            else:
+                f = pool.submit_run_into(
+                    tf,
+                    [buf[starts[i]: starts[i] + sizes[i][0]] for i in grp],
+                    [rngs[i] for i in grp], [images[i] for i in grp],
+                    None if ckeys is None else [ckeys[i] for i in grp])
+            with futs_lock:
+                futs.append(f)
+            f.add_done_callback(
+                lambda fut, g_=grp: events.put(("decoded", g_, fut)))
 
     def pump() -> None:
         with _request.attach(req):
@@ -295,7 +338,8 @@ def _decode_put_streamed(ctx: StromContext, pool: DecodePool, tf: Transform,
             # fires and the consumer below blocks forever
             for i in range(n):
                 if remaining[i] == 0:
-                    submit_sample(i)
+                    mark_ready(i)
+            flush_ready()
             while not g.done:
                 if stop.is_set():
                     g.close()
@@ -308,8 +352,9 @@ def _decode_put_streamed(ctx: StromContext, pool: DecodePool, tf: Transform,
                         if ov > 0:
                             remaining[i] -= ov
                             if remaining[i] == 0:
-                                submit_sample(i)
+                                mark_ready(i)
                         i += 1
+                flush_ready()
             g.finish()
             events.put(("done", None))
         except BaseException as e:  # surfaced on the consumer side
@@ -328,20 +373,21 @@ def _decode_put_streamed(ctx: StromContext, pool: DecodePool, tf: Transform,
         while decoded < n or not gather_done:
             kind, *payload = events.get()
             if kind == "decoded":
-                p, fut = payload
+                grp, fut = payload
                 fut.result()  # per-sample decode errors were absorbed by
                 # the pool; anything else (a transform bug) aborts the batch
-                decoded += 1
+                decoded += len(grp)
                 t_last_decode = time.perf_counter()
-                for di in pos_devs[p]:
-                    pending[di] -= 1
-                    if pending[di] == 0:
-                        device, (lo, hi) = dev_items[di]
-                        base = row_pos[lo]
-                        if t_first_put is None:
-                            t_first_put = time.perf_counter()
-                        shards[di] = ctx.device_put(
-                            images[base: base + hi - lo], device)
+                for p in grp:
+                    for di in pos_devs[p]:
+                        pending[di] -= 1
+                        if pending[di] == 0:
+                            device, (lo, hi) = dev_items[di]
+                            base = row_pos[lo]
+                            if t_first_put is None:
+                                t_first_put = time.perf_counter()
+                            shards[di] = ctx.device_put(
+                                images[base: base + hi - lo], device)
             elif kind == "done":
                 gather_done = True
             elif kind == "error":
@@ -381,6 +427,10 @@ def make_wds_vision_pipeline(ctx: StromContext, paths: Sequence[str], *,
                              decode_reduced_scale: bool | None = None,
                              decode_to_slot: bool | None = None,
                              decode_overlap_put: bool | None = None,
+                             decode_native: bool | None = None,
+                             decode_fuse_runs: bool | None = None,
+                             decode_roi: bool | None = None,
+                             decode_cache: bool | None = None,
                              stream_intra_batch: bool | None = None,
                              resume_from: str | SamplerState | None = None,
                              scope: dict | None = None
@@ -419,7 +469,35 @@ def make_wds_vision_pipeline(ctx: StromContext, paths: Sequence[str], *,
     to_slot = cfg.decode_to_slot if decode_to_slot is None else decode_to_slot
     overlap_put = cfg.decode_overlap_put if decode_overlap_put is None \
         else decode_overlap_put
-    tf = transform or make_train_transform(image_size, reduced_scale=reduced)
+    # decode path v2 knobs (ISSUE 12): native turbo binding, fused-run
+    # dispatch, ROI/partial-MCU decode, decoded-output cache
+    native = cfg.decode_native if decode_native is None else decode_native
+    fuse = cfg.decode_fuse_runs if decode_fuse_runs is None \
+        else decode_fuse_runs
+    use_roi = cfg.decode_roi if decode_roi is None else decode_roi
+    use_dcache = cfg.decode_cache if decode_cache is None else decode_cache
+    pscope = ctx.scope.scoped(**(scope if scope is not None
+                                 else {"pipeline": "vision"}))
+    # scheduler tenant (ISSUE 7): a tenant-labeled scope routes every
+    # gather this pipeline issues into that tenant's queue (priority,
+    # fair-drain weight, budgets, cache partition) — unlabeled pipelines
+    # ride the context's default tenant, single-tenant behavior unchanged
+    tname = getattr(pscope, "labels", {}).get("tenant")
+    # decoded-output cache (ISSUE 12 front 4): only with a hot cache to
+    # admit into and only for the built-in transform (custom transforms
+    # own their decode; the ckey kwarg is the built-in's contract).
+    # Entries charge this pipeline's tenant partition.
+    dcache = None
+    if use_dcache and transform is None and ctx.hot_cache is not None:
+        from strom.formats.decoded_cache import DecodedCache
+        from strom.formats import jpeg as _jpeg
+
+        eng = "turbo" if (native and _jpeg.native_available()) else "cv2"
+        dcache = DecodedCache(ctx.hot_cache, tenant=tname,
+                              fingerprint=f"rgb8/{eng}", scope=pscope)
+    tf = transform or make_train_transform(image_size, reduced_scale=reduced,
+                                           native=native, roi=use_roi,
+                                           dcache=dcache)
     try:
         tf_out_ok = "out" in inspect.signature(tf).parameters
     except (TypeError, ValueError):  # pragma: no cover - exotic callables
@@ -433,14 +511,7 @@ def make_wds_vision_pipeline(ctx: StromContext, paths: Sequence[str], *,
     stream = cfg.stream_intra_batch if stream_intra_batch is None \
         else stream_intra_batch
     stream = stream and overlap_put
-    pool = DecodePool(decode_workers)
-    pscope = ctx.scope.scoped(**(scope if scope is not None
-                                 else {"pipeline": "vision"}))
-    # scheduler tenant (ISSUE 7): a tenant-labeled scope routes every
-    # gather this pipeline issues into that tenant's queue (priority,
-    # fair-drain weight, budgets, cache partition) — unlabeled pipelines
-    # ride the context's default tenant, single-tenant behavior unchanged
-    tname = getattr(pscope, "labels", {}).get("tenant")
+    pool = DecodePool(decode_workers, fuse_runs=fuse)
     label_sharding = NamedSharding(
         sharding.mesh,
         P(sharding.spec[0] if len(sharding.spec) else None))
@@ -470,6 +541,14 @@ def make_wds_vision_pipeline(ctx: StromContext, paths: Sequence[str], *,
         rngs = [np.random.Generator(np.random.Philox(
                     key=[seed, (serial << 32) + r]))
                 for r in local_rows]
+        # decoded-output cache keys (ISSUE 12): the image member's physical
+        # extent — stable across epochs, exactly like the extent cache
+        ckeys = None
+        if dcache is not None:
+            ckeys = [dcache.key(s.shard, s.members[image_ext].offset,
+                                s.members[image_ext].offset
+                                + s.members[image_ext].size)
+                     for s in samples]
 
         if stream:
             # completion-driven dataflow (ISSUE 5): samples decode the
@@ -479,7 +558,7 @@ def make_wds_vision_pipeline(ctx: StromContext, paths: Sequence[str], *,
                               dtype=np.uint8)
             img_shards, labels = _decode_put_streamed(
                 ctx, pool, tf, el, sizes, rngs, images, dev_items, row_pos,
-                scope=pscope)
+                scope=pscope, ckeys=ckeys)
             labels_np = np.asarray(labels, dtype=np.int32)
             pscope.add("decode_slot_bytes", images.nbytes)
             lbl_shards = [ctx.device_put(shard_view(labels_np, lo, hi), d)
@@ -508,10 +587,10 @@ def make_wds_vision_pipeline(ctx: StromContext, paths: Sequence[str], *,
             if overlap_put:
                 img_shards = _decode_put_overlapped(
                     ctx, pool, tf, blobs, rngs, images, dev_items, row_pos,
-                    scope=pscope)
+                    scope=pscope, ckeys=ckeys)
             else:
                 with pscope.timer_us("decode_batch"):
-                    pool.map_into(tf, blobs, rngs, images)
+                    pool.map_into(tf, blobs, rngs, images, ckeys=ckeys)
                 img_shards = [ctx.device_put(shard_view(images, lo, hi), d)
                               for d, (lo, hi) in dev_items]
             # billed after the decode completes: an aborted batch never

@@ -47,6 +47,11 @@ NEW_ROUND = {  # r5-era shape: binding + context + audit arrays + headline
     "resnet_stream_batches": 14,
     "resnet_stream_samples_early": 301,
     "resnet_nostream_data_stalls": 6,
+    # r12+: decode path v2 (native/fused/ROI A/B + decoded-output cache)
+    "resnet_decode_native_img_per_s": 661.0,
+    "resnet_decode_native_vs_cv2": 2.054,
+    "resnet_decode_roi_rows_skipped": 31744,
+    "resnet_decode_cache_warm_vs_cold": 3.117,
     # r7+: multi-tenant scheduler arm (strom/sched)
     "mt_vs_solo_mean": 0.913,
     "mt_pq_sched_queue_wait_p99_us": 65536.0,
@@ -176,6 +181,46 @@ def test_stream_keys_match_producers():
         assert suffix in produced, \
             f"compare_rounds consumes {key!r} but the bench arms produce " \
             f"no {suffix!r} (renamed column?)"
+
+
+def test_decode2_section_renders(artifacts, capsys):
+    """r12+ artifacts get the decode-v2 section with the native-vs-cv2
+    ratio and the decoded-cache warm/cold row."""
+    assert compare_rounds.main(artifacts) == 0
+    out = capsys.readouterr().out
+    assert "decode v2" in out
+    assert "resnet_decode_native_vs_cv2" in out
+    assert "2.054" in out
+    assert "resnet_decode_cache_warm_vs_cold" in out
+    assert "3.117" in out
+
+
+def test_decode2_section_hidden_without_keys(tmp_path, capsys):
+    """Rounds predating decode v2 don't get an all-dash section."""
+    p = tmp_path / "BENCH_r02.json"
+    p.write_text(json.dumps(OLD_ROUND))
+    assert compare_rounds.main([str(p)]) == 0
+    assert "decode v2" not in capsys.readouterr().out
+
+
+def test_decode2_keys_match_producers():
+    """Producer↔report key parity for the decode-v2 section (ISSUE 12
+    satellite, the decode/stall/cache/stream/sched/slo pattern): every
+    compare_rounds decode-v2 column must be an arm prefix plus a key
+    cli._decode2_phases actually emits (single-sourced in
+    strom.formats.jpeg.DECODE2_FIELDS) — a rename on either side fails
+    HERE, not on a dashboard."""
+    from strom.formats.jpeg import DECODE2_FIELDS
+
+    prefixes = ("resnet", "vit")
+    produced = set(DECODE2_FIELDS)
+    for key in compare_rounds.DECODE2_KEYS:
+        prefix = next((p for p in prefixes if key.startswith(p + "_")), None)
+        assert prefix is not None, key
+        suffix = key[len(prefix) + 1:]
+        assert suffix in produced, \
+            f"compare_rounds consumes {key!r} but the decode-v2 phases " \
+            f"produce no {suffix!r} (renamed column?)"
 
 
 def test_slo_keys_match_producers():

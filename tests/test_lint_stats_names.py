@@ -137,6 +137,18 @@ def test_repo_flight_and_sentinel_tuples_seen():
     assert "pipeline" in labels
 
 
+def test_repo_decode2_tuple_seen():
+    """DECODE2_FIELDS (strom/formats/jpeg.py) rides the *_FIELDS scan
+    (ISSUE 12 satellite) so the decode-v2 bench columns, the
+    compare_rounds section and the sentinel gates can't fork spellings
+    from the counter producers."""
+    found, _labels = lint.scan_sources(_ROOT)
+    assert "decodenativeimgpers" in found     # DECODE2_FIELDS
+    assert "decoderoirowsskipped" in found    # DECODE2_FIELDS + producer
+    assert "decodecachewarmimgpers" in found  # DECODE2_FIELDS
+    assert "decodefusedruns" in found         # DECODE2_FIELDS + producer
+
+
 def test_repo_slo_and_exemplar_tuples_seen():
     """SLO_FIELDS / SLO_BENCH_FIELDS (strom/obs/slo.py) and
     EXEMPLAR_FIELDS (strom/obs/exemplars.py) ride the *_FIELDS scan

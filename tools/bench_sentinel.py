@@ -45,9 +45,9 @@ import sys
 _HERE = os.path.dirname(os.path.abspath(__file__))
 sys.path.insert(0, _HERE)  # runnable as a script from anywhere
 
-from compare_rounds import (BINDING_ORDER, CACHE_KEYS, DECODE_KEYS,  # noqa: E402
-                            RESIL_KEYS, SLO_KEYS, STALL_KEYS, STREAM_KEYS,
-                            unwrap)
+from compare_rounds import (BINDING_ORDER, CACHE_KEYS, DECODE2_KEYS,  # noqa: E402
+                            DECODE_KEYS, RESIL_KEYS, SLO_KEYS, STALL_KEYS,
+                            STREAM_KEYS, unwrap)
 
 # The gated metric set: (metric, direction) over the single-sourced
 # comparison tuples, where direction is "up" (bigger is better) or "down"
@@ -94,6 +94,15 @@ SENTINEL_FIELDS = (
     # (same-run ratio, weather-independent)
     ("chaos_ok", "up"),
     ("chaos_slowdown", "down"),
+    # decode path v2 (ISSUE 12): the native+fused+ROI decode arm's img/s
+    # (fixture-bound but host-CPU-decode-bound, gated like the other
+    # decode img/s trends — the acceptance metric is >= 2x the r05
+    # 322 img/s baseline) and the decoded-output cache's warm/cold ratio
+    # (same-run, weather-independent)
+    ("resnet_decode_native_img_per_s", "up"),
+    ("resnet_decode_cache_warm_vs_cold", "up"),
+    ("vit_decode_native_img_per_s", "up"),
+    ("vit_decode_cache_warm_vs_cold", "up"),
 )
 
 # absolute slack for count-like "down" metrics around small values: going
@@ -107,8 +116,8 @@ ABS_SLACK = 2.0
 RATIO_DOWN = frozenset({"chaos_slowdown"})
 
 TABLE_KEYS = list(dict.fromkeys(
-    BINDING_ORDER + DECODE_KEYS + STALL_KEYS + CACHE_KEYS + STREAM_KEYS
-    + SLO_KEYS + RESIL_KEYS))
+    BINDING_ORDER + DECODE_KEYS + DECODE2_KEYS + STALL_KEYS + CACHE_KEYS
+    + STREAM_KEYS + SLO_KEYS + RESIL_KEYS))
 
 
 def load_round(path: str) -> dict:

@@ -62,6 +62,38 @@ DECODE_KEYS = [
     "resnet_decode_put_overlap_ms",
     "resnet_decode_batch_p50_us",
 ]
+# decode path v2 (ISSUE 12 tentpole): the native-vs-cv2 A/B epochs and the
+# decoded-output-cache cold/warm pair on the JPEG vision arms.
+# decode_native_vs_cv2 and decode_cache_warm_vs_cold are same-run ratios
+# (weather-independent, like warm_vs_cold); decode_native_img_per_s is
+# fixture-bound but host-CPU-decode-bound, so its round-over-round trend IS
+# the decode speedup (the ISSUE 12 acceptance metric: >= 2x the r05
+# 322 img/s baseline). The counter rows prove WHICH mechanism engaged
+# (native decodes, fused runs, ROI scanlines skipped, cache hits). Suffixes
+# single-sourced in strom.formats.jpeg.DECODE2_FIELDS (parity-tested in
+# tests/test_compare_rounds.py, same contract as the decode/stall/cache
+# sections).
+DECODE2_KEYS = [
+    "resnet_decode_native_img_per_s",
+    "resnet_decode_cv2_img_per_s",
+    "resnet_decode_native_vs_cv2",
+    "resnet_decode_native_imgs",
+    "resnet_decode_native_fallbacks",
+    "resnet_decode_fused_runs",
+    "resnet_decode_fused_samples",
+    "resnet_decode_roi_hits",
+    "resnet_decode_roi_rows_skipped",
+    "resnet_decode_cache_cold_img_per_s",
+    "resnet_decode_cache_warm_img_per_s",
+    "resnet_decode_cache_warm_vs_cold",
+    "resnet_decode_cache_hit_bytes",
+    "resnet_decode_cache_admitted_bytes",
+    "vit_decode_native_img_per_s",
+    "vit_decode_native_vs_cv2",
+    "vit_decode_roi_rows_skipped",
+    "vit_decode_cache_warm_img_per_s",
+    "vit_decode_cache_warm_vs_cold",
+]
 # per-step stall attribution (ISSUE 3 tentpole): goodput_pct = the fraction
 # of train-step wall the consumer spent computing (100 = the 0-stall north
 # star restated), and the bucket p50s say WHICH subsystem the waits went to
@@ -325,6 +357,8 @@ def main(argv: list[str]) -> int:
     have_headline = any(c != "-" for c in headline_cells)
     have_decode = any(cell(d, k) != "-" for _, d in rounds
                       for k in DECODE_KEYS)
+    have_decode2 = any(cell(d, k) != "-" for _, d in rounds
+                       for k in DECODE2_KEYS)
     have_stall = any(cell(d, k) != "-" for _, d in rounds
                      for k in STALL_KEYS)
     have_cache = any(cell(d, k) != "-" for _, d in rounds
@@ -338,8 +372,8 @@ def main(argv: list[str]) -> int:
     have_resil = any(cell(d, k) != "-" for _, d in rounds
                      for k in RESIL_KEYS)
     name_w = max(len(k) for k in binding_keys + CONTEXT_KEYS + DECODE_KEYS
-                 + STALL_KEYS + CACHE_KEYS + STREAM_KEYS + SCHED_KEYS
-                 + SLO_KEYS + RESIL_KEYS + audit_keys) + 2
+                 + DECODE2_KEYS + STALL_KEYS + CACHE_KEYS + STREAM_KEYS
+                 + SCHED_KEYS + SLO_KEYS + RESIL_KEYS + audit_keys) + 2
     # every rendered cell folds into ONE column width, or rows misalign
     col_w = max(max(len(n) for n, _ in rounds) + 2, 12,
                 *(len(c) + 2 for cs in audit_cells.values() for c in cs),
@@ -364,6 +398,12 @@ def main(argv: list[str]) -> int:
         print("decode path (vision JPEG arms: img/s + which decode "
               "optimizations engaged):")
         for k in DECODE_KEYS:
+            print(k.ljust(name_w)
+                  + "".join(cell(d, k).rjust(col_w) for _, d in rounds))
+    if have_decode2:
+        print("decode v2 (native-vs-cv2 A/B + decoded-cache cold/warm "
+              "pair; ratios are same-run):")
+        for k in DECODE2_KEYS:
             print(k.ljust(name_w)
                   + "".join(cell(d, k).rjust(col_w) for _, d in rounds))
     if have_stall:

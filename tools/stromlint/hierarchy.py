@@ -69,6 +69,11 @@ LOCK_RANKS = {
     # -- band: slab pool -----------------------------------------------------
     "slab.pool": 50,
     # -- band: hot cache -----------------------------------------------------
+    "cache.decoded": 58,       # DecodedCache tallies (ISSUE 12): a leaf
+                               # held only for counter updates, ranked
+                               # before cache.meta so a tally-then-admit
+                               # sequence could nest legally if it ever
+                               # needed to (it doesn't today)
     "cache.meta": 60,
     # -- observability (leaves, but may write stats under themselves) --------
     "obs.flight": 70,
