@@ -956,6 +956,42 @@ def main() -> int:
                   file=sys.stderr)
             flush_partial(**loader_res)
 
+        # ISSUE 13: write path — engine checkpoint save/restore of the
+        # llama train state (chunked op="write" gathers, crash-safe
+        # tmp+rename, restore via memcpy_ssd2tpu) rated against the
+        # pickle-to-filesystem baseline, plus the warm-spill epoch pair
+        # (evicted cache entries demoted to the NVMe spill file serve a
+        # repeat epoch with ZERO source-engine reads —
+        # spill_cache_miss_bytes must stay 0). Keys copy via the
+        # single-sourced CKPT_FIELDS/SPILL_FIELDS tuples (parity-tested
+        # like the cache/sched sections); bench_sentinel gates
+        # ckpt_save_mb_per_s and spill_hit_ratio.
+        from strom.ckpt.checkpoint import CKPT_FIELDS
+        from strom.cli import bench_checkpoint
+        from strom.delivery.spill import SPILL_FIELDS
+
+        ckargs = argparse.Namespace(
+            file=None, size=size, block=cfg.block_size, depth=32, iters=1,
+            engine="auto", tmpdir=args.tmpdir, json=True, model="small",
+            fault_plan="", metrics_port=args.metrics_port)
+        ckres = attempt("checkpoint", lambda: bench_checkpoint(ckargs)) \
+            if phase_ok("checkpoint", 180) else None
+        if ckres is not None:
+            for k in (*CKPT_FIELDS, *SPILL_FIELDS):
+                if k in ckres:
+                    loader_res[k] = ckres[k]
+            print(f"checkpoint ({ckres.get('model')}, "
+                  f"{ckres.get('ckpt_bytes', 0) / 1e6:.0f}MB): save "
+                  f"{ckres.get('ckpt_save_mb_per_s')}MB/s "
+                  f"(pickle {ckres.get('ckpt_pickle_save_mb_per_s')}MB/s, "
+                  f"x{ckres.get('ckpt_save_vs_pickle')}), restore "
+                  f"{ckres.get('ckpt_restore_mb_per_s')}MB/s, roundtrip_ok="
+                  f"{ckres.get('ckpt_roundtrip_ok')}; spill served "
+                  f"{ckres.get('spill_hit_bytes', 0) / 1e6:.0f}MB with "
+                  f"{ckres.get('spill_cache_miss_bytes')} source-miss bytes",
+                  file=sys.stderr)
+            flush_partial(**loader_res)
+
     # --- numerator: one streamed memcpy_ssd2tpu ----------------------------
     # (engine reads piece k+1 while piece k streams host->HBM)
     # Capped at 512MiB: the relay link's token bucket holds ~0.5-1 GiB of

@@ -149,6 +149,7 @@ struct FileEntry {
   uint32_t offset_align = 4096;
   bool o_direct = false;
   bool in_use = false;
+  bool writable = false;  // opened O_RDWR (ISSUE 13: engine write path)
 };
 
 struct OpSlot {
@@ -159,6 +160,7 @@ struct OpSlot {
   uint32_t length = 0;
   int32_t file_index = -1;
   bool in_use = false;
+  bool is_write = false;  // IORING_OP_WRITE: no EOF topup, write accounting
 };
 
 }  // namespace
@@ -207,6 +209,11 @@ struct sc_stats {
   // would otherwise be invisible (VERDICT.md r3 weak #5; bounded to <=
   // kMaxResidencyProbes groups per segment)
   uint64_t residency_probes;
+  // write path (ISSUE 13): IORING_OP_WRITE ops completed and bytes landed
+  // on media/page cache through this engine — appended at the struct tail
+  // so older readers of the ABI see an unchanged prefix
+  uint64_t ops_written;
+  uint64_t bytes_written;
 };
 
 struct sc_engine {
@@ -287,7 +294,8 @@ struct sc_engine {
   // stats
   std::atomic<uint64_t> ops_submitted{0}, ops_completed{0}, ops_errored{0},
       ops_faulted{0}, bytes_read{0}, unaligned_fallback{0}, eof_topup{0},
-      lat_count{0}, lat_total_us{0}, chunk_retries{0}, ops_fixed{0};
+      lat_count{0}, lat_total_us{0}, chunk_retries{0}, ops_fixed{0},
+      ops_written{0}, bytes_written{0};
   std::atomic<uint64_t> lat_hist[kHistBuckets]{};
   // last non-transient errno from the SQPOLL SQ_WAKEUP enter (0 = none):
   // a dead/unwakeable poller otherwise presents only as a read timeout
@@ -572,9 +580,16 @@ void sc_destroy(sc_engine *e) {
 
 void *sc_pool_base(sc_engine *e) { return e->pool; }
 
-// o_direct: 0 = buffered, 1 = required (else fall back), 2 = auto
+// o_direct bits 0-2: 0 = buffered, 1 = required (else fall back), 2 = auto.
+// Bit 3 (| 8): open the file READ-WRITE (ISSUE 13 write path) — the caller
+// creates/sizes the file first; both fds (direct + buffered) carry O_RDWR so
+// aligned writes ride O_DIRECT and unaligned ones fall back buffered exactly
+// like reads do.
 int sc_register_file(sc_engine *e, const char *path, int o_direct) {
-  int fd_buf = open(path, O_RDONLY | O_CLOEXEC);
+  bool writable = (o_direct & 8) != 0;
+  o_direct &= 7;
+  int base_flags = (writable ? O_RDWR : O_RDONLY) | O_CLOEXEC;
+  int fd_buf = open(path, base_flags);
   if (fd_buf < 0) return -errno;
 
   uint32_t mem_align = 4096, offset_align = 4096;
@@ -599,7 +614,7 @@ int sc_register_file(sc_engine *e, const char *path, int o_direct) {
   int fd = -1;
   bool use_direct = false;
   if (o_direct != 0 && (!dio_known || dio_ok)) {
-    fd = open(path, O_RDONLY | O_DIRECT | O_CLOEXEC);
+    fd = open(path, base_flags | O_DIRECT);
     if (fd >= 0) use_direct = true;
   }
   if (fd < 0) {
@@ -614,7 +629,8 @@ int sc_register_file(sc_engine *e, const char *path, int o_direct) {
   std::lock_guard<std::mutex> g(e->files_mu);
   for (uint32_t i = 0; i < kMaxFiles; ++i) {
     if (!e->files[i].in_use) {
-      e->files[i] = FileEntry{fd, fd_buf, mem_align, offset_align, use_direct, true};
+      e->files[i] = FileEntry{fd,         fd_buf, mem_align, offset_align,
+                              use_direct, true,   writable};
       if (e->fixed_files) {
         struct io_uring_files_update up;
         memset(&up, 0, sizeof(up));
@@ -679,7 +695,8 @@ static void fill_sqe_locked(sc_engine *e, const FileEntry &f, int file_index,
                             uint64_t offset, uint32_t length,
                             int64_t buf_index, uint32_t buf_offset,
                             uint8_t *addr, uint64_t tag,
-                            bool force_buffered = false) {
+                            bool force_buffered = false,
+                            bool is_write = false) {
   uint32_t slot_idx = e->free_slots[--e->n_free];
   OpSlot &slot = e->slots[slot_idx];
   slot.tag = tag;
@@ -689,6 +706,7 @@ static void fill_sqe_locked(sc_engine *e, const FileEntry &f, int file_index,
   slot.length = length;
   slot.file_index = file_index;
   slot.in_use = true;
+  slot.is_write = is_write;
 
   bool aligned = (offset % f.offset_align == 0) &&
                  (length % f.offset_align == 0) &&
@@ -707,17 +725,31 @@ static void fill_sqe_locked(sc_engine *e, const FileEntry &f, int file_index,
   // checks addr against the entry's iovec) — gating on buf_offset == 0 kept
   // the fixed path off every partial-slot and external-slab read
   (void)buf_offset;
-  sqe->opcode = (direct && e->fixed_buffers && buf_index >= 0)
-                    ? IORING_OP_READ_FIXED
-                    : IORING_OP_READ;
+  if (is_write) {
+    // the write twin of the read path (ISSUE 13): same fd routing, same
+    // fixed-buffer eligibility. IORING_OP_WRITE carries addr/len inline
+    // (no caller-lifetime iovec like WRITEV), which matters under SQPOLL
+    // where the kernel may consume the SQE after this call returns.
+    sqe->opcode = (direct && e->fixed_buffers && buf_index >= 0)
+                      ? IORING_OP_WRITE_FIXED
+                      : IORING_OP_WRITE;
+    if (sqe->opcode == IORING_OP_WRITE_FIXED) {
+      sqe->buf_index = (uint16_t)buf_index;
+      e->ops_fixed.fetch_add(1, std::memory_order_relaxed);
+    }
+  } else {
+    sqe->opcode = (direct && e->fixed_buffers && buf_index >= 0)
+                      ? IORING_OP_READ_FIXED
+                      : IORING_OP_READ;
+    if (sqe->opcode == IORING_OP_READ_FIXED) {
+      sqe->buf_index = (uint16_t)buf_index;
+      e->ops_fixed.fetch_add(1, std::memory_order_relaxed);
+    }
+  }
   sqe->addr = (uint64_t)(uintptr_t)addr;
   sqe->len = length;
   sqe->off = offset;
   sqe->user_data = slot_idx;
-  if (sqe->opcode == IORING_OP_READ_FIXED) {
-    sqe->buf_index = (uint16_t)buf_index;
-    e->ops_fixed.fetch_add(1, std::memory_order_relaxed);
-  }
   if (direct && e->fixed_files) {
     sqe->fd = file_index;
     sqe->flags |= IOSQE_FIXED_FILE;
@@ -913,7 +945,8 @@ static uint32_t reap_locked(sc_engine *e, sc_completion *out, uint32_t max) {
     OpSlot &slot = e->slots[slot_idx];
     int64_t res = cqe->res;
     head++;
-    if (res >= 0 && (uint32_t)res < slot.length && slot.file_index >= 0) {
+    if (res >= 0 && (uint32_t)res < slot.length && slot.file_index >= 0 &&
+        !slot.is_write) {
       // Short read. For O_DIRECT files this is the aligned-EOF case: top up
       // the unaligned tail through the page cache (≙ the reference's
       // page-cache fallback arm, SURVEY.md §2.1).
@@ -939,7 +972,19 @@ static uint32_t reap_locked(sc_engine *e, sc_completion *out, uint32_t max) {
       e->ops_errored.fetch_add(1, std::memory_order_relaxed);
     else {
       e->ops_completed.fetch_add(1, std::memory_order_relaxed);
-      e->bytes_read.fetch_add((uint64_t)res, std::memory_order_relaxed);
+      if (slot.is_write) {
+        // short writes count NOTHING here: the Python retry rewrites the
+        // WHOLE piece, whose full completion counts once — crediting the
+        // partial res too would double-count the overlap (reads have no
+        // such asymmetry: their short tail detours to the EOF topup)
+        if ((uint32_t)res >= slot.length) {
+          e->ops_written.fetch_add(1, std::memory_order_relaxed);
+          e->bytes_written.fetch_add((uint64_t)res,
+                                     std::memory_order_relaxed);
+        }
+      } else {
+        e->bytes_read.fetch_add((uint64_t)res, std::memory_order_relaxed);
+      }
       record_latency(e, (now_ns() - slot.submit_ns) / 1000);
     }
     out[n++] = sc_completion{slot.tag, res};
@@ -1023,9 +1068,12 @@ struct sc_raw_op {
   int32_t buf_index;  // registered-buffer table index for READ_FIXED
                       // (addr must lie inside that entry); -1 = plain READ
   int32_t op_flags;   // bit0 (SC_OP_BUFFERED): force the buffered fd —
-                      // the residency hybrid routes cache-warm chunks here
+                      // the residency hybrid routes cache-warm chunks here.
+                      // bit1 (SC_OP_WRITE): IORING_OP_WRITE from addr
+                      // (ISSUE 13) — file must be registered writable
 };
 static constexpr int32_t SC_OP_BUFFERED = 1;
+static constexpr int32_t SC_OP_WRITE = 2;
 
 // Batch submit into caller-owned memory: one lock, one io_uring_enter for the
 // whole vector (the per-op path costs one syscall per 128KiB block — at NVMe
@@ -1087,6 +1135,14 @@ int sc_submit_raw_batch(sc_engine *e, const sc_raw_op *ops, uint32_t n,
         }
         f = e->files[op.file_index];
       }
+      if ((op.op_flags & SC_OP_WRITE) && !f.writable) {
+        // a write against a read-only registration can never succeed:
+        // fail it at the submission boundary with its true errno instead
+        // of an async kernel EBADF the retry machinery would chew on
+        rc = accepted ? (int)accepted : -EBADF;
+        stop = EBADF;
+        break;
+      }
       if (e->n_free == 0) break;  // queue depth reached: caller reaps + resumes
       // honor a registered-buffer index only when it names a live table
       // entry; anything else degrades to plain READ instead of an async
@@ -1104,7 +1160,8 @@ int sc_submit_raw_batch(sc_engine *e, const sc_raw_op *ops, uint32_t n,
       }
       fill_sqe_locked(e, f, op.file_index, op.offset, op.length, bi, 0,
                       (uint8_t *)op.addr, op.tag,
-                      (op.op_flags & SC_OP_BUFFERED) != 0);
+                      (op.op_flags & SC_OP_BUFFERED) != 0,
+                      (op.op_flags & SC_OP_WRITE) != 0);
       ++filled;
       ++accepted;
     }
@@ -1559,6 +1616,8 @@ void sc_get_stats(sc_engine *e, sc_stats *s) {
   s->cached_bytes = e->cached_bytes.load(std::memory_order_relaxed);
   s->media_bytes = e->media_bytes.load(std::memory_order_relaxed);
   s->residency_probes = e->residency_probes.load(std::memory_order_relaxed);
+  s->ops_written = e->ops_written.load(std::memory_order_relaxed);
+  s->bytes_written = e->bytes_written.load(std::memory_order_relaxed);
 }
 
 }  // extern "C"

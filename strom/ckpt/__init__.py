@@ -1,0 +1,15 @@
+"""HBM→SSD checkpointing through the engine write path (ISSUE 13)."""
+
+from strom.ckpt.checkpoint import (CKPT_FIELDS, CkptCorruptError, CkptError,
+                                   load_pickle, restore_checkpoint,
+                                   save_checkpoint, save_pickle)
+
+__all__ = [
+    "CKPT_FIELDS",
+    "CkptCorruptError",
+    "CkptError",
+    "load_pickle",
+    "restore_checkpoint",
+    "save_checkpoint",
+    "save_pickle",
+]

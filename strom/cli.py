@@ -14,9 +14,11 @@ reference cite UNVERIFIED — empty mount, SURVEY.md §0):
 from __future__ import annotations
 
 import argparse
+import contextlib
 import dataclasses
 import json
 import os
+import shutil
 import sys
 import threading
 import time
@@ -1964,6 +1966,145 @@ def bench_chaos(args: argparse.Namespace) -> dict:
     return out
 
 
+def bench_checkpoint(args: argparse.Namespace) -> dict:
+    """Write path bench (ISSUE 13): engine checkpoint save/restore of the
+    llama train state vs a pickle-to-filesystem baseline, plus a warm-spill
+    epoch pair over an engine-written rawbin fixture.
+
+    Three phases, all on the engine write path the PR added:
+    1. ckpt — ``strom.ckpt.save_checkpoint`` of a real llama train state
+       (chunked ``op="write"`` gathers through slab-pool staging, crash-safe
+       tmp+rename) rated MB/s against ``save_pickle``; restore rides
+       ``memcpy_ssd2tpu`` and the round-trip is verified bit-exact
+       (``ckpt_roundtrip_ok``). Keys: strom.ckpt.checkpoint.CKPT_FIELDS.
+    2. spill — a tiny hot cache over a rawbin fixture GENERATED through
+       ``write_token_shard`` (the engine writes what it will read): epoch 1
+       admits+evicts into the NVMe spill tier, epoch 2 re-reads the same
+       records — served RAM+spill with ZERO source-engine reads
+       (``spill_cache_miss_bytes`` = 0 is the acceptance bit). Keys:
+       strom.delivery.spill.SPILL_FIELDS."""
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P  # noqa: F401
+
+    from strom.ckpt import (CKPT_FIELDS, restore_checkpoint, save_checkpoint,
+                            save_pickle)
+    from strom.config import StromConfig
+    from strom.delivery.core import StromContext
+    from strom.delivery.spill import SPILL_FIELDS  # noqa: F401 (contract)
+    from strom.formats.rawbin import TokenShardSet, write_token_shard
+    from strom.models.llama import LlamaConfig
+    from strom.parallel.mesh import make_mesh
+    from strom.parallel.train import init_train_state, make_optimizer
+
+    cfg = StromConfig(engine=args.engine, block_size=args.block,
+                      queue_depth=args.depth,
+                      num_buffers=max(args.depth * 2, 8),
+                      **_obs_config_kw(args))
+    out: dict = {"bench": "checkpoint", "engine": cfg.engine,
+                 "model": args.model}
+    ctx = StromContext(cfg)
+    try:
+        mesh = make_mesh({"dp": 1}, devices=jax.devices()[:1])
+        mcfg = getattr(LlamaConfig, args.model)()
+        opt = make_optimizer()
+        with mesh:
+            state = init_train_state(jax.random.key(0), mcfg, mesh, opt)
+        jax.block_until_ready(state)
+        d = os.path.join(args.tmpdir, "strom_bench_ckpt")
+        t0 = time.perf_counter()
+        manifest = save_checkpoint(ctx, d, state)
+        save_s = time.perf_counter() - t0
+        payload = manifest["payload_bytes"]
+        pk = os.path.join(args.tmpdir, "strom_bench_ckpt.pkl")
+        t0 = time.perf_counter()
+        save_pickle(pk, state)
+        pickle_s = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        back = restore_checkpoint(ctx, d, state)
+        jax.block_until_ready(back)
+        restore_s = time.perf_counter() - t0
+        la, _ = jax.tree_util.tree_flatten(state)
+        lb, _ = jax.tree_util.tree_flatten(back)
+        ok = all(np.array_equal(np.asarray(a), np.asarray(b))
+                 for a, b in zip(la, lb))
+        mb = payload / 1e6
+        out.update({
+            "ckpt_bytes": payload,
+            "ckpt_leaves": len(manifest["leaves"]),
+            "ckpt_save_mb_per_s": round(mb / save_s, 1) if save_s else None,
+            "ckpt_restore_mb_per_s":
+                round(mb / restore_s, 1) if restore_s else None,
+            "ckpt_pickle_save_mb_per_s":
+                round(mb / pickle_s, 1) if pickle_s else None,
+            "ckpt_save_vs_pickle":
+                round(pickle_s / save_s, 3) if save_s else None,
+            "ckpt_roundtrip_ok": int(ok),
+        })
+        with contextlib.suppress(OSError):
+            os.unlink(pk)
+        shutil.rmtree(d, ignore_errors=True)
+    finally:
+        ctx.close()
+
+    # -- spill epoch pair ---------------------------------------------------
+    fixture_bytes = 16 << 20
+    record_tokens = 1024
+    scfg = StromConfig(engine=args.engine, block_size=args.block,
+                       queue_depth=args.depth,
+                       num_buffers=max(args.depth * 2, 8),
+                       hot_cache_bytes=max(fixture_bytes // 8, 1 << 20),
+                       hot_cache_admit="always",
+                       spill_bytes=fixture_bytes * 2,
+                       spill_dir=args.tmpdir,
+                       **_obs_config_kw(args))
+    sctx = StromContext(scfg)
+    try:
+        shard = os.path.join(args.tmpdir, "strom_bench_spill_tokens.bin")
+        rng = np.random.default_rng(7)
+        toks = rng.integers(0, 1 << 15,
+                            fixture_bytes // 4, dtype=np.int32)
+        # the fixture is generated through the SAME engine that reads it
+        # back (ISSUE 13 front 4: writers feed the bench they serve)
+        write_token_shard(sctx, shard, toks)
+        ss = TokenShardSet((shard,), record_tokens=record_tokens)
+        _drop_cache_hint(shard)
+        step = 32  # records per read
+
+        def one_epoch() -> float:
+            t0 = time.perf_counter()
+            for lo in range(0, ss.num_records - step + 1, step):
+                sctx.pread(ss.extents(list(range(lo, lo + step))))
+            return time.perf_counter() - t0
+
+        one_epoch()  # epoch 1: cold — admit, evict, demote to spill
+        s1 = sctx.stats(sections=["cache", "spill"])
+        miss1 = s1["cache"]["cache_miss_bytes"]
+        cold_spilled = s1["spill"]["spill_spilled_bytes"]
+        warm_s = one_epoch()  # epoch 2: RAM + spill, zero source reads
+        s2 = sctx.stats(sections=["cache", "spill"])
+        sp = s2["spill"]
+        hit = sp["spill_hit_bytes"]
+        out.update({
+            "spill_hit_bytes": hit,
+            "spill_hits": sp["spill_hits"],
+            "spill_spilled_bytes": cold_spilled,
+            "spill_entries": sp["spill_entries"],
+            "spill_bytes": sp["spill_bytes"],
+            "spill_hit_ratio": sp["spill_hit_ratio"],
+            # the acceptance bit: repeat traffic never misses to the
+            # source engine (RAM + spill covered everything)
+            "spill_cache_miss_bytes":
+                s2["cache"]["cache_miss_bytes"] - miss1,
+            "spill_warm_mb_per_s":
+                round(fixture_bytes / 1e6 / warm_s, 1) if warm_s else None,
+        })
+        with contextlib.suppress(OSError):
+            os.unlink(shard)
+    finally:
+        sctx.close()
+    return out
+
+
 def bench_all(args: argparse.Namespace) -> dict:
     """Every BASELINE config in one run (quick shapes): nvme raw baseline,
     ssd2host framework ratio, ssd2tpu delivered, resnet/vit/llama loaders
@@ -2406,6 +2547,23 @@ def main(argv: list[str] | None = None) -> int:
                               "(the arm then runs the 'chaos:<seed>' "
                               "preset)")
     p_chaos.set_defaults(fn=bench_chaos)
+
+    p_ckpt = sub.add_parser(
+        "checkpoint",
+        help="ISSUE 13 write-path arm: engine checkpoint save/restore of "
+             "the llama train state (chunked op='write' gathers, crash-"
+             "safe tmp+rename, restore via memcpy_ssd2tpu) rated vs a "
+             "pickle-to-filesystem baseline, plus a warm-spill epoch pair "
+             "over an engine-written rawbin fixture (ckpt_*/spill_* "
+             "columns, keys single-sourced in strom.ckpt.checkpoint."
+             "CKPT_FIELDS and strom.delivery.spill.SPILL_FIELDS)")
+    common(p_ckpt)
+    p_ckpt.add_argument("--model", default="small",
+                        choices=["tiny", "small", "llama3_8b"],
+                        help="LlamaConfig preset whose train state is "
+                             "checkpointed (default: small — a few hundred "
+                             "MB of params+opt, enough to rate MB/s)")
+    p_ckpt.set_defaults(fn=bench_checkpoint)
 
     p_daemon = sub.add_parser(
         "daemon",

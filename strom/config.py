@@ -197,6 +197,17 @@ class StromConfig:
     # cache from a background thread that uses idle engine queue budget and
     # yields to demand reads (0 = off; needs hot_cache_bytes > 0 to matter)
     readahead_window_batches: int = 0
+    # NVMe spill tier (strom/delivery/spill.py — ISSUE 13 tentpole):
+    # hot-cache entries evicted under byte pressure demote to a dedicated
+    # spill file of this many bytes instead of vanishing, and the delivery
+    # consult serves them from there — a RAM → NVMe → source hierarchy
+    # (decoded-cache entries demote too, making the spill file a second
+    # decoded tier). 0 = off; needs hot_cache_bytes > 0 to do anything.
+    spill_bytes: int = 0
+    # directory the spill file lives in ("" = the system temp dir); it is
+    # created per context and unlinked at close — spilled bytes are a
+    # cache, not a durability promise
+    spill_dir: str = ""
 
     # multi-tenant I/O scheduler (strom/sched — ISSUE 7 tentpole): the
     # shared arbiter that replaces the per-transfer engine lock. Tenants
@@ -380,6 +391,8 @@ class StromConfig:
                              "multiple of 4096")
         if self.readahead_window_batches < 0:
             raise ValueError("readahead_window_batches must be >= 0 (0 = off)")
+        if self.spill_bytes < 0:
+            raise ValueError("spill_bytes must be >= 0 (0 = off)")
         if self.sched_slice_bytes < -1:
             raise ValueError("sched_slice_bytes must be >= 0 (0 = no "
                              "slicing) or exactly -1 (auto)")

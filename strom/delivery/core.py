@@ -303,6 +303,9 @@ class StromContext:
             self.scope = scope
         self.engine.set_scope(self.scope)
         self._files: dict[str, int] = {}
+        # writable registrations (ISSUE 13 write path): separate indexes —
+        # the read side keeps its O_RDONLY fds and probe state
+        self._wfiles: dict[str, int] = {}
         # path → StripedFile aliases (register_striped): lets format readers
         # that traffic in path-keyed extents (tar members, Parquet column
         # chunks) ride RAID0 without knowing about striping
@@ -406,6 +409,23 @@ class StromContext:
             block_bytes=self.config.hot_cache_block_bytes,
             scope=self.scope) \
             if self.config.hot_cache_bytes > 0 else None
+        # NVMe spill tier (ISSUE 13 tentpole, strom/delivery/spill.py):
+        # evicted-but-warm cache entries demote to a dedicated spill file
+        # instead of vanishing; the cache consult serves them back with
+        # zero source-engine reads (RAM -> NVMe -> source hierarchy).
+        self._spill = None
+        if self.config.spill_bytes > 0 and self._hot_cache is not None:
+            import tempfile
+
+            from strom.delivery.spill import SpillTier
+
+            sdir = self.config.spill_dir or tempfile.gettempdir()
+            os.makedirs(sdir, exist_ok=True)
+            self._spill = SpillTier(
+                os.path.join(sdir,
+                             f"strom-spill-{os.getpid()}-{id(self):x}.bin"),
+                self.config.spill_bytes, scope=self.scope)
+            self._hot_cache.spill = self._spill
         # in-flight DEMAND gathers (not readahead): the readahead thread
         # checks this between engine-budget-sized slices and yields, so a
         # consumer's read never queues behind more than one warming slice
@@ -503,6 +523,12 @@ class StromContext:
         return self._hot_cache
 
     @property
+    def spill_tier(self):
+        """The NVMe spill tier when ``spill_bytes > 0`` (and a hot cache
+        exists), else None (strom/delivery/spill.py)."""
+        return self._spill
+
+    @property
     def slo(self):
         """The per-tenant SLO engine (always on — targets default loose;
         customize via ``ctx.slo.set_target(tenant, ...)``)."""
@@ -568,6 +594,11 @@ class StromContext:
                 hot_cache_bytes=hot_cache_bytes)
             if hot_cache_bytes and self._hot_cache is not None:
                 self._hot_cache.set_partition(name, hot_cache_bytes)
+                if self._spill is not None:
+                    # the spill carve-out mirrors the RAM one (ISSUE 13):
+                    # a tenant's demoted working set is bounded the same
+                    # way its resident one is
+                    self._spill.set_partition(name, hot_cache_bytes)
             return t
 
     @contextlib.contextmanager
@@ -598,13 +629,45 @@ class StromContext:
             return self._demand_reads > 0
 
     # -- file registry ------------------------------------------------------
-    def file_index(self, path: str) -> int:
+    def file_index(self, path: str, *, writable: bool = False) -> int:
+        """Engine file index for *path*, registered lazily. ``writable=True``
+        (ISSUE 13) registers a separate read-write index — write ops
+        (``ctx.pwrite``, checkpoint saves, dataset writers) ride it; the
+        read-only registration (and its o_direct probe state) is left
+        untouched."""
         with self._files_lock:
-            idx = self._files.get(path)
+            table = self._wfiles if writable else self._files
+            idx = table.get(path)
             if idx is None:
-                idx = self.engine.register_file(path, o_direct=self.config.o_direct)
-                self._files[path] = idx
+                idx = self.engine.register_file(
+                    path, o_direct=self.config.o_direct, writable=writable)
+                table[path] = idx
             return idx
+
+    def invalidate_file(self, path: str, *,
+                        registrations: bool = True) -> None:
+        """Forget everything cached about *path* (ISSUE 13): hot-cache and
+        spill entries (the bytes changed — a write landed), the FIEMAP
+        extent map, and (``registrations=True``) the engine file
+        registrations — required when the path now names a DIFFERENT inode
+        (a tmp+rename commit), where a cached fd would keep reading the
+        old file forever. In-place writers (:meth:`pwrite`) keep their
+        registrations: the inode is the same, only the cached bytes lie."""
+        idxs: list[int] = []
+        with self._files_lock:
+            self._extent_maps.pop(path, None)
+            if registrations:
+                for table in (self._files, self._wfiles):
+                    idx = table.pop(path, None)
+                    if idx is not None:
+                        idxs.append(idx)
+        for idx in idxs:
+            with contextlib.suppress(Exception):
+                self.engine.unregister_file(idx)
+        if self._hot_cache is not None:
+            # cascades to the spill tier (a spill tier only exists under a
+            # hot cache); derived tuple keys (decoded frames) drop too
+            self._hot_cache.invalidate(path)
 
     def register_striped(self, path: str, striped: "StripedFile | Sequence[str]",
                          chunk: int | None = None,
@@ -846,7 +909,8 @@ class StromContext:
 
     def _consult_cache(self, cache, chunks: list[tuple[int, int, int, int]],
                        idx_paths: dict[int, str],
-                       dflat: "np.ndarray | None", *, warm: bool = False
+                       dflat: "np.ndarray | None", *, warm: bool = False,
+                       tenant: "str | None" = None
                        ) -> tuple[list[tuple[int, int, int, int]], int,
                                   list[tuple[int, int]]]:
         """Hot-set cache consult (ISSUE 4 tentpole): split every physical
@@ -856,28 +920,72 @@ class StromContext:
         hit_bytes, hit_ranges)`` — *hit_ranges* are the dest [lo, hi) spans
         served from RAM, which the streaming path reports as INSTANT
         completions. ``warm=True`` (readahead) records nothing and never
-        copies (*dflat* may be None)."""
+        copies (*dflat* may be None).
+
+        With a spill tier attached (ISSUE 13), RAM misses probe the spill
+        file next: spill-resident ranges pread from local NVMe into *dflat*
+        (and re-offer themselves for RAM promotion — the hierarchy works in
+        both directions), never reaching the source engine and never
+        counting as ``cache_miss_bytes``; only TRUE misses (neither tier)
+        do."""
         cache_hit = 0
         t0 = _events_ring.now_us()
         miss_chunks: list[tuple[int, int, int, int]] = []
         hit_ranges: list[tuple[int, int]] = []
         pinned: list = []
+        spill = getattr(cache, "spill", None)
+        spill_served = 0
         for fi, fo, do, ln in chunks:
             path = idx_paths.get(fi)
             if path is None:  # untracked fd: bypass the cache
                 miss_chunks.append((fi, fo, do, ln))
                 continue
             hits, misses, pins = cache.lookup(path, fo, fo + ln,
-                                              record=not warm)
+                                              record=not warm,
+                                              count_misses=spill is None)
             pinned.extend(pins)
             for s, t, view in hits:
                 if not warm:  # warm mode discards dest: skip the copy
                     dflat[do + (s - fo): do + (t - fo)] = view
                     hit_ranges.append((do + (s - fo), do + (t - fo)))
                 cache_hit += t - s
+            if spill is None:
+                for s, t in misses:
+                    miss_chunks.append((fi, s, do + (s - fo), t - s))
+                continue
             for s, t in misses:
-                miss_chunks.append((fi, s, do + (s - fo), t - s))
+                sp_hits, sp_misses = spill.lookup(path, s, t,
+                                                  record=not warm)
+                try:
+                    for ss, tt, ent in sp_hits:
+                        if warm:
+                            # spill-resident = warm enough: readahead must
+                            # not re-read the source for it (promotion is
+                            # the demand path's job)
+                            cache_hit += tt - ss
+                            continue
+                        d_lo = do + (ss - fo)
+                        spill.read_into(ent, ss, tt,
+                                        dflat[d_lo: d_lo + (tt - ss)])
+                        hit_ranges.append((d_lo, d_lo + (tt - ss)))
+                        cache_hit += tt - ss
+                        spill_served += tt - ss
+                        # promote back to RAM (admission policy applies):
+                        # hot reuse graduates up the hierarchy, one memcpy
+                        cache.admit(path, ss, tt,
+                                    dflat[d_lo: d_lo + (tt - ss)],
+                                    tenant=tenant)
+                finally:
+                    spill.unpin([e for _, _, e in sp_hits])
+                for ss, tt in sp_misses:
+                    miss_chunks.append((fi, ss, do + (ss - fo), tt - ss))
+                    if not warm:
+                        cache.note_miss(tt - ss)
         cache.unpin(pinned)
+        if spill_served:
+            _request.complete(t0, _events_ring.now_us() - t0,
+                              "cache", "spill.serve",
+                              {"bytes": spill_served})
         if cache_hit and not warm:
             # request-tagged (ISSUE 8): which request the RAM-served bytes
             # belonged to — cache hits are why a "slow path" request isn't
@@ -918,7 +1026,8 @@ class StromContext:
                 cache = None
             if cache is not None and chunks:
                 chunks, _, _ = self._consult_cache(
-                    cache, chunks, idx_paths, None, warm=True)
+                    cache, chunks, idx_paths, None, warm=True,
+                    tenant=tenant)
             return self._warm_read_chunks(chunks, dest, idx_paths, tenant)
 
         # causal request tracing (ISSUE 8): every demand gather is (or
@@ -950,7 +1059,7 @@ class StromContext:
                 dflat = dest if dest.ndim == 1 and dest.dtype == np.uint8 \
                     else dest.reshape(-1).view(np.uint8)
                 chunks, cache_hit, _ = self._consult_cache(
-                    cache, chunks, idx_paths, dflat)
+                    cache, chunks, idx_paths, dflat, tenant=tenant)
             return self._demand_read_chunks(chunks, dest, idx_paths, cache,
                                             dflat, cache_hit, tenant)
 
@@ -1606,6 +1715,94 @@ class StromContext:
                             tenant=tenant, deadline_s=deadline_s)
         return dest
 
+    # -- the write path (ISSUE 13): host bytes -> SSD through the engine ----
+    def write_chunks(self, chunks, src: np.ndarray, *,
+                     tenant: "str | None" = None,
+                     priority: "str | None" = None) -> int:
+        """Execute a planned write scatter — (file_index, file_offset,
+        src_offset, length) chunks out of *src* — scheduler-granted when a
+        scheduler exists (PR 7 budgets/priority apply to writes), else under
+        the legacy engine lock. Feeds the circuit breaker (a sick engine's
+        write failures count toward the trip like read failures; writes do
+        NOT fail over — a half-written checkpoint on a second engine is
+        worse than a loud error, and the tmp+rename commit makes the retry
+        unit the whole save). Returns bytes written; raises on short."""
+        cfg = self.config
+        planned = sum(ln for (_, _, _, ln) in chunks)
+        if not chunks:
+            return 0
+        br = self._resilience.breaker if self._resilience is not None else None
+        try:
+            with self._demand_gate(), \
+                    _request.span("strom.write_chunks", cat="write",
+                                  args={"ops": len(chunks),
+                                        "bytes": planned}):
+                if self._scheduler is not None:
+                    total = self._scheduler.write_chunks(
+                        chunks, src, tenant=tenant,
+                        retries=cfg.io_retries, priority=priority)
+                else:
+                    with self._engine_lock:
+                        total = self.engine.write_vectored(
+                            chunks, src, retries=cfg.io_retries)
+        except (DeadlineExceeded, EngineStallError):
+            raise
+        except EngineError as e:
+            if br is not None:
+                from strom.engine.resilience import classify_errno
+
+                if classify_errno(e.errno or errno.EIO) == "transient":
+                    br.record_failure()
+            raise EngineError(e.errno, f"host2ssd {e.strerror}") from None
+        if br is not None:
+            br.record_success()
+        if total != planned:
+            raise EngineError(errno.EIO,
+                              f"host2ssd wrote {total} bytes, "
+                              f"planned {planned}")
+        self.scope.add("host2ssd_bytes", total)
+        return total
+
+    def pwrite(self, path: str, data: "np.ndarray | bytes | memoryview",
+               offset: int = 0, *, tenant: "str | None" = None,
+               create: bool = True, fsync: bool = False) -> int:
+        """Write *data* to ``path[offset:offset+len)`` through the engine
+        write path (ISSUE 13) — the write twin of :meth:`pread`. The file
+        is created when absent (*create*); *fsync* makes the bytes durable
+        before returning (the checkpoint layer's crash-safe commit relies
+        on it). Alignment is handled like reads: page-aligned source
+        buffers at aligned offsets ride O_DIRECT, anything else falls back
+        to the buffered fd inside the engine. Returns bytes written."""
+        if self._closed:
+            raise RuntimeError("StromContext is closed")
+        src = np.frombuffer(data, dtype=np.uint8) \
+            if not isinstance(data, np.ndarray) \
+            else np.ascontiguousarray(data).reshape(-1).view(np.uint8)
+        n = src.nbytes
+        if n == 0:
+            return 0
+        if create and not os.path.exists(path):
+            os.close(os.open(path, os.O_WRONLY | os.O_CREAT, 0o644))
+        fi = self.file_index(path, writable=True)
+        try:
+            total = self.write_chunks([(fi, offset, 0, n)], src,
+                                      tenant=tenant)
+        finally:
+            # cached bytes for this path are stale once ANY of the write
+            # landed — invalidated AFTER the write (a concurrent read
+            # during the write window may have re-admitted pre-write
+            # bytes; invalidating first would leave those stale entries
+            # servable forever). fds stay valid (same inode), so
+            # registrations are kept.
+            self.invalidate_file(path, registrations=False)
+        if fsync:
+            fd = os.open(path, os.O_RDONLY)
+            try:
+                os.fsync(fd)
+            finally:
+                os.close(fd)
+        return total
+
     # -- introspection (≙ LIST/INFO_GPU_MEMORY, /proc stats) ----------------
     def buffer_info(self) -> dict:
         return self.engine.buffer_info()
@@ -1616,8 +1813,8 @@ class StromContext:
         per-section TTL cache uses it so a scrape that only wants counters
         never recomputes the expensive stall-attribution section (ISSUE 6
         satellite). None = every section (the pre-existing contract).
-        Known sections: context, decode, stream, steps, cache, slab_pool,
-        engine, sched, slo, exemplars, resilience, scopes."""
+        Known sections: context, decode, stream, steps, cache, spill,
+        slab_pool, engine, sched, slo, exemplars, resilience, scopes."""
         want = None if sections is None else set(sections)
 
         def wanted(name: str) -> bool:
@@ -1628,6 +1825,9 @@ class StromContext:
             out["context"] = {
             "registered_files": len(self._files),
             "ssd2tpu_bytes": global_stats.counter("ssd2tpu_bytes").value,
+            # write path (ISSUE 13): bytes landed on media through
+            # ctx.pwrite / write_chunks (checkpoint saves, dataset writers)
+            "host2ssd_bytes": global_stats.counter("host2ssd_bytes").value,
             # delivery-scheduler observability: op counts before/after
             # coalescing (cumulative + last transfer) and the striped-read
             # overlap window (bytes per window, windows planned)
@@ -1750,6 +1950,8 @@ class StromContext:
         # registry mirror (same contract as the context section)
         if wanted("cache") and self._hot_cache is not None:
             out["cache"] = self._hot_cache.stats()
+        if wanted("spill") and self._spill is not None:
+            out["spill"] = self._spill.stats()
         if wanted("slab_pool") and self._slab_pool is not None:
             out["slab_pool"] = self._slab_pool.stats()
         if wanted("engine"):
@@ -1811,6 +2013,11 @@ class StromContext:
         self._group_executor.shutdown(wait=True)
         self._resilience.close()
         self.engine.close()
+        if self._spill is not None:
+            # after the engine: no gather can be mid-consult anymore
+            if self._hot_cache is not None:
+                self._hot_cache.spill = None
+            self._spill.close()
         if self._witness_enabled_here:
             # revert the witness THIS context turned on: locks already
             # constructed as WitnessLocks keep witnessing (the graph is

@@ -88,7 +88,7 @@ class _Entry:
     memory actually respects ``hot_cache_bytes``."""
 
     __slots__ = ("skey", "lo", "hi", "buf", "refs", "dead", "charge",
-                 "tenant")
+                 "tenant", "demote")
 
     def __init__(self, skey: Any, lo: int, hi: int, buf: np.ndarray,
                  charge: int, tenant: "str | None" = None):
@@ -102,6 +102,11 @@ class _Entry:
         # owning tenant for partition accounting (ISSUE 7): None = charged
         # to the shared budget only (single-tenant behavior unchanged)
         self.tenant = tenant
+        # evicted under byte pressure with a spill tier attached (ISSUE 13):
+        # the freeing caller demotes the bytes to NVMe before returning the
+        # slab to the pool. clear() leaves it False — a cleared cache drops,
+        # it does not spill (the bench epoch pairs depend on that).
+        self.demote = False
 
     @property
     def nbytes(self) -> int:
@@ -131,6 +136,12 @@ class HotCache:
         self.admit_policy = admit
         self._block = block_bytes
         self._pool = pool
+        # NVMe spill tier (ISSUE 13): when attached (StromContext wires a
+        # strom.delivery.spill.SpillTier for spill_bytes > 0), entries
+        # evicted under byte pressure demote there instead of vanishing —
+        # the delivery consult then serves them from the spill file with
+        # zero source-engine reads. None = single-tier behavior unchanged.
+        self.spill = None
         # phase gate: a disabled cache serves/admits/warms nothing (entries
         # are kept). The bench arms use it to scope the cache to the
         # cold/warm epoch pair so the pre-existing headline phases
@@ -198,7 +209,8 @@ class HotCache:
         # else: GC unmaps
 
     # -- lookup / pinning ---------------------------------------------------
-    def lookup(self, skey: Any, lo: int, hi: int, *, record: bool = True
+    def lookup(self, skey: Any, lo: int, hi: int, *, record: bool = True,
+               count_misses: bool = True
                ) -> tuple[list[tuple[int, int, np.ndarray]],
                           list[tuple[int, int]], list[_Entry]]:
         """Split [lo, hi) of *skey* into cached and missing ranges.
@@ -209,7 +221,10 @@ class HotCache:
         the caller MUST :meth:`unpin` them once it stops reading the views
         (after the memcpy, or after a device_put sourced from them retires).
         ``record=False`` skips the hit/miss counters (readahead probes must
-        not inflate the demand hit ratio).
+        not inflate the demand hit ratio). ``count_misses=False`` defers
+        ONLY the miss counters to the caller (:meth:`note_miss`) — the
+        spill-tier consult (ISSUE 13) uses it so a RAM miss the spill file
+        serves never shows up as ``cache_miss_bytes``.
         """
         hits: list[tuple[int, int, np.ndarray]] = []
         misses: list[tuple[int, int]] = []
@@ -241,21 +256,32 @@ class HotCache:
                 misses.append((pos, hi))
             if record:
                 hb = sum(t - s for s, t, _ in hits)
-                mb = sum(t - s for s, t in misses)
                 self.hit_bytes += hb
-                self.miss_bytes += mb
                 self.hits += len(hits)
-                self.misses += len(misses)
+                if count_misses:
+                    self.miss_bytes += sum(t - s for s, t in misses)
+                    self.misses += len(misses)
         if record:
             if hits:
                 self._scope.add("cache_hits", len(hits))
                 self._scope.add("cache_hit_bytes",
                                  sum(t - s for s, t, _ in hits))
-            if misses:
+            if misses and count_misses:
                 self._scope.add("cache_misses", len(misses))
                 self._scope.add("cache_miss_bytes",
                                  sum(t - s for s, t in misses))
         return hits, misses, pinned
+
+    def note_miss(self, nbytes: int, n: int = 1) -> None:
+        """Count a TRUE miss (no RAM entry, no spill entry) whose counting
+        :meth:`lookup` deferred via ``count_misses=False``."""
+        if nbytes <= 0:
+            return
+        with self._lock:
+            self.miss_bytes += nbytes
+            self.misses += n
+        self._scope.add("cache_misses", n)
+        self._scope.add("cache_miss_bytes", nbytes)
 
     def view(self, skey: Any, lo: int, hi: int, *, record: bool = True
              ) -> tuple[np.ndarray, _Entry] | None:
@@ -283,17 +309,39 @@ class HotCache:
         return e.buf[lo - e.lo: hi - e.lo], e
 
     def unpin(self, entries: Iterable[_Entry]) -> None:
-        """Drop pins taken by :meth:`lookup`/:meth:`view`; frees the slab of
-        any entry that was evicted while pinned."""
-        dead_bufs = []
+        """Drop pins taken by :meth:`lookup`/:meth:`view`; frees the slab
+        of any entry that was evicted while pinned. Dead entries NEVER
+        demote to the spill tier: pressure eviction only picks unpinned
+        victims, so a dead entry can only come from invalidate()/clear()
+        — and spilling at unpin time could republish bytes a concurrent
+        invalidation (a write landed on the file) just purged."""
+        dead: list[np.ndarray] = []
         with self._lock:
             for e in entries:
                 e.refs -= 1
                 if e.dead and e.refs == 0:
-                    dead_bufs.append(e.buf)
+                    dead.append(e.buf)
                     e.buf = None  # type: ignore[assignment]
-        for buf in dead_bufs:
+        for buf in dead:
             self._free(buf)
+
+    def _demote_and_free(self, e: _Entry, buf: np.ndarray) -> None:
+        """Outside-the-lock half of eviction (ISSUE 13): offer the evicted
+        bytes to the spill tier (when attached and the eviction wanted it),
+        then hand the slab back to the pool. Spill failures are counted,
+        never raised — losing a demotion means a future source re-read, the
+        exact behavior of the spill-less cache."""
+        sp = self.spill
+        if e.demote and sp is not None and e.skey is not None:
+            try:
+                sp.offer(e.skey, e.lo, e.hi, buf[: e.nbytes],
+                         tenant=e.tenant)
+            # stromlint: ignore[swallowed-exceptions] -- advisory demotion:
+            # a full/closed spill file degrades to the pre-spill eviction
+            # (drop), and the error is counted below
+            except Exception:
+                self._scope.add("spill_errors")
+        self._free(buf)
 
     # -- admission / eviction -----------------------------------------------
     def _blocks(self, skey: Any, lo: int, hi: int) -> list[tuple]:
@@ -365,12 +413,13 @@ class HotCache:
         charge = self._charge(n)
         buf = self._alloc(n)
         buf[:n] = data[:n]
-        # evicted-but-unpinned slabs collected under the lock, returned to
-        # the pool AFTER it releases: pool.release takes the slab-pool
-        # lock, which ranks BEFORE the cache lock in the canonical
-        # hierarchy (scheduler -> engine -> slab pool -> hot cache ->
-        # stats/ring) — the same free-outside-the-lock shape unpin() has
-        to_free: list[np.ndarray] = []
+        # evicted-but-unpinned slabs collected under the lock, demoted to
+        # the spill tier and returned to the pool AFTER it releases:
+        # spill pwrites block and pool.release takes the slab-pool lock,
+        # which ranks BEFORE the cache lock in the canonical hierarchy
+        # (scheduler -> engine -> slab pool -> hot cache -> stats/ring) —
+        # the same free-outside-the-lock shape unpin() has
+        to_free: list[tuple[_Entry, np.ndarray]] = []
         with self._lock:
             # partition enforcement (ISSUE 7): a tenant over its carve-out
             # first evicts its OWN unpinned entries (self-displacement —
@@ -419,19 +468,23 @@ class HotCache:
                         self._tenant_bytes[tenant] = \
                             self._tenant_bytes.get(tenant, 0) + charge
                     drop = None
-        for victim_buf in to_free:
-            self._free(victim_buf)
+        for victim, victim_buf in to_free:
+            self._demote_and_free(victim, victim_buf)
         if drop is not None:
             self._free(drop)
             return 0
         return n
 
-    def _evict_locked(self, e: _Entry) -> list:
-        """Remove *e* from the index/LRU (lock held). Returns the slabs to
-        hand back to the pool — the CALLER frees them after releasing the
-        cache lock (pool.release takes the slab-pool lock, which the
-        hierarchy orders before this one). A still-pinned entry returns
-        nothing here; its last unpin frees."""
+    def _evict_locked(self, e: _Entry, *, demote: bool = True
+                      ) -> list[tuple[_Entry, np.ndarray]]:
+        """Remove *e* from the index/LRU (lock held). Returns the
+        (entry, slab) pairs to demote+free — the CALLER runs
+        :meth:`_demote_and_free` after releasing the cache lock (spill
+        pwrites and pool.release must not run under it; the hierarchy
+        orders the slab-pool lock before this one). A still-pinned entry
+        returns nothing here; its last unpin frees WITHOUT demoting
+        (see unpin). ``demote=False``
+        (clear()) drops without spilling."""
         self._lru.pop(id(e), None)
         entries = self._index.get(e.skey)
         if entries is not None:
@@ -451,23 +504,47 @@ class HotCache:
         self.evicted_bytes += e.nbytes
         self._scope.add("cache_evictions")
         self._scope.add("cache_evicted_bytes", e.nbytes)
+        e.demote = demote and self.spill is not None
         if e.refs == 0:
             buf, e.buf = e.buf, None  # type: ignore[assignment]
-            return [buf]
-        e.dead = True  # last unpin frees
+            return [(e, buf)]
+        e.dead = True  # last unpin frees (never demotes: see unpin)
         return []
+
+    def invalidate(self, skey: Any) -> int:
+        """Drop every entry of *skey* — and of any DERIVED tuple key that
+        embeds it (the decoded-output cache keys frames as
+        ``("jpegdec", path, lo, hi, fp)``: pixels decoded from the old
+        bytes must go too) — WITHOUT demoting: the backing bytes changed
+        (a write landed on the file), so neither tier may keep serving
+        them. Returns entries dropped. Pinned entries leave the index
+        immediately; their slabs free on the last unpin."""
+        to_free: list[tuple[_Entry, np.ndarray]] = []
+        dropped = 0
+        with self._lock:
+            keys = [k for k in self._index
+                    if k == skey or (isinstance(k, tuple) and skey in k)]
+            for k in keys:
+                for e in list(self._index.get(k, ())):
+                    dropped += 1
+                    to_free.extend(self._evict_locked(e, demote=False))
+        for _e, buf in to_free:
+            self._free(buf)
+        if self.spill is not None:
+            self.spill.invalidate(skey)
+        return dropped
 
     def clear(self) -> None:
         """Drop every entry AND the touch ledger (a cleared cache forgets
         its observations too — the cold/warm bench pair depends on this).
         Pinned entries leave the index immediately (no new lookup can hit
         them) but their slabs free on the last unpin."""
-        to_free: list[np.ndarray] = []
+        to_free: list[tuple[_Entry, np.ndarray]] = []
         with self._lock:
             for e in list(self._lru.values()):
-                to_free.extend(self._evict_locked(e))
+                to_free.extend(self._evict_locked(e, demote=False))
             self._touched.clear()
-        for buf in to_free:
+        for _e, buf in to_free:
             self._free(buf)
 
     # -- readahead accounting ----------------------------------------------

@@ -160,7 +160,8 @@ class StreamingGather:
                 hit_bytes = 0
                 if cache is not None and chunks:
                     chunks, hit_bytes, self._instant = ctx._consult_cache(
-                        cache, chunks, idx_paths, self._dflat)
+                        cache, chunks, idx_paths, self._dflat,
+                        tenant=self._tenant)
                 self._chunks = chunks
                 self._miss_planned = sum(ln for (_, _, _, ln) in chunks)
                 self.total_bytes = self._miss_planned + hit_bytes

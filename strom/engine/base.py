@@ -68,9 +68,32 @@ class RawRead:
 
 
 @dataclasses.dataclass(frozen=True)
+class RawWrite:
+    """One block write from caller-owned memory to file[offset:offset+length)
+    (ISSUE 13: the write twin of :class:`RawRead`). *src* must be a readable
+    C-contiguous uint8 view whose lifetime the caller guarantees until the op
+    completes; for the O_DIRECT path it must satisfy the file's memory
+    alignment (the slab pool's buffers do). The file must have been
+    registered with ``writable=True``."""
+
+    file_index: int
+    offset: int
+    length: int
+    src: np.ndarray
+    tag: int
+
+    @property
+    def dest(self) -> np.ndarray:
+        # uniform accessor: engine internals (keepalives, fault flips,
+        # python workers) address "the op's buffer" without branching on
+        # direction; for a write that buffer is the source
+        return self.src
+
+
+@dataclasses.dataclass(frozen=True)
 class Completion:
     tag: int
-    result: int        # bytes read (>=0) or negative errno
+    result: int        # bytes read/written (>=0) or negative errno
 
 
 @dataclasses.dataclass(frozen=True)
@@ -128,14 +151,20 @@ class StreamToken:
                  "_pieces", "_backlog", "_exhausted", "_ready", "bytes_done",
                  "cancelled", "inflight_peak", "_err", "chunks_done",
                  "req_id", "deadline", "fail_fast", "_delayed",
-                 "retries_used", "failed_chunks")
+                 "retries_used", "failed_chunks", "op")
 
     def __init__(self, chunks: Sequence[tuple[int, int, int, int]],
                  dest: np.ndarray, block: int, retries: int,
                  req_id: "int | None" = None,
-                 deadline: "float | None" = None, fail_fast: bool = True):
+                 deadline: "float | None" = None, fail_fast: bool = True,
+                 op: str = "read"):
         self.chunks = list(chunks)
         self.retries = retries
+        # op direction (ISSUE 13): "read" gathers file->dest, "write"
+        # scatters dest->file (dest is then the SOURCE buffer). The whole
+        # submit/poll/drain state machine is direction-agnostic — only the
+        # RawRead/RawWrite built per piece differs.
+        self.op = op
         # causal request tracing (ISSUE 8): the req_id of the request this
         # gather belongs to, if traced — carried on the token so poll/drain
         # telemetry and tools can attribute engine work to one request
@@ -218,10 +247,14 @@ class Engine(abc.ABC):
 
     # -- file registration (≙ CHECK_FILE handing an fd to the kmod) ---------
     @abc.abstractmethod
-    def register_file(self, path: str, *, o_direct: bool | None = None) -> int:
+    def register_file(self, path: str, *, o_direct: bool | None = None,
+                      writable: bool = False) -> int:
         """Open (or adopt) *path* and return a file index for ReadRequests.
 
-        o_direct=None uses the engine config / per-file auto-probe."""
+        o_direct=None uses the engine config / per-file auto-probe.
+        writable=True (ISSUE 13) opens the file read-write so the index
+        also accepts :class:`RawWrite` ops / ``op="write"`` gathers; the
+        caller creates and sizes the file first."""
 
     @abc.abstractmethod
     def unregister_file(self, file_index: int) -> None: ...
@@ -593,7 +626,8 @@ class Engine(abc.ABC):
                         dest: np.ndarray, *, retries: int = 1,
                         req_id: "int | None" = None,
                         deadline: "float | None" = None,
-                        fail_fast: bool = True) -> StreamToken:
+                        fail_fast: bool = True,
+                        op: str = "read") -> StreamToken:
         """Begin an async gather of (file_index, file_offset, dest_offset,
         length) chunks into *dest*. Pieces are submitted up to queue_depth
         immediately; the rest flow in as :meth:`poll` reaps completions.
@@ -606,15 +640,34 @@ class Engine(abc.ABC):
         rest of the gather continue past an exhausted chunk (it retires as
         a negative ChunkCompletion instead of stopping the feed) — the
         streamed delivery path recovers such chunks on the fallback
-        engine."""
+        engine. ``op="write"`` (ISSUE 13) runs the gather in reverse:
+        *dest* is the SOURCE buffer and each chunk writes
+        dest[dest_offset:dest_offset+length) to file[file_offset:) — the
+        files must be registered ``writable=True``; retries rewrite whole
+        pieces (idempotent at fixed offsets), short writes retry like
+        short reads."""
+        if op not in ("read", "write"):
+            raise ValueError(f"op must be 'read' or 'write', got {op!r}")
         if deadline is None:
             deadline = self._request_deadline()
         tok = StreamToken(chunks, dest, self.config.block_size, retries,
                           req_id=req_id, deadline=deadline,
-                          fail_fast=fail_fast)
+                          fail_fast=fail_fast, op=op)
         self._track_token(tok)
         self._pump_token(tok)
         return tok
+
+    def write_vectored(self, chunks: Sequence[tuple[int, int, int, int]],
+                       src: np.ndarray, *, retries: int = 1) -> int:
+        """Blocking write twin of :meth:`read_vectored` (ISSUE 13): execute
+        a whole scatter list of (file_index, file_offset, src_offset,
+        length) chunks from *src*, block_size-chunked and pipelined at
+        queue_depth with per-chunk retry, through the async token machinery.
+        Returns total bytes written; raises EngineError on any failed or
+        short chunk. Same single-transfer concurrency contract as
+        read_vectored."""
+        tok = self.submit_vectored(chunks, src, retries=retries, op="write")
+        return self.drain(tok)
 
     def poll(self, token: StreamToken, min_completions: int = 1,
              timeout_s: float | None = None) -> list[ChunkCompletion]:
@@ -814,6 +867,7 @@ class Engine(abc.ABC):
             if not hasattr(self, "_vec_tag"):
                 self._vec_tag = 0
             reqs = []
+            is_write = tok.op == "write"
             for piece in batch:
                 ci, fi, fo, do, want, attempts = piece
                 tag = self._vec_tag
@@ -821,8 +875,12 @@ class Engine(abc.ABC):
                 # registered BEFORE submission: a completion can land (and a
                 # concurrent reap must find the entry) inside submit_raw
                 tok._pending[tag] = piece
-                reqs.append(RawRead(fi, fo, want,
-                                    tok._d8[do: do + want], tag))
+                if is_write:
+                    reqs.append(RawWrite(fi, fo, want,
+                                         tok._d8[do: do + want], tag))
+                else:
+                    reqs.append(RawRead(fi, fo, want,
+                                        tok._d8[do: do + want], tag))
             try:
                 self.submit_raw(reqs)
             except EngineError as e:
@@ -901,13 +959,15 @@ class Engine(abc.ABC):
                     self.op_scope.add("retry_budget_exhausted")
             if c.result < 0:
                 err = EngineError(
-                    -c.result, f"read failed after {attempts + 1} attempts: "
-                               f"{os.strerror(-c.result)}")
+                    -c.result,
+                    f"{tok.op} failed after {attempts + 1} attempts: "
+                    f"{os.strerror(-c.result)}")
             elif c.result < want:
                 tok.bytes_done += c.result
                 err = EngineError(
-                    _ENODATA, f"short read ({c.result} < {want}) — "
-                              "file smaller than requested range?")
+                    _ENODATA, f"short {tok.op} ({c.result} < {want})"
+                    + (" — file smaller than requested range?"
+                       if tok.op == "read" else ""))
             else:
                 tok.bytes_done += c.result
                 err = None

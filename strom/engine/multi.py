@@ -37,7 +37,7 @@ import numpy as np
 from strom.config import StromConfig
 from strom.engine.base import (ChunkCompletion, Completion, Engine,
                                EngineError, EngineStallError, RawRead,
-                               ReadRequest, StreamToken)
+                               RawWrite, ReadRequest, StreamToken)
 from strom.obs.events import ring as _events
 from strom.utils.locks import make_lock
 
@@ -146,9 +146,10 @@ class MultiRingEngine(Engine):
             for c in self._children:
                 c.close()
             raise
-        # my file index -> (path, o_direct); child registrations are lazy
-        # (a file only occupies a ring's fd table once a transfer lands there)
-        self._files: dict[int, tuple[str, bool | None]] = {}
+        # my file index -> (path, o_direct, writable); child registrations
+        # are lazy (a file only occupies a ring's fd table once a transfer
+        # lands there)
+        self._files: dict[int, tuple[str, bool | None, bool]] = {}
         self._next_fi = 0
         self._child_fi: list[dict[int, int]] = [dict() for _ in range(n)]
         self._reg_lock = make_lock("engine.multi_reg")
@@ -183,11 +184,12 @@ class MultiRingEngine(Engine):
             c.set_scope(scope)
 
     # -- files --------------------------------------------------------------
-    def register_file(self, path: str, *, o_direct: bool | None = None) -> int:
+    def register_file(self, path: str, *, o_direct: bool | None = None,
+                      writable: bool = False) -> int:
         with self._reg_lock:
             fi = self._next_fi
             self._next_fi += 1
-            self._files[fi] = (path, o_direct)
+            self._files[fi] = (path, o_direct, writable)
         # eager on ring 0 so o_direct probing happens once up front and
         # file_uses_o_direct answers without I/O later
         self._child_index(0, fi)
@@ -214,8 +216,9 @@ class MultiRingEngine(Engine):
             if ent is None:
                 raise EngineError(_errno.EBADF,
                                   f"file index {fi} not registered")
-            path, od = ent
-            ci = self._children[ring].register_file(path, o_direct=od)
+            path, od, wr = ent
+            ci = self._children[ring].register_file(path, o_direct=od,
+                                                    writable=wr)
             m[fi] = ci
             return ci
 
@@ -250,6 +253,8 @@ class MultiRingEngine(Engine):
 
     def submit_raw(self, requests: Sequence[RawRead]) -> int:
         return self._children[0].submit_raw([
+            RawWrite(self._child_index(0, r.file_index), r.offset, r.length,
+                     r.src, r.tag) if isinstance(r, RawWrite) else
             RawRead(self._child_index(0, r.file_index), r.offset, r.length,
                     r.dest, r.tag) for r in requests])
 
@@ -403,7 +408,8 @@ class MultiRingEngine(Engine):
                         dest: np.ndarray, *, retries: int = 1,
                         req_id: "int | None" = None,
                         deadline: "float | None" = None,
-                        fail_fast: bool = True):
+                        fail_fast: bool = True,
+                        op: str = "read"):
         """ISSUE 5: the async twin of read_vectored's routing — chunks fan
         per file onto member rings (member i → ring i mod N, stable) and
         each ring gets its own child StreamToken; completions map back to
@@ -452,7 +458,7 @@ class MultiRingEngine(Engine):
                               self._children[r].submit_vectored(
                                   ch, dest, retries=retries,
                                   req_id=req_id, deadline=deadline,
-                                  fail_fast=fail_fast), imap))
+                                  fail_fast=fail_fast, op=op), imap))
         except BaseException:
             for _, child, ctok, _ in parts:
                 with contextlib.suppress(Exception):
@@ -588,7 +594,7 @@ class MultiRingEngine(Engine):
                     "ops_faulted", "bytes_read", "unaligned_fallback_reads",
                     "eof_topup_reads", "chunk_retries", "ops_fixed",
                     "cached_bytes", "media_bytes", "residency_probes",
-                    "in_flight"):
+                    "ops_written", "bytes_written", "in_flight"):
             out[key] = sum(int(s.get(key, 0)) for s in per_ring)
         # feature flags: children share one config, ring 0 speaks for all
         for key in ("fixed_buffers", "fixed_files", "mlocked", "coop_taskrun",

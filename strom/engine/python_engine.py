@@ -19,7 +19,8 @@ from typing import Sequence
 import numpy as np
 
 from strom.config import StromConfig
-from strom.engine.base import Completion, Engine, EngineError, RawRead, ReadRequest
+from strom.engine.base import (Completion, Engine, EngineError, RawRead,
+                               RawWrite, ReadRequest)
 from strom.obs.events import ring as _events_ring
 from strom.probe.odirect import probe_dio
 from strom.probe.residency import cached_pages, range_fully_cached
@@ -80,14 +81,17 @@ class PythonEngine(Engine):
             w.start()
 
     # -- files --------------------------------------------------------------
-    def register_file(self, path: str, *, o_direct: bool | None = None) -> int:
+    def register_file(self, path: str, *, o_direct: bool | None = None,
+                      writable: bool = False) -> int:
         want_direct = self.config.o_direct if o_direct is None else o_direct
         dio = probe_dio(path)
         use_direct = dio.supported if want_direct is None else (want_direct and dio.supported)
         if want_direct is True and not dio.supported:
             use_direct = False  # observable degrade, not an error
             self._stats.add("o_direct_denied")
-        flags = os.O_RDONLY
+        # writable (ISSUE 13): both fds carry O_RDWR so aligned writes ride
+        # O_DIRECT and unaligned ones fall back buffered, like reads
+        flags = os.O_RDWR if writable else os.O_RDONLY
         fd_buffered = os.open(path, flags)
         if use_direct:
             try:
@@ -319,7 +323,7 @@ class PythonEngine(Engine):
             if f is None:
                 self._done_q.put(Completion(req.tag, -_errno.EBADF))
                 continue
-            if isinstance(req, RawRead):
+            if isinstance(req, (RawRead, RawWrite)):
                 view = memoryview(req.dest.view(np.uint8).reshape(-1))[: req.length]
                 addr = req.dest.__array_interface__["data"][0]
             else:
@@ -329,6 +333,31 @@ class PythonEngine(Engine):
             aligned = (req.offset % f.offset_align == 0
                        and req.length % f.offset_align == 0
                        and addr % f.mem_align == 0)
+            if isinstance(req, RawWrite):
+                # write path (ISSUE 13): aligned writes ride the O_DIRECT
+                # fd, unaligned ones fall back buffered — no residency
+                # routing (that is a read-side economy), no EOF topup
+                direct = f.o_direct and aligned
+                if f.o_direct and not aligned:
+                    self._stats.add("unaligned_fallback_writes")
+                try:
+                    n = os.pwritev(f.fd if direct else f.fd_buffered,
+                                   [view], req.offset)
+                    # short writes count nothing (the retry rewrites the
+                    # whole piece, whose full completion counts once —
+                    # same rule as the native engine)
+                    if n >= req.length:
+                        self._stats.add("bytes_written", n)
+                        self._stats.add("ops_written")
+                    self._stats.add("ops_completed")
+                    self._stats.observe_us("write_latency",
+                                           (time.monotonic() - t0) * 1e6)
+                    self._done_q.put(Completion(req.tag, n))
+                except OSError as e:
+                    self._stats.add("ops_errored")
+                    self._done_q.put(
+                        Completion(req.tag, -(e.errno or _errno.EIO)))
+                continue
             # residency hybrid: a cache-WARM chunk is served through the
             # buffered fd (a memcpy from the page cache) instead of being
             # re-read from media O_DIRECT (SURVEY.md §2.1 "Page-cache

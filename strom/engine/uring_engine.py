@@ -21,7 +21,7 @@ import numpy as np
 
 from strom.config import StromConfig
 from strom.engine.base import (Completion, DeadlineExceeded, Engine,
-                               EngineError, RawRead, ReadRequest)
+                               EngineError, RawRead, RawWrite, ReadRequest)
 from strom.utils.stats import StatsRegistry
 from strom.utils.locks import make_lock
 
@@ -58,6 +58,8 @@ class _ScStats(ctypes.Structure):
         ("cached_bytes", ctypes.c_uint64),
         ("media_bytes", ctypes.c_uint64),
         ("residency_probes", ctypes.c_uint64),
+        ("ops_written", ctypes.c_uint64),
+        ("bytes_written", ctypes.c_uint64),
     ]
 
 
@@ -213,9 +215,12 @@ class UringEngine(Engine):
         self._dest_regs: dict[int, tuple[int, int]] = {}
         self._dest_lock = make_lock("engine.uring_dest")
 
-    def register_file(self, path: str, *, o_direct: bool | None = None) -> int:
+    def register_file(self, path: str, *, o_direct: bool | None = None,
+                      writable: bool = False) -> int:
         want = self.config.o_direct if o_direct is None else o_direct
         mode = 2 if want is None else (1 if want else 0)
+        if writable:
+            mode |= 8  # O_RDWR on both fds (ISSUE 13 write path)
         rc = self._lib.sc_register_file(self._h, os.fsencode(path), mode)
         if rc < 0:
             raise EngineError(-rc, f"register_file({path}): {os.strerror(-rc)}")
@@ -310,17 +315,22 @@ class UringEngine(Engine):
                 f"{self.config.queue_depth})")
         ops = (_ScRawOp * len(requests))()
         for i, r in enumerate(requests):
-            if not r.dest.flags["C_CONTIGUOUS"] or not r.dest.flags["WRITEABLE"]:
-                raise EngineError(_errno.EINVAL, "RawRead.dest must be writable C-contiguous")
+            is_write = isinstance(r, RawWrite)
+            if not r.dest.flags["C_CONTIGUOUS"] or \
+                    (not is_write and not r.dest.flags["WRITEABLE"]):
+                raise EngineError(_errno.EINVAL,
+                                  "RawRead.dest must be writable C-contiguous")
             if r.length > 0xFFFFFFFF:
                 raise EngineError(_errno.EINVAL,
-                                  f"RawRead.length {r.length} exceeds uint32; "
-                                  "split the read (see _split_chunks)")
+                                  f"op length {r.length} exceeds uint32; "
+                                  "split the op (see _split_chunks)")
             if r.dest.nbytes < r.length:
-                raise EngineError(_errno.EINVAL, "RawRead.dest smaller than length")
+                raise EngineError(_errno.EINVAL,
+                                  "op buffer smaller than length")
             addr = r.dest.__array_interface__["data"][0]
             ops[i] = _ScRawOp(r.file_index, r.length, r.offset, r.tag,
-                              ctypes.c_void_p(addr), -1)
+                              ctypes.c_void_p(addr), -1,
+                              2 if is_write else 0)  # SC_OP_WRITE
         # Register keepalives BEFORE the C call: the kernel can complete an op
         # inside sc_submit_raw_batch, and a concurrent wait() must find the
         # entry to pop — insert-after-submit would leak the pinned dest.
@@ -515,6 +525,8 @@ class UringEngine(Engine):
             "cached_bytes": int(s.cached_bytes),
             "media_bytes": int(s.media_bytes),
             "residency_probes": int(s.residency_probes),
+            "ops_written": int(s.ops_written),
+            "bytes_written": int(s.bytes_written),
             "sparse_table": bool(s.sparse_table),
             "ext_buffers": int(s.ext_buffers),
             "ops_fixed": int(s.ops_fixed),

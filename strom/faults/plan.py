@@ -37,6 +37,13 @@ class FaultRule:
 
     - ``path``: substring of the op's registered file path
     - ``tenant``: the active traced request's tenant
+    - ``op``: ``"read"`` / ``"write"`` — the op's direction (ISSUE 13:
+      engines write now; a direction-less rule matches both, which is
+      usually wrong for presets tuned against read traffic). ``bit_flip``
+      rules never match writes regardless: flipping the CALLER's source
+      buffer would corrupt live training state, not the op (use ``errno``
+      / ``short_read`` to chaos the write path; the checkpoint layer's
+      CRC catches on-media corruption separately)
     - ``offset_lo`` / ``offset_hi``: op byte range must OVERLAP [lo, hi)
     - ``op_lo`` / ``op_hi``: plan-global op-index window [lo, hi)
     - ``every``: inject on every Nth op that passes the matchers (0 = all)
@@ -57,6 +64,7 @@ class FaultRule:
     kind: str
     path: "str | None" = None
     tenant: "str | None" = None
+    op: "str | None" = None
     offset_lo: int = 0
     offset_hi: "int | None" = None
     op_lo: int = 0
@@ -73,6 +81,9 @@ class FaultRule:
         if self.kind not in FAULT_KINDS:
             raise ValueError(f"unknown fault kind {self.kind!r} "
                              f"(one of {FAULT_KINDS})")
+        if self.op not in (None, "read", "write"):
+            raise ValueError(f"op matcher must be 'read', 'write' or None, "
+                             f"got {self.op!r}")
         if isinstance(self.err, str):
             object.__setattr__(self, "err",
                                getattr(_errno, self.err.upper()))
@@ -116,7 +127,8 @@ class FaultPlan:
 
     # -- the decision point --------------------------------------------------
     def decide(self, *, path: "str | None", offset: int, length: int,
-               tenant: "str | None" = None) -> "Fault | None":
+               tenant: "str | None" = None, op: str = "read"
+               ) -> "Fault | None":
         with self._lock:
             idx = self._op_index
             self._op_index += 1
@@ -128,6 +140,16 @@ class FaultPlan:
                                            or r.path not in path):
                     continue
                 if r.tenant is not None and tenant != r.tenant:
+                    continue
+                # direction matcher (ISSUE 13 satellite): a read-scoped
+                # rule must not fire on (or consume RNG draws for) write
+                # traffic — presets tuned against read streams would
+                # otherwise silently double-count once writes exist. A
+                # bit_flip can never apply to a write: the flip would land
+                # in the caller's SOURCE buffer (live training state).
+                if r.op is not None and r.op != op:
+                    continue
+                if r.kind == "bit_flip" and op == "write":
                     continue
                 if idx < r.op_lo or (r.op_hi is not None and idx >= r.op_hi):
                     continue
@@ -213,11 +235,26 @@ class FaultPlan:
         latency spikes at rates the retry/hedge machinery must absorb
         with bit-identical output and bounded slowdown. No engine_death
         or stuck rules — those are for targeted tests, not a throughput
-        arm."""
+        arm. Rules are pinned ``op="read"`` (ISSUE 13): the preset's
+        rates were tuned against read streams, and an unpinned rule
+        would silently double-count the moment write traffic (checkpoint
+        saves, cache spill) shares the engine. Chaos the write path with
+        :meth:`chaos_writes` or an explicit plan."""
         return cls([
-            FaultRule("errno", p=0.02, err=_errno.EIO),
-            FaultRule("short_read", p=0.01, short_frac=0.5),
-            FaultRule("latency", p=0.02, latency_s=0.005),
+            FaultRule("errno", op="read", p=0.02, err=_errno.EIO),
+            FaultRule("short_read", op="read", p=0.01, short_frac=0.5),
+            FaultRule("latency", op="read", p=0.02, latency_s=0.005),
+        ], seed=seed)
+
+    @classmethod
+    def chaos_writes(cls, seed: int = 0) -> "FaultPlan":
+        """Write-path chaos (ISSUE 13): transient EIO + short writes at
+        rates the write retry machinery must absorb with bit-identical
+        on-disk bytes (read-back verified by the tests/bench). No
+        bit_flip — it can never apply to writes (see FaultRule)."""
+        return cls([
+            FaultRule("errno", op="write", p=0.02, err=_errno.EIO),
+            FaultRule("short_read", op="write", p=0.02, short_frac=0.5),
         ], seed=seed)
 
     @classmethod
@@ -230,6 +267,9 @@ class FaultPlan:
         if spec == "chaos" or spec.startswith("chaos:"):
             seed = int(spec.split(":", 1)[1]) if ":" in spec else 0
             return cls.chaos(seed)
+        if spec == "chaos_writes" or spec.startswith("chaos_writes:"):
+            seed = int(spec.split(":", 1)[1]) if ":" in spec else 0
+            return cls.chaos_writes(seed)
         if spec.lstrip().startswith("{"):
             return cls.from_doc(json.loads(spec))
         if os.path.exists(spec):

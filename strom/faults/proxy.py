@@ -39,7 +39,7 @@ from typing import Sequence
 import numpy as np
 
 from strom.engine.base import (Completion, Engine, EngineError, RawRead,
-                               ReadRequest)
+                               RawWrite, ReadRequest)
 from strom.faults.plan import Fault, FaultPlan
 from strom.utils.locks import make_lock
 
@@ -67,8 +67,10 @@ class FaultyEngine(Engine):
         self._tag_faults: dict[int, tuple[Fault, object]] = {}
 
     # -- delegation ----------------------------------------------------------
-    def register_file(self, path: str, *, o_direct: "bool | None" = None) -> int:
-        fi = self.inner.register_file(path, o_direct=o_direct)
+    def register_file(self, path: str, *, o_direct: "bool | None" = None,
+                      writable: bool = False) -> int:
+        fi = self.inner.register_file(path, o_direct=o_direct,
+                                      writable=writable)
         with self._lock:
             self._paths[fi] = path
         return fi
@@ -131,7 +133,9 @@ class FaultyEngine(Engine):
         with self._lock:
             path = self._paths.get(req.file_index)
         f = self.plan.decide(path=path, offset=req.offset,
-                             length=req.length, tenant=self._tenant())
+                             length=req.length, tenant=self._tenant(),
+                             op="write" if isinstance(req, RawWrite)
+                             else "read")
         if f is not None:
             with contextlib.suppress(Exception):
                 self.op_scope.add("faults_injected")
@@ -162,7 +166,7 @@ class FaultyEngine(Engine):
             caller_pos.append(i)
         if passthrough:
             try:
-                if isinstance(passthrough[0], RawRead):
+                if isinstance(passthrough[0], (RawRead, RawWrite)):
                     self.inner.submit_raw(passthrough)
                 else:
                     self.inner.submit(passthrough)

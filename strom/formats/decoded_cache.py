@@ -41,12 +41,33 @@ the hit ratio the warm/cold epoch analysis reads.
 
 from __future__ import annotations
 
+from collections import OrderedDict
 from typing import Any
 
 import numpy as np
 
 from strom.utils.locks import make_lock
 from strom.utils.stats import global_stats
+
+_DIMS_CAP = 1 << 16  # bounded (ckey -> (h, w)) ledger for plan-time probes
+
+
+class ServedFrame:
+    """A decoded frame served from the cache at PLAN time (ISSUE 13
+    satellite): carries the pinned full-frame view straight into the decode
+    pool in place of the JPEG bytes that were never gathered. The transform
+    (and the batch's error path) release it; release is idempotent — the
+    pin drops exactly once however many paths race to clean up."""
+
+    __slots__ = ("img", "_pin", "_dcache")
+
+    def __init__(self, img: np.ndarray, pin, dcache: "DecodedCache"):
+        self.img = img
+        self._pin = pin
+        self._dcache = dcache
+
+    def release(self) -> None:
+        self._dcache._release_frame(self)
 
 
 class DecodedCache:
@@ -66,6 +87,12 @@ class DecodedCache:
         self.misses = 0
         self.hit_bytes = 0
         self.admitted_bytes = 0
+        # plan-time probe support (ISSUE 13 satellite): frame dims per key,
+        # learned at offer/get — the pre-gather probe has no JPEG header to
+        # read h/w from, so it consults this bounded ledger instead
+        self._dims: "OrderedDict[Any, tuple[int, int]]" = OrderedDict()
+        self.plan_hits = 0
+        self.plan_skipped_bytes = 0
 
     @property
     def enabled(self) -> bool:
@@ -86,6 +113,7 @@ class DecodedCache:
         view (after the crop+resize)."""
         n = h * w * 3
         got = self._hot_cache.view(ckey, 0, n, record=False)
+        self._note_dims(ckey, h, w)
         if got is None:
             with self._lock:
                 self.misses += 1
@@ -99,13 +127,65 @@ class DecodedCache:
         self._scope.add("decode_cache_hit_bytes", n)
         return buf.reshape(h, w, 3), entry
 
+    def _note_dims(self, ckey: Any, h: int, w: int) -> None:
+        with self._lock:
+            self._dims[ckey] = (h, w)
+            self._dims.move_to_end(ckey)
+            while len(self._dims) > _DIMS_CAP:
+                self._dims.popitem(last=False)
+
+    def probe(self, ckey: Any, skipped_bytes: int = 0
+              ) -> "ServedFrame | None":
+        """Plan-time probe (ISSUE 13 satellite): a pinned
+        :class:`ServedFrame` when the FULL frame for *ckey* is resident —
+        the caller then skips gathering the image member entirely (labels +
+        misses only reach the engine) and hands the frame to the transform
+        in place of the bytes. None when the frame (or its dims ledger
+        entry) is absent: the member is gathered and the in-transform
+        serve/offer path runs as before — a stale ledger can only cost a
+        wasted gather, never wrong pixels. *skipped_bytes* (the member size
+        the hit avoids gathering) feeds the observability counters."""
+        if not self.enabled:
+            return None
+        with self._lock:
+            dims = self._dims.get(ckey)
+        if dims is None:
+            return None
+        h, w = dims
+        got = self._hot_cache.view(ckey, 0, h * w * 3, record=False)
+        if got is None:
+            return None
+        buf, entry = got
+        with self._lock:
+            self.hits += 1
+            self.hit_bytes += h * w * 3
+            self.plan_hits += 1
+            self.plan_skipped_bytes += skipped_bytes
+        self._scope.add("decode_cache_hits")
+        self._scope.add("decode_cache_hit_bytes", h * w * 3)
+        self._scope.add("decode_cache_plan_hits")
+        if skipped_bytes:
+            self._scope.add("decode_cache_plan_skipped_bytes",
+                            skipped_bytes)
+        return ServedFrame(buf.reshape(h, w, 3), entry, self)
+
     def release(self, pin) -> None:
         self._hot_cache.unpin((pin,))
+
+    def _release_frame(self, frame: ServedFrame) -> None:
+        """Idempotent ServedFrame release: the pin drops exactly once even
+        when the transform's finally and the batch abort path both run."""
+        with self._lock:
+            pin, frame._pin = frame._pin, None
+        if pin is not None:
+            self._hot_cache.unpin((pin,))
 
     def offer(self, ckey: Any, img: np.ndarray) -> int:
         """Offer a decoded full frame for admission (subject to the
         cache's policy, budget, and the owning tenant's partition).
         Returns bytes admitted (0 = refused/duplicate)."""
+        if img.ndim == 3:
+            self._note_dims(ckey, img.shape[0], img.shape[1])
         flat = np.ascontiguousarray(img).reshape(-1)
         admitted = self._hot_cache.admit(ckey, 0, flat.size, flat,
                                          tenant=self._tenant)

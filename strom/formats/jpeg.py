@@ -72,6 +72,7 @@ from typing import Callable, Iterable, NamedTuple, Sequence
 
 import numpy as np
 
+from strom.formats.decoded_cache import ServedFrame
 from strom.obs.events import ring
 from strom.utils.stats import global_stats
 from strom.utils.locks import make_lock
@@ -488,6 +489,24 @@ def make_train_transform(size: int, *, reduced_scale: bool = True,
 
     def tf(data, rng: np.random.Generator,
            out: np.ndarray | None = None, ckey=None) -> np.ndarray:
+        if isinstance(data, ServedFrame):
+            # plan-time decoded-cache hit (ISSUE 13 satellite): the image
+            # member was never gathered — *data* IS the pinned full frame.
+            # Same RNG draws as the in-transform cached branch below
+            # (geometry, then one flip coin), so resume determinism and
+            # the bit-identity contract hold whichever path a sample takes.
+            img = data.img
+            try:
+                fh, fw = img.shape[:2]
+                top, left, ch, cw = sample_rrc_geometry(
+                    fh, fw, rng, scale=scale, ratio=ratio)
+                dst = _resize_into(img[top: top + ch, left: left + cw],
+                                   size, out)
+            finally:
+                data.release()
+            if rng.random() < 0.5:
+                return _flip_h(dst, out)
+            return np.ascontiguousarray(dst) if out is None else dst
         info = parse_jpeg_info(data) if (reduced_scale or native
                                          or dcache is not None) else None
         if info is None:

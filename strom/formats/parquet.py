@@ -591,3 +591,30 @@ class ParquetShard:
         global_stats.add("parquet_decode_bytes",
                          int(sum(a.nbytes for a in out.values())))
         return out
+
+
+def write_parquet(ctx, path: str, columns: "dict[str, np.ndarray]", *,
+                  row_group_rows: "int | None" = None,
+                  compression: str = "NONE",
+                  tenant: "str | None" = None,
+                  fsync: bool = True) -> int:
+    """Write *columns* as a Parquet file through the ENGINE write path
+    (ISSUE 13 front 4): pyarrow serializes the table into an in-memory
+    buffer, and the bytes land on disk via ``ctx.pwrite`` — the same
+    scheduler-granted O_DIRECT machinery :class:`ParquetShard` reads them
+    back with, so bench fixtures are generated and consumed by one I/O
+    stack. ``compression="NONE"`` (the default) keeps the column chunks
+    PLAIN-decodable by the zero-copy fast path. Returns bytes written."""
+    try:
+        import pyarrow as pa
+        import pyarrow.parquet as pq
+    except ImportError as e:  # pragma: no cover - pyarrow is a test dep
+        raise RuntimeError("write_parquet needs pyarrow") from e
+
+    table = pa.table({k: pa.array(np.asarray(v)) for k, v in columns.items()})
+    sink = pa.BufferOutputStream()
+    pq.write_table(table, sink, compression=compression.lower(),
+                   use_dictionary=False,
+                   row_group_size=row_group_rows or len(table))
+    buf = sink.getvalue()
+    return ctx.pwrite(path, memoryview(buf), tenant=tenant, fsync=fsync)

@@ -32,6 +32,7 @@ from typing import Any, Callable, Sequence
 import numpy as np
 
 from strom.delivery.core import StromContext
+from strom.delivery.extents import ExtentList
 from strom.formats.jpeg import (DecodePool, decode_jpeg,
                                 make_train_transform, random_resized_crop)
 from strom.obs import request as _request
@@ -230,7 +231,8 @@ def _decode_put_streamed(ctx: StromContext, pool: DecodePool, tf: Transform,
                          el, sizes: Sequence[tuple[int, int]],
                          rngs: Sequence, images: np.ndarray,
                          dev_items: Sequence, row_pos: dict, scope=None,
-                         ckeys: "Sequence | None" = None
+                         ckeys: "Sequence | None" = None,
+                         served: "Sequence | None" = None
                          ) -> tuple[list, list[int]]:
     """Completion-driven batch assembly (ISSUE 5 tentpole): the member
     gather is submitted through ``ctx.stream_segments`` and each sample is
@@ -306,20 +308,27 @@ def _decode_put_streamed(ctx: StromContext, pool: DecodePool, tf: Transform,
             scope.add("stream_samples_early")
         ready.append(i)
 
+    def blob(i: int):
+        # a plan-time decoded-cache hit (ISSUE 13 satellite) carries its
+        # pinned ServedFrame instead of member bytes (sizes[i][0] == 0 —
+        # the image member was never gathered)
+        if served is not None and served[i] is not None:
+            return served[i]
+        return buf[starts[i]: starts[i] + sizes[i][0]]
+
     def flush_ready() -> None:
         while ready:
             grp = tuple(ready[:run])
             del ready[: run]
             if len(grp) == 1:
                 i = grp[0]
-                f = pool.submit_into(tf, buf[starts[i]: starts[i]
-                                             + sizes[i][0]],
+                f = pool.submit_into(tf, blob(i),
                                      rngs[i], images[i],
                                      None if ckeys is None else ckeys[i])
             else:
                 f = pool.submit_run_into(
                     tf,
-                    [buf[starts[i]: starts[i] + sizes[i][0]] for i in grp],
+                    [blob(i) for i in grp],
                     [rngs[i] for i in grp], [images[i] for i in grp],
                     None if ckeys is None else [ckeys[i] for i in grp])
             with futs_lock:
@@ -533,10 +542,6 @@ def make_wds_vision_pipeline(ctx: StromContext, paths: Sequence[str], *,
 
     def make_batch(indices: np.ndarray, serial: int) -> tuple[Any, Any]:
         samples = [ss.samples[int(indices[r])] for r in local_rows]
-        el = ss.batch_extents([int(indices[r]) for r in local_rows],
-                              [image_ext, label_ext])
-        sizes = [(s.members[image_ext].size, s.members[label_ext].size)
-                 for s in samples]
         # Philox keys are two 64-bit words: (seed, serial ‖ row)
         rngs = [np.random.Generator(np.random.Philox(
                     key=[seed, (serial << 32) + r]))
@@ -544,12 +549,50 @@ def make_wds_vision_pipeline(ctx: StromContext, paths: Sequence[str], *,
         # decoded-output cache keys (ISSUE 12): the image member's physical
         # extent — stable across epochs, exactly like the extent cache
         ckeys = None
+        served = None
         if dcache is not None:
             ckeys = [dcache.key(s.shard, s.members[image_ext].offset,
                                 s.members[image_ext].offset
                                 + s.members[image_ext].size)
                      for s in samples]
+            if dcache.enabled:
+                # decoded-cache fast path (ISSUE 13 satellite): probe the
+                # cache BEFORE extent planning — hit samples skip the
+                # image-member gather entirely (their pinned frames ride
+                # straight to the decode pool; only labels + miss members
+                # reach the engine). This is the ROADMAP item 3 residual:
+                # warm decoded epochs stop paying the compressed gather
+                # the pixels make redundant.
+                served = [dcache.probe(ck, s.members[image_ext].size)
+                          for ck, s in zip(ckeys, samples)]
+                if not any(sv is not None for sv in served):
+                    served = None
+        if served is not None:
+            el = ExtentList.concat([
+                s.extents([label_ext] if sv is not None
+                          else [image_ext, label_ext])
+                for s, sv in zip(samples, served)])
+            sizes = [(0 if sv is not None else s.members[image_ext].size,
+                      s.members[label_ext].size)
+                     for s, sv in zip(samples, served)]
+        else:
+            el = ss.batch_extents([int(indices[r]) for r in local_rows],
+                                  [image_ext, label_ext])
+            sizes = [(s.members[image_ext].size, s.members[label_ext].size)
+                     for s in samples]
+        try:
+            return _assemble_batch(el, sizes, rngs, ckeys, served)
+        except BaseException:
+            # transforms release their own frames; anything that died
+            # before (or instead of) a transform still holds pins —
+            # release is idempotent, so sweeping everything is safe
+            if served is not None:
+                for sv in served:
+                    if sv is not None:
+                        sv.release()
+            raise
 
+    def _assemble_batch(el, sizes, rngs, ckeys, served) -> tuple[Any, Any]:
         if stream:
             # completion-driven dataflow (ISSUE 5): samples decode the
             # moment their extents land, device groups put the moment their
@@ -558,7 +601,7 @@ def make_wds_vision_pipeline(ctx: StromContext, paths: Sequence[str], *,
                               dtype=np.uint8)
             img_shards, labels = _decode_put_streamed(
                 ctx, pool, tf, el, sizes, rngs, images, dev_items, row_pos,
-                scope=pscope, ckeys=ckeys)
+                scope=pscope, ckeys=ckeys, served=served)
             labels_np = np.asarray(labels, dtype=np.int32)
             pscope.add("decode_slot_bytes", images.nbytes)
             lbl_shards = [ctx.device_put(shard_view(labels_np, lo, hi), d)
@@ -570,10 +613,15 @@ def make_wds_vision_pipeline(ctx: StromContext, paths: Sequence[str], *,
             return imgs, lbls
 
         buf = ctx.pread(el, tenant=tname)
-        # split the concatenated buffer back into per-sample members
+        # split the concatenated buffer back into per-sample members; a
+        # plan-time decoded-cache hit (isz == 0) rides its ServedFrame in
+        # place of bytes that were never gathered
         blobs, labels, pos = [], [], 0
-        for isz, lsz in sizes:
-            blobs.append(buf[pos: pos + isz])
+        for i, (isz, lsz) in enumerate(sizes):
+            if served is not None and served[i] is not None:
+                blobs.append(served[i])
+            else:
+                blobs.append(buf[pos: pos + isz])
             labels.append(int(buf[pos + isz: pos + isz + lsz].tobytes() or b"0"))
             pos += isz + lsz
         labels_np = np.asarray(labels, dtype=np.int32)

@@ -494,6 +494,38 @@ class IoScheduler:
                 total += self.engine.read_vectored(sl, dest, retries=retries)
         return total
 
+    def write_chunks(self, chunks: Sequence[tuple[int, int, int, int]],
+                     src, *, tenant: "Tenant | str | None" = None,
+                     retries: int = 1, priority: str | None = None) -> int:
+        """Write twin of :meth:`read_chunks` (ISSUE 13): execute a planned
+        scatter — (file_index, file_offset, src_offset, length) chunks out
+        of *src* — under the same fair scheduling. One grant per slice, so
+        a checkpoint save's multi-GiB write stream is preemptible at slice
+        boundaries exactly like an epoch gather: a concurrent tenant's read
+        queues behind at most ~``sched_slice_bytes`` of it. Budgets and
+        priorities apply unchanged (bytes are bytes to the token buckets,
+        whichever direction they flow)."""
+        from strom.obs import request as _request
+
+        t = self.resolve(tenant)
+        req = _request.current()
+        req_deadline = getattr(req, "deadline", None) \
+            if req is not None else None
+        total = 0
+        for si, sl in enumerate(self.iter_slices(chunks)):
+            if req_deadline is not None \
+                    and time.monotonic() >= req_deadline:
+                t.scope.add("deadline_exceeded")
+                raise DeadlineExceeded(
+                    f"write stopped at slice {si} ({total} bytes landed)")
+            nbytes = sum(ln for (_, _, _, ln) in sl)
+            with self.grant(t, nbytes, priority=priority), \
+                    _request.span("engine.slice", cat="write",
+                                  args={"slice": si, "ops": len(sl),
+                                        "bytes": nbytes}):
+                total += self.engine.write_vectored(sl, src, retries=retries)
+        return total
+
     # -- drain (daemon shutdown / tenant teardown) --------------------------
     def drain(self, tenant: "Tenant | str | None" = None,
               timeout_s: float = 30.0) -> bool:

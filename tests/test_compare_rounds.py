@@ -373,3 +373,42 @@ def test_rc124_round_flagged_invalid(tmp_path, capsys):
 
 def test_no_artifacts_errors(tmp_path, capsys):
     assert compare_rounds.main([str(tmp_path / "missing.json")]) == 1
+
+
+def test_write_keys_match_producers():
+    """Producer↔report key parity for the write-path section (ISSUE 13
+    satellite, the decode/stall/cache/stream/sched/slo/resil pattern):
+    every compare_rounds write column must be a key the checkpoint bench
+    arm emits (single-sourced in strom.ckpt.checkpoint.CKPT_FIELDS and
+    strom.delivery.spill.SPILL_FIELDS) — a rename on either side is a
+    silently dead column."""
+    from strom.ckpt.checkpoint import CKPT_FIELDS
+    from strom.delivery.spill import SPILL_FIELDS
+
+    produced = set(CKPT_FIELDS) | set(SPILL_FIELDS) | {"ckpt_bytes"}
+    for key in compare_rounds.WRITE_KEYS:
+        assert key in produced, \
+            f"compare_rounds consumes {key!r} but the checkpoint arm " \
+            f"produces no such key (renamed column?)"
+
+
+def test_write_section_renders(tmp_path, capsys):
+    """A round carrying ckpt_*/spill_* keys gets the write-path section."""
+    d = dict(NEW_ROUND)
+    d.update({"ckpt_save_mb_per_s": 409.1, "ckpt_save_vs_pickle": 1.154,
+              "ckpt_roundtrip_ok": 1, "spill_hit_bytes": 16777216,
+              "spill_cache_miss_bytes": 0, "spill_hit_ratio": 0.5})
+    p = tmp_path / "BENCH_r13.json"
+    p.write_text(json.dumps(d))
+    assert compare_rounds.main([str(p)]) == 0
+    out = capsys.readouterr().out
+    assert "write path" in out
+    assert "ckpt_save_vs_pickle" in out
+    assert "spill_cache_miss_bytes" in out
+
+
+def test_write_section_hidden_without_keys(tmp_path, capsys):
+    p = tmp_path / "BENCH_r02.json"
+    p.write_text(json.dumps(OLD_ROUND))
+    assert compare_rounds.main([str(p)]) == 0
+    assert "write path" not in capsys.readouterr().out

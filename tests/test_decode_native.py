@@ -455,6 +455,46 @@ class TestPipelineDecodeCache:
         finally:
             ctx.close()
 
+    def test_plan_probe_skips_image_gather(self, vision_setup):
+        """Decoded-cache fast path (ISSUE 13 satellite): epoch >= 2 probes
+        the cache BEFORE extent planning — hit samples never gather their
+        image member (labels + misses only), batches stay bit-identical to
+        the full-gather path, and the gathered-byte counter collapses."""
+        from strom.config import StromConfig
+        from strom.delivery.core import StromContext
+
+        tar, sharding = vision_setup
+        ctx = StromContext(StromConfig(engine="python", queue_depth=8,
+                                       num_buffers=8,
+                                       hot_cache_bytes=64 * 1024 * 1024,
+                                       hot_cache_admit="always"))
+        try:
+            ref = self._batches(ctx, tar, sharding,
+                                decode_reduced_scale=False,
+                                decode_cache=False)
+            ph0 = global_stats.counter("decode_cache_plan_hits").value
+            ssd0 = global_stats.counter("ssd2tpu_bytes").value
+            got = self._batches(ctx, tar, sharding,
+                                decode_reduced_scale=False,
+                                decode_cache=True)
+            for (ri, rl), (gi, gl) in zip(ref, got):
+                np.testing.assert_array_equal(ri, gi)
+                np.testing.assert_array_equal(rl, gl)
+            # 4 batches x 8 rows over 16 samples = 2 epochs: epoch 2's 16
+            # rows (prefetch may run ahead) hit at PLAN time
+            assert global_stats.counter(
+                "decode_cache_plan_hits").value >= ph0 + 16
+            assert global_stats.counter(
+                "decode_cache_plan_skipped_bytes").value > 0
+            # the cache-on pass gathered roughly half the bytes of the
+            # cache-off pass (epoch 2 fetched labels only)
+            cache_on_bytes = global_stats.counter("ssd2tpu_bytes").value \
+                - ssd0
+            full = sum(os.path.getsize(tar) for _ in (0,))
+            assert cache_on_bytes < full * 2  # 4 batches ~ 2 epochs worth
+        finally:
+            ctx.close()
+
     def test_knobs_surface_in_stats_and_metrics(self, vision_setup):
         from strom.config import StromConfig
         from strom.delivery.core import StromContext

@@ -1,0 +1,300 @@
+"""Engine-API conformance for the WRITE path (ISSUE 13 tentpole):
+``submit_vectored(op="write")`` / ``write_vectored`` semantics over EVERY
+Engine implementation — the python thread-pool engine, the native io_uring
+engine, and the multi-ring engine in both shapes (tests/test_engine_api.py
+pattern). One behavioral contract, three machines: exactly-once completion
+accounting, read-back bit-identity, short-write retry, cancel/close with a
+live write token, fan-out index mapping."""
+
+import errno
+import os
+import threading
+
+import numpy as np
+import pytest
+
+from strom.config import StromConfig
+from strom.engine.base import EngineError
+
+MiB = 1024 * 1024
+
+
+def _uring_ok() -> bool:
+    from strom.engine.uring_engine import uring_available
+
+    return uring_available()
+
+
+@pytest.fixture(params=["python", "uring", "multi", "multi2"])
+def any_engine(request):
+    cfg = StromConfig(queue_depth=8, num_buffers=16)
+    if request.param == "python":
+        from strom.engine.python_engine import PythonEngine
+
+        eng = PythonEngine(cfg)
+    elif request.param == "uring":
+        if not _uring_ok():
+            pytest.skip("io_uring unavailable in this sandbox")
+        from strom.engine.uring_engine import UringEngine
+
+        eng = UringEngine(cfg)
+    else:
+        if not _uring_ok():
+            pytest.skip("io_uring unavailable in this sandbox")
+        from strom.engine.multi import MultiRingEngine
+
+        eng = MultiRingEngine(cfg, rings=2 if request.param == "multi2" else 1)
+    yield eng
+    eng.close()
+
+
+def _mk_file(path, nbytes: int = 0) -> str:
+    fd = os.open(str(path), os.O_WRONLY | os.O_CREAT, 0o644)
+    try:
+        if nbytes:
+            os.ftruncate(fd, nbytes)
+    finally:
+        os.close(fd)
+    return str(path)
+
+
+class TestWriteVectored:
+    def test_integrity_and_exactly_once_accounting(self, any_engine,
+                                                   tmp_path, rng):
+        """Every write chunk completes exactly once; the bytes land where
+        the plan says (read back bit-identical via plain file read)."""
+        p = _mk_file(tmp_path / "w.bin")
+        fi = any_engine.register_file(p, writable=True)
+        data = rng.integers(0, 256, 4 * MiB, dtype=np.uint8)
+        per = len(data) // 16
+        chunks = [(fi, i * per, i * per, per) for i in range(16)]
+        tok = any_engine.submit_vectored(chunks, data, op="write")
+        seen = []
+        while not tok.done:
+            for c in any_engine.poll(tok, min_completions=1):
+                assert c.result == per
+                seen.append(c.index)
+        assert sorted(seen) == list(range(16))
+        assert any_engine.drain(tok) == len(data)
+        assert any_engine.in_flight() == 0
+        np.testing.assert_array_equal(np.fromfile(p, dtype=np.uint8), data)
+
+    def test_blocking_write_vectored_and_readback_via_engine(
+            self, any_engine, tmp_path, rng):
+        """write_vectored then read_vectored through the SAME engine:
+        bit-identity across the full O_DIRECT round trip."""
+        p = _mk_file(tmp_path / "rt.bin")
+        fi = any_engine.register_file(p, writable=True)
+        data = rng.integers(0, 256, 2 * MiB, dtype=np.uint8)
+        assert any_engine.write_vectored([(fi, 0, 0, len(data))],
+                                         data) == len(data)
+        dest = np.zeros(len(data), dtype=np.uint8)
+        assert any_engine.read_vectored([(fi, 0, 0, len(data))],
+                                        dest) == len(data)
+        np.testing.assert_array_equal(dest, data)
+
+    def test_unaligned_offset_falls_back_buffered(self, any_engine,
+                                                  tmp_path, rng):
+        p = _mk_file(tmp_path / "u.bin", 4096)
+        fi = any_engine.register_file(p, writable=True)
+        data = rng.integers(0, 256, 1000, dtype=np.uint8)
+        assert any_engine.write_vectored([(fi, 7, 0, 1000)], data) == 1000
+        back = np.fromfile(p, dtype=np.uint8)
+        np.testing.assert_array_equal(back[7:1007], data)
+
+    def test_multi_piece_chunks_complete_once(self, any_engine, tmp_path,
+                                              rng):
+        """A chunk larger than block_size (several engine ops) surfaces as
+        ONE completion, on its last piece."""
+        p = _mk_file(tmp_path / "mp.bin")
+        fi = any_engine.register_file(p, writable=True)
+        ln = 1 * MiB  # 8 block-size pieces at the 128KiB default
+        data = rng.integers(0, 256, 2 * ln, dtype=np.uint8)
+        chunks = [(fi, 0, 0, ln), (fi, ln, ln, ln)]
+        tok = any_engine.submit_vectored(chunks, data, op="write")
+        seen = []
+        while not tok.done:
+            seen.extend(any_engine.poll(tok, min_completions=1))
+        assert sorted(c.index for c in seen) == [0, 1]
+        assert all(c.result == ln for c in seen)
+        assert any_engine.drain(tok) == 2 * ln
+        np.testing.assert_array_equal(np.fromfile(p, dtype=np.uint8), data)
+
+    def test_sequential_write_read_cycles(self, any_engine, tmp_path, rng):
+        """Alternating writes and reads leave the engine clean (no stale
+        tags, no leaked depth) — and in-place rewrites win."""
+        p = _mk_file(tmp_path / "cyc.bin")
+        fi = any_engine.register_file(p, writable=True)
+        for round_i in range(3):
+            data = rng.integers(0, 256, 1 * MiB, dtype=np.uint8)
+            assert any_engine.write_vectored([(fi, 0, 0, len(data))],
+                                             data) == len(data)
+            dest = np.zeros(len(data), dtype=np.uint8)
+            any_engine.read_vectored([(fi, 0, 0, len(data))], dest)
+            np.testing.assert_array_equal(dest, data)
+        assert any_engine.in_flight() == 0
+
+    def test_write_to_readonly_registration_fails(self, any_engine,
+                                                  tmp_path, rng):
+        """A write against a read-only registration fails loudly (EBADF or
+        EINVAL per engine) instead of corrupting anything silently."""
+        p = _mk_file(tmp_path / "ro.bin", 1 * MiB)
+        fi = any_engine.register_file(p)  # NOT writable
+        data = rng.integers(0, 256, 64 * 1024, dtype=np.uint8)
+        with pytest.raises(EngineError):
+            any_engine.write_vectored([(fi, 0, 0, len(data))], data,
+                                      retries=0)
+
+    def test_cancel_reaps_everything(self, any_engine, tmp_path, rng):
+        p = _mk_file(tmp_path / "c.bin")
+        fi = any_engine.register_file(p, writable=True)
+        data = rng.integers(0, 256, 4 * MiB, dtype=np.uint8)
+        per = len(data) // 16
+        chunks = [(fi, i * per, i * per, per) for i in range(16)]
+        tok = any_engine.submit_vectored(chunks, data, op="write")
+        any_engine.cancel(tok)
+        assert tok.cancelled
+        assert any_engine.in_flight() == 0
+        with pytest.raises(EngineError):
+            any_engine.poll(tok)
+
+    def test_close_cancels_live_write_token(self, any_engine, tmp_path,
+                                            rng):
+        p = _mk_file(tmp_path / "cl.bin")
+        fi = any_engine.register_file(p, writable=True)
+        data = rng.integers(0, 256, 4 * MiB, dtype=np.uint8)
+        per = len(data) // 16
+        chunks = [(fi, i * per, i * per, per) for i in range(16)]
+        tok = any_engine.submit_vectored(chunks, data, op="write")
+        t = threading.Thread(target=any_engine.close)
+        t.start()
+        t.join(timeout=30)
+        assert not t.is_alive(), "close() hung on a live write token"
+        assert tok.cancelled
+
+
+@pytest.fixture()
+def py_multi(monkeypatch):
+    """2-ring MultiRingEngine over PYTHON children (fan-out state machine
+    without io_uring — tests/test_engine_api.py pattern)."""
+    import strom.engine.multi as multi_mod  # noqa: F401
+    import strom.engine.uring_engine as ue
+    from strom.engine.python_engine import PythonEngine
+
+    class _PyChild(PythonEngine):
+        def __init__(self, config, variant=""):
+            super().__init__(config)
+
+    monkeypatch.setattr(ue, "UringEngine", _PyChild)
+    from strom.engine.multi import MultiRingEngine
+
+    eng = MultiRingEngine(StromConfig(queue_depth=8, num_buffers=16),
+                          rings=2)
+    yield eng
+    eng.close()
+
+
+class TestFanOutWrites:
+    def test_two_file_write_fanout_integrity(self, py_multi, tmp_path, rng):
+        """A two-file write gather fans per ring; completions map back to
+        the CALLER's chunk indices and each file lands its own bytes."""
+        paths = [_mk_file(tmp_path / f"f{i}.bin") for i in range(2)]
+        fis = [py_multi.register_file(p, writable=True) for p in paths]
+        half = 512 * 1024
+        src = rng.integers(0, 256, 4 * half, dtype=np.uint8)
+        chunks = [(fis[0], 0, 0, half), (fis[1], 0, half, half),
+                  (fis[0], half, 2 * half, half),
+                  (fis[1], half, 3 * half, half)]
+        tok = py_multi.submit_vectored(chunks, src, op="write")
+        seen = []
+        while not tok.done:
+            seen.extend(py_multi.poll(tok, min_completions=1))
+        assert sorted(c.index for c in seen) == [0, 1, 2, 3]
+        assert py_multi.drain(tok) == 4 * half
+        f0 = np.fromfile(paths[0], dtype=np.uint8)
+        f1 = np.fromfile(paths[1], dtype=np.uint8)
+        np.testing.assert_array_equal(f0[:half], src[:half])
+        np.testing.assert_array_equal(f0[half:], src[2 * half: 3 * half])
+        np.testing.assert_array_equal(f1[:half], src[half: 2 * half])
+        np.testing.assert_array_equal(f1[half:], src[3 * half:])
+
+
+class TestWriteFaults:
+    def _faulty(self, rules, seed=0):
+        from strom.faults import FaultPlan, FaultyEngine
+        from strom.faults.plan import FaultRule
+        from strom.engine.python_engine import PythonEngine
+
+        plan = FaultPlan([FaultRule(**r) for r in rules], seed=seed)
+        return FaultyEngine(PythonEngine(
+            StromConfig(queue_depth=8, num_buffers=16)), plan), plan
+
+    def test_short_write_retried_to_full_bytes(self, tmp_path, rng):
+        """An injected short write is retried (whole-piece rewrite, the
+        read path's contract) and the full bytes land bit-identical."""
+        eng, plan = self._faulty([
+            {"kind": "short_read", "op": "write", "times": 2,
+             "short_frac": 0.5}])
+        try:
+            p = _mk_file(tmp_path / "sw.bin")
+            fi = eng.register_file(p, writable=True)
+            data = rng.integers(0, 256, 1 * MiB, dtype=np.uint8)
+            assert eng.write_vectored([(fi, 0, 0, len(data))], data,
+                                      retries=2) == len(data)
+            np.testing.assert_array_equal(np.fromfile(p, dtype=np.uint8),
+                                          data)
+            assert plan.stats()["faults_injected"] >= 1
+        finally:
+            eng.close()
+
+    def test_transient_errno_write_retried(self, tmp_path, rng):
+        eng, plan = self._faulty([
+            {"kind": "errno", "op": "write", "times": 1,
+             "err": errno.EIO}])
+        try:
+            p = _mk_file(tmp_path / "ew.bin")
+            fi = eng.register_file(p, writable=True)
+            data = rng.integers(0, 256, 512 * 1024, dtype=np.uint8)
+            assert eng.write_vectored([(fi, 0, 0, len(data))], data,
+                                      retries=2) == len(data)
+            np.testing.assert_array_equal(np.fromfile(p, dtype=np.uint8),
+                                          data)
+        finally:
+            eng.close()
+
+    def test_read_rule_never_fires_on_writes(self, tmp_path, rng):
+        """An op='read' rule (the chaos preset's shape) must not inject
+        into — or consume RNG draws for — write traffic."""
+        eng, plan = self._faulty([
+            {"kind": "errno", "op": "read", "p": 1.0}])
+        try:
+            p = _mk_file(tmp_path / "nr.bin")
+            fi = eng.register_file(p, writable=True)
+            data = rng.integers(0, 256, 256 * 1024, dtype=np.uint8)
+            assert eng.write_vectored([(fi, 0, 0, len(data))], data,
+                                      retries=0) == len(data)
+            assert plan.stats()["faults_injected"] == 0
+        finally:
+            eng.close()
+
+
+class TestSchedulerWrites:
+    def test_write_chunks_grants_and_bit_identity(self, tmp_path, rng):
+        """Scheduler-granted writes (PR 7 budgets/priority apply): sliced
+        grants, bytes identical, tenant accounting lands."""
+        from strom.delivery.core import StromContext
+
+        ctx = StromContext(StromConfig(engine="python", queue_depth=8,
+                                       num_buffers=16,
+                                       slab_pool_bytes=32 * MiB))
+        try:
+            assert ctx.scheduler is not None
+            p = _mk_file(tmp_path / "sch.bin")
+            data = rng.integers(0, 256, 2 * MiB, dtype=np.uint8)
+            t = ctx.register_tenant("writer")
+            ctx.pwrite(p, data, tenant="writer", fsync=True)
+            back = ctx.pread(p)
+            np.testing.assert_array_equal(back[: len(data)], data)
+            assert t.granted_bytes >= len(data)
+        finally:
+            ctx.close()

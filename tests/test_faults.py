@@ -783,3 +783,69 @@ class TestResilienceSurfaces:
                      "chaos_ok", "chaos_slowdown", "failover_bytes"):
             norm = name.replace("_", "").lower()
             assert norm in found, f"lint does not see {name}"
+
+
+class TestOpMatchers:
+    """ISSUE 13 satellite: read/write direction matchers on fault rules —
+    presets tuned against read streams must not silently double-count once
+    writes share the engine."""
+
+    def test_op_matcher_scopes_rules(self):
+        plan = FaultPlan([FaultRule("errno", op="read", p=1.0),
+                          FaultRule("errno", op="write", err="ENOSPC",
+                                    p=1.0)], seed=0)
+        f = plan.decide(path="/d/x", offset=0, length=4096, op="read")
+        assert f is not None and f.err == errno.EIO
+        f = plan.decide(path="/d/x", offset=0, length=4096, op="write")
+        assert f is not None and f.err == errno.ENOSPC
+
+    def test_unscoped_rule_matches_both(self):
+        plan = FaultPlan([FaultRule("errno", p=1.0)], seed=0)
+        assert plan.decide(path=None, offset=0, length=1, op="read") \
+            is not None
+        assert plan.decide(path=None, offset=0, length=1, op="write") \
+            is not None
+
+    def test_mismatched_op_consumes_no_rng_draw(self):
+        """A read-scoped p<1 rule evaluated against write traffic must not
+        advance the plan RNG: the read stream's injected sequence is
+        identical with or without interleaved writes (the double-count
+        fix)."""
+        ops = [(f"/d/s{i % 2}", i * 4096, 4096) for i in range(200)]
+        a = FaultPlan([FaultRule("errno", op="read", p=0.1)], seed=3)
+        plain = [a.decide(path=p, offset=o, length=ln, op="read")
+                 is not None for p, o, ln in ops]
+        b = FaultPlan([FaultRule("errno", op="read", p=0.1)], seed=3)
+        mixed = []
+        for p, o, ln in ops:
+            b.decide(path=p, offset=o, length=ln, op="write")  # interleave
+            mixed.append(b.decide(path=p, offset=o, length=ln, op="read")
+                         is not None)
+        assert plain == mixed
+
+    def test_bit_flip_never_matches_writes(self):
+        plan = FaultPlan([FaultRule("bit_flip", p=1.0)], seed=0)
+        assert plan.decide(path=None, offset=0, length=64,
+                           op="write") is None
+        assert plan.decide(path=None, offset=0, length=64,
+                           op="read") is not None
+
+    def test_chaos_preset_is_read_scoped(self):
+        plan = FaultPlan.chaos(seed=0)
+        assert all(r.op == "read" for r in plan.rules)
+        for i in range(200):
+            assert plan.decide(path="/d/w", offset=i * 4096, length=4096,
+                               op="write") is None
+        assert plan.stats()["faults_injected"] == 0
+
+    def test_chaos_writes_preset(self):
+        plan = FaultPlan.from_spec("chaos_writes:5")
+        assert all(r.op == "write" for r in plan.rules)
+        assert plan.seed == 5
+        hits = sum(plan.decide(path="/d/w", offset=i * 4096, length=4096,
+                               op="write") is not None for i in range(400))
+        assert hits > 0
+
+    def test_bad_op_matcher_rejected(self):
+        with pytest.raises(ValueError):
+            FaultRule("errno", op="sideways")

@@ -1,0 +1,314 @@
+"""NVMe spill tier (ISSUE 13 tentpole): demote-on-evict, interval serving,
+refcounted slots, per-tenant accounting — and the end-to-end acceptance: a
+warm-spill epoch serves evicted extents with ZERO source-engine reads
+(spill_hit_bytes > 0, cache_miss_bytes = 0 on repeat traffic)."""
+
+import os
+
+import numpy as np
+import pytest
+
+from strom.config import StromConfig
+from strom.delivery.core import StromContext
+from strom.delivery.hotcache import HotCache
+from strom.delivery.spill import SPILL_FIELDS, SpillTier
+
+KiB = 1024
+MiB = 1024 * KiB
+
+
+@pytest.fixture()
+def tier(tmp_path):
+    t = SpillTier(str(tmp_path / "spill.bin"), 8 * MiB)
+    yield t
+    t.close()
+
+
+def _read(tier, skey, lo, hi) -> np.ndarray:
+    out = np.zeros(hi - lo, dtype=np.uint8)
+    hits, misses = tier.lookup(skey, lo, hi)
+    assert not misses, misses
+    try:
+        for s, t, e in hits:
+            tier.read_into(e, s, t, out[s - lo: t - lo])
+    finally:
+        tier.unpin([e for _, _, e in hits])
+    return out
+
+
+class TestSpillTierUnit:
+    def test_offer_lookup_roundtrip(self, tier, rng):
+        data = rng.integers(0, 256, 256 * KiB, dtype=np.uint8)
+        assert tier.offer("k", 0, len(data), data) == len(data)
+        np.testing.assert_array_equal(_read(tier, "k", 0, len(data)), data)
+        # subrange serves by interval intersection
+        np.testing.assert_array_equal(_read(tier, "k", 1000, 5000),
+                                      data[1000:5000])
+
+    def test_disjointness_skips_respilled_ranges(self, tier, rng):
+        data = rng.integers(0, 256, 64 * KiB, dtype=np.uint8)
+        assert tier.offer("k", 0, len(data), data) == len(data)
+        # a re-evicted identical range: nothing new spilled
+        assert tier.offer("k", 0, len(data), data) == 0
+        # an overlapping wider range spills only the gaps
+        wide = rng.integers(0, 256, 96 * KiB, dtype=np.uint8)
+        wide[: len(data)] = data
+        assert tier.offer("k", 0, len(wide), wide) == 32 * KiB
+
+    def test_budget_evicts_oldest(self, tmp_path, rng):
+        t = SpillTier(str(tmp_path / "s.bin"), 1 * MiB)
+        try:
+            blobs = {}
+            for i in range(8):
+                b = rng.integers(0, 256, 256 * KiB, dtype=np.uint8)
+                blobs[i] = b
+                t.offer(f"k{i}", 0, len(b), b)
+            # budget holds 4 entries: the oldest dropped, newest serve
+            assert t.bytes <= 1 * MiB
+            hits, misses = t.lookup("k0", 0, 256 * KiB)
+            t.unpin([e for _, _, e in hits])
+            assert misses  # oldest gone
+            np.testing.assert_array_equal(
+                _read(t, "k7", 0, 256 * KiB), blobs[7])
+        finally:
+            t.close()
+
+    def test_pinned_entry_not_evicted(self, tmp_path, rng):
+        t = SpillTier(str(tmp_path / "p.bin"), 512 * KiB)
+        try:
+            a = rng.integers(0, 256, 256 * KiB, dtype=np.uint8)
+            t.offer("a", 0, len(a), a)
+            hits, _ = t.lookup("a", 0, len(a))
+            # budget pressure while pinned: "a" survives (the other offer
+            # is refused or evicts nothing — never the pinned entry)
+            b = rng.integers(0, 256, 512 * KiB, dtype=np.uint8)
+            t.offer("b", 0, len(b), b)
+            for s, tt, e in hits:
+                out = np.zeros(tt - s, dtype=np.uint8)
+                t.read_into(e, s, tt, out)
+                np.testing.assert_array_equal(out, a[s:tt])
+            t.unpin([e for _, _, e in hits])
+        finally:
+            t.close()
+
+    def test_slot_recycling(self, tmp_path, rng):
+        """Evicted entries' file slots recycle — the spill file does not
+        grow without bound under churn."""
+        t = SpillTier(str(tmp_path / "r.bin"), 1 * MiB)
+        try:
+            for i in range(32):
+                b = rng.integers(0, 256, 256 * KiB, dtype=np.uint8)
+                t.offer(f"k{i}", 0, len(b), b)
+            assert os.path.getsize(str(tmp_path / "r.bin")) <= 2 * MiB
+        finally:
+            t.close()
+
+    def test_tenant_partition_self_evicts(self, tmp_path, rng):
+        t = SpillTier(str(tmp_path / "t.bin"), 8 * MiB)
+        try:
+            t.set_partition("a", 512 * KiB)
+            for i in range(4):
+                b = rng.integers(0, 256, 256 * KiB, dtype=np.uint8)
+                t.offer(f"a{i}", 0, len(b), b, tenant="a")
+            parts = t.partitions()
+            assert parts["a"]["bytes"] <= 512 * KiB
+            # tenant b is untouched by a's churn
+            bb = rng.integers(0, 256, 256 * KiB, dtype=np.uint8)
+            t.offer("b0", 0, len(bb), bb, tenant="b")
+            np.testing.assert_array_equal(_read(t, "b0", 0, len(bb)), bb)
+        finally:
+            t.close()
+
+    def test_invalidate_drops_key(self, tier, rng):
+        data = rng.integers(0, 256, 64 * KiB, dtype=np.uint8)
+        tier.offer("k", 0, len(data), data)
+        assert tier.invalidate("k") == 1
+        _, misses = tier.lookup("k", 0, len(data))
+        assert misses
+
+    def test_stats_names_cover_fields(self, tier):
+        snap = tier.stats()
+        for k in ("spill_hit_bytes", "spill_hits", "spill_spilled_bytes",
+                  "spill_entries", "spill_bytes", "spill_hit_ratio"):
+            assert k in snap, k
+        assert len(set(SPILL_FIELDS)) == len(SPILL_FIELDS)
+
+
+class TestHotCacheDemotion:
+    def _cache(self, tmp_path, cache_bytes=256 * KiB, spill_bytes=8 * MiB):
+        cache = HotCache(cache_bytes, admit="always")
+        cache.spill = SpillTier(str(tmp_path / "sp.bin"), spill_bytes)
+        return cache
+
+    def test_evicted_entry_demotes_and_serves(self, tmp_path, rng):
+        cache = self._cache(tmp_path)
+        data = [rng.integers(0, 256, 128 * KiB, dtype=np.uint8)
+                for _ in range(4)]
+        for i, b in enumerate(data):
+            cache.admit(f"k{i}", 0, len(b), b)
+        # budget ~2 entries: the early ones demoted, not vanished
+        hits, misses = cache.spill.lookup("k0", 0, 128 * KiB)
+        try:
+            assert hits and not misses
+            out = np.zeros(128 * KiB, dtype=np.uint8)
+            for s, t, e in hits:
+                cache.spill.read_into(e, s, t, out[s: t])
+            np.testing.assert_array_equal(out, data[0])
+        finally:
+            cache.spill.unpin([e for _, _, e in hits])
+        cache.spill.close()
+
+    def test_clear_drops_without_demoting(self, tmp_path, rng):
+        cache = self._cache(tmp_path)
+        b = rng.integers(0, 256, 64 * KiB, dtype=np.uint8)
+        cache.admit("k", 0, len(b), b)
+        cache.clear()
+        _, misses = cache.spill.lookup("k", 0, len(b))
+        assert misses  # clear() drops, it does not spill
+        cache.spill.close()
+
+    def test_pinned_entry_never_evicted_under_pressure(self, tmp_path,
+                                                       rng):
+        """Budget eviction skips pinned entries entirely (the refcount
+        contract): the reader's view stays valid, nothing demotes out from
+        under it, and an oversized admission is refused instead."""
+        cache = self._cache(tmp_path, cache_bytes=128 * KiB)
+        a = rng.integers(0, 256, 64 * KiB, dtype=np.uint8)
+        cache.admit("a", 0, len(a), a)
+        hits, _, pins = cache.lookup("a", 0, len(a))
+        assert pins
+        b = rng.integers(0, 256, 128 * KiB, dtype=np.uint8)
+        assert cache.admit("b", 0, len(b), b) == 0  # refused, not displaced
+        for s, t, view in hits:
+            np.testing.assert_array_equal(view, a[s:t])
+        _, sp_miss = cache.spill.lookup("a", 0, len(a))
+        assert sp_miss  # never evicted -> never demoted
+        cache.unpin(pins)
+        cache.spill.close()
+
+    def test_cleared_while_pinned_frees_without_demoting(self, tmp_path,
+                                                         rng):
+        """clear() on a pinned entry: the slab frees on the LAST unpin and
+        the bytes are dropped, not spilled (clear is a drop, the bench
+        epoch scoping depends on it)."""
+        cache = self._cache(tmp_path)
+        a = rng.integers(0, 256, 64 * KiB, dtype=np.uint8)
+        cache.admit("a", 0, len(a), a)
+        hits, _, pins = cache.lookup("a", 0, len(a))
+        cache.clear()
+        for s, t, view in hits:  # readers keep a valid view until unpin
+            np.testing.assert_array_equal(view, a[s:t])
+        cache.unpin(pins)
+        _, sp_miss = cache.spill.lookup("a", 0, len(a))
+        assert sp_miss
+        cache.spill.close()
+
+
+class TestEndToEnd:
+    def test_warm_spill_epoch_zero_source_reads(self, tmp_path, rng):
+        """The ISSUE 13 acceptance: epoch 2 over a working set larger than
+        the RAM cache serves RAM + spill with spill_hit_bytes > 0 and
+        cache_miss_bytes = 0 — the source engine reads NOTHING."""
+        ctx = StromContext(StromConfig(
+            engine="python", queue_depth=8, num_buffers=16,
+            slab_pool_bytes=32 * MiB, hot_cache_bytes=256 * KiB,
+            hot_cache_admit="always", spill_bytes=16 * MiB,
+            spill_dir=str(tmp_path)))
+        try:
+            p = str(tmp_path / "src.bin")
+            data = rng.integers(0, 256, 4 * MiB, dtype=np.uint8)
+            data.tofile(p)
+            step = 256 * KiB
+            for off in range(0, len(data), step):
+                ctx.pread(p, offset=off, length=step)
+            s1 = ctx.stats(sections=["cache", "spill"])
+            assert s1["spill"]["spill_spilled_bytes"] > 0
+            miss1 = s1["cache"]["cache_miss_bytes"]
+            eng1 = ctx.engine.stats().get("bytes_read", 0)
+            for off in range(0, len(data), step):
+                back = ctx.pread(p, offset=off, length=step)
+                np.testing.assert_array_equal(back, data[off: off + step])
+            s2 = ctx.stats(sections=["cache", "spill"])
+            assert s2["spill"]["spill_hit_bytes"] > 0
+            assert s2["cache"]["cache_miss_bytes"] == miss1
+            assert ctx.engine.stats().get("bytes_read", 0) == eng1
+        finally:
+            ctx.close()
+        # the spill file is unlinked with the context
+        assert not any(n.startswith("strom-spill")
+                       for n in os.listdir(str(tmp_path)))
+
+    def test_spill_off_behavior_unchanged(self, tmp_path, rng):
+        """spill_bytes=0 (the default): eviction drops, repeat traffic
+        re-reads the source — the pre-spill contract, bit-identical."""
+        ctx = StromContext(StromConfig(
+            engine="python", queue_depth=8, num_buffers=16,
+            slab_pool_bytes=32 * MiB, hot_cache_bytes=256 * KiB,
+            hot_cache_admit="always"))
+        try:
+            assert ctx.spill_tier is None
+            p = str(tmp_path / "src.bin")
+            data = rng.integers(0, 256, 2 * MiB, dtype=np.uint8)
+            data.tofile(p)
+            for _ in range(2):
+                for off in range(0, len(data), 256 * KiB):
+                    back = ctx.pread(p, offset=off, length=256 * KiB)
+                    np.testing.assert_array_equal(
+                        back, data[off: off + 256 * KiB])
+            assert ctx.stats(sections=["cache"])["cache"][
+                "cache_miss_bytes"] > 0
+        finally:
+            ctx.close()
+
+    def test_registered_tenant_carves_spill_partition(self, tmp_path):
+        ctx = StromContext(StromConfig(
+            engine="python", queue_depth=8, num_buffers=16,
+            slab_pool_bytes=32 * MiB, hot_cache_bytes=1 * MiB,
+            spill_bytes=8 * MiB, spill_dir=str(tmp_path)))
+        try:
+            ctx.register_tenant("t1", hot_cache_bytes=512 * KiB)
+            assert "t1" in ctx.spill_tier.partitions()
+        finally:
+            ctx.close()
+
+
+class TestWriteInvalidation:
+    def test_invalidate_sweeps_derived_tuple_keys(self, tmp_path, rng):
+        """Decoded-frame entries key on ('jpegdec', path, lo, hi, fp)
+        tuples: invalidating the path must drop them (RAM and spill) —
+        pixels decoded from overwritten bytes may not survive."""
+        cache = HotCache(8 * MiB, admit="always")
+        cache.spill = SpillTier(str(tmp_path / "sp.bin"), 8 * MiB)
+        raw = rng.integers(0, 256, 4 * KiB, dtype=np.uint8)
+        dec = rng.integers(0, 256, 8 * KiB, dtype=np.uint8)
+        cache.admit("/data/shard.tar", 0, len(raw), raw)
+        dkey = ("jpegdec", "/data/shard.tar", 0, 4096, "rgb8/cv2")
+        cache.admit(dkey, 0, len(dec), dec)
+        cache.spill.offer(dkey, 0, len(dec), dec)
+        assert cache.invalidate("/data/shard.tar") == 2
+        assert cache.view("/data/shard.tar", 0, len(raw)) is None
+        assert cache.view(dkey, 0, len(dec)) is None
+        _, sp_miss = cache.spill.lookup(dkey, 0, len(dec))
+        assert sp_miss
+        cache.spill.close()
+
+    def test_pwrite_then_read_serves_new_bytes(self, tmp_path, rng):
+        """A cached-then-overwritten file serves the NEW bytes: pwrite
+        invalidates AFTER the write lands (a pre-write invalidation would
+        leave bytes re-admitted mid-window stale forever)."""
+        ctx = StromContext(StromConfig(
+            engine="python", queue_depth=8, num_buffers=16,
+            slab_pool_bytes=32 * MiB, hot_cache_bytes=8 * MiB,
+            hot_cache_admit="always", spill_bytes=8 * MiB,
+            spill_dir=str(tmp_path)))
+        try:
+            p = str(tmp_path / "f.bin")
+            v1 = rng.integers(0, 256, 256 * KiB, dtype=np.uint8)
+            v1.tofile(p)
+            np.testing.assert_array_equal(ctx.pread(p), v1)  # cached
+            np.testing.assert_array_equal(ctx.pread(p), v1)  # from RAM
+            v2 = rng.integers(0, 256, 256 * KiB, dtype=np.uint8)
+            ctx.pwrite(p, v2, fsync=True)
+            np.testing.assert_array_equal(ctx.pread(p), v2)
+        finally:
+            ctx.close()

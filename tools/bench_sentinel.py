@@ -47,7 +47,7 @@ sys.path.insert(0, _HERE)  # runnable as a script from anywhere
 
 from compare_rounds import (BINDING_ORDER, CACHE_KEYS, DECODE2_KEYS,  # noqa: E402
                             DECODE_KEYS, RESIL_KEYS, SLO_KEYS, STALL_KEYS,
-                            STREAM_KEYS, unwrap)
+                            STREAM_KEYS, WRITE_KEYS, unwrap)
 
 # The gated metric set: (metric, direction) over the single-sourced
 # comparison tuples, where direction is "up" (bigger is better) or "down"
@@ -103,6 +103,15 @@ SENTINEL_FIELDS = (
     ("resnet_decode_cache_warm_vs_cold", "up"),
     ("vit_decode_native_img_per_s", "up"),
     ("vit_decode_cache_warm_vs_cold", "up"),
+    # write path (ISSUE 13): engine checkpoint save rate (host-CPU +
+    # NVMe-bound on the fixture box, gated like the decode img/s trends;
+    # the acceptance metric is beating the pickle baseline) and the spill
+    # tier's serve share on the warm epoch (same-run ratio,
+    # weather-independent; a shrinking ratio means evictions stopped
+    # demoting or the consult stopped finding them)
+    ("ckpt_save_mb_per_s", "up"),
+    ("ckpt_roundtrip_ok", "up"),
+    ("spill_hit_ratio", "up"),
 )
 
 # absolute slack for count-like "down" metrics around small values: going
@@ -117,7 +126,7 @@ RATIO_DOWN = frozenset({"chaos_slowdown"})
 
 TABLE_KEYS = list(dict.fromkeys(
     BINDING_ORDER + DECODE_KEYS + DECODE2_KEYS + STALL_KEYS + CACHE_KEYS
-    + STREAM_KEYS + SLO_KEYS + RESIL_KEYS))
+    + STREAM_KEYS + SLO_KEYS + RESIL_KEYS + WRITE_KEYS))
 
 
 def load_round(path: str) -> dict:

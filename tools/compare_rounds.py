@@ -231,6 +231,27 @@ RESIL_KEYS = [
     "chaos_breaker_trips",
     "chaos_hedges_fired",
 ]
+# write path (ISSUE 13 tentpole): the checkpoint arm's engine save/restore
+# of the llama train state vs the pickle baseline (ckpt_save_vs_pickle is
+# a same-run ratio — weather-independent; roundtrip_ok = restored bit-
+# exact through write+read) and the warm-spill epoch pair
+# (spill_cache_miss_bytes = 0 is the acceptance bit: repeat traffic never
+# reached the source engine; spill_hit_ratio is the tier's serve share).
+# Suffixes single-sourced in strom.ckpt.checkpoint.CKPT_FIELDS and
+# strom.delivery.spill.SPILL_FIELDS (parity-tested in
+# tests/test_compare_rounds.py, same contract as the other sections).
+WRITE_KEYS = [
+    "ckpt_bytes",
+    "ckpt_save_mb_per_s",
+    "ckpt_restore_mb_per_s",
+    "ckpt_pickle_save_mb_per_s",
+    "ckpt_save_vs_pickle",
+    "ckpt_roundtrip_ok",
+    "spill_hit_bytes",
+    "spill_spilled_bytes",
+    "spill_hit_ratio",
+    "spill_cache_miss_bytes",
+]
 # per-attempt / per-pass audit arrays (VERDICT.md r4 next #3): printed so
 # the best-of selection's discards are visible in the comparison too
 AUDIT_SUFFIXES = ("_attempts", "_passes")
@@ -371,9 +392,12 @@ def main(argv: list[str]) -> int:
                    for k in SLO_KEYS)
     have_resil = any(cell(d, k) != "-" for _, d in rounds
                      for k in RESIL_KEYS)
+    have_write = any(cell(d, k) != "-" for _, d in rounds
+                     for k in WRITE_KEYS)
     name_w = max(len(k) for k in binding_keys + CONTEXT_KEYS + DECODE_KEYS
                  + DECODE2_KEYS + STALL_KEYS + CACHE_KEYS + STREAM_KEYS
-                 + SCHED_KEYS + SLO_KEYS + RESIL_KEYS + audit_keys) + 2
+                 + SCHED_KEYS + SLO_KEYS + RESIL_KEYS + WRITE_KEYS
+                 + audit_keys) + 2
     # every rendered cell folds into ONE column width, or rows misalign
     col_w = max(max(len(n) for n, _ in rounds) + 2, 12,
                 *(len(c) + 2 for cs in audit_cells.values() for c in cs),
@@ -440,6 +464,12 @@ def main(argv: list[str]) -> int:
         print("resilience (seeded chaos arm: chaos_ok=1 = completed "
               "bit-identical under injected faults):")
         for k in RESIL_KEYS:
+            print(k.ljust(name_w)
+                  + "".join(cell(d, k).rjust(col_w) for _, d in rounds))
+    if have_write:
+        print("write path (engine checkpoint vs pickle + warm-spill "
+              "epoch; spill_cache_miss_bytes=0 = zero source reads):")
+        for k in WRITE_KEYS:
             print(k.ljust(name_w)
                   + "".join(cell(d, k).rjust(col_w) for _, d in rounds))
     if audit_keys:

@@ -74,6 +74,11 @@ LOCK_RANKS = {
                                # before cache.meta so a tally-then-admit
                                # sequence could nest legally if it ever
                                # needed to (it doesn't today)
+    "cache.spill": 59,         # SpillTier index/allocator (ISSUE 13): a
+                               # sibling tier consulted AFTER cache.meta
+                               # releases (never nested under it — spill
+                               # pwrites/preads run outside every cache
+                               # lock), writing only stats under itself
     "cache.meta": 60,
     # -- observability (leaves, but may write stats under themselves) --------
     "obs.flight": 70,
