@@ -992,6 +992,43 @@ def main() -> int:
                   file=sys.stderr)
             flush_partial(**loader_res)
 
+        # ISSUE 14: preemption-safe training — async snapshot-then-commit
+        # save stall vs the synchronous save wall (ckpt_async_stall_frac
+        # is the <25%-of-sync acceptance, same-run ratio), then the
+        # kill/restart recovery cycle (subprocess trainer SIGKILL'd at a
+        # seeded mid-epoch step, restarted from last_committed +
+        # StepToken; resume_ok=1 = remaining batch stream bit-identical,
+        # no epoch replay, no orphaned checkpoint). Keys copy via the
+        # single-sourced CKPT_ASYNC_FIELDS / RESUME_FIELDS tuples
+        # (parity-tested like the other sections); bench_sentinel gates
+        # resume_ok and ckpt_async_stall_p99_us/_frac.
+        from strom.ckpt.async_save import CKPT_ASYNC_FIELDS
+        from strom.ckpt.jobstate import RESUME_FIELDS
+        from strom.cli import bench_resume
+
+        rsargs = argparse.Namespace(
+            file=None, size=size, block=cfg.block_size, depth=32, iters=1,
+            engine="auto", tmpdir=args.tmpdir, json=True, model="small",
+            saves=4, seed=0, signal="KILL", fault_plan="",
+            metrics_port=args.metrics_port)
+        rsres = attempt("resume", lambda: bench_resume(rsargs)) \
+            if phase_ok("resume", 240) else None
+        if rsres is not None:
+            for k in (*CKPT_ASYNC_FIELDS, *RESUME_FIELDS):
+                if k in rsres:
+                    loader_res[k] = rsres[k]
+            print(f"resume: async stall p99 "
+                  f"{rsres.get('ckpt_async_stall_p99_us')}us = "
+                  f"{rsres.get('ckpt_async_stall_frac')} of sync wall "
+                  f"{rsres.get('ckpt_sync_save_wall_us')}us; kill@"
+                  f"{rsres.get('resume_kill_step')} -> restart@"
+                  f"{rsres.get('resume_restart_step')} "
+                  f"({rsres.get('resume_batches_checked')} batches "
+                  f"bit-identical, {rsres.get('resume_replayed_batches')} "
+                  f"replayed, ok={rsres.get('resume_ok')})",
+                  file=sys.stderr)
+            flush_partial(**loader_res)
+
     # --- numerator: one streamed memcpy_ssd2tpu ----------------------------
     # (engine reads piece k+1 while piece k streams host->HBM)
     # Capped at 512MiB: the relay link's token bucket holds ~0.5-1 GiB of

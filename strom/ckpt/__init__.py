@@ -1,15 +1,34 @@
-"""HBM→SSD checkpointing through the engine write path (ISSUE 13)."""
+"""HBM→SSD checkpointing through the engine write path (ISSUE 13) plus the
+preemption-safety layer on top (ISSUE 14): async snapshot-then-commit
+saves (strom/ckpt/async_save.py) and deterministic end-to-end resume
+tokens (strom/ckpt/jobstate.py)."""
 
+from strom.ckpt.async_save import (CKPT_ASYNC_FIELDS, AsyncCheckpointer,
+                                   CkptAsyncError, save_checkpoint_async)
 from strom.ckpt.checkpoint import (CKPT_FIELDS, CkptCorruptError, CkptError,
+                                   clean_orphans, last_committed, load_manifest,
                                    load_pickle, restore_checkpoint,
                                    save_checkpoint, save_pickle)
+from strom.ckpt.jobstate import (RESUME_FIELDS, StepToken, capture_warm_state,
+                                 restore_warm_state)
 
 __all__ = [
+    "CKPT_ASYNC_FIELDS",
     "CKPT_FIELDS",
+    "RESUME_FIELDS",
+    "AsyncCheckpointer",
+    "CkptAsyncError",
     "CkptCorruptError",
     "CkptError",
+    "StepToken",
+    "capture_warm_state",
+    "clean_orphans",
+    "last_committed",
+    "load_manifest",
     "load_pickle",
     "restore_checkpoint",
+    "restore_warm_state",
     "save_checkpoint",
+    "save_checkpoint_async",
     "save_pickle",
 ]

@@ -89,9 +89,19 @@ def _np_dtype(name: str) -> np.dtype:
         return np.dtype(getattr(ml_dtypes, name))
 
 
-def _host_leaves(state: Any) -> tuple[list[np.ndarray], Any]:
+def _host_leaves(state: Any, *, snapshot: bool = False
+                 ) -> tuple[list[np.ndarray], Any]:
     """Flatten *state* and pull every leaf to host memory as a contiguous
-    numpy array (jax arrays device_get; scalars become 0-d arrays)."""
+    numpy array (jax arrays device_get; scalars become 0-d arrays).
+
+    ``snapshot=True`` (the async save path, strom/ckpt/async_save.py)
+    additionally COPIES leaves the caller could mutate in place after this
+    returns: jax arrays are immutable — holding the device_get result is
+    already a stable snapshot — but a plain numpy leaf (an optimizer step
+    counter someone increments, a running metric buffer) is live memory,
+    and a background commit reading it mid-train would persist a torn
+    state. The copy is the snapshot half of snapshot-then-commit: bounded
+    by host memcpy bandwidth, never by NVMe."""
     import jax
 
     leaves, treedef = jax.tree_util.tree_flatten(state)
@@ -102,6 +112,8 @@ def _host_leaves(state: Any) -> tuple[list[np.ndarray], Any]:
             # ascontiguousarray unconditionally would also promote 0-d
             # scalars to (1,) and break the template shape check
             a = np.ascontiguousarray(a)
+        elif snapshot and isinstance(leaf, np.ndarray):
+            a = a.copy()
         out.append(a)
     return out, treedef
 
@@ -115,12 +127,17 @@ class _Stager:
     ~max(copy, write) instead of their sum. The slabs are the aligned
     bounce the caller's (arbitrarily-aligned) host arrays ride to disk."""
 
-    def __init__(self, ctx, fi: int, tenant: "str | None"):
+    def __init__(self, ctx, fi: int, tenant: "str | None",
+                 priority: "str | None" = None):
         import concurrent.futures
 
         self._ctx = ctx
         self._fi = fi
         self._tenant = tenant
+        # scheduler priority class for the engine writes (ISSUE 14): the
+        # async checkpointer commits as "background" so a save stream never
+        # outranks the training tenants' demand reads in the fair drain
+        self._priority = priority
         pool = getattr(ctx, "_slab_pool", None)
         self._pool = pool
         self._bufs = [pool.acquire(_STAGE_BYTES) if pool is not None
@@ -140,7 +157,7 @@ class _Stager:
         i = self._cur
         self._futs[i] = self._exec.submit(
             self._ctx.write_chunks, self._chunks, self._bufs[i],
-            tenant=self._tenant)
+            tenant=self._tenant, priority=self._priority)
         self._chunks = []
         self._used = 0
         self._cur = 1 - i
@@ -188,14 +205,13 @@ class _Stager:
         self._bufs = []
 
 
-def save_checkpoint(ctx, directory: str, state: Any, *,
-                    tenant: "str | None" = None) -> dict:
-    """Write *state* (any pytree of arrays) to *directory* through the
-    engine write path. Returns the manifest dict (``total_bytes`` is the
-    payload size the bench rates). Crash-safe: the directory rename is the
-    commit; an existing checkpoint at *directory* is replaced atomically
-    (old state survives any crash before the rename lands)."""
-    leaves, _treedef = _host_leaves(state)
+def _build_manifest(leaves: "list[np.ndarray]",
+                    extra: "dict | None" = None) -> dict:
+    """Leaf table + span layout for a flattened state. ``extra`` is an
+    opaque caller payload stored INSIDE the manifest (the resume layer
+    puts the StepToken there, strom/ckpt/jobstate.py) — committed by the
+    same rename as the data, so a checkpoint can never exist without its
+    resume point or vice versa."""
     metas = []
     off = 0
     for i, a in enumerate(leaves):
@@ -208,11 +224,39 @@ def save_checkpoint(ctx, directory: str, state: Any, *,
             "crc32": 0,  # filled during staging (one pass over the bytes)
         })
         off += _aligned(max(a.nbytes, 1))
-    total = off
-    manifest = {"format": FORMAT, "total_bytes": total,
-                "payload_bytes": int(sum(m["nbytes"] for m in metas)),
-                "leaves": metas}
+    return {"format": FORMAT, "total_bytes": off,
+            "payload_bytes": int(sum(m["nbytes"] for m in metas)),
+            "extra": extra or {},
+            "leaves": metas}
 
+
+def save_checkpoint(ctx, directory: str, state: Any, *,
+                    tenant: "str | None" = None,
+                    extra: "dict | None" = None,
+                    priority: "str | None" = None) -> dict:
+    """Write *state* (any pytree of arrays) to *directory* through the
+    engine write path. Returns the manifest dict (``total_bytes`` is the
+    payload size the bench rates). Crash-safe: the directory rename is the
+    commit; an existing checkpoint at *directory* is replaced atomically
+    (old state survives any crash before the rename lands). *extra* rides
+    the manifest (see :func:`_build_manifest`); *priority* is the
+    scheduler class the engine writes run under."""
+    leaves, _treedef = _host_leaves(state)
+    return _commit_checkpoint(ctx, directory, leaves,
+                              _build_manifest(leaves, extra),
+                              tenant=tenant, priority=priority)
+
+
+def _commit_checkpoint(ctx, directory: str, leaves: "list[np.ndarray]",
+                       manifest: dict, *, tenant: "str | None" = None,
+                       priority: "str | None" = None) -> dict:
+    """The commit half of a save: stage + engine-write the (already
+    host-resident) leaves into ``<dir>.tmp-<pid>``, fsync, and rename —
+    shared by the blocking save above and the async checkpointer's writer
+    thread (strom/ckpt/async_save.py), so the two paths' crash-safety
+    semantics can never drift."""
+    metas = manifest["leaves"]
+    total = manifest["total_bytes"]
     directory = os.path.abspath(directory)
     tmp = f"{directory}.tmp-{os.getpid()}"
     shutil.rmtree(tmp, ignore_errors=True)
@@ -233,7 +277,7 @@ def save_checkpoint(ctx, directory: str, state: Any, *,
                                           o_direct=ctx.config.o_direct,
                                           writable=True)
             try:
-                st = _Stager(ctx, fi, tenant)
+                st = _Stager(ctx, fi, tenant, priority)
                 try:
                     for meta, a in zip(metas, leaves):
                         if meta["nbytes"]:
@@ -309,6 +353,53 @@ def load_manifest(directory: str) -> dict:
         raise CkptError(f"unknown checkpoint format "
                         f"{manifest.get('format')!r} at {directory}")
     return manifest
+
+
+def last_committed(directory: str) -> "tuple[str, dict] | None":
+    """The committed checkpoint at *directory* as ``(path, manifest)``, or
+    None when nothing committed. Cross-process recovery entry point
+    (ISSUE 14): a restarted job calls this FIRST. Handles the one residual
+    crash hole of the commit protocol — a hard kill exactly between the
+    two renames of a replace-commit leaves *directory* absent and the
+    previous checkpoint at ``<dir>.old-<pid>``; that orphan is rolled back
+    into place here (the pid in the suffix belongs to the dead process, so
+    nobody else can be mid-commit on it)."""
+    directory = os.path.abspath(directory)
+    try:
+        return directory, load_manifest(directory)
+    except CkptError:
+        pass
+    import glob as _glob
+
+    if not os.path.exists(directory):
+        for old in sorted(_glob.glob(f"{directory}.old-*")):
+            try:
+                manifest = load_manifest(old)
+            except CkptError:
+                continue
+            os.rename(old, directory)
+            return directory, manifest
+    return None
+
+
+def clean_orphans(directory: str) -> list[str]:
+    """Remove ``<dir>.tmp-*`` staging orphans a killed process left behind
+    (and any ``.old-*`` made redundant by a live committed checkpoint).
+    Returns the paths removed. Never touches the committed checkpoint —
+    orphans are, by the commit protocol, never loadable as one. Call
+    AFTER :func:`last_committed` (which may still need an ``.old-*``)."""
+    directory = os.path.abspath(directory)
+    import glob as _glob
+
+    removed = []
+    for p in sorted(_glob.glob(f"{directory}.tmp-*")):
+        shutil.rmtree(p, ignore_errors=True)
+        removed.append(p)
+    if os.path.isdir(directory):
+        for p in sorted(_glob.glob(f"{directory}.old-*")):
+            shutil.rmtree(p, ignore_errors=True)
+            removed.append(p)
+    return removed
 
 
 def restore_checkpoint(ctx, directory: str, template: Any, *,

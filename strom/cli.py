@@ -2091,6 +2091,11 @@ def bench_checkpoint(args: argparse.Namespace) -> dict:
             "spill_entries": sp["spill_entries"],
             "spill_bytes": sp["spill_bytes"],
             "spill_hit_ratio": sp["spill_hit_ratio"],
+            # ISSUE 14 satellites: spill I/O route split (engine vs
+            # buffered-fd fallback) and readahead-driven promotions
+            "spill_promote_bytes": sp["spill_promote_bytes"],
+            "spill_engine_ops": sp["spill_engine_ops"],
+            "spill_fallback_ops": sp["spill_fallback_ops"],
             # the acceptance bit: repeat traffic never misses to the
             # source engine (RAM + spill covered everything)
             "spill_cache_miss_bytes":
@@ -2102,6 +2107,105 @@ def bench_checkpoint(args: argparse.Namespace) -> dict:
             os.unlink(shard)
     finally:
         sctx.close()
+    return out
+
+
+def bench_resume(args: argparse.Namespace) -> dict:
+    """Preemption-safety arm (ISSUE 14): async-save stall overhead vs the
+    synchronous save wall, then a full kill/restart recovery cycle.
+
+    Phase 1 — **async save stall**: the llama train state is saved once
+    synchronously (the wall the old path charged the training thread),
+    then ``--saves`` times through the AsyncCheckpointer with a drained
+    writer between saves, so each measured stall is the pure
+    snapshot+handoff cost. ``ckpt_async_stall_frac`` (mean stall / sync
+    wall) is the <25% acceptance; commits run CRC-verified-restorable
+    (round-trip checked on the last one). Keys:
+    strom.ckpt.async_save.CKPT_ASYNC_FIELDS.
+
+    Phase 2 — **kill/resume**: strom.faults.resume_harness.run_kill_resume
+    — a subprocess trainer SIGKILL'd at a seeded mid-epoch step, restarted
+    from last_committed + its StepToken, the remaining batch stream
+    asserted bit-identical to an uninterrupted run (no epoch replay, no
+    orphaned tmp checkpoint). Keys: strom.ckpt.jobstate.RESUME_FIELDS."""
+    import jax
+
+    from strom.ckpt import (AsyncCheckpointer, restore_checkpoint,
+                            save_checkpoint)
+    from strom.ckpt.async_save import CKPT_ASYNC_FIELDS  # noqa: F401 (contract)
+    from strom.ckpt.jobstate import RESUME_FIELDS  # noqa: F401 (contract)
+    from strom.config import StromConfig
+    from strom.delivery.core import StromContext
+    from strom.faults.resume_harness import run_kill_resume
+    from strom.models.llama import LlamaConfig
+    from strom.parallel.mesh import make_mesh
+    from strom.parallel.train import init_train_state, make_optimizer
+
+    cfg = StromConfig(engine=args.engine, block_size=args.block,
+                      queue_depth=args.depth,
+                      num_buffers=max(args.depth * 2, 8),
+                      **_obs_config_kw(args))
+    out: dict = {"bench": "resume", "engine": cfg.engine,
+                 "model": args.model}
+    ctx = StromContext(cfg)
+    try:
+        mesh = make_mesh({"dp": 1}, devices=jax.devices()[:1])
+        mcfg = getattr(LlamaConfig, args.model)()
+        opt = make_optimizer()
+        with mesh:
+            state = init_train_state(jax.random.key(0), mcfg, mesh, opt)
+        jax.block_until_ready(state)
+        d = os.path.join(args.tmpdir, "strom_bench_resume_ckpt")
+        t0 = time.perf_counter()
+        manifest = save_checkpoint(ctx, d, state)
+        sync_wall_us = (time.perf_counter() - t0) * 1e6
+        payload = manifest["payload_bytes"]
+        cp = AsyncCheckpointer(ctx, d)
+        commit_walls = []
+        try:
+            for _ in range(max(args.saves, 1)):
+                t0 = time.perf_counter()
+                cp.save(state)
+                cp.wait()  # drained between saves: stall = pure snapshot
+                commit_walls.append(time.perf_counter() - t0)
+        finally:
+            cp.close()
+        back = restore_checkpoint(ctx, d, state, verify=True)
+        jax.block_until_ready(back)
+        la, _ = jax.tree_util.tree_flatten(state)
+        lb, _ = jax.tree_util.tree_flatten(back)
+        ok = all(np.array_equal(np.asarray(a), np.asarray(b))
+                 for a, b in zip(la, lb))
+        st = cp.stats()
+        stall_mean = st["ckpt_async_stall_mean_us"]
+        commit_s = min(commit_walls) if commit_walls else 0.0
+        out.update({
+            "ckpt_bytes": payload,
+            "ckpt_async_saves": st["ckpt_async_saves"],
+            "ckpt_async_stall_p99_us": st["ckpt_async_stall_p99_us"],
+            "ckpt_async_stall_mean_us": stall_mean,
+            "ckpt_sync_save_wall_us": round(sync_wall_us, 1),
+            "ckpt_async_stall_frac":
+                round(stall_mean / sync_wall_us, 4) if sync_wall_us else None,
+            "ckpt_async_commit_mb_per_s":
+                round(payload / 1e6 / commit_s, 1) if commit_s else None,
+            "ckpt_async_roundtrip_ok": int(ok),
+        })
+        shutil.rmtree(d, ignore_errors=True)
+    finally:
+        ctx.close()
+
+    # -- kill/restart recovery cycle ----------------------------------------
+    wd = os.path.join(args.tmpdir, "strom_bench_resume_harness")
+    shutil.rmtree(wd, ignore_errors=True)
+    res = run_kill_resume(wd, seed=args.seed, sig=args.signal,
+                          engine=args.engine if args.engine != "auto"
+                          else "python")
+    for k in RESUME_FIELDS:
+        out[k] = res.get(k)
+    if res.get("failures"):
+        out["resume_failures"] = res["failures"][:4]
+    shutil.rmtree(wd, ignore_errors=True)
     return out
 
 
@@ -2564,6 +2668,32 @@ def main(argv: list[str] | None = None) -> int:
                              "checkpointed (default: small — a few hundred "
                              "MB of params+opt, enough to rate MB/s)")
     p_ckpt.set_defaults(fn=bench_checkpoint)
+
+    p_res = sub.add_parser(
+        "resume",
+        help="ISSUE 14 preemption-safety arm: async snapshot-then-commit "
+             "save stall vs the synchronous save wall on the llama train "
+             "state (ckpt_async_* columns, keys single-sourced in "
+             "strom.ckpt.async_save.CKPT_ASYNC_FIELDS), then a kill/"
+             "restart recovery cycle — subprocess trainer SIGKILL'd at a "
+             "seeded mid-epoch step, restarted from last_committed + "
+             "StepToken, remaining batch stream asserted bit-identical "
+             "(resume_* columns, keys single-sourced in "
+             "strom.ckpt.jobstate.RESUME_FIELDS)")
+    common(p_res)
+    p_res.add_argument("--model", default="small",
+                       choices=["tiny", "small", "llama3_8b"],
+                       help="LlamaConfig preset whose train state the "
+                            "async-save stall is measured on")
+    p_res.add_argument("--saves", type=int, default=4,
+                       help="async saves to measure (writer drained "
+                            "between saves; stall = pure snapshot)")
+    p_res.add_argument("--seed", type=int, default=0,
+                       help="harness seed (kill step + fixture)")
+    p_res.add_argument("--signal", default="KILL",
+                       choices=["KILL", "TERM"],
+                       help="how the victim trainer dies")
+    p_res.set_defaults(fn=bench_resume)
 
     p_daemon = sub.add_parser(
         "daemon",

@@ -208,6 +208,17 @@ class StromConfig:
     # created per context and unlinked at close — spilled bytes are a
     # cache, not a durability promise
     spill_dir: str = ""
+    # spill-tier I/O rides the engines (ISSUE 14 satellite, ROADMAP item 2
+    # residual b): demotion pwrites and spill-serve preads route through
+    # the context's engine write/read path — O_DIRECT on the spill file,
+    # scheduler-granted as the BACKGROUND class so spill traffic never
+    # outranks demand reads. Requires the scheduler (sched_enabled); ops
+    # that would nest inside an outstanding exclusive grant (a demote
+    # fired from a mid-gather admission) take the legacy buffered-fd
+    # fallback instead of deadlocking — both routes are counted
+    # (spill_engine_ops / spill_fallback_ops). False = the pre-ISSUE-14
+    # page-cache pread/pwrite path everywhere (the A/B flag).
+    spill_engine_io: bool = True
 
     # multi-tenant I/O scheduler (strom/sched — ISSUE 7 tentpole): the
     # shared arbiter that replaces the per-transfer engine lock. Tenants

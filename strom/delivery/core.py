@@ -248,6 +248,90 @@ class SourceIO(io.RawIOBase):
         return data
 
 
+class _SpillEngineIo:
+    """Engine router for spill-tier I/O (ISSUE 14 satellite, ROADMAP item
+    2 residual b): demotion writes and spill-serve reads ride the
+    context's engine path — O_DIRECT on the spill file, scheduler-granted
+    as the BACKGROUND class, billed to the "spill" tenant — instead of
+    page-cache pread/pwrite. ``write``/``read`` return False whenever
+    enqueueing is unsafe or fails, and the tier falls back to its buffered
+    fd (counted: ``spill_fallback_ops``): unsafe means the calling thread
+    already holds a scheduler grant, or — writes only — ANY exclusive
+    grant is outstanding (a demote fired from a mid-gather admission on
+    the pump thread must not queue behind a grant its own progress
+    releases). The two-phase allocate/publish discipline is unchanged:
+    none of this runs under the tier lock."""
+
+    def __init__(self, ctx, path: str):
+        self._ctx = ctx
+        self._path = path
+        self._closed = False
+        # registered EAGERLY (the file exists — the tier created it), so
+        # no lazy-registration lock is needed. O_DIRECT preferred,
+        # probed down PER REGISTRATION to buffered where the spill dir's
+        # fs refuses it (tmpfs) — never to the context's configured
+        # o_direct, which may itself be a hard True the spill fs can't
+        # honor, and never leaving a half-registered pair behind.
+        def _reg(writable: bool) -> int:
+            try:
+                return ctx.engine.register_file(path, o_direct=True,
+                                                writable=writable)
+            except OSError:
+                return ctx.engine.register_file(path, o_direct=False,
+                                                writable=writable)
+
+        self._wfi = _reg(True)
+        try:
+            self._rfi = _reg(False)
+        except BaseException:
+            with contextlib.suppress(Exception):
+                ctx.engine.unregister_file(self._wfi)
+            raise
+
+    def _safe(self, *, write: bool) -> bool:
+        sched = self._ctx._scheduler
+        if sched is None or self._closed or self._ctx._closed:
+            return False
+        if sched.held_by_me():
+            return False
+        return not write or sched.engine_idle()
+
+    def write(self, data: np.ndarray, off: int) -> bool:
+        if not self._safe(write=True):
+            return False
+        try:
+            self._ctx._scheduler.write_chunks(
+                [(self._wfi, off, 0, data.nbytes)], data, tenant="spill",
+                retries=self._ctx.config.io_retries, priority="background")
+            return True
+        # stromlint: ignore[swallowed-exceptions] -- advisory route: any
+        # engine-path failure degrades to the buffered-fd fallback (the
+        # bytes still land) and is counted below
+        except Exception:
+            self._ctx.scope.add("spill_errors")
+            return False
+
+    def read(self, dest: np.ndarray, off: int, n: int) -> bool:
+        if not self._safe(write=False):
+            return False
+        try:
+            got = self._ctx._scheduler.read_chunks(
+                [(self._rfi, off, 0, n)], dest, tenant="spill",
+                retries=self._ctx.config.io_retries, priority="background")
+            return got == n
+        # stromlint: ignore[swallowed-exceptions] -- advisory route, same
+        # degrade-to-fallback contract as write(); counted
+        except Exception:
+            self._ctx.scope.add("spill_errors")
+            return False
+
+    def close(self) -> None:
+        self._closed = True
+        for fi in (self._wfi, self._rfi):
+            with contextlib.suppress(Exception):
+                self._ctx.engine.unregister_file(fi)
+
+
 class StromContext:
     """Owns the engine, file-registration cache and delivery executor.
 
@@ -425,6 +509,18 @@ class StromContext:
                 os.path.join(sdir,
                              f"strom-spill-{os.getpid()}-{id(self):x}.bin"),
                 self.config.spill_bytes, scope=self.scope)
+            if self.config.spill_engine_io and self._scheduler is not None:
+                # spill I/O rides the engines (ISSUE 14 satellite):
+                # O_DIRECT + background-class grants; attached after the
+                # tier so registration sees the created file. The router
+                # is ADVISORY — if even buffered registration fails, the
+                # tier keeps its legacy fd path (counted, not fatal:
+                # a spill tier must never abort context construction)
+                try:
+                    self._spill.set_io(_SpillEngineIo(
+                        self, self._spill.path))
+                except OSError:
+                    self.scope.add("spill_errors")
             self._hot_cache.spill = self._spill
         # in-flight DEMAND gathers (not readahead): the readahead thread
         # checks this between engine-budget-sized slices and yields, so a
@@ -959,10 +1055,27 @@ class StromContext:
                 try:
                     for ss, tt, ent in sp_hits:
                         if warm:
-                            # spill-resident = warm enough: readahead must
-                            # not re-read the source for it (promotion is
-                            # the demand path's job)
-                            cache_hit += tt - ss
+                            # readahead-driven spill→RAM promotion
+                            # (ISSUE 14 satellite, ROADMAP item 2
+                            # residual c): an upcoming-window range that
+                            # is spill-resident promotes NOW — one local
+                            # NVMe read on the warm thread instead of a
+                            # demand-path serve+promote later. Still
+                            # never a source-engine read; failures
+                            # degrade to the old skip (the demand path
+                            # serves it from spill).
+                            n = tt - ss
+                            tmp = np.empty(n, np.uint8)
+                            try:
+                                spill.read_into(ent, ss, tt, tmp)
+                                promoted = cache.admit(
+                                    path, ss, tt, tmp, force=True,
+                                    tenant=tenant)
+                            except OSError:
+                                promoted = 0
+                            if promoted:
+                                spill.note_promote(promoted)
+                            cache_hit += n
                             continue
                         d_lo = do + (ss - fo)
                         spill.read_into(ent, ss, tt,

@@ -573,6 +573,21 @@ class HotCache:
         with self._lock:
             return len(self._lru)
 
+    def manifest(self, *, max_entries: int = 4096) -> list[list]:
+        """Resident path-keyed ranges, newest-first, as JSON-stable
+        ``[path, lo, hi]`` triples — the warm-state hints a StepToken can
+        carry across a restart (ISSUE 14, strom/ckpt/jobstate.py).
+        Derived tuple keys (decoded frames) are skipped: they are decode
+        OUTPUT, not re-readable source ranges."""
+        out: list[list] = []
+        with self._lock:
+            for e in reversed(self._lru.values()):
+                if len(out) >= max_entries:
+                    break
+                if isinstance(e.skey, str):
+                    out.append([e.skey, e.lo, e.hi])
+        return out
+
     def stats(self) -> dict:
         """The ``cache`` section of ``StromContext.stats()`` — full metric
         names as keys so the sections exposition types the counters via the

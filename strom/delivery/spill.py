@@ -65,6 +65,9 @@ SPILL_FIELDS = (
     "spill_bytes",
     "spill_hit_ratio",
     "spill_cache_miss_bytes",
+    "spill_promote_bytes",
+    "spill_engine_ops",
+    "spill_fallback_ops",
 )
 
 
@@ -98,7 +101,7 @@ class SpillTier:
     entries and per-tenant accounting. Thread-safe; all file I/O runs
     outside the tier lock (see module docstring)."""
 
-    def __init__(self, path: str, max_bytes: int, *, scope=None):
+    def __init__(self, path: str, max_bytes: int, *, scope=None, io=None):
         if max_bytes <= 0:
             raise ValueError("max_bytes must be positive")
         from strom.utils.stats import global_stats
@@ -107,6 +110,14 @@ class SpillTier:
         self.max_bytes = max_bytes
         self._scope = scope if scope is not None else global_stats
         self._fd = os.open(path, os.O_RDWR | os.O_CREAT, 0o600)
+        # engine I/O router (ISSUE 14 satellite): an object with
+        # write(data_u8, off) -> bool / read(dest_u8, off, n) -> bool that
+        # routes spill bytes through the context's engine path (O_DIRECT,
+        # background-class scheduler grants) when it is SAFE to enqueue,
+        # returning False to request the buffered-fd fallback below
+        # (strom.delivery.core._SpillEngineIo). None = always buffered fd
+        # (the pre-ISSUE-14 behavior; the spill_engine_io=False A/B arm).
+        self._io = io
         self._lock = make_lock("cache.spill")
         self._index: dict[Any, list[_SpillEntry]] = {}
         self._lru: "OrderedDict[int, _SpillEntry]" = OrderedDict()
@@ -124,6 +135,12 @@ class SpillTier:
         self.spilled_bytes = 0
         self.spills = 0
         self.evictions = 0
+        # readahead-driven spill→RAM promotions (ISSUE 14 satellite,
+        # ROADMAP item 2 residual c) — counted by the warm consult
+        self.promote_bytes = 0
+        # which route spill bytes took (engine vs buffered-fd fallback)
+        self.engine_ops = 0
+        self.fallback_ops = 0
 
     # -- allocator (lock held) ----------------------------------------------
     def _alloc_locked(self, n: int, tenant: "str | None") -> "int | None":
@@ -229,8 +246,7 @@ class SpillTier:
                     continue
                 staged.append((g_lo, g_hi, off, size_class(g_hi - g_lo)))
         for g_lo, g_hi, off, _cls in staged:
-            # numpy slices speak the buffer protocol: no bytes() bounce
-            os.pwrite(self._fd, d8[g_lo - lo: g_hi - lo].data, off)
+            self._pwrite(d8[g_lo - lo: g_hi - lo], off)
         if not staged:
             return 0
         with self._lock:
@@ -301,11 +317,44 @@ class SpillTier:
 
     def read_into(self, e: _SpillEntry, s: int, t: int,
                   dest: np.ndarray) -> int:
-        """pread spill bytes [s, t) of *e*'s range straight into *dest*
-        (writable uint8 view, len >= t-s; preadv — no intermediate bytes
-        copy). The entry must be pinned (a :meth:`lookup` hit)."""
-        return os.preadv(self._fd, [memoryview(dest)[: t - s]],
-                         e.off + (s - e.lo))
+        """Read spill bytes [s, t) of *e*'s range straight into *dest*
+        (writable uint8 view, len >= t-s) — engine-routed when a router is
+        attached and can enqueue safely, else preadv on the buffered fd
+        (no intermediate bytes copy either way). The entry must be pinned
+        (a :meth:`lookup` hit)."""
+        n = t - s
+        off = e.off + (s - e.lo)
+        io = self._io
+        if io is not None and io.read(dest[:n], off, n):
+            with self._lock:
+                self.engine_ops += 1
+            return n
+        with self._lock:
+            self.fallback_ops += 1
+        return os.preadv(self._fd, [memoryview(dest)[:n]], off)
+
+    def _pwrite(self, data: np.ndarray, off: int) -> None:
+        """Spill-file write: engine-routed when safe, buffered fd
+        otherwise. Never called under the tier lock (two-phase
+        allocate/publish — see module docstring)."""
+        io = self._io
+        if io is not None and io.write(data, off):
+            with self._lock:
+                self.engine_ops += 1
+            return
+        with self._lock:
+            self.fallback_ops += 1
+        # numpy slices speak the buffer protocol: no bytes() bounce
+        os.pwrite(self._fd, data.data, off)
+
+    def note_promote(self, nbytes: int) -> None:
+        """Count a readahead-driven spill→RAM promotion (the warm consult
+        in strom/delivery/core.py re-admits upcoming-window spill hits)."""
+        if nbytes <= 0:
+            return
+        with self._lock:
+            self.promote_bytes += nbytes
+        self._scope.add("spill_promote_bytes", nbytes)
 
     def unpin(self, entries) -> None:
         with self._lock:
@@ -316,6 +365,12 @@ class SpillTier:
                     e.dead = False
 
     # -- partitions / lifecycle ----------------------------------------------
+    def set_io(self, io) -> None:
+        """Attach the engine I/O router (see ``__init__``; the context
+        attaches it after construction so registration sees the created
+        spill file)."""
+        self._io = io
+
     def set_partition(self, tenant: str, max_bytes: int) -> None:
         """Cap *tenant*'s spill bytes (0 removes the partition)."""
         with self._lock:
@@ -354,6 +409,10 @@ class SpillTier:
             if self._closed:
                 return
             self._closed = True
+        io, self._io = self._io, None
+        if io is not None:
+            with contextlib.suppress(Exception):
+                io.close()
         os.close(self._fd)
         with contextlib.suppress(OSError):
             os.unlink(self.path)
@@ -363,6 +422,20 @@ class SpillTier:
     def entries(self) -> int:
         with self._lock:
             return len(self._lru)
+
+    def manifest(self, *, max_entries: int = 4096) -> list[list]:
+        """Spilled path-keyed ranges, newest-first, as JSON-stable
+        ``[path, lo, hi]`` triples — warm-state hints for a StepToken
+        (ISSUE 14); tuple (decoded-frame) keys are skipped like the hot
+        cache's manifest."""
+        out: list[list] = []
+        with self._lock:
+            for e in reversed(self._lru.values()):
+                if len(out) >= max_entries:
+                    break
+                if isinstance(e.skey, str):
+                    out.append([e.skey, e.lo, e.hi])
+        return out
 
     def stats(self) -> dict:
         """The ``spill`` section of ``StromContext.stats()`` — full metric
@@ -378,6 +451,9 @@ class SpillTier:
                 "spill_miss_bytes": self.miss_bytes,
                 "spill_spilled_bytes": self.spilled_bytes,
                 "spill_evictions": self.evictions,
+                "spill_promote_bytes": self.promote_bytes,
+                "spill_engine_ops": self.engine_ops,
+                "spill_fallback_ops": self.fallback_ops,
                 "spill_hit_ratio":
                     round(self.hit_bytes / served, 4) if served else 0.0,
             }

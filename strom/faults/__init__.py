@@ -13,4 +13,15 @@ exactly these plans.
 from strom.faults.plan import Fault, FaultPlan, FaultRule
 from strom.faults.proxy import FaultyEngine
 
-__all__ = ["Fault", "FaultPlan", "FaultRule", "FaultyEngine"]
+__all__ = ["Fault", "FaultPlan", "FaultRule", "FaultyEngine",
+           "run_kill_resume"]
+
+
+def run_kill_resume(*args, **kwargs):
+    """Kill/restart recovery harness (ISSUE 14) — lazy re-export: the
+    harness pulls in the checkpoint/pipeline stack, which plain fault-plan
+    users (and the FaultyEngine wrap inside StromContext.__init__) must
+    not pay for at import time."""
+    from strom.faults.resume_harness import run_kill_resume as _run
+
+    return _run(*args, **kwargs)

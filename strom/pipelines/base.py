@@ -69,10 +69,21 @@ class Pipeline:
 
         self.monitor = StragglerMonitor()
         self._last_next: float | None = None
+        # retained for restore(): a StepToken restart rebuilds the thunk
+        # stream + prefetcher with the same wiring __init__ used
+        self._make_batch = make_batch
+        self._depth_args = (depth, auto_depth, max_depth)
+        self._executor = executor
         st = sampler.state
         self._consumed = st.epoch * sampler.batches_per_epoch + st.batch_in_epoch
         self._seed = st.seed
+        self._prefetcher: Prefetcher = self._start_stream(depth)
 
+    def _start_stream(self, depth: int) -> Prefetcher:
+        """Build the thunk generator + prefetcher from the sampler's
+        CURRENT cursor — __init__'s tail, reused by :meth:`restore`."""
+        sampler = self.sampler
+        make_batch = self._make_batch
         start = self._consumed
         bpe = sampler.batches_per_epoch
 
@@ -88,11 +99,12 @@ class Pipeline:
                 yield lambda idx=indices, s=serial: make_batch(idx, s)
                 serial += 1
 
-        self._prefetcher: Prefetcher = Prefetcher(thunks(), depth=depth,
-                                                  auto_depth=auto_depth,
-                                                  max_depth=max_depth,
-                                                  executor=executor,
-                                                  scope=self.scope)
+        _, auto_depth, max_depth = self._depth_args
+        return Prefetcher(thunks(), depth=depth,
+                          auto_depth=auto_depth,
+                          max_depth=max_depth,
+                          executor=self._executor,
+                          scope=self.scope)
 
     def __iter__(self) -> "Pipeline":
         return self
@@ -139,6 +151,70 @@ class Pipeline:
     def load_state(path: str, fingerprint: dict | None = None
                    ) -> tuple[SamplerState, dict]:
         return load_loader_state(path, fingerprint)
+
+    def token(self, ctx: Any | None = None, *, warm_state: bool = False,
+              extra: dict | None = None):
+        """The :class:`~strom.ckpt.jobstate.StepToken` of the NEXT
+        unconsumed batch (ISSUE 14): sampler position derived from the
+        consumed count (same no-replay/no-skip contract as
+        :meth:`state`), the global serial, the prefetcher's current
+        operating depth, and — with ``warm_state=True`` and a *ctx* —
+        the cache/spill manifests as advisory rewarm hints. Cheap enough
+        to capture every step when hints are off."""
+        from strom.ckpt.jobstate import StepToken, capture_warm_state
+
+        return StepToken(
+            sampler=self.state(),
+            consumed=self._consumed,
+            prefetch_depth=self._prefetcher.depth,
+            fingerprint=dict(self.fingerprint),
+            warm=capture_warm_state(ctx) if (warm_state and ctx is not None)
+            else None,
+            extra=dict(extra or {}))
+
+    def restore(self, token) -> "Pipeline":
+        """Rewind/fast-forward THIS pipeline to *token*'s position: the
+        next delivered batch is exactly the one an uninterrupted run
+        would have delivered there (bit-identical stream from then on —
+        the harness's contract). In-flight prefetched batches are
+        discarded; the prefetcher restarts at the token's depth (the
+        auto-depth operating point travels with the job). Accepts a
+        StepToken or a bare SamplerState. Returns self."""
+        from strom.ckpt.jobstate import StepToken
+
+        if isinstance(token, StepToken):
+            st, depth = token.sampler, token.prefetch_depth
+            if token.fingerprint and self.fingerprint \
+                    and token.fingerprint != self.fingerprint:
+                raise ValueError(
+                    "StepToken was captured against a different dataset "
+                    f"({len(token.fingerprint.get('paths', ()))} shards vs "
+                    f"{len(self.fingerprint.get('paths', ()))}); refusing "
+                    "to resume")
+        else:
+            st, depth = token, 0
+        if st.seed != self._seed:
+            raise ValueError(
+                f"token was captured with seed {st.seed} but this pipeline "
+                f"shuffles with seed {self._seed}; refusing to resume a "
+                "different batch order")
+        target = st.epoch * self.sampler.batches_per_epoch \
+            + st.batch_in_epoch
+        if self._consumed == target \
+                and (depth <= 0 or depth == self._prefetcher.depth):
+            # already positioned (a pipeline constructed with the token's
+            # sampler state, or restored twice): the in-flight prefetch
+            # window is dispatching exactly the right serials — keep it
+            # instead of discarding and re-issuing those reads
+            return self
+        self._prefetcher.close()
+        self.sampler.state = SamplerState(epoch=st.epoch,
+                                          batch_in_epoch=st.batch_in_epoch,
+                                          seed=st.seed)
+        self._consumed = target
+        self._prefetcher = self._start_stream(
+            depth if depth > 0 else self._depth_args[0])
+        return self
 
     # -- observability ------------------------------------------------------
     @property
@@ -202,15 +278,31 @@ def _auto_depth_bounds(ctx, auto_prefetch: bool | None,
 
 
 def resolve_state(paths: tuple[str, ...], *, seed: int,
-                  resume_from: str | SamplerState | None,
+                  resume_from: "str | SamplerState | Any | None",
                   ctx=None) -> tuple[SamplerState | None, dict]:
     """Common resume plumbing: fingerprint the shard list and, when resuming,
     validate both the dataset identity and the shuffle seed — a checkpoint
-    saved under a different seed describes a different data order."""
+    saved under a different seed describes a different data order. Accepts
+    a loader-state path, a bare SamplerState, or a StepToken (ISSUE 14 —
+    its embedded fingerprint is validated against the live shard list)."""
     fp = dataset_fingerprint(paths, ctx)
     if resume_from is None:
         return None, fp
-    if isinstance(resume_from, SamplerState):
+    if hasattr(resume_from, "sampler") and hasattr(resume_from, "consumed"):
+        # StepToken (duck-typed: pipelines.base must not import strom.ckpt
+        # at call time just to isinstance-check). POSITION only: the
+        # factory path restores the batch stream; the token's prefetch
+        # depth and warm hints are runtime state — adopt them with
+        # Pipeline.restore(token) / restore_warm_state(ctx, token.warm)
+        # after construction (cheap: restore() no-ops the prefetcher
+        # rebuild when the pipeline is already at the token's position)
+        if resume_from.fingerprint and resume_from.fingerprint != fp:
+            raise ValueError(
+                "StepToken was captured against a different dataset "
+                f"(saved {len(resume_from.fingerprint.get('paths', ()))} "
+                f"shards, now {len(fp['paths'])}); refusing to resume")
+        state = resume_from.sampler
+    elif isinstance(resume_from, SamplerState):
         state = resume_from
     else:
         state, _ = load_loader_state(resume_from, fp)

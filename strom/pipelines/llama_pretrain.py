@@ -29,7 +29,7 @@ def make_llama_pipeline(ctx: StromContext, paths: Sequence[str], *,
                         shuffle: bool = True,
                         prefetch_depth: int | None = None,
                         auto_prefetch: bool | None = None,
-                        resume_from: str | SamplerState | None = None,
+                        resume_from: "str | SamplerState | object | None" = None,
                         epoch_sync: bool = False,
                         scope: dict | None = None
                         ) -> Pipeline:
@@ -38,7 +38,10 @@ def make_llama_pipeline(ctx: StromContext, paths: Sequence[str], *,
 
     Every host must construct the pipeline with the same arguments (the
     sampler is deterministic in (seed, epoch)); the sharded read planner then
-    fetches only host-local bytes.
+    fetches only host-local bytes. *resume_from* accepts a loader-state
+    path, a SamplerState, or a StepToken (ISSUE 14 — validated against the
+    live shard fingerprint); a live pipeline also restores in place via
+    ``Pipeline.restore(token)``.
     """
     from strom.delivery.core import source_size
 

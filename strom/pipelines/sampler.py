@@ -58,7 +58,13 @@ class EpochShuffleSampler:
         self.num_records = num_records
         self.batch = batch
         self.shuffle = shuffle
-        self.state = state or SamplerState(seed=seed)
+        # COPY the caller's state: iteration mutates self.state in place,
+        # and aliasing the caller's object would silently corrupt it — a
+        # StepToken whose sampler position advances with the prefetch
+        # window is a resume point that no longer points anywhere
+        # (ISSUE 14; bitten in the resume harness)
+        self.state = dataclasses.replace(state) if state is not None \
+            else SamplerState(seed=seed)
         # permutation memo for peek(): the readahead thread polls the
         # upcoming window every few ms, and re-permuting num_records per
         # poll would be a dataset-sized tax on a warming path. TWO epochs

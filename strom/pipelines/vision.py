@@ -441,7 +441,7 @@ def make_wds_vision_pipeline(ctx: StromContext, paths: Sequence[str], *,
                              decode_roi: bool | None = None,
                              decode_cache: bool | None = None,
                              stream_intra_batch: bool | None = None,
-                             resume_from: str | SamplerState | None = None,
+                             resume_from: "str | SamplerState | object | None" = None,
                              scope: dict | None = None
                              ) -> Pipeline:
     """Infinite stream of (images [B,S,S,3] uint8, labels [B] int32) jax.Array
@@ -449,7 +449,9 @@ def make_wds_vision_pipeline(ctx: StromContext, paths: Sequence[str], *,
     labels inherit its batch-dim spec).
 
     Augmentation is deterministic in (seed, batch serial, row): identical
-    across hosts and across checkpoint resume.
+    across hosts and across checkpoint resume. *resume_from* accepts a
+    loader-state path, a SamplerState, or a StepToken (ISSUE 14); a live
+    pipeline also restores in place via ``Pipeline.restore(token)``.
 
     *scope*: telemetry labels for this pipeline (ISSUE 6), refined over the
     context's scope — defaults to ``{"pipeline": "vision"}`` so two
@@ -690,7 +692,7 @@ def make_predecoded_vision_pipeline(ctx: StromContext, paths: Sequence[str], *,
                                     shuffle: bool = True,
                                     prefetch_depth: int | None = None,
                                     auto_prefetch: bool | None = None,
-                                    resume_from: str | SamplerState | None = None,
+                                    resume_from: "str | SamplerState | object | None" = None,
                                     scope: dict | None = None
                                     ) -> Pipeline:
     """Decode-free vision loader over pre-decoded shards (see

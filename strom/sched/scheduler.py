@@ -88,7 +88,7 @@ class _Waiter:
     """One queued grant request (scheduler-lock-owned)."""
 
     __slots__ = ("tenant", "nbytes", "prio", "enq_t", "granted", "wait_s",
-                 "throttled")
+                 "throttled", "owner_ident")
 
     def __init__(self, tenant: Tenant, nbytes: int, prio: int, enq_t: float):
         self.tenant = tenant
@@ -101,6 +101,10 @@ class _Waiter:
         # flag keeps repeated dispatch passes / poll ticks over the same
         # still-throttled head-of-queue from re-counting it
         self.throttled = False
+        # thread that ACQUIRED the grant (release may land on another — a
+        # streamed gather releases at drain on the pump side): the key the
+        # held_by_me() re-entrancy probe charges/refunds (ISSUE 14)
+        self.owner_ident = 0
 
 
 class IoScheduler:
@@ -123,6 +127,12 @@ class IoScheduler:
         self._cond = make_condition("sched.arbiter")
         self._tenants: dict[str, Tenant] = {}
         self._current: _Waiter | None = None
+        # grants outstanding per ACQUIRING thread ident (ISSUE 14): the
+        # re-entrancy probe the engine-routed spill I/O consults before
+        # queueing — a thread already holding (or working under) a grant
+        # must never enqueue a nested one (self-deadlock on an exclusive
+        # engine). Cross-thread releases refund the acquirer's entry.
+        self._held_by: dict[int, int] = {}
         # service baseline: a tenant going active joins at this vtime, so
         # an idle tenant can't bank unbounded credit (classic WFQ rule)
         self._vbase = 0.0
@@ -365,6 +375,9 @@ class IoScheduler:
                     self._cond.wait(wait_s)
                     delay = self._dispatch_locked()
             t.scope.set_gauge("sched_queue_depth", len(t.queue))
+            w.owner_ident = threading.get_ident()
+            self._held_by[w.owner_ident] = \
+                self._held_by.get(w.owner_ident, 0) + 1
         w.wait_s = max(self._clock() - w.enq_t, 0.0)
         t.scope.observe_us("sched_queue_wait", w.wait_s * 1e6)
         t.scope.add("sched_granted_ops")
@@ -396,10 +409,35 @@ class IoScheduler:
             self.engine.set_scope(self._scope)
         with self._cond:
             w.tenant.active -= 1
+            left = self._held_by.get(w.owner_ident, 0) - 1
+            if left > 0:
+                self._held_by[w.owner_ident] = left
+            else:
+                self._held_by.pop(w.owner_ident, None)
             if self.exclusive and self._current is w:
                 self._current = None
                 self._dispatch_locked()
             self._cond.notify_all()
+
+    # -- re-entrancy probes (ISSUE 14: engine-routed spill I/O) -------------
+    def held_by_me(self) -> bool:
+        """True when the CALLING thread acquired a grant that is still
+        outstanding — a nested enqueue from it would self-deadlock on an
+        exclusive engine."""
+        with self._cond:
+            return self._held_by.get(threading.get_ident(), 0) > 0
+
+    def engine_idle(self) -> bool:
+        """Advisory: no exclusive grant outstanding right now. The
+        engine-routed spill WRITE path requires it — a demote fired from a
+        mid-gather admission (the streamed pump thread, whose gather's
+        grant is held by ANOTHER thread) must fall back to the buffered fd
+        rather than queue behind a grant its own progress is supposed to
+        release. Races are safe in both directions: a stale True just
+        queues normally; a stale False takes the fallback."""
+        if not self.exclusive:
+            return True
+        return self._current is None
 
     @contextlib.contextmanager
     def grant(self, tenant: "Tenant | str | None" = None, nbytes: int = 0,

@@ -392,6 +392,40 @@ def test_write_keys_match_producers():
             f"produces no such key (renamed column?)"
 
 
+def test_resume_keys_match_producers():
+    """Producer↔report key parity for the preemption/resume section
+    (ISSUE 14 tentpole, the decode/stall/cache/stream/sched/slo/resil/
+    write pattern): every compare_rounds resume column must be a key the
+    resume bench arm emits (single-sourced in
+    strom.ckpt.jobstate.RESUME_FIELDS and
+    strom.ckpt.async_save.CKPT_ASYNC_FIELDS) — a rename on either side is
+    a silently dead column."""
+    from strom.ckpt.async_save import CKPT_ASYNC_FIELDS
+    from strom.ckpt.jobstate import RESUME_FIELDS
+
+    produced = set(RESUME_FIELDS) | set(CKPT_ASYNC_FIELDS)
+    for key in compare_rounds.RESUME_KEYS:
+        assert key in produced, \
+            f"compare_rounds consumes {key!r} but the resume arm " \
+            f"produces no such key (renamed column?)"
+
+
+def test_resume_section_renders(tmp_path, capsys):
+    """A round carrying resume_*/ckpt_async_* keys gets the resume
+    section."""
+    d = dict(NEW_ROUND)
+    d.update({"resume_ok": 1, "resume_kill_step": 12,
+              "resume_restart_step": 8, "resume_replayed_batches": 5,
+              "ckpt_async_stall_frac": 0.021,
+              "ckpt_async_stall_p99_us": 1481.4})
+    p = tmp_path / "BENCH_r14.json"
+    p.write_text(json.dumps(d))
+    assert compare_rounds.main([str(p)]) == 0
+    out = capsys.readouterr().out
+    assert "resume (kill/restart harness" in out
+    assert "resume_ok" in out and "ckpt_async_stall_frac" in out
+
+
 def test_write_section_renders(tmp_path, capsys):
     """A round carrying ckpt_*/spill_* keys gets the write-path section."""
     d = dict(NEW_ROUND)

@@ -1,0 +1,262 @@
+"""StepToken round-trips (ISSUE 14 satellite): capture/restore bit-identity
+mid-epoch and across the epoch boundary, warm-cache resume serving with
+zero source-engine reads, warm-hint replay into a fresh context, and
+resume-after-failed-save falling back to the prior commit."""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from strom.ckpt.jobstate import (RESUME_FIELDS, StepToken,
+                                 capture_warm_state, restore_warm_state,
+                                 set_resume_gauges)
+from strom.config import StromConfig
+from strom.delivery.core import StromContext
+from strom.pipelines.base import Pipeline, resolve_state
+from strom.pipelines.sampler import EpochShuffleSampler, SamplerState
+
+KiB = 1024
+MiB = 1024 * KiB
+
+
+def _pipe(records=64, batch=4, seed=5, make=None, depth=2, **kw):
+    s = EpochShuffleSampler(records, batch, seed=seed)
+    return Pipeline(s, make or (lambda idx, serial: (serial, idx.copy())),
+                    depth=depth, **kw)
+
+
+class TestTokenRoundTrip:
+    def test_json_and_file_round_trip(self, tmp_path):
+        t = StepToken(sampler=SamplerState(epoch=3, batch_in_epoch=7,
+                                           seed=11),
+                      consumed=55, prefetch_depth=4,
+                      fingerprint={"paths": ["a"], "sizes": [1]},
+                      warm={"cache": [["a", 0, 64]]}, extra={"k": 1})
+        t2 = StepToken.from_dict(json.loads(json.dumps(t.to_dict())))
+        assert t2 == t
+        p = str(tmp_path / "tok.json")
+        t.save(p)
+        assert StepToken.load(p) == t
+
+    def test_unknown_version_refused(self):
+        with pytest.raises(ValueError, match="version"):
+            StepToken.from_dict({"version": 99, "sampler": {}})
+
+    @pytest.mark.parametrize("consume", [5, 16, 21])
+    def test_restore_continues_bit_identical(self, consume):
+        """Mid-epoch (5), exactly at the epoch boundary (16 = bpe), and
+        mid-epoch-2 (21): the restored stream equals the uninterrupted
+        one, serial for serial, index for index."""
+        p = _pipe()          # bpe = 16
+        for _ in range(consume):
+            next(p)
+        tok = p.token()
+        assert tok.consumed == consume
+        ref = [next(p) for _ in range(20)]
+        p.restore(tok)
+        got = [next(p) for _ in range(20)]
+        for (sa, ia), (sb, ib) in zip(ref, got):
+            assert sa == sb
+            np.testing.assert_array_equal(ia, ib)
+        p.close()
+
+    def test_restore_into_fresh_pipeline(self):
+        """The restart shape: a NEW pipeline object (fresh process),
+        restored from the old one's token, continues its stream."""
+        p1 = _pipe()
+        for _ in range(9):
+            next(p1)
+        tok = p1.token()
+        ref = [next(p1) for _ in range(10)]
+        p1.close()
+        p2 = _pipe().restore(tok)
+        got = [next(p2) for _ in range(10)]
+        for (sa, ia), (sb, ib) in zip(ref, got):
+            assert sa == sb
+            np.testing.assert_array_equal(ia, ib)
+        p2.close()
+
+    def test_restore_refuses_wrong_seed_and_dataset(self):
+        p = _pipe(seed=5)
+        tok = p.token()
+        p.close()
+        other = _pipe(seed=6)
+        with pytest.raises(ValueError, match="seed"):
+            other.restore(tok)
+        other.close()
+        tok2 = StepToken(sampler=tok.sampler, consumed=tok.consumed,
+                         fingerprint={"paths": ["x"], "sizes": [1]})
+        fp = {"paths": ["y"], "sizes": [2]}
+        wrong = _pipe(seed=5, fingerprint=fp)
+        with pytest.raises(ValueError, match="different dataset"):
+            wrong.restore(tok2)
+        wrong.close()
+
+    def test_resolve_state_accepts_token(self, tmp_path):
+        p = str(tmp_path / "d.bin")
+        np.zeros(1024, np.uint8).tofile(p)
+        fp_tok = StepToken(
+            sampler=SamplerState(epoch=1, batch_in_epoch=2, seed=3),
+            consumed=10,
+            fingerprint={"paths": [p], "sizes": [1024]})
+        state, fp = resolve_state((p,), seed=3, resume_from=fp_tok)
+        assert state.epoch == 1 and state.batch_in_epoch == 2
+        bad = StepToken(sampler=fp_tok.sampler, consumed=10,
+                        fingerprint={"paths": [p], "sizes": [999]})
+        with pytest.raises(ValueError, match="different dataset"):
+            resolve_state((p,), seed=3, resume_from=bad)
+
+    def test_token_carries_prefetch_depth(self):
+        p = _pipe(depth=3)
+        next(p)
+        tok = p.token()
+        assert tok.prefetch_depth == 3
+        p.restore(tok)
+        assert p.prefetch_depth == 3
+        p.close()
+
+    def test_resume_gauges_mirror(self):
+        from strom.utils.stats import global_stats
+
+        set_resume_gauges({k: i for i, k in enumerate(RESUME_FIELDS)})
+        assert global_stats.gauge("resume_ok").value == 0
+        assert global_stats.gauge("resume_kill_step").value == 1
+
+
+class TestWarmResume:
+    def _ctx(self, tmp_path, **kw):
+        return StromContext(StromConfig(
+            engine="python", queue_depth=8, num_buffers=16,
+            slab_pool_bytes=32 * MiB, hot_cache_bytes=8 * MiB,
+            hot_cache_admit="always", spill_dir=str(tmp_path), **kw))
+
+    def test_warm_cache_resume_zero_source_reads(self, tmp_path):
+        """The satellite's acceptance shape: a pipeline restored from a
+        StepToken over an already-warm cache serves the continued stream
+        with ZERO additional source-engine reads."""
+        ctx = self._ctx(tmp_path)
+        try:
+            p = str(tmp_path / "src.bin")
+            data = np.random.default_rng(0).integers(
+                0, 256, 1 * MiB, dtype=np.uint8)
+            data.tofile(p)
+            step = 64 * KiB
+            n_rec = len(data) // step
+
+            def make(idx, serial):
+                out = [np.asarray(ctx.pread(p, offset=int(i) * step,
+                                            length=step)) for i in idx]
+                return serial, np.stack(out)
+
+            pipe = Pipeline(EpochShuffleSampler(n_rec, 2, seed=1), make,
+                            depth=1)
+            bpe = n_rec // 2
+            for _ in range(bpe):          # epoch 1: admit everything
+                next(pipe)
+            tok = pipe.token(ctx, warm_state=True)
+            assert tok.warm and tok.warm["cache"]
+            eng0 = ctx.engine.stats().get("bytes_read", 0)
+            pipe.restore(tok)
+            got = [next(pipe) for _ in range(bpe)]  # epoch 2, warm
+            assert len(got) == bpe
+            assert ctx.engine.stats().get("bytes_read", 0) == eng0, \
+                "warm-cache resume reached the source engine"
+            pipe.close()
+        finally:
+            ctx.close()
+
+    def test_warm_hints_replay_into_fresh_context(self, tmp_path):
+        """Cross-process shape: hints captured in ctx A, replayed into a
+        COLD ctx B (one warming pass, background class); the demand reads
+        after it add zero engine bytes."""
+        p = str(tmp_path / "src.bin")
+        data = np.random.default_rng(1).integers(
+            0, 256, 512 * KiB, dtype=np.uint8)
+        data.tofile(p)
+        ctx_a = self._ctx(tmp_path)
+        try:
+            for off in range(0, len(data), 64 * KiB):
+                ctx_a.pread(p, offset=off, length=64 * KiB)
+            warm = capture_warm_state(ctx_a)
+            assert warm and warm["cache"]
+        finally:
+            ctx_a.close()
+        ctx_b = self._ctx(tmp_path)
+        try:
+            warmed = restore_warm_state(ctx_b, warm)
+            assert warmed > 0
+            eng0 = ctx_b.engine.stats().get("bytes_read", 0)
+            for off in range(0, len(data), 64 * KiB):
+                back = ctx_b.pread(p, offset=off, length=64 * KiB)
+                np.testing.assert_array_equal(back,
+                                              data[off: off + 64 * KiB])
+            assert ctx_b.engine.stats().get("bytes_read", 0) == eng0
+        finally:
+            ctx_b.close()
+
+    def test_warm_hints_skip_vanished_sources(self, tmp_path):
+        ctx = self._ctx(tmp_path)
+        try:
+            gone = str(tmp_path / "gone.bin")
+            assert restore_warm_state(
+                ctx, {"cache": [[gone, 0, 4096]]}) == 0
+        finally:
+            ctx.close()
+
+
+class TestFailedSaveFallback:
+    def test_resume_after_failed_save_uses_prior_commit(self, tmp_path):
+        """ISSUE 14 satellite: save at step 8 commits; the save at step 12
+        fails (write chaos past an op window); a restart resumes from the
+        step-8 token — prior commit, bit-identical stream."""
+        import jax.numpy as jnp
+
+        from strom.ckpt import (AsyncCheckpointer, CkptAsyncError,
+                                last_committed, restore_checkpoint)
+        from strom.ckpt.jobstate import TOKEN_KEY
+
+        d = str(tmp_path / "ckpt")
+        # each 256KB save stages 2 write ops at 128KB blocks: ops 0-1 are
+        # the step-8 save (clean), everything later fails
+        plan = json.dumps({"seed": 0, "rules": [
+            {"kind": "errno", "op": "write", "op_lo": 2, "err": "EIO"}]})
+        ctx = StromContext(StromConfig(
+            engine="python", queue_depth=8, num_buffers=16,
+            slab_pool_bytes=32 * MiB, fault_plan=plan, io_retries=1))
+        try:
+            pipe = _pipe(seed=9)
+            cp = AsyncCheckpointer(ctx, d)
+            state8 = None
+            for _ in range(8):
+                next(pipe)
+            cp.save({"w": jnp.arange(1 << 16, dtype=jnp.float32)},
+                    extra={TOKEN_KEY: pipe.token().to_dict()})
+            cp.wait()                       # step-8 commit lands
+            for _ in range(4):
+                next(pipe)
+            cp.save({"w": jnp.arange(1 << 16, dtype=jnp.float32)},
+                    extra={TOKEN_KEY: pipe.token().to_dict()})
+            with pytest.raises(CkptAsyncError):
+                cp.wait()                   # step-12 commit failed
+            ref = [next(pipe) for _ in range(8)]
+            cp.close(wait=False)
+            pipe.close()
+            # the restart: prior commit's token, stream from step 8
+            lc = last_committed(d)
+            assert lc is not None
+            tok = StepToken.from_manifest(lc[1])
+            assert tok.consumed == 8
+            state8 = restore_checkpoint(
+                ctx, lc[0], {"w": jnp.zeros((1 << 16,), jnp.float32)},
+                verify=True)
+            assert state8 is not None
+            fresh = _pipe(seed=9).restore(tok)
+            replay = [next(fresh) for _ in range(12)]  # 8..19
+            for (sa, ia), (sb, ib) in zip(ref, replay[4:]):
+                assert sa == sb
+                np.testing.assert_array_equal(ia, ib)
+            fresh.close()
+        finally:
+            ctx.close()

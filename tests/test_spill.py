@@ -205,15 +205,21 @@ class TestHotCacheDemotion:
 
 
 class TestEndToEnd:
-    def test_warm_spill_epoch_zero_source_reads(self, tmp_path, rng):
-        """The ISSUE 13 acceptance: epoch 2 over a working set larger than
-        the RAM cache serves RAM + spill with spill_hit_bytes > 0 and
-        cache_miss_bytes = 0 — the source engine reads NOTHING."""
+    @pytest.mark.parametrize("engine_io", [False, True])
+    def test_warm_spill_epoch_zero_source_reads(self, tmp_path, rng,
+                                                engine_io):
+        """The ISSUE 13 acceptance, both spill I/O routes (ISSUE 14 A/B
+        flag): epoch 2 over a working set larger than the RAM cache serves
+        RAM + spill with spill_hit_bytes > 0 and cache_miss_bytes = 0 —
+        the SOURCE is never re-read. With ``spill_engine_io`` the spill
+        serves themselves ride the engine (every warm-epoch engine byte is
+        spill traffic, none source); with the legacy route the engine sees
+        nothing at all."""
         ctx = StromContext(StromConfig(
             engine="python", queue_depth=8, num_buffers=16,
             slab_pool_bytes=32 * MiB, hot_cache_bytes=256 * KiB,
             hot_cache_admit="always", spill_bytes=16 * MiB,
-            spill_dir=str(tmp_path)))
+            spill_dir=str(tmp_path), spill_engine_io=engine_io))
         try:
             p = str(tmp_path / "src.bin")
             data = rng.integers(0, 256, 4 * MiB, dtype=np.uint8)
@@ -224,6 +230,7 @@ class TestEndToEnd:
             s1 = ctx.stats(sections=["cache", "spill"])
             assert s1["spill"]["spill_spilled_bytes"] > 0
             miss1 = s1["cache"]["cache_miss_bytes"]
+            hit1 = s1["spill"]["spill_hit_bytes"]
             eng1 = ctx.engine.stats().get("bytes_read", 0)
             for off in range(0, len(data), step):
                 back = ctx.pread(p, offset=off, length=step)
@@ -231,12 +238,62 @@ class TestEndToEnd:
             s2 = ctx.stats(sections=["cache", "spill"])
             assert s2["spill"]["spill_hit_bytes"] > 0
             assert s2["cache"]["cache_miss_bytes"] == miss1
-            assert ctx.engine.stats().get("bytes_read", 0) == eng1
+            eng_delta = ctx.engine.stats().get("bytes_read", 0) - eng1
+            spill_served = s2["spill"]["spill_hit_bytes"] - hit1
+            if engine_io:
+                # spill reads ride the engine now; anything beyond the
+                # engine-routed spill serves would be a source re-read
+                assert s2["spill"]["spill_engine_ops"] > 0
+                assert eng_delta <= spill_served
+            else:
+                assert s2["spill"]["spill_engine_ops"] == 0
+                assert eng_delta == 0
         finally:
             ctx.close()
         # the spill file is unlinked with the context
         assert not any(n.startswith("strom-spill")
                        for n in os.listdir(str(tmp_path)))
+
+    @pytest.mark.parametrize("engine_io", [False, True])
+    def test_readahead_promotes_spill_hits_to_ram(self, tmp_path, rng,
+                                                  engine_io):
+        """ISSUE 14 satellite (ROADMAP item 2 residual c): the warm path
+        (ctx.warm — what the Readahead thread drives) probes the spill
+        tier and PROMOTES upcoming-window hits back to RAM instead of
+        skipping them; the counter proves it and a demand read afterwards
+        serves from RAM (no new spill serve, no source read)."""
+        from strom.delivery.shard import Segment
+
+        ctx = StromContext(StromConfig(
+            engine="python", queue_depth=8, num_buffers=16,
+            slab_pool_bytes=32 * MiB, hot_cache_bytes=8 * MiB,
+            hot_cache_admit="always", spill_bytes=16 * MiB,
+            spill_dir=str(tmp_path), spill_engine_io=engine_io))
+        try:
+            p = str(tmp_path / "src.bin")
+            data = rng.integers(0, 256, 512 * KiB, dtype=np.uint8)
+            data.tofile(p)
+            n = 128 * KiB
+            # spill-seed directly (the deterministic route: eviction
+            # timing under slab size-classes is not the point here)
+            ctx.hot_cache.spill.offer(p, 0, n, data[:n])
+            assert ctx.spill_tier.entries == 1
+            promote0 = ctx.spill_tier.stats()["spill_promote_bytes"]
+            warmed = ctx.warm(p, [Segment(0, 0, n)])
+            st = ctx.spill_tier.stats()
+            assert st["spill_promote_bytes"] - promote0 == n
+            assert warmed >= 0
+            # promoted = RAM-resident now: a demand read is a pure RAM hit
+            hit0 = ctx.hot_cache.stats()["cache_hit_bytes"]
+            back = ctx.pread(p, offset=0, length=n)
+            np.testing.assert_array_equal(back, data[:n])
+            assert ctx.hot_cache.stats()["cache_hit_bytes"] - hit0 == n
+            # a second warm pass finds it in RAM: no re-promotion
+            ctx.warm(p, [Segment(0, 0, n)])
+            assert ctx.spill_tier.stats()["spill_promote_bytes"] \
+                - promote0 == n
+        finally:
+            ctx.close()
 
     def test_spill_off_behavior_unchanged(self, tmp_path, rng):
         """spill_bytes=0 (the default): eviction drops, repeat traffic

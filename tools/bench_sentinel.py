@@ -46,8 +46,8 @@ _HERE = os.path.dirname(os.path.abspath(__file__))
 sys.path.insert(0, _HERE)  # runnable as a script from anywhere
 
 from compare_rounds import (BINDING_ORDER, CACHE_KEYS, DECODE2_KEYS,  # noqa: E402
-                            DECODE_KEYS, RESIL_KEYS, SLO_KEYS, STALL_KEYS,
-                            STREAM_KEYS, WRITE_KEYS, unwrap)
+                            DECODE_KEYS, RESIL_KEYS, RESUME_KEYS, SLO_KEYS,
+                            STALL_KEYS, STREAM_KEYS, WRITE_KEYS, unwrap)
 
 # The gated metric set: (metric, direction) over the single-sourced
 # comparison tuples, where direction is "up" (bigger is better) or "down"
@@ -112,6 +112,14 @@ SENTINEL_FIELDS = (
     ("ckpt_save_mb_per_s", "up"),
     ("ckpt_roundtrip_ok", "up"),
     ("spill_hit_ratio", "up"),
+    # preemption safety (ISSUE 14): the kill/restart harness verdict is
+    # 0/1 — any drop from 1 fails the gate outright — and the async
+    # save's training-thread stall must stay a small fraction of the
+    # sync save wall (the <25% acceptance; stall_frac is a same-run
+    # ratio, weather-independent, banded relatively like chaos_slowdown)
+    ("resume_ok", "up"),
+    ("ckpt_async_stall_frac", "down"),
+    ("ckpt_async_stall_p99_us", "down"),
 )
 
 # absolute slack for count-like "down" metrics around small values: going
@@ -121,12 +129,13 @@ ABS_SLACK = 2.0
 
 # "down" metrics that are RATIOS near 1.0, not counts: the count-sized
 # ABS_SLACK would swamp them (chaos_slowdown ~1.2 could reach ~3.2 before
-# the gate fired) — they band relatively, like the "up" direction
-RATIO_DOWN = frozenset({"chaos_slowdown"})
+# the gate fired) — they band relatively, like the "up" direction.
+# ckpt_async_stall_frac is a <1 ratio for the same reason.
+RATIO_DOWN = frozenset({"chaos_slowdown", "ckpt_async_stall_frac"})
 
 TABLE_KEYS = list(dict.fromkeys(
     BINDING_ORDER + DECODE_KEYS + DECODE2_KEYS + STALL_KEYS + CACHE_KEYS
-    + STREAM_KEYS + SLO_KEYS + RESIL_KEYS + WRITE_KEYS))
+    + STREAM_KEYS + SLO_KEYS + RESIL_KEYS + WRITE_KEYS + RESUME_KEYS))
 
 
 def load_round(path: str) -> dict:

@@ -251,6 +251,32 @@ WRITE_KEYS = [
     "spill_spilled_bytes",
     "spill_hit_ratio",
     "spill_cache_miss_bytes",
+    "spill_promote_bytes",
+    "spill_engine_ops",
+]
+# preemption-safe training (ISSUE 14 tentpole): the resume arm's
+# kill/restart verdict (resume_ok folds bit-identity + no-epoch-replay +
+# no-orphans into one bit; replayed_batches is the bounded
+# un-checkpointed tail) and the async-save stall columns
+# (ckpt_async_stall_frac is the same-run stall/sync-wall ratio — the
+# <25% acceptance, weather-independent; stall p99 is host-memcpy-bound).
+# Suffixes single-sourced in strom.ckpt.jobstate.RESUME_FIELDS and
+# strom.ckpt.async_save.CKPT_ASYNC_FIELDS (parity-tested in
+# tests/test_compare_rounds.py, same contract as the other sections).
+RESUME_KEYS = [
+    "resume_ok",
+    "resume_kill_step",
+    "resume_restart_step",
+    "resume_replayed_batches",
+    "resume_batches_checked",
+    "resume_orphan_tmps",
+    "resume_wall_s",
+    "ckpt_async_saves",
+    "ckpt_async_stall_p99_us",
+    "ckpt_async_stall_mean_us",
+    "ckpt_sync_save_wall_us",
+    "ckpt_async_stall_frac",
+    "ckpt_async_commit_mb_per_s",
 ]
 # per-attempt / per-pass audit arrays (VERDICT.md r4 next #3): printed so
 # the best-of selection's discards are visible in the comparison too
@@ -394,10 +420,12 @@ def main(argv: list[str]) -> int:
                      for k in RESIL_KEYS)
     have_write = any(cell(d, k) != "-" for _, d in rounds
                      for k in WRITE_KEYS)
+    have_resume = any(cell(d, k) != "-" for _, d in rounds
+                      for k in RESUME_KEYS)
     name_w = max(len(k) for k in binding_keys + CONTEXT_KEYS + DECODE_KEYS
                  + DECODE2_KEYS + STALL_KEYS + CACHE_KEYS + STREAM_KEYS
                  + SCHED_KEYS + SLO_KEYS + RESIL_KEYS + WRITE_KEYS
-                 + audit_keys) + 2
+                 + RESUME_KEYS + audit_keys) + 2
     # every rendered cell folds into ONE column width, or rows misalign
     col_w = max(max(len(n) for n, _ in rounds) + 2, 12,
                 *(len(c) + 2 for cs in audit_cells.values() for c in cs),
@@ -470,6 +498,13 @@ def main(argv: list[str]) -> int:
         print("write path (engine checkpoint vs pickle + warm-spill "
               "epoch; spill_cache_miss_bytes=0 = zero source reads):")
         for k in WRITE_KEYS:
+            print(k.ljust(name_w)
+                  + "".join(cell(d, k).rjust(col_w) for _, d in rounds))
+    if have_resume:
+        print("resume (kill/restart harness: resume_ok=1 = bit-identical "
+              "continue, no epoch replay, no orphans; async-save stall "
+              "vs sync wall):")
+        for k in RESUME_KEYS:
             print(k.ljust(name_w)
                   + "".join(cell(d, k).rjust(col_w) for _, d in rounds))
     if audit_keys:

@@ -46,6 +46,9 @@ LOCK_RANKS = {
     "app.vision_futs": 11,     # streamed-batch decode future list
     "app.jpeg_errs": 12,       # DecodePool error tally
     "app.parquet_footer": 13,  # footer read-once (takes engine reads)
+    "app.ckpt_async": 14,      # AsyncCheckpointer writer bookkeeping
+                               # (ISSUE 14; holds only for latch/future
+                               # swaps — commits run outside it)
     # -- band: scheduler -----------------------------------------------------
     "sched.arbiter": 20,       # IoScheduler._cond (the fair-drain core)
     "sched.admission": 21,     # AdmissionGate._cond
