@@ -1029,6 +1029,44 @@ def main() -> int:
                   file=sys.stderr)
             flush_partial(**loader_res)
 
+        # ISSUE 15: distributed data plane — a 2-process CPU-mesh ingest
+        # over a shared engine-written fixture: per-host engines + hot
+        # caches, balanced file ownership, and the peer extent service
+        # (an extent hot on host A serves host B over the socket).
+        # dist_ok=1 = every worker's batch stream bit-identical to the
+        # single-process pipeline; dist_peer_hit_ratio = share of
+        # assembled batch bytes served peer-to-peer instead of duplicate
+        # SSD reads (seeded row stream -> same-run-stable);
+        # dist_engine_ingest_bytes = 0 is the zero-duplicate-read
+        # invariant. Keys copy via the single-sourced DIST_BENCH_FIELDS
+        # tuple (parity-tested like the other sections); bench_sentinel
+        # gates dist_ok up and dist_peer_hit_ratio up.
+        from strom.cli import bench_dist
+        from strom.dist.peers import DIST_BENCH_FIELDS
+
+        dsargs = argparse.Namespace(
+            file=None, size=size, block=cfg.block_size, depth=32, iters=1,
+            engine="auto", tmpdir=args.tmpdir, json=True, procs=2,
+            steps=6, batch=16, seq_len=64, files=4, records=128, seed=0,
+            mode="host", devices_per_proc=1, fault_plan="",
+            metrics_port=args.metrics_port)
+        dsres = attempt("dist", lambda: bench_dist(dsargs)) \
+            if phase_ok("dist", 120) else None
+        if dsres is not None:
+            for k in DIST_BENCH_FIELDS:
+                if k in dsres:
+                    loader_res[k] = dsres[k]
+            print(f"dist: {dsres.get('dist_procs')} procs ok="
+                  f"{dsres.get('dist_ok')} "
+                  f"{dsres.get('dist_items_per_s')} items/s "
+                  f"(single {dsres.get('dist_single_items_per_s')}), "
+                  f"peer_hit_ratio={dsres.get('dist_peer_hit_ratio')} "
+                  f"({dsres.get('dist_peer_hit_bytes')}B peer-served, "
+                  f"{dsres.get('dist_engine_ingest_bytes')}B duplicate "
+                  f"engine reads, {dsres.get('dist_worker_errors')} peer "
+                  f"errors)", file=sys.stderr)
+            flush_partial(**loader_res)
+
     # --- numerator: one streamed memcpy_ssd2tpu ----------------------------
     # (engine reads piece k+1 while piece k streams host->HBM)
     # Capped at 512MiB: the relay link's token bucket holds ~0.5-1 GiB of

@@ -2209,6 +2209,82 @@ def bench_resume(args: argparse.Namespace) -> dict:
     return out
 
 
+def bench_dist(args: argparse.Namespace) -> dict:
+    """Distributed data plane arm (ISSUE 15 tentpole): an N-process
+    CPU-mesh ingest over a shared engine-written token fixture. Each
+    worker owns a balanced file shard (``multihost.assign_balanced``),
+    warms it into its hot cache, serves it to peers over the extent
+    service (strom/dist/peers.py), and assembles its slice of every
+    global batch through the full delivery plan — rows backed by another
+    host's files arrive over the socket, not as duplicate SSD reads.
+
+    ``dist_ok=1`` folds the acceptance: every worker exited 0 AND every
+    per-host batch stream was bit-identical to the single-process
+    pipeline; ``dist_peer_hit_ratio`` is the share of assembled batch
+    bytes served peer-to-peer; ``dist_engine_ingest_bytes`` must be 0
+    when ownership warming covered the dataset (no duplicate SSD reads).
+    A single-process pass rates the same row stream for ``dist_vs_single``.
+    Keys single-sourced in ``strom.dist.peers.DIST_BENCH_FIELDS``."""
+    import shutil
+
+    from strom.config import StromConfig
+    from strom.delivery.core import StromContext
+    from strom.dist.launch import measure_ingest
+    from strom.dist.peers import DIST_BENCH_FIELDS  # noqa: F401 (contract)
+    from strom.formats.rawbin import write_token_shard
+
+    wd = os.path.join(args.tmpdir, "strom_bench_dist")
+    shutil.rmtree(wd, ignore_errors=True)
+    data_dir = os.path.join(wd, "data")
+    os.makedirs(data_dir, exist_ok=True)
+    # fixture through the ENGINE write path (ISSUE 13 contract: fixtures
+    # are generated by the machinery that later reads them)
+    rng = np.random.default_rng(args.seed)
+    ctx = StromContext(StromConfig(engine=args.engine, queue_depth=8,
+                                   num_buffers=16))
+    try:
+        for i in range(args.files):
+            write_token_shard(
+                ctx, os.path.join(data_dir, f"shard{i}.bin"),
+                rng.integers(0, 32000, (args.records, args.seq_len),
+                             dtype=np.int32))
+    finally:
+        ctx.close()
+
+    worker_engine = args.engine if args.engine != "auto" else "python"
+    single = measure_ingest(
+        1, os.path.join(wd, "single"), data_dir=data_dir, steps=args.steps,
+        batch=args.batch, seq_len=args.seq_len, seed=args.seed,
+        engine=worker_engine, mode=args.mode,
+        devices_per_proc=args.devices_per_proc)
+    multi = measure_ingest(
+        args.procs, os.path.join(wd, "multi"), data_dir=data_dir,
+        steps=args.steps, batch=args.batch, seq_len=args.seq_len,
+        seed=args.seed, engine=worker_engine, mode=args.mode,
+        devices_per_proc=args.devices_per_proc,
+        # chaos rides the WORKERS (peer-op rules fire on their fetch
+        # streams); the single-process baseline has no peers to chaos
+        fault_plan=getattr(args, "fault_plan", "") or "")
+    workers = multi.pop("workers")
+    single_rate = single.get("dist_items_per_s") or 0.0
+    out = {
+        "bench": "dist",
+        "procs": args.procs, "mode": args.mode, "engine": worker_engine,
+        "batch": args.batch, "seq_len": args.seq_len, "files": args.files,
+        **{k: v for k, v in multi.items()},
+        "dist_single_items_per_s": single_rate,
+        "dist_vs_single":
+            round(multi["dist_items_per_s"] / single_rate, 3)
+            if single_rate else None,
+        # single-process pass must itself be clean, or vs_single is noise
+        "dist_single_ok": single.get("dist_ok"),
+        "dist_worker_errors": sum(w.get("peer_errors", 0)
+                                  for w in workers),
+    }
+    shutil.rmtree(wd, ignore_errors=True)
+    return out
+
+
 def bench_all(args: argparse.Namespace) -> dict:
     """Every BASELINE config in one run (quick shapes): nvme raw baseline,
     ssd2host framework ratio, ssd2tpu delivered, resnet/vit/llama loaders
@@ -2694,6 +2770,41 @@ def main(argv: list[str] | None = None) -> int:
                        choices=["KILL", "TERM"],
                        help="how the victim trainer dies")
     p_res.set_defaults(fn=bench_resume)
+
+    p_dist = sub.add_parser(
+        "dist",
+        help="ISSUE 15 distributed data plane arm: N-process ingest over "
+             "a shared engine-written token fixture — per-host engines, "
+             "balanced shard ownership, peer extent service (an extent "
+             "hot on host A serves host B over the socket, no duplicate "
+             "SSD read). dist_ok=1 = every worker bit-identical to the "
+             "single-process pipeline; dist_peer_hit_ratio = batch bytes "
+             "served peer-to-peer (keys single-sourced in "
+             "strom.dist.peers.DIST_BENCH_FIELDS)")
+    common(p_dist)
+    p_dist.add_argument("--procs", type=int, default=2,
+                        help="worker processes (each its own engine + "
+                             "cache + peer server)")
+    p_dist.add_argument("--steps", type=int, default=6)
+    p_dist.add_argument("--batch", type=int, default=16,
+                        help="GLOBAL batch rows per step (split across "
+                             "the workers)")
+    p_dist.add_argument("--seq-len", type=int, dest="seq_len", default=64)
+    p_dist.add_argument("--files", type=int, default=4,
+                        help="fixture shard files (ownership is balanced "
+                             "across workers by size)")
+    p_dist.add_argument("--records", type=int, default=128,
+                        help="rows per fixture shard")
+    p_dist.add_argument("--seed", type=int, default=0)
+    p_dist.add_argument("--mode", default="host", choices=["host", "mesh"],
+                        help="host = numpy assembly (jax-free workers); "
+                             "mesh = jax.distributed + per-host "
+                             "memcpy_ssd2tpu into "
+                             "make_array_from_single_device_arrays")
+    p_dist.add_argument("--devices-per-proc", type=int,
+                        dest="devices_per_proc", default=1,
+                        help="virtual CPU devices per worker (mesh mode)")
+    p_dist.set_defaults(fn=bench_dist)
 
     p_daemon = sub.add_parser(
         "daemon",

@@ -240,6 +240,19 @@ class StromConfig:
     # 0 disables admission control.
     sched_high_water: float = 0.9
 
+    # distributed data plane (strom/dist — ISSUE 15 tentpole): the peer
+    # extent service's knobs. A context with peers attached
+    # (ctx.attach_peers) probes them in the delivery consult AFTER local
+    # RAM/spill and BEFORE the engine: an extent hot on another host
+    # arrives over the socket instead of a duplicate SSD read. Fetch
+    # failures fall back to the local engine (never fatal); a dead peer
+    # trips a per-peer circuit breaker.
+    dist_peer_timeout_s: float = 0.5   # per-fetch connect/recv timeout: a
+                                       # slow peer costs at most this
+                                       # before the local engine serves
+    dist_server_max_conns: int = 8     # bounded peer-server concurrency;
+                                       # excess connects queue in accept
+
     # NUMA affinity (multi-socket hosts): pin submitting threads to the NVMe's
     # home node, mbind staging slabs there, optionally steer the device IRQs
     # (needs root). Off by default; no-op on UMA boxes (strom/utils/numa.py).
@@ -419,6 +432,10 @@ class StromConfig:
             raise ValueError("request_deadline_s must be >= 0 (0 = none)")
         if not 0.0 < self.breaker_error_rate <= 1.0:
             raise ValueError("breaker_error_rate must be in (0, 1]")
+        if self.dist_peer_timeout_s <= 0:
+            raise ValueError("dist_peer_timeout_s must be > 0")
+        if self.dist_server_max_conns < 1:
+            raise ValueError("dist_server_max_conns must be >= 1")
 
     @property
     def resolved_stripe_window_bytes(self) -> int:

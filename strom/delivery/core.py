@@ -522,6 +522,14 @@ class StromContext:
                 except OSError:
                     self.scope.add("spill_errors")
             self._hot_cache.spill = self._spill
+        # distributed data plane (ISSUE 15 tentpole, strom/dist): the peer
+        # extent service. serve_peers() starts the exporter (other hosts
+        # read THIS host's hot extents over the socket); attach_peers()
+        # wires the client tier the delivery consult probes after local
+        # RAM/spill and before the engine. Both None = single-host
+        # behavior unchanged.
+        self._peer_tier = None
+        self._peer_server = None
         # in-flight DEMAND gathers (not readahead): the readahead thread
         # checks this between engine-budget-sized slices and yields, so a
         # consumer's read never queues behind more than one warming slice
@@ -696,6 +704,56 @@ class StromContext:
                     # way its resident one is
                     self._spill.set_partition(name, hot_cache_bytes)
             return t
+
+    # -- distributed data plane (ISSUE 15, strom/dist) ----------------------
+    @property
+    def peer_tier(self):
+        """The peer extent client when :meth:`attach_peers` wired one,
+        else None (strom/dist/peers.py)."""
+        return self._peer_tier
+
+    @property
+    def peer_server(self):
+        """The peer extent exporter when :meth:`serve_peers` started one,
+        else None."""
+        return self._peer_server
+
+    def serve_peers(self, port: int = 0, host: str = "127.0.0.1") -> str:
+        """Start the peer extent service: a bounded threaded TCP server
+        exporting this context's hot-cache/spill extents by their
+        ``(path, physical offset)`` keys. Served bytes are billed to a
+        background-class ``"peer"`` tenant through the scheduler, so peer
+        traffic can never starve local demand. Returns the bound
+        ``host:port`` (port 0 = ephemeral); idempotent."""
+        if self._closed:
+            raise RuntimeError("StromContext is closed")
+        if self._peer_server is not None:
+            return self._peer_server.addr
+        if self._scheduler is not None:
+            self.register_tenant("peer", priority="background")
+        from strom.dist.peers import PeerServer
+
+        self._peer_server = PeerServer(
+            self, host=host, port=port,
+            max_conns=self.config.dist_server_max_conns)
+        return self._peer_server.addr
+
+    def attach_peers(self, peers, owner_fn=None) -> None:
+        """Wire the peer tier of the delivery consult: *peers* maps a
+        peer name to its ``host:port`` (or is a plain address list);
+        *owner_fn* maps a dataset path to the peer name expected to have
+        it hot (None/unknown = straight to the engine). Fetch failures
+        and timeouts fall back to the local engine read — never fatal —
+        and a dead peer trips a per-peer circuit breaker. Replaces any
+        previously attached tier."""
+        from strom.dist.peers import PeerTier
+
+        if self._peer_tier is not None:
+            self._peer_tier.close()
+        self._peer_tier = PeerTier(
+            peers, owner_fn=owner_fn, scope=self.scope,
+            timeout_s=self.config.dist_peer_timeout_s,
+            plan=getattr(self.engine, "plan", None))
 
     @contextlib.contextmanager
     def engine_exclusive(self, nbytes: int = 0, tenant: str | None = None):
@@ -1023,22 +1081,65 @@ class StromContext:
         (and re-offer themselves for RAM promotion — the hierarchy works in
         both directions), never reaching the source engine and never
         counting as ``cache_miss_bytes``; only TRUE misses (neither tier)
-        do."""
+        do.
+
+        With a peer tier attached (ISSUE 15, ``ctx.attach_peers``), TRUE
+        misses probe the PEERS last — RAM → spill → peer → engine: an
+        extent hot on another host arrives over the socket (and promotes
+        into the local cache) instead of a duplicate SSD read. Peer-served
+        bytes count as hits, never as ``cache_miss_bytes``; a fetch
+        failure/timeout/open-breaker falls through to the engine. *cache*
+        may be None here (a peered context without a hot cache still
+        probes peers); ``warm=True`` never probes peers — readahead must
+        not generate network traffic."""
         cache_hit = 0
+        peer_hit = 0
         t0 = _events_ring.now_us()
         miss_chunks: list[tuple[int, int, int, int]] = []
         hit_ranges: list[tuple[int, int]] = []
         pinned: list = []
-        spill = getattr(cache, "spill", None)
+        spill = getattr(cache, "spill", None) if cache is not None else None
+        peers = self._peer_tier if (not warm and dflat is not None) else None
         spill_served = 0
+
+        def true_miss(fi: int, path, fo: int, do: int, s: int, t: int, *,
+                      deferred: bool) -> None:
+            """Neither RAM nor spill holds [s, t): probe the peer tier,
+            engine on miss. *deferred* = the cache lookup left miss
+            counting to us (a peer hit must not read as a cache miss)."""
+            nonlocal cache_hit, peer_hit
+            if peers is not None and path is not None:
+                data = peers.fetch(path, s, t)
+                if data is not None:
+                    d_lo = do + (s - fo)
+                    dflat[d_lo: d_lo + (t - s)] = data
+                    hit_ranges.append((d_lo, d_lo + (t - s)))
+                    cache_hit += t - s
+                    peer_hit += t - s
+                    if cache is not None:
+                        # promote like a spill hit: the NEXT request is a
+                        # RAM hit, and this host can serve it onward
+                        cache.admit(path, s, t,
+                                    dflat[d_lo: d_lo + (t - s)],
+                                    tenant=tenant)
+                    return
+            miss_chunks.append((fi, s, do + (s - fo), t - s))
+            if deferred and cache is not None and not warm:
+                cache.note_miss(t - s)
+
         for fi, fo, do, ln in chunks:
             path = idx_paths.get(fi)
             if path is None:  # untracked fd: bypass the cache
                 miss_chunks.append((fi, fo, do, ln))
                 continue
-            hits, misses, pins = cache.lookup(path, fo, fo + ln,
-                                              record=not warm,
-                                              count_misses=spill is None)
+            if cache is None:
+                # no hot cache, peers attached: every range is a RAM/spill
+                # miss by construction
+                true_miss(fi, path, fo, do, fo, fo + ln, deferred=False)
+                continue
+            hits, misses, pins = cache.lookup(
+                path, fo, fo + ln, record=not warm,
+                count_misses=spill is None and peers is None)
             pinned.extend(pins)
             for s, t, view in hits:
                 if not warm:  # warm mode discards dest: skip the copy
@@ -1047,7 +1148,8 @@ class StromContext:
                 cache_hit += t - s
             if spill is None:
                 for s, t in misses:
-                    miss_chunks.append((fi, s, do + (s - fo), t - s))
+                    true_miss(fi, path, fo, do, s, t,
+                              deferred=peers is not None)
                 continue
             for s, t in misses:
                 sp_hits, sp_misses = spill.lookup(path, s, t,
@@ -1091,20 +1193,25 @@ class StromContext:
                 finally:
                     spill.unpin([e for _, _, e in sp_hits])
                 for ss, tt in sp_misses:
-                    miss_chunks.append((fi, ss, do + (ss - fo), tt - ss))
-                    if not warm:
-                        cache.note_miss(tt - ss)
-        cache.unpin(pinned)
+                    true_miss(fi, path, fo, do, ss, tt, deferred=True)
+        if cache is not None:
+            cache.unpin(pinned)
         if spill_served:
             _request.complete(t0, _events_ring.now_us() - t0,
                               "cache", "spill.serve",
                               {"bytes": spill_served})
-        if cache_hit and not warm:
+        if peer_hit:
+            # request-tagged (ISSUE 8 contract): which request rode the
+            # peer tier instead of re-reading the SSD
+            _request.complete(t0, _events_ring.now_us() - t0,
+                              "dist", "peer.serve",
+                              {"bytes": peer_hit})
+        if cache_hit - peer_hit > 0 and not warm:
             # request-tagged (ISSUE 8): which request the RAM-served bytes
             # belonged to — cache hits are why a "slow path" request isn't
             _request.complete(t0, _events_ring.now_us() - t0,
                               "cache", "cache.serve",
-                              {"bytes": cache_hit})
+                              {"bytes": cache_hit - peer_hit})
         return miss_chunks, cache_hit, hit_ranges
 
     def _read_segments(self, source: "Source",
@@ -1168,7 +1275,7 @@ class StromContext:
                 cache = None
             cache_hit = 0
             dflat: np.ndarray | None = None
-            if cache is not None and chunks:
+            if (cache is not None or self._peer_tier is not None) and chunks:
                 dflat = dest if dest.ndim == 1 and dest.dtype == np.uint8 \
                     else dest.reshape(-1).view(np.uint8)
                 chunks, cache_hit, _ = self._consult_cache(
@@ -1927,7 +2034,8 @@ class StromContext:
         never recomputes the expensive stall-attribution section (ISSUE 6
         satellite). None = every section (the pre-existing contract).
         Known sections: context, decode, stream, steps, cache, spill,
-        slab_pool, engine, sched, slo, exemplars, resilience, scopes."""
+        dist, slab_pool, engine, sched, slo, exemplars, resilience,
+        scopes."""
         want = None if sections is None else set(sections)
 
         def wanted(name: str) -> bool:
@@ -2065,6 +2173,18 @@ class StromContext:
             out["cache"] = self._hot_cache.stats()
         if wanted("spill") and self._spill is not None:
             out["spill"] = self._spill.stats()
+        # distributed data plane (ISSUE 15): peer-tier client traffic
+        # (hits/misses/errors/rtt) + exporter serve counters, keyed by the
+        # single-sourced DIST_FIELDS names so the exposition and the bench
+        # columns derived from them cannot drift
+        if wanted("dist") and (self._peer_tier is not None
+                               or self._peer_server is not None):
+            d: dict = {}
+            if self._peer_tier is not None:
+                d.update(self._peer_tier.stats())
+            if self._peer_server is not None:
+                d.update(self._peer_server.stats())
+            out["dist"] = d
         if wanted("slab_pool") and self._slab_pool is not None:
             out["slab_pool"] = self._slab_pool.stats()
         if wanted("engine"):
@@ -2116,6 +2236,13 @@ class StromContext:
             return
         self._closed = True
         _request.remove_observer(self._slo_observer)
+        # peer service down first: no new serve can start a cache/spill
+        # read (or a scheduler grant) against a closing context, and the
+        # consult stops probing peers before the engine goes away
+        if self._peer_server is not None:
+            self._peer_server.close()
+        if self._peer_tier is not None:
+            self._peer_tier.close()
         if self._metrics_server is not None:
             self._metrics_server.close()
         if self._history is not None:

@@ -158,7 +158,11 @@ class StreamingGather:
                 self._cache = cache
                 self._instant: list[tuple[int, int]] = []
                 hit_bytes = 0
-                if cache is not None and chunks:
+                # peer tier included (ISSUE 15): peer-served ranges surface
+                # as INSTANT completions exactly like cache hits — the
+                # consult handles cache=None for peered cacheless contexts
+                if (cache is not None or ctx._peer_tier is not None) \
+                        and chunks:
                     chunks, hit_bytes, self._instant = ctx._consult_cache(
                         cache, chunks, idx_paths, self._dflat,
                         tenant=self._tenant)

@@ -1,0 +1,23 @@
+"""Distributed data plane (ISSUE 15 tentpole).
+
+``strom/dist`` promotes the repo from one-process lowering dry-runs
+(ROADMAP item 4) to a real N-process data plane:
+
+- :mod:`strom.dist.peers` — the peer extent service: each host runs a
+  small threaded TCP server exporting its hot-cache/spill extents by the
+  host-stable ``(path, physical offset)`` keys, and the delivery consult
+  gains a peer tier probed after local RAM/spill and before the engine —
+  a host that has an extent hot serves it to peers over the socket
+  instead of every host re-reading the SSD.
+- :mod:`strom.dist.launch` — the launcher/runtime: N worker processes,
+  each owning a deterministic shard of the dataset
+  (``multihost.assign_balanced``) and a per-host :class:`StromContext`,
+  with global-batch assembly via per-host ``memcpy_ssd2tpu`` into
+  ``jax.make_array_from_single_device_arrays`` and epoch barriers from
+  ``strom/parallel/multihost.py``.
+"""
+
+from strom.dist.peers import (DIST_BENCH_FIELDS, DIST_FIELDS, PeerServer,
+                              PeerTier)
+
+__all__ = ["DIST_FIELDS", "DIST_BENCH_FIELDS", "PeerServer", "PeerTier"]

@@ -1,0 +1,556 @@
+"""Peer extent service (ISSUE 15 tentpole, front 2).
+
+Every host in the distributed data plane already keys its hot cache and
+spill tier by ``(path, physical offset)`` — the one identity that is
+stable ACROSS hosts too (the dataset files are shared). This module turns
+that into a cooperative cache tier:
+
+- :class:`PeerServer` — a small threaded TCP server each host runs,
+  exporting its locally-hot extents: a request names ``(path, lo, hi)``
+  and the server answers with the bytes when the WHOLE range is resident
+  in its hot cache or spill tier (RAM first, spill preads for the rest),
+  or a one-byte miss. Serving never touches the source engine — the
+  zero-duplicate-SSD-read invariant tests/test_dist.py pins. Concurrency
+  is bounded (``dist_server_max_conns``), and the local read is billed to
+  a background-class ``"peer"`` tenant through the PR-7 scheduler, so
+  peer traffic can never starve local demand.
+- :class:`PeerTier` — the client side, probed by the delivery consult
+  (``StromContext._consult_cache``) after local RAM/spill and before the
+  engine. One persistent connection per peer; fetch failures/timeouts
+  are NEVER fatal (the range falls back to the local engine read), and a
+  dead peer trips a per-peer :class:`~strom.engine.resilience.CircuitBreaker`
+  so a down host costs one cooldown, not a timeout per request.
+
+Framing is length-prefixed binary: every frame is ``u32 payload length``
+followed by the payload, so a truncated frame (mid-stream hangup, the
+``chaos_net`` fault preset) is detected as a short read, never parsed as
+data. Requests: ``op u8 | path_len u16 | path | lo u64 | hi u64``.
+Responses: ``status u8 | bytes`` (status 0 = hit, 1 = miss).
+
+Counters (``DIST_FIELDS``, the ``stats()["dist"]`` section → /metrics):
+client ``peer_hit_bytes``/``peer_hits``/``peer_misses``/``peer_errors``/
+``peer_skips`` + the ``peer_rtt`` histogram, server ``peer_served_bytes``/
+``peer_serves``/``peer_serve_misses``, breaker ``peer_breaker_trips`` and
+the ``peer_breaker_open`` gauge.
+
+Lock discipline (tools/stromlint ``dist.peer``/``dist.server`` ranks):
+neither lock is ever held across socket I/O — the client lock checks a
+connection out and back in, the server lock guards only counters.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import socket
+import struct
+import threading
+import time
+from typing import Callable, Mapping, Sequence
+
+import numpy as np
+
+from strom.engine.resilience import CircuitBreaker
+from strom.utils.locks import make_lock
+
+# The dist section of ``StromContext.stats()`` (→ /stats, /metrics),
+# single-sourced so the exposition, the bench columns derived from it and
+# tools/lint_stats_names.py cannot drift from the producer — the same
+# contract CACHE_BENCH_FIELDS / SPILL_FIELDS enforce.
+DIST_FIELDS = (
+    "peer_hit_bytes",
+    "peer_hits",
+    "peer_misses",
+    "peer_errors",
+    "peer_skips",
+    "peer_rtt_p50_us",
+    "peer_rtt_p99_us",
+    "peer_served_bytes",
+    "peer_serves",
+    "peer_serve_misses",
+    "peer_breaker_trips",
+    "peer_breaker_open",
+)
+
+# bench-JSON columns the dist arm emits (cli.py bench_dist → bench.py copy
+# loop → compare_rounds "distributed" section; parity-tested like
+# CACHE_BENCH_FIELDS). dist_ok folds the whole acceptance into one bit:
+# every worker exited 0 AND every per-host batch stream was bit-identical
+# to the single-process pipeline's corresponding rows.
+DIST_BENCH_FIELDS = (
+    "dist_ok",
+    "dist_procs",
+    "dist_steps",
+    "dist_items_per_s",
+    "dist_single_items_per_s",
+    "dist_vs_single",
+    "dist_peer_hit_ratio",
+    "dist_peer_hit_bytes",
+    "dist_peer_served_bytes",
+    "dist_engine_ingest_bytes",
+    "dist_assembly_wait_p99_us",
+    "dist_peer_rtt_p99_us",
+)
+
+# wire protocol ------------------------------------------------------------
+OP_GET = 1
+ST_HIT, ST_MISS = 0, 1
+_LEN = struct.Struct("!I")
+_REQ_HEAD = struct.Struct("!BH")
+_REQ_RANGE = struct.Struct("!QQ")
+# sanity bound on any single frame: an extent-sized response, never a
+# whole-file stream (the consult asks per miss run, which is bounded by
+# the gather's chunking) — a corrupt length prefix fails fast instead of
+# allocating gigabytes
+MAX_FRAME = 64 * 1024 * 1024
+
+
+class PeerProtocolError(RuntimeError):
+    """Malformed or truncated peer frame (hangup mid-stream included)."""
+
+
+def send_frame(sock: socket.socket, payload) -> None:
+    """One length-prefixed frame. *payload* is bytes-like (a list/tuple
+    concatenates without an intermediate copy of the data part)."""
+    if isinstance(payload, (list, tuple)):
+        head = _LEN.pack(sum(len(p) for p in payload))
+        sock.sendall(head)
+        for p in payload:
+            sock.sendall(p)
+        return
+    sock.sendall(_LEN.pack(len(payload)))
+    sock.sendall(payload)
+
+
+def recv_exact(sock: socket.socket, n: int) -> bytearray:
+    """Exactly *n* bytes or :class:`PeerProtocolError` (EOF mid-frame is
+    how a killed peer looks from here)."""
+    buf = bytearray(n)
+    view = memoryview(buf)
+    got = 0
+    while got < n:
+        r = sock.recv_into(view[got:], n - got)
+        if r == 0:
+            raise PeerProtocolError(
+                f"peer hung up mid-frame ({got}/{n} bytes)")
+        got += r
+    return buf
+
+
+def recv_frame(sock: socket.socket, max_len: int = MAX_FRAME) -> bytearray:
+    (n,) = _LEN.unpack(recv_exact(sock, _LEN.size))
+    if n > max_len:
+        raise PeerProtocolError(f"frame of {n} bytes exceeds cap {max_len}")
+    return recv_exact(sock, n)
+
+
+def encode_request(path: str, lo: int, hi: int) -> bytes:
+    p = path.encode("utf-8")
+    if len(p) > 0xFFFF:
+        raise ValueError(f"path too long for the wire ({len(p)} bytes)")
+    return _REQ_HEAD.pack(OP_GET, len(p)) + p + _REQ_RANGE.pack(lo, hi)
+
+
+def decode_request(payload) -> tuple[str, int, int]:
+    if len(payload) < _REQ_HEAD.size + _REQ_RANGE.size:
+        raise PeerProtocolError(f"request frame too short ({len(payload)})")
+    op, plen = _REQ_HEAD.unpack_from(payload, 0)
+    if op != OP_GET:
+        raise PeerProtocolError(f"unknown peer op {op}")
+    end = _REQ_HEAD.size + plen
+    if len(payload) != end + _REQ_RANGE.size:
+        raise PeerProtocolError("request frame length mismatch")
+    path = bytes(payload[_REQ_HEAD.size: end]).decode("utf-8")
+    lo, hi = _REQ_RANGE.unpack_from(payload, end)
+    if hi < lo:
+        raise PeerProtocolError(f"bad range [{lo}, {hi})")
+    return path, lo, hi
+
+
+def split_addr(addr: str) -> tuple[str, int]:
+    host, _, port = addr.rpartition(":")
+    return host, int(port)
+
+
+class PeerServer:
+    """Threaded TCP exporter of one context's hot extents.
+
+    Serving reads ONLY the local RAM/spill tiers — a range not fully
+    resident answers miss, never a source read (the whole point is that
+    the OWNER already paid the SSD read once). The local copy out of the
+    tiers runs under a background-class scheduler grant billed to the
+    ``"peer"`` tenant (registered by ``StromContext.serve_peers``), so a
+    storm of peer requests queues behind every local demand gather.
+    """
+
+    def __init__(self, ctx, host: str = "127.0.0.1", port: int = 0, *,
+                 max_conns: int = 8):
+        self._ctx = ctx
+        self._scope = ctx.scope
+        self._closed = False
+        self._lock = make_lock("dist.server")
+        self._sem = threading.Semaphore(max(int(max_conns), 1))
+        self.served_bytes = 0
+        self.serves = 0
+        self.serve_misses = 0
+        self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._sock.bind((host, port))
+        self._sock.listen(16)
+        self._host, self._port = self._sock.getsockname()[:2]
+        self._accept = threading.Thread(target=self._run_accept,
+                                        name="strom-peer-accept",
+                                        daemon=True)
+        self._accept.start()
+
+    @property
+    def addr(self) -> str:
+        return f"{self._host}:{self._port}"
+
+    # -- accept / serve -----------------------------------------------------
+    def _run_accept(self) -> None:
+        n = 0
+        while not self._closed:
+            try:
+                conn, _ = self._sock.accept()
+            except OSError:
+                return  # listener closed
+            n += 1
+            threading.Thread(target=self._serve_conn, args=(conn,),
+                             name=f"strom-peer-serve-{n}",
+                             daemon=True).start()
+
+    def _serve_conn(self, conn: socket.socket) -> None:
+        try:
+            conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            while not self._closed:
+                try:
+                    path, lo, hi = decode_request(recv_frame(conn))
+                except (PeerProtocolError, OSError, ValueError):
+                    return  # peer went away / spoke garbage: drop the conn
+                # bounded concurrency PER REQUEST, not per connection:
+                # every remote host keeps one pooled conn open for its
+                # lifetime, so a connection-scoped slot would wedge the
+                # service the moment peers outnumber max_conns — only
+                # in-flight local reads hold a slot, any number of idle
+                # conns park here costing a blocked thread each
+                with self._sem:
+                    # stromlint: ignore[lock-order] -- counting semaphore,
+                    # not a mutex: N independent slots can't nest or
+                    # invert, and the billed read under it enters the
+                    # hierarchy at the scheduler band exactly as it
+                    # would uncontended
+                    data = self._serve_range(path, lo, hi)
+                try:
+                    if data is None:
+                        send_frame(conn, bytes([ST_MISS]))
+                    else:
+                        send_frame(conn, (bytes([ST_HIT]), data.data))
+                except OSError:
+                    return
+                n = 0 if data is None else data.nbytes
+                with self._lock:
+                    if data is None:
+                        self.serve_misses += 1
+                    else:
+                        self.serves += 1
+                        self.served_bytes += n
+                if data is None:
+                    self._scope.add("peer_serve_misses")
+                else:
+                    self._scope.add("peer_serves")
+                    self._scope.add("peer_served_bytes", n)
+        finally:
+            with contextlib.suppress(OSError):
+                conn.close()
+
+    def _serve_range(self, path: str, lo: int, hi: int
+                     ) -> "np.ndarray | None":
+        """The billed local read: full-range coverage from RAM + spill, or
+        None (a partial range is a miss — the asker's engine read is
+        cheaper than a split conversation)."""
+        n = hi - lo
+        if n <= 0 or n + 1 > MAX_FRAME or self._closed:
+            return None
+        sched = getattr(self._ctx, "scheduler", None)
+        try:
+            if sched is not None:
+                # billed serve (ISSUE 15): one background-class grant per
+                # range — demand gathers of every local tenant outrank it
+                # in the fair drain, and the per-tenant budget/accounting
+                # machinery sees peer traffic like any other tenant's.
+                # Held across the tier memcpy/pread only, NEVER across
+                # socket I/O (the caller sends after we return).
+                with sched.grant("peer", n, priority="background"):
+                    return self._read_local(path, lo, hi)
+            return self._read_local(path, lo, hi)
+        # stromlint: ignore[swallowed-exceptions] -- advisory service: any
+        # local failure (closing context, deadline on the grant) answers
+        # miss and is visible as peer_serve_misses; the asker falls back
+        # to its own engine
+        except Exception:
+            return None
+
+    def _read_local(self, path: str, lo: int, hi: int
+                    ) -> "np.ndarray | None":
+        cache = getattr(self._ctx, "hot_cache", None)
+        if cache is None or not cache.enabled:
+            return None
+        n = hi - lo
+        out = np.empty(n, np.uint8)
+        hits, misses, pinned = cache.lookup(path, lo, hi, record=False)
+        try:
+            for s, t, view in hits:
+                out[s - lo: t - lo] = view
+            if misses:
+                spill = cache.spill
+                if spill is None:
+                    return None
+                for s, t in misses:
+                    sp_hits, sp_misses = spill.lookup(path, s, t,
+                                                      record=False)
+                    try:
+                        if sp_misses:
+                            return None
+                        for ss, tt, ent in sp_hits:
+                            spill.read_into(ent, ss, tt,
+                                            out[ss - lo: tt - lo])
+                    finally:
+                        spill.unpin([e for _, _, e in sp_hits])
+        finally:
+            cache.unpin(pinned)
+        return out
+
+    # -- introspection / lifecycle ------------------------------------------
+    def stats(self) -> dict:
+        with self._lock:
+            return {"peer_served_bytes": self.served_bytes,
+                    "peer_serves": self.serves,
+                    "peer_serve_misses": self.serve_misses}
+
+    def close(self) -> None:
+        if self._closed:
+            return
+        self._closed = True
+        with contextlib.suppress(OSError):
+            self._sock.close()
+        self._accept.join(timeout=5)
+
+
+class _PeerState:
+    """Client-side per-peer state: one pooled connection (checked out
+    under the tier lock, used outside it) and a circuit breaker."""
+
+    __slots__ = ("addr", "sock", "busy", "breaker")
+
+    def __init__(self, addr: str, breaker: CircuitBreaker):
+        self.addr = addr
+        self.sock: "socket.socket | None" = None
+        self.busy = False
+        self.breaker = breaker
+
+
+class PeerTier:
+    """The peer tier of the delivery consult: RAM → spill → PEERS → engine.
+
+    *peers* maps a peer name (any stable id — the launcher uses the rank)
+    to a ``host:port`` address; *owner_fn* maps a dataset path to the
+    name of the peer expected to have it hot (the launcher derives it
+    from the same ``assign_balanced`` shard ownership every process
+    computes), or None for "nobody — go to the engine". Without an
+    *owner_fn* every fetch is a miss: directory-less probing of N-1 peers
+    per range would be chatter, not a cache.
+
+    Failure contract: :meth:`fetch` returns the bytes or None, NEVER
+    raises — a refused connect, timeout, hangup or truncated frame counts
+    ``peer_errors``, feeds that peer's breaker, and the caller reads the
+    range from its local engine. An OPEN breaker short-circuits to None
+    (``peer_skips``) until its cooldown elapses; a half-open probe rides
+    a real fetch.
+    """
+
+    def __init__(self, peers: "Mapping[object, str] | Sequence[str]", *,
+                 owner_fn: "Callable[[str], object] | None" = None,
+                 scope=None, timeout_s: float = 0.5, plan=None,
+                 clock: Callable[[], float] = time.monotonic,
+                 breaker_kwargs: "dict | None" = None):
+        from strom.utils.stats import global_stats
+
+        if not isinstance(peers, Mapping):
+            peers = {a: a for a in peers}
+        self._scope = scope if scope is not None else global_stats
+        self._owner_fn = owner_fn
+        self._timeout = float(timeout_s)
+        self._plan = plan
+        self._lock = make_lock("dist.peer")
+        self._closed = False
+        self.breaker_trips = 0
+        bk = dict(window_s=5.0, min_events=4, error_rate=0.5,
+                  cooldown_s=1.0, half_open_successes=2)
+        bk.update(breaker_kwargs or {})
+        self._peers: dict = {}
+        for name, addr in peers.items():
+            br = CircuitBreaker(name=f"peer:{addr}", clock=clock,
+                                on_trip=self._on_trip, **bk)
+            self._peers[name] = _PeerState(str(addr), br)
+        # tallies (authoritative for stats(); mirrored into the scope)
+        self.hit_bytes = 0
+        self.hits = 0
+        self.misses = 0
+        self.errors = 0
+        self.skips = 0
+
+    def _on_trip(self, note: str) -> None:
+        with self._lock:
+            self.breaker_trips += 1
+        self._scope.add("peer_breaker_trips")
+
+    # -- the consult's probe -------------------------------------------------
+    def fetch(self, path: str, lo: int, hi: int) -> "np.ndarray | None":
+        """Bytes [lo, hi) of *path* from the owning peer, or None (miss /
+        error / breaker open / no owner). The returned array is read-only;
+        callers copy it into their dest."""
+        n = hi - lo
+        # +1: a hit response is status byte + payload in ONE frame, so the
+        # largest servable range is one byte under the frame cap
+        if n <= 0 or n + 1 > MAX_FRAME or self._closed:
+            return None
+        name = self._owner_fn(path) if self._owner_fn is not None else None
+        st = self._peers.get(name) if name is not None else None
+        if st is None:
+            return None
+        if not st.breaker.allow():
+            with self._lock:
+                self.skips += 1
+            self._scope.add("peer_skips")
+            return None
+        # network fault injection (ISSUE 15 satellite): peer-op rules of
+        # the context's fault plan decide here, in op order on the shared
+        # plan RNG — refused connect / mid-stream hangup / truncated frame
+        # produce the real outcome (a counted failure + breaker feed +
+        # engine fallback) without damaging a live socket; a latency spike
+        # delays the real fetch.
+        fault = None
+        if self._plan is not None:
+            fault = self._plan.decide(path=path, offset=lo, length=n,
+                                      op="peer")
+        if fault is not None and fault.kind == "latency":
+            time.sleep(fault.latency_s)
+            fault = None
+        if fault is not None:
+            # ephemeral: the injected failure happens BEFORE any checkout,
+            # so it must not reset a pooled slot another in-flight request
+            # owns (or discard a healthy idle connection)
+            self._fail(st, None, ephemeral=True)
+            return None
+        ephemeral = False
+        with self._lock:
+            if st.busy:
+                # the pooled conn is mid-request (concurrent per-device
+                # gathers): ride a fresh ephemeral connection instead of
+                # queueing on the socket — the server's bounded accept
+                # backpressures if this host asks too wide
+                ephemeral = True
+                sock = None
+            else:
+                st.busy = True
+                sock, st.sock = st.sock, None
+        t0 = time.perf_counter()
+        try:
+            if sock is None:
+                host, port = split_addr(st.addr)
+                sock = socket.create_connection((host, port),
+                                                timeout=self._timeout)
+                sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            sock.settimeout(self._timeout)
+            send_frame(sock, encode_request(path, lo, hi))
+            payload = recv_frame(sock)
+        except (OSError, PeerProtocolError, ValueError):
+            self._fail(st, sock, ephemeral=ephemeral)
+            return None
+        rtt_us = (time.perf_counter() - t0) * 1e6
+        status = payload[0] if payload else -1
+        if status == ST_HIT and len(payload) == 1 + n:
+            data = np.frombuffer(payload, np.uint8, count=n, offset=1)
+        elif status == ST_MISS and len(payload) == 1:
+            data = None
+        else:
+            # wrong-length hit = a truncated/corrupt frame that happened
+            # to parse: never trust it
+            self._fail(st, sock, ephemeral=ephemeral)
+            return None
+        if ephemeral:
+            with contextlib.suppress(OSError):
+                sock.close()
+        with self._lock:
+            if not ephemeral:
+                st.sock, st.busy = sock, False
+            if data is None:
+                self.misses += 1
+            else:
+                self.hits += 1
+                self.hit_bytes += n
+        st.breaker.record_success()
+        self._scope.observe_us("peer_rtt", rtt_us)
+        if data is None:
+            self._scope.add("peer_misses")
+        else:
+            self._scope.add("peer_hits")
+            self._scope.add("peer_hit_bytes", n)
+        return data
+
+    def _fail(self, st: _PeerState, sock: "socket.socket | None", *,
+              ephemeral: bool = False) -> None:
+        if sock is not None:
+            with contextlib.suppress(OSError):
+                sock.close()
+        with self._lock:
+            if not ephemeral:
+                # the pooled slot is ours to reset; an ephemeral failure
+                # must not clear another in-flight request's busy mark
+                st.busy = False
+                st.sock = None
+            self.errors += 1
+        st.breaker.record_failure()
+        self._scope.add("peer_errors")
+
+    # -- introspection / lifecycle ------------------------------------------
+    def peers_info(self) -> dict:
+        out = {}
+        for name, st in self._peers.items():
+            out[str(name)] = {"addr": st.addr, **st.breaker.info()}
+        return out
+
+    def stats(self) -> dict:
+        # the SCOPED series, not the process-global aggregate: two peered
+        # contexts in one process (daemon mode) must not read each
+        # other's latencies into their dist sections
+        h = self._scope.histogram("peer_rtt")
+        open_peers = sum(1 for st in self._peers.values()
+                         if st.breaker.state == CircuitBreaker.OPEN)
+        with self._lock:
+            out = {
+                "peer_hit_bytes": self.hit_bytes,
+                "peer_hits": self.hits,
+                "peer_misses": self.misses,
+                "peer_errors": self.errors,
+                "peer_skips": self.skips,
+                "peer_breaker_trips": self.breaker_trips,
+            }
+        out["peer_breaker_open"] = open_peers
+        out["peer_rtt_p50_us"] = h.percentile(0.50)
+        out["peer_rtt_p99_us"] = h.percentile(0.99)
+        self._scope.set_gauge("peer_breaker_open", open_peers)
+        return out
+
+    def close(self) -> None:
+        with self._lock:
+            if self._closed:
+                return
+            self._closed = True
+            socks = [st.sock for st in self._peers.values()
+                     if st.sock is not None]
+            for st in self._peers.values():
+                st.sock = None
+        for s in socks:
+            with contextlib.suppress(OSError):
+                s.close()

@@ -81,10 +81,14 @@ CHAOS_BENCH_FIELDS = (
     "chaos_hedges_fired",
 )
 
-# errnos worth a resubmit: the device/link may answer next time
+# errnos worth a resubmit: the device/link may answer next time.
+# ECONNREFUSED/ECONNRESET/EPIPE are the network-fault spellings the peer
+# tier's chaos_net preset injects (ISSUE 15): peer fetches already degrade
+# to the local engine, and a refused peer may be back next cooldown.
 TRANSIENT_ERRNOS = frozenset({
     _errno.EIO, _errno.EAGAIN, _errno.EINTR, _errno.ETIMEDOUT,
     _errno.ENXIO, _errno.EBUSY, _errno.ENODATA,
+    _errno.ECONNREFUSED, _errno.ECONNRESET, _errno.EPIPE,
 })
 # errnos where a retry is guaranteed to fail identically
 PERMANENT_ERRNOS = frozenset({

@@ -26,7 +26,7 @@ from typing import Sequence
 from strom.utils.locks import make_lock
 
 FAULT_KINDS = ("errno", "short_read", "bit_flip", "latency", "stuck",
-               "engine_death")
+               "engine_death", "hangup")
 
 
 @dataclasses.dataclass(frozen=True)
@@ -37,13 +37,21 @@ class FaultRule:
 
     - ``path``: substring of the op's registered file path
     - ``tenant``: the active traced request's tenant
-    - ``op``: ``"read"`` / ``"write"`` — the op's direction (ISSUE 13:
-      engines write now; a direction-less rule matches both, which is
-      usually wrong for presets tuned against read traffic). ``bit_flip``
-      rules never match writes regardless: flipping the CALLER's source
-      buffer would corrupt live training state, not the op (use ``errno``
-      / ``short_read`` to chaos the write path; the checkpoint layer's
-      CRC catches on-media corruption separately)
+    - ``op``: ``"read"`` / ``"write"`` / ``"peer"`` — the op's kind
+      (ISSUE 13: engines write now; a direction-less rule matches
+      everything, which is usually wrong for presets tuned against read
+      traffic). ``bit_flip`` rules never match writes regardless:
+      flipping the CALLER's source buffer would corrupt live training
+      state, not the op (use ``errno`` / ``short_read`` to chaos the
+      write path; the checkpoint layer's CRC catches on-media corruption
+      separately). ``"peer"`` ops are the network fetches of the
+      distributed data plane's peer tier (ISSUE 15,
+      strom/dist/peers.py): ``errno`` reads as a refused connect,
+      ``hangup`` as a mid-stream connection drop, ``short_read`` as a
+      truncated frame, ``latency`` as a network latency spike — all
+      applied client-side, so the real outcome (counted failure, breaker
+      feed, local-engine fallback) happens without damaging a live
+      socket
     - ``offset_lo`` / ``offset_hi``: op byte range must OVERLAP [lo, hi)
     - ``op_lo`` / ``op_hi``: plan-global op-index window [lo, hi)
     - ``every``: inject on every Nth op that passes the matchers (0 = all)
@@ -81,9 +89,9 @@ class FaultRule:
         if self.kind not in FAULT_KINDS:
             raise ValueError(f"unknown fault kind {self.kind!r} "
                              f"(one of {FAULT_KINDS})")
-        if self.op not in (None, "read", "write"):
-            raise ValueError(f"op matcher must be 'read', 'write' or None, "
-                             f"got {self.op!r}")
+        if self.op not in (None, "read", "write", "peer"):
+            raise ValueError(f"op matcher must be 'read', 'write', 'peer' "
+                             f"or None, got {self.op!r}")
         if isinstance(self.err, str):
             object.__setattr__(self, "err",
                                getattr(_errno, self.err.upper()))
@@ -190,6 +198,12 @@ class FaultPlan:
                          flip_mask=1 << self._rng.randrange(8))
         if r.kind == "latency":
             return Fault("latency", ri, latency_s=r.latency_s)
+        if r.kind == "hangup":
+            # peer-op kind (ISSUE 15): the connection drops mid-stream.
+            # Presented to an ENGINE op (a direction-less rule) it
+            # degrades to a plain transient errno — engines have no
+            # stream to hang up.
+            return Fault("hangup", ri, err=r.err)
         return Fault("stuck", ri, release_s=r.release_s)
 
     def _count_locked(self, ri: int, kind: str) -> None:
@@ -258,9 +272,28 @@ class FaultPlan:
         ], seed=seed)
 
     @classmethod
+    def chaos_net(cls, seed: int = 0) -> "FaultPlan":
+        """Network chaos for the distributed data plane (ISSUE 15
+        satellite): refused connects, mid-stream hangups, latency spikes
+        and truncated frames on the PEER fetch stream, at rates the peer
+        tier must absorb with bit-identical batches — every injected
+        failure falls back to the local engine read, so the only visible
+        cost is rate. Rules are pinned ``op="peer"``: engine read/write
+        traffic sharing the plan consumes no draws from (and is never hit
+        by) the network rules, the same isolation the ``chaos`` preset's
+        ``op="read"`` pin provides."""
+        return cls([
+            FaultRule("errno", op="peer", p=0.05, err=_errno.ECONNREFUSED),
+            FaultRule("hangup", op="peer", p=0.03),
+            FaultRule("latency", op="peer", p=0.05, latency_s=0.005),
+            FaultRule("short_read", op="peer", p=0.03, short_frac=0.5),
+        ], seed=seed)
+
+    @classmethod
     def from_spec(cls, spec: str) -> "FaultPlan":
         """``--fault-plan`` / ``StromConfig.fault_plan`` resolver: a JSON
-        file path, an inline JSON object, or ``chaos[:seed]``."""
+        file path, an inline JSON object, or a named preset —
+        ``chaos[:seed]`` / ``chaos_writes[:seed]`` / ``chaos_net[:seed]``."""
         spec = spec.strip()
         if not spec:
             raise ValueError("empty fault-plan spec")
@@ -270,6 +303,9 @@ class FaultPlan:
         if spec == "chaos_writes" or spec.startswith("chaos_writes:"):
             seed = int(spec.split(":", 1)[1]) if ":" in spec else 0
             return cls.chaos_writes(seed)
+        if spec == "chaos_net" or spec.startswith("chaos_net:"):
+            seed = int(spec.split(":", 1)[1]) if ":" in spec else 0
+            return cls.chaos_net(seed)
         if spec.lstrip().startswith("{"):
             return cls.from_doc(json.loads(spec))
         if os.path.exists(spec):

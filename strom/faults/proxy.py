@@ -155,7 +155,12 @@ class FaultyEngine(Engine):
                 passthrough.append(r)
                 caller_pos.append(i)
                 continue
-            if f.kind in ("errno", "engine_death"):
+            if f.kind in ("errno", "engine_death", "hangup"):
+                # hangup is a PEER-op kind (ISSUE 15); presented to an
+                # engine op by a direction-less rule it degrades to a
+                # plain transient errno — engines have no stream to drop,
+                # and the "stuck" fallthrough would swallow the
+                # completion forever
                 with self._lock:
                     self._synth.append(Completion(r.tag, -f.err))
                 synth_added.append((i, r.tag, f))

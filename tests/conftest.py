@@ -21,6 +21,9 @@ import pytest
 # cold-start + an 8B pp lowering) — driver-artifact work, not suite work
 # on a 1-core box; the dryrun test covers the executed 8-device matrix
 os.environ.setdefault("STROM_DRYRUN_AT_SCALE", "0")
+# same policy for the dryrun's measured 2-process dist ingest (ISSUE 15):
+# tests/test_dist.py drives the data plane directly
+os.environ.setdefault("STROM_DRYRUN_DIST", "0")
 
 
 @pytest.fixture(scope="session")

@@ -410,6 +410,42 @@ def test_resume_keys_match_producers():
             f"produces no such key (renamed column?)"
 
 
+def test_dist_keys_match_producers():
+    """Producer↔report key parity for the distributed section (ISSUE 15
+    tentpole, the decode/stall/cache/stream/sched/slo/resil/write/resume
+    pattern): every compare_rounds dist column must be a key the dist
+    bench arm emits (single-sourced in
+    strom.dist.peers.DIST_BENCH_FIELDS) — a rename on either side is a
+    silently dead column."""
+    from strom.dist.peers import DIST_BENCH_FIELDS
+
+    produced = set(DIST_BENCH_FIELDS)
+    for key in compare_rounds.DIST_KEYS:
+        assert key in produced, \
+            f"compare_rounds consumes {key!r} but the dist arm " \
+            f"produces no such key (renamed column?)"
+
+
+def test_dist_section_renders(tmp_path, capsys):
+    """A round carrying dist_* keys gets the distributed section."""
+    d = dict(NEW_ROUND)
+    d.update({"dist_ok": 1, "dist_procs": 2, "dist_items_per_s": 1502.3,
+              "dist_peer_hit_ratio": 0.53, "dist_engine_ingest_bytes": 0})
+    p = tmp_path / "BENCH_r15.json"
+    p.write_text(json.dumps(d))
+    assert compare_rounds.main([str(p)]) == 0
+    out = capsys.readouterr().out
+    assert "distributed (N-process data plane" in out
+    assert "dist_peer_hit_ratio" in out
+
+
+def test_dist_section_hidden_without_dist_keys(tmp_path, capsys):
+    p = tmp_path / "BENCH_r01.json"
+    p.write_text(json.dumps(dict(NEW_ROUND)))
+    assert compare_rounds.main([str(p)]) == 0
+    assert "distributed (N-process" not in capsys.readouterr().out
+
+
 def test_resume_section_renders(tmp_path, capsys):
     """A round carrying resume_*/ckpt_async_* keys gets the resume
     section."""

@@ -1,0 +1,481 @@
+"""Distributed data plane (ISSUE 15): peer extent service + launcher.
+
+Covers the acceptance invariants directly:
+
+- framing round-trip units (length-prefixed binary, truncation detected),
+- an extent hot on host A is served to host B with host B's engine
+  ``bytes_read`` delta = 0 (peer hit, no duplicate SSD read), and the
+  served range promotes into B's own cache,
+- a killed/garbage peer mid-serve degrades to the local engine with
+  bit-identical bytes (never fatal),
+- per-peer breaker trip + recovery on a fake clock,
+- peer-op fault matchers (refused connect / hangup / latency / truncated
+  frame) + the ``chaos_net`` preset, isolated from engine read draws,
+- subprocess 2- and 4-process runs: global-batch bit-identity vs the
+  single-process pipeline and the zero-duplicate-SSD-read invariant,
+- the ``stats()["dist"]`` section exposes exactly ``DIST_FIELDS``.
+"""
+
+import os
+import socket
+import struct
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from strom.config import StromConfig
+from strom.delivery.core import StromContext
+from strom.dist.launch import (launch_local, make_fixture, measure_ingest,
+                               owner_of, reference_shard_hashes)
+from strom.dist.peers import (DIST_BENCH_FIELDS, DIST_FIELDS,
+                              PeerProtocolError, PeerTier, decode_request,
+                              encode_request, recv_frame, send_frame)
+from strom.engine.resilience import CircuitBreaker
+from strom.faults.plan import FaultPlan, FaultRule
+
+
+def _cfg(**kw):
+    base = dict(engine="python", queue_depth=8, num_buffers=8,
+                hot_cache_bytes=64 << 20, hot_cache_admit="always")
+    base.update(kw)
+    return StromConfig(**base)
+
+
+def _fixture(tmp_path, n=256 * 1024, seed=0):
+    p = str(tmp_path / "data.bin")
+    payload = np.random.default_rng(seed).integers(
+        0, 255, n, dtype=np.uint8)
+    payload.tofile(p)
+    return p, payload
+
+
+# -- framing units -----------------------------------------------------------
+
+def test_request_roundtrip():
+    raw = encode_request("/some/path.bin", 4096, 123456)
+    assert decode_request(raw) == ("/some/path.bin", 4096, 123456)
+
+
+def test_request_rejects_garbage():
+    with pytest.raises(PeerProtocolError):
+        decode_request(b"\x01\x00")
+    with pytest.raises(PeerProtocolError):
+        decode_request(encode_request("p", 0, 8) + b"extra")
+    # op byte nobody speaks
+    bad = bytearray(encode_request("p", 0, 8))
+    bad[0] = 99
+    with pytest.raises(PeerProtocolError):
+        decode_request(bad)
+
+
+def test_frame_roundtrip_over_socketpair():
+    a, b = socket.socketpair()
+    try:
+        payload = os.urandom(70000)  # > one TCP segment
+        t = threading.Thread(target=send_frame, args=(a, payload),
+                             name="test-frame-send", daemon=True)
+        t.start()
+        got = recv_frame(b)
+        t.join()
+        assert bytes(got) == payload
+    finally:
+        a.close()
+        b.close()
+
+
+def test_truncated_frame_detected():
+    a, b = socket.socketpair()
+    try:
+        # header promises 100 bytes, sender hangs up after 10
+        a.sendall(struct.pack("!I", 100) + b"x" * 10)
+        a.close()
+        with pytest.raises(PeerProtocolError):
+            recv_frame(b)
+    finally:
+        b.close()
+
+
+def test_frame_cap_enforced():
+    a, b = socket.socketpair()
+    try:
+        a.sendall(struct.pack("!I", 1 << 31))
+        with pytest.raises(PeerProtocolError):
+            recv_frame(b)
+    finally:
+        a.close()
+        b.close()
+
+
+# -- peer serve: the zero-duplicate-SSD-read acceptance ----------------------
+
+def test_peer_hit_zero_engine_reads(tmp_path):
+    """Extent hot on A, read from B: B's engine bytes_read delta = 0 and
+    the bytes are identical; the range then promotes into B's own cache
+    (second read = RAM hit, no peer round-trip)."""
+    p, payload = _fixture(tmp_path)
+    A, B = StromContext(_cfg()), StromContext(_cfg())
+    try:
+        addr = A.serve_peers()
+        A.pread(p, 0, payload.nbytes)  # warm A (admit=always)
+        B.attach_peers({0: addr}, owner_fn=lambda path: 0)
+
+        b0 = B.engine.stats().get("bytes_read", 0)
+        got = B.pread(p, 1024, 8192)
+        assert bytes(got) == payload[1024:1024 + 8192].tobytes()
+        assert B.engine.stats().get("bytes_read", 0) - b0 == 0
+        tier = B.peer_tier.stats()
+        assert tier["peer_hit_bytes"] == 8192
+        assert A.peer_server.stats()["peer_served_bytes"] == 8192
+
+        # promotion: the next read of the same range never leaves B
+        hits0 = B.peer_tier.stats()["peer_hits"]
+        got2 = B.pread(p, 1024, 8192)
+        assert bytes(got2) == bytes(got)
+        assert B.engine.stats().get("bytes_read", 0) - b0 == 0
+        assert B.peer_tier.stats()["peer_hits"] == hits0
+    finally:
+        A.close()
+        B.close()
+
+
+def test_peer_miss_falls_back_to_engine(tmp_path):
+    """A range the owner does NOT have hot answers miss; the asker's
+    engine serves it — correct bytes, miss counted, never an error."""
+    p, payload = _fixture(tmp_path)
+    A, B = StromContext(_cfg()), StromContext(_cfg())
+    try:
+        addr = A.serve_peers()  # A serves but never warmed anything
+        B.attach_peers({0: addr}, owner_fn=lambda path: 0)
+        got = B.pread(p, 0, 4096)
+        assert bytes(got) == payload[:4096].tobytes()
+        st = B.peer_tier.stats()
+        assert st["peer_misses"] >= 1 and st["peer_errors"] == 0
+        assert A.peer_server.stats()["peer_serve_misses"] >= 1
+    finally:
+        A.close()
+        B.close()
+
+
+def test_cacheless_context_still_probes_peers(tmp_path):
+    """A peered context WITHOUT a hot cache still rides the peer tier
+    (the consult handles cache=None)."""
+    p, payload = _fixture(tmp_path)
+    A = StromContext(_cfg())
+    B = StromContext(_cfg(hot_cache_bytes=0))
+    try:
+        addr = A.serve_peers()
+        A.pread(p, 0, payload.nbytes)
+        B.attach_peers({0: addr}, owner_fn=lambda path: 0)
+        b0 = B.engine.stats().get("bytes_read", 0)
+        got = B.pread(p, 0, 4096)
+        assert bytes(got) == payload[:4096].tobytes()
+        assert B.engine.stats().get("bytes_read", 0) - b0 == 0
+        assert B.peer_tier.stats()["peer_hit_bytes"] == 4096
+    finally:
+        A.close()
+        B.close()
+
+
+def test_killed_peer_mid_serve_degrades_bit_identical(tmp_path):
+    """A peer that dies mid-frame (partial response, then hangup) costs a
+    counted error and an engine fallback — the delivered bytes are
+    bit-identical to a peer-less read."""
+    p, payload = _fixture(tmp_path)
+
+    # a rogue "peer": accepts, reads the request, sends HALF a frame
+    # header's promised payload, then slams the connection
+    lsock = socket.socket()
+    lsock.bind(("127.0.0.1", 0))
+    lsock.listen(4)
+    addr = f"127.0.0.1:{lsock.getsockname()[1]}"
+    stop = threading.Event()
+
+    def rogue():
+        while not stop.is_set():
+            try:
+                conn, _ = lsock.accept()
+            except OSError:
+                return
+            try:
+                recv_frame(conn)
+                conn.sendall(struct.pack("!I", 4097) + b"\x00" * 100)
+            except (OSError, PeerProtocolError):
+                pass
+            finally:
+                conn.close()  # mid-stream hangup
+
+    t = threading.Thread(target=rogue, name="test-rogue-peer", daemon=True)
+    t.start()
+    B = StromContext(_cfg())
+    try:
+        B.attach_peers({0: addr}, owner_fn=lambda path: 0)
+        got = B.pread(p, 0, 4096)
+        assert bytes(got) == payload[:4096].tobytes()
+        assert B.peer_tier.stats()["peer_errors"] >= 1
+    finally:
+        stop.set()
+        lsock.close()
+        B.close()
+        t.join(timeout=5)
+
+
+def test_dead_peer_refused_connect_falls_back(tmp_path):
+    p, payload = _fixture(tmp_path)
+    port = _free_port()
+    B = StromContext(_cfg())
+    try:
+        B.attach_peers({0: f"127.0.0.1:{port}"}, owner_fn=lambda path: 0)
+        got = B.pread(p, 0, 4096)
+        assert bytes(got) == payload[:4096].tobytes()
+        assert B.peer_tier.stats()["peer_errors"] >= 1
+    finally:
+        B.close()
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+# -- breaker lifecycle (fake clock) ------------------------------------------
+
+def test_peer_breaker_trip_and_recovery(tmp_path):
+    p, payload = _fixture(tmp_path)
+    port = _free_port()
+    now = [1000.0]
+    tier = PeerTier({0: f"127.0.0.1:{port}"}, owner_fn=lambda path: 0,
+                    timeout_s=0.2, clock=lambda: now[0],
+                    breaker_kwargs=dict(min_events=4, cooldown_s=1.0,
+                                        half_open_successes=2))
+    A = None
+    try:
+        # nothing listens: 4 straight failures trip the breaker OPEN
+        for _ in range(4):
+            assert tier.fetch(p, 0, 4096) is None
+        st = tier.stats()
+        assert st["peer_errors"] == 4
+        assert st["peer_breaker_trips"] == 1
+        assert st["peer_breaker_open"] == 1
+        # open: fetches short-circuit (skips, no new errors)
+        assert tier.fetch(p, 0, 4096) is None
+        assert tier.stats()["peer_errors"] == 4
+        assert tier.stats()["peer_skips"] >= 1
+
+        # the peer comes back at the same address; cooldown elapses
+        A = StromContext(_cfg())
+        A.serve_peers(port=port)
+        A.pread(p, 0, payload.nbytes)
+        now[0] += 1.5
+        # half-open probes ride real fetches; 2 successes close it
+        for _ in range(2):
+            got = tier.fetch(p, 0, 4096)
+            assert got is not None
+            assert bytes(got) == payload[:4096].tobytes()
+        assert tier.stats()["peer_breaker_open"] == 0
+        assert next(iter(tier.peers_info().values()))["state"] == "closed"
+    finally:
+        tier.close()
+        if A is not None:
+            A.close()
+
+
+# -- peer-op fault matchers + chaos_net --------------------------------------
+
+def test_peer_fault_kinds_injected(tmp_path):
+    """errno/hangup/short_read peer rules each produce a counted failure
+    + engine fallback; latency delays but succeeds."""
+    p, payload = _fixture(tmp_path)
+    for kind, extra in (("errno", dict(err="ECONNREFUSED")),
+                        ("hangup", {}),
+                        ("short_read", dict(short_frac=0.5))):
+        plan = FaultPlan([FaultRule(kind, op="peer", times=1, **extra)])
+        A, B = StromContext(_cfg()), StromContext(_cfg())
+        try:
+            addr = A.serve_peers()
+            A.pread(p, 0, payload.nbytes)
+            B.attach_peers({0: addr}, owner_fn=lambda path: 0)
+            B.peer_tier._plan = plan
+            got = B.pread(p, 0, 4096)  # injected failure -> engine
+            assert bytes(got) == payload[:4096].tobytes(), kind
+            assert B.peer_tier.stats()["peer_errors"] == 1, kind
+            # rule exhausted (times=1): the next fetch serves peer-side
+            got2 = B.pread(p, 8192, 4096)
+            assert bytes(got2) == payload[8192:8192 + 4096].tobytes()
+            assert B.peer_tier.stats()["peer_hits"] == 1, kind
+        finally:
+            A.close()
+            B.close()
+
+
+def test_peer_latency_fault_still_serves(tmp_path):
+    p, payload = _fixture(tmp_path)
+    plan = FaultPlan([FaultRule("latency", op="peer", times=1,
+                                latency_s=0.05)])
+    A, B = StromContext(_cfg()), StromContext(_cfg())
+    try:
+        addr = A.serve_peers()
+        A.pread(p, 0, payload.nbytes)
+        B.attach_peers({0: addr}, owner_fn=lambda path: 0)
+        B.peer_tier._plan = plan
+        t0 = time.perf_counter()
+        got = B.pread(p, 0, 4096)
+        assert time.perf_counter() - t0 >= 0.05
+        assert bytes(got) == payload[:4096].tobytes()
+        assert B.peer_tier.stats()["peer_hits"] == 1
+        assert B.peer_tier.stats()["peer_errors"] == 0
+    finally:
+        A.close()
+        B.close()
+
+
+def test_chaos_net_preset_shape_and_spec():
+    plan = FaultPlan.from_spec("chaos_net:7")
+    assert plan.seed == 7
+    assert all(r.op == "peer" for r in plan.rules)
+    kinds = {r.kind for r in plan.rules}
+    assert kinds == {"errno", "hangup", "latency", "short_read"}
+    # determinism: same seed + same op stream = same injections
+    a, b = FaultPlan.chaos_net(3), FaultPlan.chaos_net(3)
+    seq_a = [a.decide(path="x", offset=0, length=64, op="peer")
+             for _ in range(50)]
+    seq_b = [b.decide(path="x", offset=0, length=64, op="peer")
+             for _ in range(50)]
+    assert [f and f.kind for f in seq_a] == [f and f.kind for f in seq_b]
+
+
+def test_peer_rules_consume_no_engine_draws():
+    """Interleaved engine reads must not perturb the peer fault stream
+    (op-mismatched rules consume no RNG draw — the ISSUE 13 contract
+    extended to the peer op)."""
+    a, b = FaultPlan.chaos_net(5), FaultPlan.chaos_net(5)
+    seq_a = []
+    for i in range(60):
+        if i % 2:
+            # mismatched op: must not draw
+            assert a.decide(path="x", offset=0, length=64, op="read") is None
+        else:
+            f = a.decide(path="x", offset=0, length=64, op="peer")
+            seq_a.append(f and f.kind)
+    seq_b = [b.decide(path="x", offset=0, length=64, op="peer")
+             for _ in range(30)]
+    assert seq_a == [f and f.kind for f in seq_b]
+
+
+def test_chaos_net_pipeline_bit_identical(tmp_path):
+    """A context reading THROUGH chaos_net-injected peer faults delivers
+    bit-identical data (every injected network failure degrades to the
+    local engine)."""
+    p, payload = _fixture(tmp_path)
+    A = StromContext(_cfg())
+    B = StromContext(_cfg(fault_plan="chaos_net:1"))
+    try:
+        addr = A.serve_peers()
+        A.pread(p, 0, payload.nbytes)
+        B.attach_peers({0: addr}, owner_fn=lambda path: 0)
+        out = bytearray()
+        for off in range(0, 64 * 1024, 4096):
+            out += bytes(B.pread(p, off, 4096))
+        assert bytes(out) == payload[: 64 * 1024].tobytes()
+        st = B.peer_tier.stats()
+        assert st["peer_hits"] + st["peer_errors"] + st["peer_skips"] > 0
+    finally:
+        A.close()
+        B.close()
+
+
+def test_hangup_rule_on_engine_op_degrades_to_errno(tmp_path):
+    """A direction-less hangup rule hitting an ENGINE op completes as a
+    transient errno (retried), never a swallowed completion."""
+    p, payload = _fixture(tmp_path)
+    plan_doc = ('{"rules": [{"kind": "hangup", "times": 1, '
+                '"err": "EIO"}]}')
+    ctx = StromContext(_cfg(fault_plan=plan_doc, io_retries=2))
+    try:
+        got = ctx.pread(p, 0, 8192)
+        assert bytes(got) == payload[:8192].tobytes()
+    finally:
+        ctx.close()
+
+
+# -- stats exposure ----------------------------------------------------------
+
+def test_dist_stats_section_single_sourced(tmp_path):
+    p, payload = _fixture(tmp_path)
+    A, B = StromContext(_cfg()), StromContext(_cfg())
+    try:
+        addr = A.serve_peers()
+        A.pread(p, 0, payload.nbytes)
+        B.attach_peers({0: addr}, owner_fn=lambda path: 0)
+        B.pread(p, 0, 4096)
+        merged = {**B.stats(sections=["dist"])["dist"],
+                  **A.stats(sections=["dist"])["dist"]}
+        assert set(merged) == set(DIST_FIELDS)
+        # a context with neither tier nor server has no section
+        C = StromContext(_cfg())
+        try:
+            assert "dist" not in C.stats()
+        finally:
+            C.close()
+    finally:
+        A.close()
+        B.close()
+
+
+def test_serve_peers_idempotent_and_closed_refused(tmp_path):
+    ctx = StromContext(_cfg())
+    addr = ctx.serve_peers()
+    assert ctx.serve_peers() == addr
+    ctx.close()
+    with pytest.raises(RuntimeError):
+        ctx.serve_peers()
+
+
+# -- the launcher: N-process bit-identity + zero-duplicate-read --------------
+
+@pytest.mark.parametrize("nproc", [2, 4])
+def test_multiprocess_ingest_bit_identical(tmp_path, nproc):
+    """Subprocess N-process runs: every worker's batch stream must be
+    bit-identical to the single-process pipeline's corresponding rows,
+    with ZERO duplicate SSD reads during ingest (owned rows = local RAM,
+    peer rows = the extent service) and real peer traffic flowing."""
+    data = str(tmp_path / "data")
+    make_fixture(data, files=4, records=48, seq_len=16)
+    paths = sorted(os.path.join(data, f) for f in os.listdir(data)
+                   if f.endswith(".bin"))
+    ref = reference_shard_hashes(paths, 16, nproc, 8, 4, seed=0)
+    results = launch_local(nproc, data, str(tmp_path / "run"),
+                           steps=4, batch=8, seq_len=16, seed=0)
+    assert len(results) == nproc
+    for r, res in enumerate(results):
+        assert res.get("rc") == 0 and res.get("ok"), \
+            f"worker {r}: {res.get('tail', res)}"
+        assert res["sha256"] == ref[r], f"worker {r} diverged"
+        assert res["engine_ingest_bytes"] == 0, \
+            f"worker {r} re-read the SSD during ingest: {res}"
+        assert res["peer_errors"] == 0, res
+    assert sum(r["peer_hit_bytes"] for r in results) > 0
+    assert sum(r["peer_hit_bytes"] for r in results) == \
+        sum(r["peer_served_bytes"] for r in results)
+
+
+def test_measure_ingest_fields(tmp_path):
+    res = measure_ingest(2, str(tmp_path), steps=3, batch=8, seq_len=16)
+    assert res["dist_ok"] == 1
+    assert res["dist_peer_hit_ratio"] > 0
+    assert res["dist_engine_ingest_bytes"] == 0
+    # every DIST_BENCH_FIELDS column the arm copies is either produced
+    # here or derived by the arm itself (single-pass comparison keys)
+    arm_derived = {"dist_single_items_per_s", "dist_vs_single"}
+    for k in DIST_BENCH_FIELDS:
+        assert k in res or k in arm_derived, k
+
+
+def test_owner_map_deterministic_and_balanced(tmp_path):
+    data = str(tmp_path / "data")
+    paths = make_fixture(data, files=6, records=30, seq_len=16)
+    o1, o2 = owner_of(paths, 3), owner_of(paths, 3)
+    assert o1 == o2
+    assert set(o1.values()) == {0, 1, 2}

@@ -40,14 +40,16 @@ import argparse
 import glob
 import json
 import os
+import re
 import sys
 
 _HERE = os.path.dirname(os.path.abspath(__file__))
 sys.path.insert(0, _HERE)  # runnable as a script from anywhere
 
 from compare_rounds import (BINDING_ORDER, CACHE_KEYS, DECODE2_KEYS,  # noqa: E402
-                            DECODE_KEYS, RESIL_KEYS, RESUME_KEYS, SLO_KEYS,
-                            STALL_KEYS, STREAM_KEYS, WRITE_KEYS, unwrap)
+                            DECODE_KEYS, DIST_KEYS, RESIL_KEYS, RESUME_KEYS,
+                            SLO_KEYS, STALL_KEYS, STREAM_KEYS, WRITE_KEYS,
+                            unwrap)
 
 # The gated metric set: (metric, direction) over the single-sourced
 # comparison tuples, where direction is "up" (bigger is better) or "down"
@@ -120,6 +122,14 @@ SENTINEL_FIELDS = (
     ("resume_ok", "up"),
     ("ckpt_async_stall_frac", "down"),
     ("ckpt_async_stall_p99_us", "down"),
+    # distributed data plane (ISSUE 15): the dist arm's verdict is 0/1 —
+    # every worker bit-identical to the single-process pipeline, any drop
+    # fails outright — and the peer-hit ratio (share of assembled batch
+    # bytes served peer-to-peer instead of duplicate SSD reads) is a
+    # same-run ratio of a SEEDED row stream, so a shrink means the peer
+    # tier stopped serving, not weather
+    ("dist_ok", "up"),
+    ("dist_peer_hit_ratio", "up"),
 )
 
 # absolute slack for count-like "down" metrics around small values: going
@@ -135,7 +145,8 @@ RATIO_DOWN = frozenset({"chaos_slowdown", "ckpt_async_stall_frac"})
 
 TABLE_KEYS = list(dict.fromkeys(
     BINDING_ORDER + DECODE_KEYS + DECODE2_KEYS + STALL_KEYS + CACHE_KEYS
-    + STREAM_KEYS + SLO_KEYS + RESIL_KEYS + WRITE_KEYS + RESUME_KEYS))
+    + STREAM_KEYS + SLO_KEYS + RESIL_KEYS + WRITE_KEYS + RESUME_KEYS
+    + DIST_KEYS))
 
 
 def load_round(path: str) -> dict:
@@ -173,7 +184,11 @@ def load_round(path: str) -> dict:
 
 def load_multichip(path: str) -> dict:
     """MULTICHIP_r*.json rounds carry {n_devices, rc, ok, skipped}: valid
-    when rc == 0; the gated quantity is the ok-count trend."""
+    when rc == 0; the gated quantity is the ok-count trend. Rounds whose
+    dryrun tail carries the MEASURED multi-process ingest line (ISSUE 15:
+    ``dist ok: procs=N items_per_s=X peer_hit_ratio=Y``) surface those
+    numbers as dist_* columns — the artifact family graduates from
+    "lowered OK" to measured ingest rates with a peer-hit ratio."""
     name = os.path.basename(path)
     try:
         with open(path) as f:
@@ -185,10 +200,18 @@ def load_multichip(path: str) -> dict:
     if rc not in (None, 0):
         return {"name": name, "valid": False, "reason": f"rc={rc}",
                 "rc": rc, "data": {}}
+    data = {"multichip_ok": raw.get("ok"),
+            "multichip_skipped": raw.get("skipped"),
+            "multichip_n_devices": raw.get("n_devices")}
+    m = re.search(r"dist ok: procs=(\d+) items_per_s=([\d.]+) "
+                  r"peer_hit_ratio=([\d.]+)", str(raw.get("tail", "")))
+    if m:
+        data["dist_ok"] = 1
+        data["dist_procs"] = int(m.group(1))
+        data["dist_items_per_s"] = float(m.group(2))
+        data["dist_peer_hit_ratio"] = float(m.group(3))
     return {"name": name, "valid": True, "reason": "", "rc": rc,
-            "data": {"multichip_ok": raw.get("ok"),
-                     "multichip_skipped": raw.get("skipped"),
-                     "multichip_n_devices": raw.get("n_devices")}}
+            "data": data}
 
 
 def metric_value(data: dict, key: str):

@@ -278,6 +278,28 @@ RESUME_KEYS = [
     "ckpt_async_stall_frac",
     "ckpt_async_commit_mb_per_s",
 ]
+# distributed data plane (ISSUE 15 tentpole): the dist arm's N-process
+# CPU-mesh ingest — dist_ok folds the acceptance into one bit (every
+# worker exited 0 AND every per-host batch stream bit-identical to the
+# single-process pipeline), dist_peer_hit_ratio is the share of assembled
+# batch bytes served over the peer extent service instead of a duplicate
+# SSD read (same-run ratio, weather-independent), and the wait/rtt p99s
+# bound the assembly tail. Suffixes single-sourced in
+# strom.dist.peers.DIST_BENCH_FIELDS (parity-tested in
+# tests/test_compare_rounds.py, same contract as the other sections).
+DIST_KEYS = [
+    "dist_ok",
+    "dist_procs",
+    "dist_items_per_s",
+    "dist_single_items_per_s",
+    "dist_vs_single",
+    "dist_peer_hit_ratio",
+    "dist_peer_hit_bytes",
+    "dist_peer_served_bytes",
+    "dist_engine_ingest_bytes",
+    "dist_assembly_wait_p99_us",
+    "dist_peer_rtt_p99_us",
+]
 # per-attempt / per-pass audit arrays (VERDICT.md r4 next #3): printed so
 # the best-of selection's discards are visible in the comparison too
 AUDIT_SUFFIXES = ("_attempts", "_passes")
@@ -422,10 +444,12 @@ def main(argv: list[str]) -> int:
                      for k in WRITE_KEYS)
     have_resume = any(cell(d, k) != "-" for _, d in rounds
                       for k in RESUME_KEYS)
+    have_dist = any(cell(d, k) != "-" for _, d in rounds
+                    for k in DIST_KEYS)
     name_w = max(len(k) for k in binding_keys + CONTEXT_KEYS + DECODE_KEYS
                  + DECODE2_KEYS + STALL_KEYS + CACHE_KEYS + STREAM_KEYS
                  + SCHED_KEYS + SLO_KEYS + RESIL_KEYS + WRITE_KEYS
-                 + RESUME_KEYS + audit_keys) + 2
+                 + RESUME_KEYS + DIST_KEYS + audit_keys) + 2
     # every rendered cell folds into ONE column width, or rows misalign
     col_w = max(max(len(n) for n, _ in rounds) + 2, 12,
                 *(len(c) + 2 for cs in audit_cells.values() for c in cs),
@@ -505,6 +529,13 @@ def main(argv: list[str]) -> int:
               "continue, no epoch replay, no orphans; async-save stall "
               "vs sync wall):")
         for k in RESUME_KEYS:
+            print(k.ljust(name_w)
+                  + "".join(cell(d, k).rjust(col_w) for _, d in rounds))
+    if have_dist:
+        print("distributed (N-process data plane: dist_ok=1 = bit-identical "
+              "to single-process; peer_hit_ratio = batch bytes served "
+              "peer-to-peer, not re-read from SSD):")
+        for k in DIST_KEYS:
             print(k.ljust(name_w)
                   + "".join(cell(d, k).rjust(col_w) for _, d in rounds))
     if audit_keys:

@@ -72,6 +72,16 @@ LOCK_RANKS = {
     # -- band: slab pool -----------------------------------------------------
     "slab.pool": 50,
     # -- band: hot cache -----------------------------------------------------
+    "dist.peer": 56,           # PeerTier conn-pool checkout (ISSUE 15):
+                               # NEVER held across socket I/O — the fetch
+                               # checks a connection out, releases, does
+                               # the wire round-trip, re-takes to return
+                               # it; under it only counters move
+    "dist.server": 57,         # PeerServer serve tallies (ISSUE 15): a
+                               # leaf held around counter updates after
+                               # the billed local read returned — never
+                               # across the grant, the tiers, or the
+                               # socket send
     "cache.decoded": 58,       # DecodedCache tallies (ISSUE 12): a leaf
                                # held only for counter updates, ranked
                                # before cache.meta so a tally-then-admit
