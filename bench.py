@@ -1067,6 +1067,37 @@ def main() -> int:
                   f"errors)", file=sys.stderr)
             flush_partial(**loader_res)
 
+        # ISSUE 16: kernel-bypass speed pass + closed-loop autotuner —
+        # the tune arm's hand-vs-tuned A/B over the live knob surfaces
+        # (tuned_vs_hand >= 1.0 is the controller contract: guarded
+        # revert + final interleaved validation mean the tuner never
+        # ships measured-worse knobs). The nvme arm already folded the
+        # SQPOLL submit-syscall A/B into its own output; both copy via
+        # the single-sourced TUNE_BENCH_FIELDS tuple (parity-tested like
+        # the other sections); bench_sentinel gates tuned_vs_hand up and
+        # sqpoll_submit_syscalls_per_gb down.
+        from strom.cli import bench_tune
+        from strom.tune import TUNE_BENCH_FIELDS
+
+        tnargs = argparse.Namespace(
+            file=None, size=min(size, 128 * 1024 * 1024),
+            block=cfg.block_size, depth=32, iters=3, engine="auto",
+            tmpdir=args.tmpdir, json=True, cache_bytes=32 * 1024 * 1024,
+            trials=12, profile="", metrics_port=args.metrics_port)
+        tnres = attempt("tune", lambda: bench_tune(tnargs)) \
+            if phase_ok("tune", 180) else None
+        if tnres is not None:
+            for k in TUNE_BENCH_FIELDS:
+                if k in tnres:
+                    loader_res[k] = tnres[k]
+            print(f"tune: hand {tnres.get('hand_items_per_s')} -> tuned "
+                  f"{tnres.get('tuned_items_per_s')} it/s "
+                  f"(x{tnres.get('tuned_vs_hand')}) after "
+                  f"{tnres.get('tune_moves')} moves / "
+                  f"{tnres.get('tune_reverts')} reverts; knobs "
+                  f"{tnres.get('tune_knobs')}", file=sys.stderr)
+            flush_partial(**loader_res)
+
     # --- numerator: one streamed memcpy_ssd2tpu ----------------------------
     # (engine reads piece k+1 while piece k streams host->HBM)
     # Capped at 512MiB: the relay link's token bucket holds ~0.5-1 GiB of

@@ -214,6 +214,14 @@ struct sc_stats {
   // so older readers of the ABI see an unchanged prefix
   uint64_t ops_written;
   uint64_t bytes_written;
+  // submission-boundary syscall accounting (ISSUE 16): io_uring_enter calls
+  // made on the SUBMIT side only (wait-side enters are a different budget),
+  // and how many of those were SQPOLL NEED_WAKEUP kicks. Under SQPOLL the
+  // poller consumes published SQEs without any enter at all, so
+  // enter_submit_calls / bytes moved is the measured A/B the sqpoll knob is
+  // gated on. Appended at the struct tail (ABI prefix rule, see ops_written).
+  uint64_t enter_submit_calls;
+  uint64_t sqpoll_wakeups;
 };
 
 struct sc_engine {
@@ -300,6 +308,8 @@ struct sc_engine {
   // last non-transient errno from the SQPOLL SQ_WAKEUP enter (0 = none):
   // a dead/unwakeable poller otherwise presents only as a read timeout
   std::atomic<uint32_t> sqpoll_wakeup_errno{0};
+  // submit-side io_uring_enter calls + SQPOLL wakeup kicks (sc_stats tail)
+  std::atomic<uint64_t> enter_submit_calls{0}, sqpoll_wakeups{0};
   // residency hybrid (sc_create flags bit 5): route page-cache-RESIDENT
   // chunks of a vectored gather through the buffered fd (a memcpy from the
   // cache) instead of re-reading them from media O_DIRECT
@@ -794,7 +804,9 @@ static EnterResult ring_enter_submit(sc_engine *e, unsigned k,
     // (io_uring_enter(2) mandates a smp_mb() here; liburing does the same)
     std::atomic_thread_fence(std::memory_order_seq_cst);
     if (e->sq_flags->load(std::memory_order_relaxed) & IORING_SQ_NEED_WAKEUP) {
+      e->sqpoll_wakeups.fetch_add(1, std::memory_order_relaxed);
       for (;;) {
+        e->enter_submit_calls.fetch_add(1, std::memory_order_relaxed);
         if (sys_io_uring_enter(e->ring_fd, 0, 0, IORING_ENTER_SQ_WAKEUP,
                                nullptr, 0) >= 0)
           break;
@@ -814,6 +826,7 @@ static EnterResult ring_enter_submit(sc_engine *e, unsigned k,
     return EnterResult{k, 0};
   }
   while (fatal == 0 && remaining > 0) {
+    e->enter_submit_calls.fetch_add(1, std::memory_order_relaxed);
     int ret = sys_io_uring_enter(e->ring_fd, remaining, 0, 0, nullptr, 0);
     if (ret >= 0) {
       remaining -= (unsigned)ret < remaining ? (unsigned)ret : remaining;
@@ -1618,6 +1631,9 @@ void sc_get_stats(sc_engine *e, sc_stats *s) {
   s->residency_probes = e->residency_probes.load(std::memory_order_relaxed);
   s->ops_written = e->ops_written.load(std::memory_order_relaxed);
   s->bytes_written = e->bytes_written.load(std::memory_order_relaxed);
+  s->enter_submit_calls =
+      e->enter_submit_calls.load(std::memory_order_relaxed);
+  s->sqpoll_wakeups = e->sqpoll_wakeups.load(std::memory_order_relaxed);
 }
 
 }  // extern "C"

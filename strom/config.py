@@ -77,6 +77,16 @@ class StromConfig:
                                        # spare cores exist; auto-falls back
                                        # when the kernel refuses it, and
                                        # supersedes coop_taskrun when active
+    uring_sqpoll: bool = False         # ISSUE 16 spelling of the same knob
+                                       # (daemon long-lived rings); either
+                                       # flag arms SQPOLL — __post_init__
+                                       # folds this one into sqpoll
+    ring_recovery_s: float = 0.0       # MultiRingEngine quarantine recovery
+                                       # cooldown: > 0 rebuilds a quarantined
+                                       # member after this many seconds and
+                                       # replays its dest-slab registrations
+                                       # (READ_FIXED survives recovery);
+                                       # 0 keeps ISSUE-9 sticky quarantine
 
     # delivery
     prefetch_depth: int = 2            # batches dispatched ahead of consumption
@@ -252,6 +262,25 @@ class StromConfig:
                                        # before the local engine serves
     dist_server_max_conns: int = 8     # bounded peer-server concurrency;
                                        # excess connects queue in accept
+    dist_send_zc: bool = False         # zero-copy peer serving (ISSUE 16):
+                                       # serve cache hits straight from the
+                                       # pinned view (no np.empty bounce),
+                                       # spill hits via sendfile(2), and —
+                                       # when the kernel grants SO_ZEROCOPY
+                                       # — MSG_ZEROCOPY sends with errqueue
+                                       # completion waits. Off = byte-
+                                       # identical pre-PR copy path
+
+    # closed-loop knob autotuner (ISSUE 16, strom/tune/): coordinate descent
+    # over the live knob surfaces (prefetch depth, sched slice, cache
+    # budget) against goodput, with guarded revert and an SLO-burn hold.
+    tune: bool = False                 # arm the tuner thread in the context
+    tune_interval_s: float = 1.0       # settle window between tuner moves
+    tune_guard_frac: float = 0.10      # revert a move that costs more than
+                                       # this fraction of the objective
+    tune_profile: str = ""             # JSON profile path: loaded (applied)
+                                       # at attach when it exists, saved on
+                                       # close — the cli --profile flag
 
     # NUMA affinity (multi-socket hosts): pin submitting threads to the NVMe's
     # home node, mbind staging slabs there, optionally steer the device IRQs
@@ -436,6 +465,14 @@ class StromConfig:
             raise ValueError("dist_peer_timeout_s must be > 0")
         if self.dist_server_max_conns < 1:
             raise ValueError("dist_server_max_conns must be >= 1")
+        if self.uring_sqpoll and not self.sqpoll:
+            object.__setattr__(self, "sqpoll", True)
+        if self.ring_recovery_s < 0:
+            raise ValueError("ring_recovery_s must be >= 0 (0 = sticky)")
+        if self.tune_interval_s <= 0:
+            raise ValueError("tune_interval_s must be > 0")
+        if not 0.0 < self.tune_guard_frac <= 1.0:
+            raise ValueError("tune_guard_frac must be in (0, 1]")
 
     @property
     def resolved_stripe_window_bytes(self) -> int:

@@ -530,6 +530,11 @@ class StromContext:
         # behavior unchanged.
         self._peer_tier = None
         self._peer_server = None
+        # closed-loop knob autotuner (ISSUE 16 tentpole, strom/tune):
+        # armed below after every knob surface exists; None until
+        # attach_tuner() (config.tune=False = no controller, no thread,
+        # every knob byte-identical to the hand configuration)
+        self._tuner = None
         # in-flight DEMAND gathers (not readahead): the readahead thread
         # checks this between engine-budget-sized slices and yields, so a
         # consumer's read never queues behind more than one warming slice
@@ -607,6 +612,13 @@ class StromContext:
         # whose __init__ failed would pin the half-built context (and feed
         # its SLO engine from every later request) for the process lifetime
         _request.add_observer(self._slo_observer)
+        # knob autotuner (ISSUE 16): armed last — every knob surface
+        # (scheduler, cache) exists and the observability endpoint is
+        # already live to expose stats()["tune"]. config.tune_profile
+        # warm-starts the search from a previous run's converged point.
+        if self.config.tune:
+            self.attach_tuner(
+                profile_path=self.config.tune_profile or None)
         self._closed = False
 
     @property
@@ -754,6 +766,56 @@ class StromContext:
             peers, owner_fn=owner_fn, scope=self.scope,
             timeout_s=self.config.dist_peer_timeout_s,
             plan=getattr(self.engine, "plan", None))
+
+    @property
+    def tuner(self):
+        """The closed-loop knob autotuner when ``tune=True`` (or
+        :meth:`attach_tuner` was called), else None (strom/tune)."""
+        return self._tuner
+
+    def attach_tuner(self, knobs=None, *, profile_path: "str | None" = None,
+                     start: bool = True):
+        """Arm the closed-loop autotuner (ISSUE 16 tentpole, strom/tune)
+        over this context's live knob surfaces — scheduler slice bytes and
+        cache budget by default, or an explicit *knobs* list (pipelines
+        add prefetch depth via :func:`strom.tune.prefetcher_knob`). The
+        controller climbs the stall-attribution goodput and HOLDS whenever
+        any tenant's SLO is burning or goodput is not yet measurable — it
+        never experiments blind or on a tenant already missing its target.
+        *profile_path* warm-starts from a saved :class:`strom.tune.Profile`;
+        ``start=False`` builds the controller without the driver thread
+        (the bench arms beat it manually). Idempotent."""
+        if getattr(self, "_closed", False):
+            raise RuntimeError("StromContext is closed")
+        if self._tuner is not None:
+            return self._tuner
+        from strom.tune import Autotuner, Profile, standard_knobs
+
+        ks = list(knobs) if knobs is not None else standard_knobs(self)
+        name = "default"
+        if profile_path:
+            name = os.path.splitext(os.path.basename(profile_path))[0]
+        tuner = Autotuner(
+            ks, self._tune_metrics,
+            interval_s=self.config.tune_interval_s,
+            guard_frac=self.config.tune_guard_frac,
+            scope=self.scope, profile_name=name)
+        if profile_path and os.path.exists(profile_path):
+            tuner.apply_profile(Profile.load(profile_path))
+        self._tuner = tuner
+        if start:
+            tuner.start()
+        return tuner
+
+    def _tune_metrics(self) -> dict:
+        """The autotuner's objective: stall-attribution goodput (rides the
+        steps section's TTL cache). No goodput yet (no step windows) reads
+        as a hold — the controller must never experiment without a signal
+        to judge the trial by."""
+        goodput = self._current_goodput()
+        burning = bool(self._slo.stats().get("slo_tenants_burning", 0))
+        return {"objective": float(goodput or 0.0),
+                "slo_burning": burning or goodput is None}
 
     @contextlib.contextmanager
     def engine_exclusive(self, nbytes: int = 0, tenant: str | None = None):
@@ -2034,7 +2096,7 @@ class StromContext:
         never recomputes the expensive stall-attribution section (ISSUE 6
         satellite). None = every section (the pre-existing contract).
         Known sections: context, decode, stream, steps, cache, spill,
-        dist, slab_pool, engine, sched, slo, exemplars, resilience,
+        dist, slab_pool, engine, sched, slo, tune, exemplars, resilience,
         scopes."""
         want = None if sections is None else set(sections)
 
@@ -2198,6 +2260,11 @@ class StromContext:
         # per-tenant rows live on /slo, labeled gauges on /metrics
         if wanted("slo"):
             out["slo"] = self._slo.stats()
+        # closed-loop autotuner (ISSUE 16): controller state + live knob
+        # values, keyed by the single-sourced TUNE_FIELDS names (the /tune
+        # route, compare_rounds and strom_top all read this section)
+        if wanted("tune") and self._tuner is not None:
+            out["tune"] = self._tuner.stats()
         # resilience (ISSUE 9 tentpole): retry/hedge/breaker/failover
         # counters (single-sourced key list RESILIENCE_FIELDS) + the
         # breaker's live state and the fault plan's injection tally when
@@ -2236,6 +2303,11 @@ class StromContext:
             return
         self._closed = True
         _request.remove_observer(self._slo_observer)
+        # tuner first: its driver thread reads stats()/knob surfaces that
+        # are about to be torn down (knobs stay where the search left
+        # them — close is not a revert)
+        if self._tuner is not None:
+            self._tuner.close()
         # peer service down first: no new serve can start a cache/spill
         # read (or a scheduler grant) against a closing context, and the
         # consult stops probing peers before the engine goes away

@@ -126,6 +126,18 @@ class Prefetcher(Generic[T]):
     def depth(self) -> int:
         return self._depth
 
+    def set_depth(self, depth: int) -> None:
+        """External controller surface (ISSUE 16 autotuner): move the
+        target depth inside [min_depth, max_depth]. Same single-consumer
+        write discipline as the internal controller; the executor was
+        sized to max_depth only under auto_depth, so a hand-depth pool
+        additionally caps at the worker count (a deeper queue than
+        workers would just park thunks)."""
+        cap = self._max_depth if self._auto \
+            else min(self._max_depth, self._executor._max_workers)
+        d = min(max(int(depth), self._min_depth), cap)
+        self._set_depth(d, "grow" if d > self._depth else "shrink")
+
     def _fill(self) -> None:
         # next(thunks) runs OUTSIDE the lock: thunk generators may block
         # (e.g. the pipeline's epoch_sync DCN barrier sits at the epoch

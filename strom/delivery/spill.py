@@ -333,6 +333,15 @@ class SpillTier:
             self.fallback_ops += 1
         return os.preadv(self._fd, [memoryview(dest)[:n]], off)
 
+    def file_range(self, e: _SpillEntry, s: int, t: int
+                   ) -> tuple[int, int, int]:
+        """``(fd, file_offset, length)`` for bytes [s, t) of *e*'s range —
+        the sendfile(2) coordinates the zero-copy peer exporter uses to
+        ship spill-resident bytes without a userspace read. The entry must
+        be pinned (a :meth:`lookup` hit) and stay pinned until the send
+        completes; the fd is owned by this tier, do not close it."""
+        return self._fd, e.off + (s - e.lo), t - s
+
     def _pwrite(self, data: np.ndarray, off: int) -> None:
         """Spill-file write: engine-routed when safe, buffered fd
         otherwise. Never called under the tier lock (two-phase

@@ -69,6 +69,13 @@ DIST_FIELDS = (
     "peer_serve_misses",
     "peer_breaker_trips",
     "peer_breaker_open",
+    # zero-copy exporter accounting (ISSUE 16, dist_send_zc): payload bytes
+    # sent straight from pinned cache views (zc), via sendfile(2) from the
+    # spill file (sendfile), or through the legacy assemble-then-send bounce
+    # (copy) — the ratio is the mechanism's before/after proof
+    "peer_zc_bytes",
+    "peer_sendfile_bytes",
+    "peer_copy_bytes",
 )
 
 # bench-JSON columns the dist arm emits (cli.py bench_dist → bench.py copy
@@ -106,6 +113,29 @@ MAX_FRAME = 64 * 1024 * 1024
 
 class PeerProtocolError(RuntimeError):
     """Malformed or truncated peer frame (hangup mid-stream included)."""
+
+
+# MSG_ZEROCOPY plumbing (ISSUE 16): the flag values are ABI constants from
+# <linux/socket.h> / <asm-generic/socket.h>, absent from the socket module
+# on older Pythons — spell them out, probe SO_ZEROCOPY at runtime
+_SO_ZEROCOPY = getattr(socket, "SO_ZEROCOPY", 60)
+_MSG_ZEROCOPY = getattr(socket, "MSG_ZEROCOPY", 0x4000000)
+_MSG_ERRQUEUE = getattr(socket, "MSG_ERRQUEUE", 0x2000)
+# below this, MSG_ZEROCOPY's page-pinning setup costs more than the copy
+# it saves (kernel docs put the break-even around 10 KiB)
+_ZC_MIN_SEND = 32 * 1024
+
+
+class _ZcState:
+    """Per-connection MSG_ZEROCOPY bookkeeping: the kernel numbers each
+    zc send 0,1,2,… per socket and acknowledges inclusive sequence ranges
+    on the error queue once it has dropped its page references."""
+
+    __slots__ = ("seq", "acked")
+
+    def __init__(self):
+        self.seq = 0    # zc sends issued (next send gets seq)
+        self.acked = 0  # completions reaped: all of [0, acked) are done
 
 
 def send_frame(sock: socket.socket, payload) -> None:
@@ -192,6 +222,15 @@ class PeerServer:
         self.served_bytes = 0
         self.serves = 0
         self.serve_misses = 0
+        # zero-copy exporter (ISSUE 16, opt-in via dist_send_zc): serve hits
+        # straight from the pinned tier views / the spill file instead of
+        # assembling a bounce buffer. Off = the pre-PR copy path, byte for
+        # byte.
+        self._zc = bool(getattr(getattr(ctx, "config", None),
+                                "dist_send_zc", False))
+        self.zc_bytes = 0
+        self.sendfile_bytes = 0
+        self.copy_bytes = 0
         self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         self._sock.bind((host, port))
@@ -222,6 +261,17 @@ class PeerServer:
     def _serve_conn(self, conn: socket.socket) -> None:
         try:
             conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            zstate: "_ZcState | None" = None
+            if self._zc:
+                # per-conn MSG_ZEROCOPY probe: SO_ZEROCOPY is refused on
+                # kernels without it (or on loopback-disabled configs) —
+                # the conn then serves pinned-view sends without the flag,
+                # still no userspace bounce
+                try:
+                    conn.setsockopt(socket.SOL_SOCKET, _SO_ZEROCOPY, 1)
+                    zstate = _ZcState()
+                except OSError:
+                    zstate = None
             while not self._closed:
                 try:
                     path, lo, hi = decode_request(recv_frame(conn))
@@ -233,13 +283,38 @@ class PeerServer:
                 # service the moment peers outnumber max_conns — only
                 # in-flight local reads hold a slot, any number of idle
                 # conns park here costing a blocked thread each
+                # the semaphore is a counting slot pool, not a mutex: N
+                # independent slots can't nest or invert, and the billed
+                # read under it enters the lock hierarchy at the scheduler
+                # band exactly as it would uncontended — hence the
+                # per-call-site lock-order suppressions below
+                served: "tuple[int, int, int] | None" = None
+                data = None
                 with self._sem:
-                    # stromlint: ignore[lock-order] -- counting semaphore,
-                    # not a mutex: N independent slots can't nest or
-                    # invert, and the billed read under it enters the
-                    # hierarchy at the scheduler band exactly as it
-                    # would uncontended
-                    data = self._serve_range(path, lo, hi)
+                    if self._zc:
+                        try:
+                            # stromlint: ignore[lock-order] -- slot semaphore, see above
+                            served = self._serve_range_zc(conn, path, lo,
+                                                          hi, zstate)
+                        except OSError:
+                            return  # conn already destroyed by the zc path
+                    else:
+                        # stromlint: ignore[lock-order] -- slot semaphore, see above
+                        data = self._serve_range(path, lo, hi)
+                # tally BEFORE the reply frame leaves: the moment the
+                # client sees the frame it may read our stats (tests and
+                # strom_top sample right after a pread returns), and a
+                # post-send tally loses that race
+                if self._zc:
+                    self._tally(None if served is None else served[0])
+                    if served is None:
+                        try:
+                            send_frame(conn, bytes([ST_MISS]))
+                        except OSError:
+                            return
+                    continue
+                self._tally(None if data is None else data.nbytes,
+                            copied=True)
                 try:
                     if data is None:
                         send_frame(conn, bytes([ST_MISS]))
@@ -247,21 +322,24 @@ class PeerServer:
                         send_frame(conn, (bytes([ST_HIT]), data.data))
                 except OSError:
                     return
-                n = 0 if data is None else data.nbytes
-                with self._lock:
-                    if data is None:
-                        self.serve_misses += 1
-                    else:
-                        self.serves += 1
-                        self.served_bytes += n
-                if data is None:
-                    self._scope.add("peer_serve_misses")
-                else:
-                    self._scope.add("peer_serves")
-                    self._scope.add("peer_served_bytes", n)
         finally:
             with contextlib.suppress(OSError):
                 conn.close()
+
+    def _tally(self, n: "int | None", *, copied: bool = False) -> None:
+        with self._lock:
+            if n is None:
+                self.serve_misses += 1
+            else:
+                self.serves += 1
+                self.served_bytes += n
+                if copied:
+                    self.copy_bytes += n
+        if n is None:
+            self._scope.add("peer_serve_misses")
+        else:
+            self._scope.add("peer_serves")
+            self._scope.add("peer_served_bytes", n)
 
     def _serve_range(self, path: str, lo: int, hi: int
                      ) -> "np.ndarray | None":
@@ -289,6 +367,163 @@ class PeerServer:
         # to its own engine
         except Exception:
             return None
+
+    # -- zero-copy serving (ISSUE 16, dist_send_zc) --------------------------
+    def _plan_local(self, path: str, lo: int, hi: int):
+        """Pin-and-plan: the wire segments covering [lo, hi) in offset
+        order, with tier pins HELD on return (the caller unpins after the
+        send — pins are refcounts, not locks, so holding them across
+        socket I/O is legal and is exactly what makes the no-bounce send
+        safe against concurrent eviction). Returns
+        ``(segs, cache, pinned, spill, sp_pinned)`` or None for any gap."""
+        cache = getattr(self._ctx, "hot_cache", None)
+        if cache is None or not cache.enabled:
+            return None
+        hits, misses, pinned = cache.lookup(path, lo, hi, record=False)
+        spill = cache.spill
+        sp_pinned: list = []
+        segs: list = [(s, ("mem", view, 0, t - s)) for s, t, view in hits]
+        ok = True
+        if misses:
+            if spill is None:
+                ok = False
+            else:
+                for s, t in misses:
+                    if not ok:
+                        break
+                    sp_hits, sp_misses = spill.lookup(path, s, t,
+                                                      record=False)
+                    sp_pinned.extend(e for _, _, e in sp_hits)
+                    if sp_misses:
+                        ok = False
+                        break
+                    for ss, tt, ent in sp_hits:
+                        fd, off, ln = spill.file_range(ent, ss, tt)
+                        segs.append((ss, ("file", fd, off, ln)))
+        if not ok:
+            if spill is not None:
+                spill.unpin(sp_pinned)
+            cache.unpin(pinned)
+            return None
+        segs.sort(key=lambda kv: kv[0])
+        return ([seg for _, seg in segs], cache, pinned, spill, sp_pinned)
+
+    def _serve_range_zc(self, conn: socket.socket, path: str, lo: int,
+                        hi: int, zstate: "_ZcState | None"
+                        ) -> "tuple[int, int, int] | None":
+        """Serve a hit straight out of the tiers: pinned cache views go to
+        the socket with no userspace assembly (MSG_ZEROCOPY when the conn
+        granted it), spill-resident ranges ride sendfile(2) from the spill
+        file. Returns (payload, zc, sendfile) byte counts, None for a
+        miss; raises OSError with the CONNECTION ALREADY DESTROYED on any
+        send failure (a half-sent frame is unrecoverable — the peer sees
+        a truncated frame and falls back to its engine)."""
+        import os as _os
+
+        n = hi - lo
+        if n <= 0 or n + 1 > MAX_FRAME or self._closed:
+            return None
+        sched = getattr(self._ctx, "scheduler", None)
+        try:
+            # the grant covers the PLAN (tier lookups + pinning) only —
+            # never the sends; what the socket does afterwards is paced by
+            # TCP, not by the engine arbiter
+            if sched is not None:
+                with sched.grant("peer", n, priority="background"):
+                    plan = self._plan_local(path, lo, hi)
+            else:
+                plan = self._plan_local(path, lo, hi)
+        except Exception:  # stromlint: ignore[swallowed-exceptions] -- same advisory-service contract as _serve_range: any local failure answers miss (counted peer_serve_misses) and the asker reads from its own engine
+            return None
+        if plan is None:
+            return None
+        segs, cache, pinned, spill, sp_pinned = plan
+        zc0 = zstate.seq if zstate is not None else 0
+        zc_b = sf_b = 0
+        try:
+            try:
+                conn.sendall(_LEN.pack(1 + n) + bytes([ST_HIT]))
+                for kind, a, off, ln in segs:
+                    if kind == "mem":
+                        mv = memoryview(a)
+                        if zstate is not None and ln >= _ZC_MIN_SEND:
+                            self._send_view_zc(conn, mv, zstate)
+                        else:
+                            conn.sendall(mv)
+                        zc_b += ln
+                    else:
+                        while ln > 0:
+                            k = _os.sendfile(conn.fileno(), a, off, ln)
+                            if k <= 0:
+                                raise OSError(5, "sendfile stalled")
+                            off += k
+                            ln -= k
+                            sf_b += k
+                if zstate is not None and zstate.seq > zc0 \
+                        and not self._drain_zc(conn, zstate,
+                                               time.monotonic() + 2.0):
+                    raise OSError(110, "zerocopy completion timeout")
+            except OSError:
+                # un-acked MSG_ZEROCOPY sends may still reference the
+                # pinned pages: destroy the socket FIRST (close frees the
+                # skbs), unpin in the finally below, then tell the caller
+                # the conn is gone
+                with contextlib.suppress(OSError):
+                    conn.close()
+                raise
+        finally:
+            cache.unpin(pinned)
+            if spill is not None:
+                spill.unpin(sp_pinned)
+        with self._lock:
+            self.zc_bytes += zc_b
+            self.sendfile_bytes += sf_b
+        return (n, zc_b, sf_b)
+
+    def _send_view_zc(self, conn: socket.socket, mv: memoryview,
+                      zstate: "_ZcState") -> None:
+        """One view via MSG_ZEROCOPY, falling back to plain sends when the
+        kernel runs out of zerocopy budget (ENOBUFS is documented as 'try
+        again without the flag', not an error)."""
+        sent = 0
+        total = len(mv)
+        while sent < total:
+            try:
+                k = conn.send(mv[sent:], _MSG_ZEROCOPY)
+            except InterruptedError:
+                continue
+            except OSError as e:
+                if e.errno == 105:  # ENOBUFS: zc budget exhausted
+                    conn.sendall(mv[sent:])
+                    return
+                raise
+            zstate.seq += 1
+            sent += k
+
+    def _drain_zc(self, conn: socket.socket, zstate: "_ZcState",
+                  deadline: float) -> bool:
+        """Reap MSG_ERRQUEUE completion notifications until every zc send
+        on this conn is acknowledged (the kernel has dropped its page
+        references) or *deadline*. sock_extended_err carries an inclusive
+        [ee_info, ee_data] sequence range per notification."""
+        nonblock = _MSG_ERRQUEUE | getattr(socket, "MSG_DONTWAIT", 0x40)
+        while zstate.acked < zstate.seq:
+            if time.monotonic() >= deadline:
+                return False
+            try:
+                _msg, ancdata, _flags, _addr = conn.recvmsg(0, 512, nonblock)
+            except (BlockingIOError, InterruptedError):
+                time.sleep(0.001)
+                continue
+            except OSError:
+                return False
+            for _level, _type, data in ancdata:
+                if len(data) >= 16:
+                    (_eerrno, origin, _t, _c, _p, _info,
+                     dat) = struct.unpack_from("IBBBBII", data)
+                    if origin == 5:  # SO_EE_ORIGIN_ZEROCOPY
+                        zstate.acked = max(zstate.acked, dat + 1)
+        return True
 
     def _read_local(self, path: str, lo: int, hi: int
                     ) -> "np.ndarray | None":
@@ -325,7 +560,10 @@ class PeerServer:
         with self._lock:
             return {"peer_served_bytes": self.served_bytes,
                     "peer_serves": self.serves,
-                    "peer_serve_misses": self.serve_misses}
+                    "peer_serve_misses": self.serve_misses,
+                    "peer_zc_bytes": self.zc_bytes,
+                    "peer_sendfile_bytes": self.sendfile_bytes,
+                    "peer_copy_bytes": self.copy_bytes}
 
     def close(self) -> None:
         if self._closed:

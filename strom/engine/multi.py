@@ -30,6 +30,7 @@ import contextlib
 import itertools
 import threading
 import time
+import weakref
 from typing import Sequence
 
 import numpy as np
@@ -135,6 +136,7 @@ class MultiRingEngine(Engine):
         n = rings if rings is not None else max(config.engine_rings, 1)
         if n < 1:
             raise ValueError("need at least one ring")
+        self._variant = variant
         self._children: list[UringEngine] = []
         try:
             for _ in range(n):
@@ -170,6 +172,19 @@ class MultiRingEngine(Engine):
         self._quarantined: set[int] = set()
         self._quarantine_after = max(
             int(getattr(config, "breaker_min_events", 8)), 1)
+        # opt-in quarantine recovery (ISSUE 16): with ring_recovery_s > 0 a
+        # quarantined member is REBUILT (fresh ring, fresh fd table) after
+        # the cooldown and its dest-slab registrations replayed, so a
+        # recovered ring rejoins on the READ_FIXED fast path instead of
+        # serving unregistered until context rebuild. 0 keeps the sticky
+        # ISSUE-9 behaviour bit-for-bit.
+        self._recovery_s = float(getattr(config, "ring_recovery_s", 0.0))
+        self._quarantine_t: dict[int, float] = {}
+        self._ring_recoveries = 0
+        # live dest-slab registrations by base addr (weakrefs: tracking must
+        # not extend slab lifetime past the pool's finalizers) — the replay
+        # source for a rebuilt ring. Guarded by _reg_lock.
+        self._dest_refs: dict[int, "weakref.ref"] = {}
 
     @property
     def num_rings(self) -> int:
@@ -278,14 +293,26 @@ class MultiRingEngine(Engine):
                     d.unregister_dest(arr)
                 return -1
             done.append(c)
+        # track for quarantine-recovery replay (ISSUE 16): only slabs that
+        # registered on EVERY ring (the caller's unregister hook exists)
+        with self._reg_lock:
+            self._dest_refs[arr.__array_interface__["data"][0]] = \
+                weakref.ref(arr)
         return 0
 
     def unregister_dest(self, arr: np.ndarray) -> None:
-        for c in self._children:
+        addr = arr.__array_interface__["data"][0]
+        with self._reg_lock:
+            self._dest_refs.pop(addr, None)
+            children = list(self._children)
+        for c in children:
             c.unregister_dest(arr)
 
     def unregister_dest_addr(self, addr: int) -> None:
-        for c in self._children:
+        with self._reg_lock:
+            self._dest_refs.pop(addr, None)
+            children = list(self._children)
+        for c in children:
             c.unregister_dest_addr(addr)
 
     # -- the vectored hot path: route, fan out, join ------------------------
@@ -335,15 +362,79 @@ class MultiRingEngine(Engine):
                 and self._ring_errors[ring] >= self._quarantine_after \
                 and len(self._healthy_rings()) > 1:
             self._quarantined.add(ring)
+            self._quarantine_t[ring] = time.monotonic()
             with contextlib.suppress(Exception):
                 self.op_scope.add("ring_quarantines")
                 self.op_scope.set_gauge("rings_quarantined",
                                         len(self._quarantined))
 
+    def _maybe_recover_rings(self) -> None:
+        """Opt-in quarantine recovery (ISSUE 16, ring_recovery_s > 0):
+        rebuild members whose cooldown expired. A fresh child (new ring fd,
+        fd table, staging pool) replaces the sick one, its lazy file map is
+        dropped (files re-register on first touch), and every live dest
+        slab is RE-REGISTERED on the rebuilt ring — without the replay a
+        recovered ring silently serves plain READ instead of READ_FIXED
+        until the whole context is rebuilt (the satellite bug).
+
+        Lock order matches the gather path (ring lock → _reg_lock); the
+        ring lock is taken non-blocking so recovery never stalls a live
+        gather — a busy ring just retries on the next call."""
+        now = time.monotonic()
+        due = [r for r in sorted(self._quarantined)
+               if now - self._quarantine_t.get(r, now) >= self._recovery_s]
+        if not due:
+            return
+        from strom.engine.uring_engine import UringEngine
+
+        for ring in due:
+            # stromlint: ignore[lock-order] -- non-blocking try-acquire
+            # (a busy ring just skips this recovery pass), released in
+            # the finally below; a with-statement can't express the
+            # skip-on-contention shape
+            if not self._ring_locks[ring].acquire(blocking=False):
+                continue
+            try:
+                try:
+                    child = UringEngine(self.config, variant=self._variant)
+                except Exception:  # stromlint: ignore[swallowed-exceptions] -- a rebuild failure means the fault persists: stay quarantined (degraded-but-serving beats raising out of a healthy gather) and retry after another cooldown
+                    self._quarantine_t[ring] = now
+                    continue
+                sc = getattr(self, "_op_scope", None)
+                if sc is not None:
+                    child.set_scope(sc)
+                with self._reg_lock:
+                    for addr, ref in list(self._dest_refs.items()):
+                        arr = ref()
+                        if arr is None:
+                            self._dest_refs.pop(addr, None)
+                            continue
+                        if child.register_dest(arr) < 0:
+                            # the slab stays registered on the peers; this
+                            # ring serves it unregistered — the coverage
+                            # ratio gauge makes the gap visible
+                            self.op_scope.add("ring_recovery_reg_failures")
+                    old = self._children[ring]
+                    self._children[ring] = child
+                    self._child_fi[ring] = {}
+                    self._quarantined.discard(ring)
+                    self._quarantine_t.pop(ring, None)
+                    self._ring_errors[ring] = 0
+                    self._ring_recoveries += 1
+                with contextlib.suppress(Exception):
+                    self.op_scope.add("ring_recoveries")
+                    self.op_scope.set_gauge("rings_quarantined",
+                                            len(self._quarantined))
+                old.close()
+            finally:
+                self._ring_locks[ring].release()
+
     def read_vectored(self, chunks: Sequence[tuple[int, int, int, int]],
                       dest: np.ndarray, *, retries: int = 1) -> int:
         if self._closed:
             raise EngineError(9, "engine closed")
+        if self._recovery_s > 0 and self._quarantined:
+            self._maybe_recover_rings()
         files = {c[0] for c in chunks}
         n = len(self._children)
         healthy = self._healthy_rings()
@@ -419,6 +510,8 @@ class MultiRingEngine(Engine):
         completions), released at drain/cancel."""
         if self._closed:
             raise EngineError(9, "engine closed")
+        if self._recovery_s > 0 and self._quarantined:
+            self._maybe_recover_rings()
         n = len(self._children)
         files = {c[0] for c in chunks}
         healthy = self._healthy_rings()
@@ -594,8 +687,17 @@ class MultiRingEngine(Engine):
                     "ops_faulted", "bytes_read", "unaligned_fallback_reads",
                     "eof_topup_reads", "chunk_retries", "ops_fixed",
                     "cached_bytes", "media_bytes", "residency_probes",
-                    "ops_written", "bytes_written", "in_flight"):
+                    "ops_written", "bytes_written", "in_flight",
+                    "enter_submit_calls", "sqpoll_wakeups"):
             out[key] = sum(int(s.get(key, 0)) for s in per_ring)
+        # coverage ratio from the AGGREGATED counters (a mean of per-ring
+        # ratios would weight an idle ring equal to a busy one)
+        out["engine_fixed_buf_ratio"] = (
+            out["ops_fixed"] / out["ops_submitted"]
+            if out["ops_submitted"] else 0.0)
+        out["engine_unregistered_reads"] = max(
+            0, out["ops_submitted"] - out["ops_fixed"])
+        out["ring_recoveries"] = self._ring_recoveries
         # feature flags: children share one config, ring 0 speaks for all
         for key in ("fixed_buffers", "fixed_files", "mlocked", "coop_taskrun",
                     "sqpoll", "sparse_table"):

@@ -188,6 +188,16 @@ class PythonEngine(Engine):
         snap = self._stats.snapshot()
         snap["in_flight"] = self.in_flight()
         snap["engine"] = self.name
+        # registered-buffer coverage keys (ISSUE 16): the thread-pool engine
+        # has no fixed-buffer path, so coverage is honestly zero and every
+        # submitted op counts as unregistered — same stats()["engine"] shape
+        # as the uring engine, so compare_rounds columns and /metrics never
+        # see a missing key when the fallback engine is active.
+        snap["ops_fixed"] = 0
+        snap["engine_fixed_buf_ratio"] = 0.0
+        snap["engine_unregistered_reads"] = int(snap.get("ops_submitted", 0))
+        snap["enter_submit_calls"] = 0
+        snap["sqpoll_wakeups"] = 0
         return snap
 
     def close(self) -> None:

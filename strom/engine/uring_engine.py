@@ -60,6 +60,8 @@ class _ScStats(ctypes.Structure):
         ("residency_probes", ctypes.c_uint64),
         ("ops_written", ctypes.c_uint64),
         ("bytes_written", ctypes.c_uint64),
+        ("enter_submit_calls", ctypes.c_uint64),
+        ("sqpoll_wakeups", ctypes.c_uint64),
     ]
 
 
@@ -277,6 +279,23 @@ class UringEngine(Engine):
             if reg is not None:
                 self._lib.sc_unregister_dest(self._h, reg[0])
 
+    def _dest_index(self, base: int, need: int) -> int:
+        """Registered-buffer table index whose entry covers
+        [base, base+need), or -1. Delivery gathers mostly land in VIEWS of
+        a registered slab (scheduler slices, pool sub-spans) whose data
+        pointer sits strictly inside the registration; the kernel
+        bounds-checks READ_FIXED addresses against the whole entry, so an
+        interior match rides the fixed path just like an exact one."""
+        reg = self._dest_regs.get(base)
+        if reg is not None and need <= reg[1]:
+            return reg[0]
+        # snapshot: registrations are few (one per live slab) and a GC
+        # finalizer may mutate the dict from another thread mid-scan
+        for addr, (idx, ln) in list(self._dest_regs.items()):
+            if addr <= base and base + need <= addr + ln:
+                return idx
+        return -1
+
     def submit(self, requests: Sequence[ReadRequest]) -> int:
         self._note_submitted(requests)
         for i, r in enumerate(requests):
@@ -441,8 +460,7 @@ class UringEngine(Engine):
         for i, (fi, fo, do, ln) in enumerate(chunks):
             segs[i] = _ScVecSeg(fi, ln, fo, do)
         base = d8.__array_interface__["data"][0]
-        reg = self._dest_regs.get(base)
-        dest_buf_index = reg[0] if reg is not None and need <= reg[1] else -1
+        dest_buf_index = self._dest_index(base, need)
         before = self._native_lat_snapshot()
         res = self._lib.sc_read_vectored(self._h, segs, len(chunks),
                                          ctypes.c_void_p(base),
@@ -530,6 +548,20 @@ class UringEngine(Engine):
             "sparse_table": bool(s.sparse_table),
             "ext_buffers": int(s.ext_buffers),
             "ops_fixed": int(s.ops_fixed),
+            # registered-buffer coverage (ISSUE 16): what fraction of ops
+            # rode READ_FIXED/WRITE_FIXED. The complement is named
+            # *_unregistered_reads (reads dominate the op mix); both feed
+            # /metrics and the compare_rounds engine column — the mechanism
+            # half's before/after proof.
+            "engine_fixed_buf_ratio":
+                (s.ops_fixed / s.ops_submitted) if s.ops_submitted else 0.0,
+            "engine_unregistered_reads":
+                max(0, int(s.ops_submitted) - int(s.ops_fixed)),
+            # submit-side io_uring_enter calls: under SQPOLL the poller
+            # consumes published SQEs with no enter at all, so this per-GB
+            # is the measured syscall A/B the sqpoll knob is gated on
+            "enter_submit_calls": int(s.enter_submit_calls),
+            "sqpoll_wakeups": int(s.sqpoll_wakeups),
             "read_latency_mean_us": (s.lat_total_us / total) if total else 0.0,
             # exact accumulated sum: the exposition's histogram _sum reads
             # this instead of reconstructing mean*count

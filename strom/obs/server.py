@@ -28,6 +28,10 @@ same way — four routes, no dependencies beyond ``http.server``:
 - ``GET /slo``     — the per-tenant SLO engine's report (ISSUE 8): one
   row per tenant with targets, good%, fast/slow-window burn rates and
   the burning verdict. 404 when the owning context has no SLO engine.
+- ``GET /tune``    — the closed-loop knob autotuner's state (ISSUE 16):
+  controller counters (moves/reverts/holds), baseline-vs-best objective
+  and the live knob values. 404 when the context has no tuner
+  (``tune=False``).
 - ``GET /history`` — the bounded snapshot-history ring
   (strom/obs/history.py): ``?since_s=`` / ``?keys=a,b`` filter; true
   ``rate()`` math without an external TSDB. 404 without a history.
@@ -193,6 +197,17 @@ class MetricsServer:
                                        json.dumps(hist.snapshot(
                                            since, keys)).encode(),
                                        "application/json")
+                    elif path == "/tune":
+                        tuner = getattr(server._ctx, "tuner", None)
+                        if tuner is None:
+                            self._send(404, b"no autotuner on this "
+                                            b"context (tune=False)\n",
+                                       "text/plain")
+                        else:
+                            self._send(200,
+                                       json.dumps(tuner.stats(),
+                                                  default=str).encode(),
+                                       "application/json")
                     elif path == "/flight":
                         dump = q.get("dump", ["0"])[0] not in ("0", "", "no")
                         self._send(200,
@@ -202,7 +217,7 @@ class MetricsServer:
                     else:
                         self._send(404, b"not found: try /metrics /stats "
                                         b"/trace /flight /tenants /slo "
-                                        b"/history\n",
+                                        b"/tune /history\n",
                                    "text/plain")
                 except _BadQuery as e:
                     with contextlib.suppress(Exception):

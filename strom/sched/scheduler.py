@@ -124,6 +124,8 @@ class IoScheduler:
         # engines with internal per-ring arbitration keep their concurrency:
         # grants are non-exclusive there (budgets/accounting still apply)
         self.exclusive = not getattr(engine, "concurrent_gathers", False)
+        # live slice-size override (ISSUE 16 autotuner); None = config/auto
+        self.slice_bytes_override: int | None = None
         self._cond = make_condition("sched.arbiter")
         self._tenants: dict[str, Tenant] = {}
         self._current: _Waiter | None = None
@@ -470,6 +472,12 @@ class IoScheduler:
 
     # -- sliced gather execution (the delivery hot path) --------------------
     def _slice_bytes(self) -> int:
+        # live-tunable override (ISSUE 16 autotuner): the config is frozen,
+        # so the tuner writes here; None defers to config/auto. Read fresh
+        # per call — a move takes effect on the next slice boundary.
+        ov = getattr(self, "slice_bytes_override", None)
+        if ov is not None and ov >= 0:
+            return int(ov)
         sb = getattr(self.config, "sched_slice_bytes", -1)
         if sb >= 0:
             return sb

@@ -1,0 +1,107 @@
+"""Knob surfaces the autotuner can move at runtime.
+
+A :class:`Knob` is a named scalar with live get/set accessors and bounds.
+The tuner only ever moves values through ``set`` — every surface here is
+one that the owning component re-reads on its next decision (scheduler
+slice size per grant, cache budget per admit, prefetch depth per fill), so
+a move takes effect without restarting anything and a revert is exact.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable
+
+
+@dataclasses.dataclass
+class Knob:
+    """One tunable scalar: live accessors, bounds, and a search step.
+
+    ``step`` is the tuner's initial move size; ``quantize`` snaps proposed
+    values onto the surface's legal grid (int depths, 4 KiB-aligned byte
+    budgets) so a knob can never be set to a value its owner would reject.
+    """
+
+    name: str
+    get: Callable[[], float]
+    set: Callable[[float], None]
+    lo: float
+    hi: float
+    step: float
+    quantize: Callable[[float], float] | None = None
+    # smallest meaningful move — the tuner's step-halving floors here so
+    # refinement can never shrink a proposal below the quantization grid
+    # (where quantize would collapse it to a no-op and pin the knob)
+    min_step: float | None = None
+
+    @property
+    def step_floor(self) -> float:
+        return self.min_step if self.min_step is not None else self.step / 8
+
+    def clamp(self, value: float) -> float:
+        v = min(max(value, self.lo), self.hi)
+        if self.quantize is not None:
+            v = self.quantize(v)
+        return min(max(v, self.lo), self.hi)
+
+
+def _quant_int(v: float) -> float:
+    return float(int(round(v)))
+
+
+def _quant_4k(v: float) -> float:
+    return float(max(int(v) // 4096, 1) * 4096)
+
+
+def prefetcher_knob(pf, *, max_depth: int | None = None) -> Knob:
+    """Depth knob over a live :class:`strom.delivery.prefetch.Prefetcher`."""
+    hi = float(max_depth if max_depth is not None
+               else getattr(pf, "_max_depth", 16))
+    return Knob(name="prefetch_depth",
+                get=lambda: float(pf.depth),
+                set=lambda v: pf.set_depth(int(v)),
+                lo=float(getattr(pf, "_min_depth", 1)), hi=hi,
+                step=1.0, quantize=_quant_int, min_step=1.0)
+
+
+def standard_knobs(ctx) -> list[Knob]:
+    """The knobs a :class:`StromContext` exposes, built from whichever
+    surfaces this context actually has (scheduler off → no slice knob,
+    cache off → no budget knob). Pipelines append their own (prefetch
+    depth via :func:`prefetcher_knob`)."""
+    knobs: list[Knob] = []
+    sched = getattr(ctx, "scheduler", None)
+    if sched is not None:
+        base = float(sched._slice_bytes() or ctx.config.queue_depth
+                     * ctx.config.block_size)
+
+        def _set_slice(v: float, _s=sched) -> None:
+            _s.slice_bytes_override = int(v)
+
+        knobs.append(Knob(
+            name="sched_slice_bytes",
+            get=lambda _s=sched: float(_s._slice_bytes()),
+            set=_set_slice,
+            # an order of magnitude either side of the configured/auto
+            # slice: enough room to matter, bounded so one runaway arm
+            # can't turn slicing off entirely
+            lo=max(base / 8, 256 * 1024.0), hi=base * 8,
+            step=max(base / 4, 256 * 1024.0), quantize=_quant_4k,
+            min_step=4096.0))
+    cache = getattr(ctx, "hot_cache", None)
+    if cache is not None:
+        base = float(cache.max_bytes)
+
+        def _set_budget(v: float, _c=cache) -> None:
+            _c.max_bytes = int(v)
+
+        knobs.append(Knob(
+            name="cache_budget_bytes",
+            get=lambda _c=cache: float(_c.max_bytes),
+            set=_set_budget,
+            # never below half the configured budget (shrinking a warm
+            # cache evicts; the tuner explores, it must not thrash) and at
+            # most 2x (host memory is someone else's budget too)
+            lo=base / 2, hi=base * 2,
+            step=base / 8, quantize=_quant_4k, min_step=4096.0))
+    return knobs

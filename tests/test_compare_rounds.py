@@ -482,3 +482,38 @@ def test_write_section_hidden_without_keys(tmp_path, capsys):
     p.write_text(json.dumps(OLD_ROUND))
     assert compare_rounds.main([str(p)]) == 0
     assert "write path" not in capsys.readouterr().out
+
+
+def test_tune_keys_match_producers():
+    """Producer↔report key parity for the kernel-bypass/autotune section
+    (ISSUE 16, the decode/stall/.../dist pattern): the compare_rounds
+    tune columns must be EXACTLY the keys the tune + nvme bench arms emit
+    (single-sourced in strom.tune.TUNE_BENCH_FIELDS) — a rename on either
+    side is a silently dead column."""
+    from strom.tune import TUNE_BENCH_FIELDS
+
+    assert list(compare_rounds.TUNE_KEYS) == list(TUNE_BENCH_FIELDS)
+
+
+def test_tune_section_renders(tmp_path, capsys):
+    """A round carrying tune/sqpoll keys gets the kernel-bypass section."""
+    d = dict(NEW_ROUND)
+    d.update({"hand_items_per_s": 2571.0, "tuned_items_per_s": 2728.4,
+              "tuned_vs_hand": 1.0612, "tune_moves": 2, "tune_reverts": 1,
+              "plain_submit_syscalls_per_gb": 238.4,
+              "sqpoll_submit_syscalls_per_gb": 14.9, "sqpoll_active": 1})
+    p = tmp_path / "BENCH_r16.json"
+    p.write_text(json.dumps(d))
+    assert compare_rounds.main([str(p)]) == 0
+    out = capsys.readouterr().out
+    assert "kernel bypass & autotune" in out
+    assert "tuned_vs_hand" in out
+    assert "sqpoll_submit_syscalls_per_gb" in out
+    assert "1.061" in out
+
+
+def test_tune_section_hidden_without_tune_keys(tmp_path, capsys):
+    p = tmp_path / "BENCH_r02.json"
+    p.write_text(json.dumps(OLD_ROUND))
+    assert compare_rounds.main([str(p)]) == 0
+    assert "kernel bypass" not in capsys.readouterr().out

@@ -127,7 +127,9 @@ def test_peer_hit_zero_engine_reads(tmp_path):
         assert B.engine.stats().get("bytes_read", 0) - b0 == 0
         tier = B.peer_tier.stats()
         assert tier["peer_hit_bytes"] == 8192
-        assert A.peer_server.stats()["peer_served_bytes"] == 8192
+        # the server tallies a beat after the client has its bytes: poll
+        assert _wait_stats(A.peer_server, lambda s: s["peer_serves"] >= 1
+                           )["peer_served_bytes"] == 8192
 
         # promotion: the next read of the same range never leaves B
         hits0 = B.peer_tier.stats()["peer_hits"]
@@ -153,6 +155,70 @@ def test_peer_miss_falls_back_to_engine(tmp_path):
         st = B.peer_tier.stats()
         assert st["peer_misses"] >= 1 and st["peer_errors"] == 0
         assert A.peer_server.stats()["peer_serve_misses"] >= 1
+    finally:
+        A.close()
+        B.close()
+
+
+def _wait_stats(server, pred, timeout=5.0):
+    deadline = time.monotonic() + timeout
+    while True:
+        st = server.stats()
+        if pred(st) or time.monotonic() >= deadline:
+            return st
+        time.sleep(0.01)
+
+
+def test_peer_zc_serve_bit_identical(tmp_path):
+    """The zero-copy exporter (dist_send_zc, ISSUE 16) is wire-compatible:
+    a zc server serves the same bytes to an unmodified client, counts them
+    under peer_zc_bytes, and never touches the bounce path."""
+    p, payload = _fixture(tmp_path)
+    A = StromContext(_cfg(dist_send_zc=True))
+    B = StromContext(_cfg())
+    try:
+        addr = A.serve_peers()
+        A.pread(p, 0, payload.nbytes)
+        B.attach_peers({0: addr}, owner_fn=lambda path: 0)
+        b0 = B.engine.stats().get("bytes_read", 0)
+        # one send below the MSG_ZEROCOPY threshold, one above it: both
+        # ride the pinned-view path, the large one with the flag
+        for lo, n in ((1024, 8192), (64 << 10, 128 * 1024)):
+            got = B.pread(p, lo, n)
+            assert bytes(got) == payload[lo:lo + n].tobytes()
+        assert B.engine.stats().get("bytes_read", 0) - b0 == 0
+        # the server tallies AFTER reaping zc completions, a beat after the
+        # client has its bytes — poll instead of racing it
+        st = _wait_stats(A.peer_server, lambda s: s["peer_serves"] >= 2)
+        assert st["peer_zc_bytes"] + st["peer_sendfile_bytes"] \
+            >= 8192 + 128 * 1024
+        assert st["peer_copy_bytes"] == 0
+        assert st["peer_serves"] == 2
+    finally:
+        A.close()
+        B.close()
+
+
+def test_peer_zc_serves_spilled_extents_via_sendfile(tmp_path):
+    """A zc server whose extent demoted to the spill tier ships it with
+    sendfile(2) — correct bytes, no bounce, counted separately."""
+    p, payload = _fixture(tmp_path)
+    # cache far smaller than the file: the head of the sequential read is
+    # evicted into the spill file by the time the tail is admitted
+    A = StromContext(_cfg(hot_cache_bytes=96 << 10, spill_bytes=8 << 20,
+                          spill_dir=str(tmp_path), dist_send_zc=True))
+    B = StromContext(_cfg())
+    try:
+        addr = A.serve_peers()
+        for off in range(0, payload.nbytes, 32 << 10):
+            A.pread(p, off, 32 << 10)
+        B.attach_peers({0: addr}, owner_fn=lambda path: 0)
+        got = B.pread(p, 0, 64 << 10)
+        assert bytes(got) == payload[:64 << 10].tobytes()
+        st = _wait_stats(A.peer_server, lambda s: s["peer_serves"] >= 1)
+        assert st["peer_copy_bytes"] == 0
+        assert st["peer_sendfile_bytes"] > 0
+        assert st["peer_sendfile_bytes"] + st["peer_zc_bytes"] == 64 << 10
     finally:
         A.close()
         B.close()

@@ -143,3 +143,122 @@ def test_memcpy_and_unregister_roundtrip(tmp_path, rng):
 def test_engine_rings_validation():
     with pytest.raises(ValueError, match="engine_rings"):
         StromConfig(engine_rings=0)
+
+
+def _uring_engine(rings: int, **cfg_kw):
+    from strom.engine import make_engine
+    from strom.engine.uring_engine import uring_available
+
+    if not uring_available():
+        pytest.skip("io_uring unavailable")
+    return make_engine(StromConfig(engine="uring", engine_rings=rings,
+                                   **cfg_kw))
+
+
+def test_fixed_buf_ratio_covers_registered_reads(tmp_path, rng):
+    """Registered-buffer coverage gauge (ISSUE 16 satellite): a gather
+    into a REGISTERED dest rides READ_FIXED on every ring, so the
+    aggregated ratio reads 1.0 with zero unregistered reads; the same
+    gather into a plain array drops the ratio and counts the complement."""
+    from strom.delivery.buffers import alloc_aligned
+
+    data = rng.integers(0, 256, size=1024 * 1024, dtype=np.uint8)
+    path = tmp_path / "f.bin"
+    data.tofile(path)
+    eng = _uring_engine(2, residency_hybrid=False)
+    try:
+        if not eng.stats().get("fixed_buffers"):
+            pytest.skip("kernel lacks fixed buffers")
+        fi = eng.register_file(str(path), o_direct=True)
+        dest = alloc_aligned(len(data))
+        assert eng.register_dest(dest) == 0
+        got = eng.read_vectored([(fi, 0, 0, len(data))], dest)
+        assert got == len(data)
+        np.testing.assert_array_equal(dest[:got], data)
+        s = eng.stats()
+        assert s["engine_fixed_buf_ratio"] == 1.0, s
+        assert s["engine_unregistered_reads"] == 0, s
+        # unregistered dest: the complement shows up in the gauge pair
+        plain = np.empty(len(data), dtype=np.uint8)
+        eng.read_vectored([(fi, 0, 0, len(data))], plain)
+        s = eng.stats()
+        assert s["engine_fixed_buf_ratio"] < 1.0, s
+        assert s["engine_unregistered_reads"] > 0, s
+    finally:
+        eng.close()
+
+
+def test_fixed_path_covers_interior_views(tmp_path, rng):
+    """A gather whose dest is a VIEW into a registered slab (data pointer
+    strictly inside the registration) still rides READ_FIXED: the kernel
+    bounds-checks the address against the whole registered entry, and the
+    engine resolves interior pointers, not just exact slab bases. This is
+    the shape delivery produces when the scheduler hands an engine a
+    sliced sub-span of a pool slab."""
+    from strom.delivery.buffers import alloc_aligned
+
+    n = 256 * 1024
+    data = rng.integers(0, 256, size=n, dtype=np.uint8)
+    path = tmp_path / "f.bin"
+    data.tofile(path)
+    eng = _uring_engine(2, residency_hybrid=False)
+    try:
+        if not eng.stats().get("fixed_buffers"):
+            pytest.skip("kernel lacks fixed buffers")
+        fi = eng.register_file(str(path), o_direct=True)
+        slab = alloc_aligned(n + 16384)
+        assert eng.register_dest(slab) == 0
+        view = slab[8192:8192 + n]  # 512-aligned interior pointer
+        got = eng.read_vectored([(fi, 0, 0, n)], view)
+        assert got == n
+        np.testing.assert_array_equal(view[:n], data)
+        s = eng.stats()
+        assert s["engine_fixed_buf_ratio"] == 1.0, s
+        assert s["engine_unregistered_reads"] == 0, s
+    finally:
+        eng.close()
+
+
+def test_ring_recovery_reregisters_dest_buffers(tmp_path, rng):
+    """Quarantine recovery replays buffer registrations (ISSUE 16
+    satellite): after a member ring is rebuilt, every live dest slab must
+    be registered on the NEW child — without the replay a recovered ring
+    silently serves plain READ instead of READ_FIXED."""
+    import errno
+    import time as _time
+
+    from strom.delivery.buffers import alloc_aligned
+    from strom.engine.base import EngineError
+
+    data = rng.integers(0, 256, size=512 * 1024, dtype=np.uint8)
+    path = tmp_path / "f.bin"
+    data.tofile(path)
+    eng = _uring_engine(2, breaker_min_events=2, ring_recovery_s=0.05,
+                        residency_hybrid=False)
+    try:
+        if not eng.stats().get("fixed_buffers"):
+            pytest.skip("kernel lacks fixed buffers")
+        dest = alloc_aligned(len(data))
+        assert eng.register_dest(dest) == 0
+        sick = eng._children[0]
+        e = EngineError(errno.EIO, "injected")
+        eng._note_ring_error(0, e)
+        eng._note_ring_error(0, e)
+        assert eng.stats()["quarantined_rings"] == [0]
+        _time.sleep(0.08)
+        eng._maybe_recover_rings()
+        s = eng.stats()
+        assert s["quarantined_rings"] == [], s
+        assert s["ring_recoveries"] == 1, s
+        child = eng._children[0]
+        assert child is not sick
+        # the replay: the rebuilt ring carries the live slab registration
+        assert child.stats()["ext_buffers"] == 1, child.stats()
+        # and serves it via READ_FIXED, byte-exact
+        fi = eng.register_file(str(path), o_direct=True)
+        got = eng.read_vectored([(fi, 0, 0, len(data))], dest)
+        assert got == len(data)
+        np.testing.assert_array_equal(dest[:got], data)
+        assert eng.stats()["engine_fixed_buf_ratio"] == 1.0
+    finally:
+        eng.close()

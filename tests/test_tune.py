@@ -1,0 +1,207 @@
+"""Autotuner controller contracts (ISSUE 16) on a fake clock.
+
+The controller is exercised via ``step()`` directly — no driver thread, no
+real time. The synthetic landscape is deterministic, so every accept /
+revert / hold decision here is a hard contract, not a flaky heuristic.
+"""
+
+from __future__ import annotations
+
+import pytest
+
+from strom.tune import TUNE_FIELDS, Autotuner, Knob, Profile
+
+
+class FakeClock:
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self) -> float:
+        return self.t
+
+    def advance(self, dt: float) -> None:
+        self.t += dt
+
+
+class Landscape:
+    """objective = peak - (x - opt)^2: a single-peak synthetic knob
+    surface the coordinate descent must climb."""
+
+    def __init__(self, start: float, opt: float, peak: float = 100.0):
+        self.x = start
+        self.opt = opt
+        self.peak = peak
+        self.burning = False
+
+    def knob(self, *, lo=0.0, hi=20.0, step=1.0) -> Knob:
+        return Knob(name="x", get=lambda: self.x,
+                    set=lambda v: setattr(self, "x", v),
+                    lo=lo, hi=hi, step=step,
+                    quantize=lambda v: float(round(v)), min_step=1.0)
+
+    def metrics(self) -> dict:
+        return {"objective": self.peak - (self.x - self.opt) ** 2,
+                "slo_burning": self.burning}
+
+
+def _mk(land: Landscape, **kw) -> tuple[Autotuner, FakeClock]:
+    clock = FakeClock()
+    tuner = Autotuner([land.knob()], land.metrics, clock=clock, **kw)
+    return tuner, clock
+
+
+def drive(tuner: Autotuner, clock: FakeClock, steps: int) -> list[str]:
+    out = []
+    for _ in range(steps):
+        out.append(tuner.step())
+        clock.advance(1.0)
+    return out
+
+
+class TestConvergence:
+    def test_climbs_to_the_optimum(self):
+        land = Landscape(start=2.0, opt=9.0)
+        tuner, clock = _mk(land)
+        drive(tuner, clock, 60)
+        # coordinate descent with integer quantization must land within
+        # one quantum of the peak and stay there
+        assert abs(land.x - land.opt) <= 1.0
+        s = tuner.stats()
+        assert s["tune_moves"] >= 5          # it actually walked there
+        assert s["tuned_vs_baseline"] >= 1.0
+
+    def test_tuned_never_below_baseline(self):
+        """The safety contract tuned_vs_hand rides on: only measured-better
+        moves persist, so the objective at ANY settled point is >= the
+        first measurement."""
+        land = Landscape(start=15.0, opt=5.0)
+        tuner, clock = _mk(land)
+        baseline = land.metrics()["objective"]
+        for _ in range(80):
+            tuner.step()
+            clock.advance(1.0)
+            if tuner._pending is None:  # settled state only
+                assert land.metrics()["objective"] >= baseline - 1e-9
+
+    def test_converges_from_above(self):
+        land = Landscape(start=18.0, opt=6.0)
+        tuner, clock = _mk(land)
+        drive(tuner, clock, 80)
+        assert abs(land.x - land.opt) <= 1.0
+
+
+class TestGuardedStep:
+    def test_regression_is_reverted(self):
+        """A trial that measures worse is undone exactly."""
+        land = Landscape(start=9.0, opt=9.0)  # already at the peak
+        tuner, clock = _mk(land)
+        assert tuner.step() == "propose"      # first beat measures+proposes
+        moved = land.x
+        assert moved != 9.0
+        assert tuner.step() == "revert"       # any move off the peak loses
+        assert land.x == 9.0
+        assert tuner.stats()["tune_reverts"] == 1
+
+    def test_hard_regression_halves_the_step(self):
+        land = Landscape(start=9.0, opt=9.0)
+        clock = FakeClock()
+        # coarse step: moving 2 off the peak costs 4 points > guard band
+        tuner = Autotuner([land.knob(step=2.0)], land.metrics,
+                          clock=clock, guard_frac=0.01)
+        tuner.step()
+        tuner.step()  # revert past the guard band
+        assert tuner._step["x"] == 1.0
+
+    def test_both_directions_worse_advances_the_cursor(self):
+        land = Landscape(start=9.0, opt=9.0)
+        tuner, clock = _mk(land)
+        start_i = tuner._knob_i
+        drive(tuner, clock, 6)  # two full failed trials in both directions
+        assert tuner._knob_i > start_i
+        assert land.x == 9.0
+
+
+class TestSloHold:
+    def test_never_tunes_while_burning(self):
+        land = Landscape(start=2.0, opt=9.0)
+        land.burning = True
+        tuner, clock = _mk(land)
+        results = drive(tuner, clock, 10)
+        assert set(results) == {"hold"}
+        assert land.x == 2.0                  # not one knob moved
+        assert tuner.stats()["tune_holds"] == 10
+        assert tuner.stats()["tune_trials"] == 0
+
+    def test_inflight_trial_reverted_on_burn(self):
+        land = Landscape(start=2.0, opt=9.0)
+        tuner, clock = _mk(land)
+        assert tuner.step() == "propose"
+        assert land.x != 2.0
+        land.burning = True
+        assert tuner.step() == "hold"         # the trial is rolled back
+        assert land.x == 2.0
+        land.burning = False
+        assert tuner.step() == "propose"      # resumes when clean
+
+
+class TestProfiles:
+    def test_save_load_round_trip(self, tmp_path):
+        land = Landscape(start=2.0, opt=9.0)
+        tuner, clock = _mk(land, profile_name="resnet")
+        drive(tuner, clock, 40)
+        p = tuner.profile()
+        path = str(tmp_path / "resnet.json")
+        p.save(path)
+        q = Profile.load(path)
+        assert q.name == "resnet"
+        assert q.knobs == p.knobs
+        assert q.objective == pytest.approx(p.objective)
+
+    def test_apply_profile_sets_and_clamps(self):
+        land = Landscape(start=2.0, opt=9.0)
+        tuner, _ = _mk(land)
+        n = tuner.apply_profile(Profile(name="p", knobs={"x": 500.0,
+                                                         "ghost": 3.0}))
+        assert n == 1                          # unknown names are ignored
+        assert land.x == 20.0                  # clamped to the knob's hi
+
+    def test_saved_profile_restarts_at_the_converged_point(self, tmp_path):
+        land = Landscape(start=2.0, opt=9.0)
+        tuner, clock = _mk(land)
+        drive(tuner, clock, 60)
+        path = str(tmp_path / "p.json")
+        tuner.profile().save(path)
+        fresh = Landscape(start=2.0, opt=9.0)
+        t2, _ = _mk(fresh)
+        t2.apply_profile(Profile.load(path))
+        assert abs(fresh.x - land.x) < 1e-9
+
+
+class TestStatsSurface:
+    def test_every_tune_field_present_and_numeric(self):
+        land = Landscape(start=2.0, opt=9.0)
+        tuner, clock = _mk(land)
+        drive(tuner, clock, 8)
+        s = tuner.stats()
+        for k in TUNE_FIELDS:
+            assert k in s, f"missing {k}"
+            assert isinstance(s[k], (int, float)), k
+        assert isinstance(s["tune_profile"], str)
+        assert isinstance(s["tune_last_move"], str)
+        assert "x" in s["tune_knobs"]
+
+    def test_driver_thread_lifecycle(self):
+        land = Landscape(start=2.0, opt=9.0)
+        tuner = Autotuner([land.knob()], land.metrics, interval_s=0.01)
+        tuner.start()
+        try:
+            import time as _t
+
+            deadline = _t.monotonic() + 5.0
+            while tuner.stats()["tune_trials"] < 2:
+                assert _t.monotonic() < deadline, "tuner thread never ran"
+                _t.sleep(0.01)
+            assert tuner.stats()["tune_active"] == 1
+        finally:
+            tuner.close()
+        assert tuner.stats()["tune_active"] == 0

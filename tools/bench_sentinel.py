@@ -48,8 +48,8 @@ sys.path.insert(0, _HERE)  # runnable as a script from anywhere
 
 from compare_rounds import (BINDING_ORDER, CACHE_KEYS, DECODE2_KEYS,  # noqa: E402
                             DECODE_KEYS, DIST_KEYS, RESIL_KEYS, RESUME_KEYS,
-                            SLO_KEYS, STALL_KEYS, STREAM_KEYS, WRITE_KEYS,
-                            unwrap)
+                            SLO_KEYS, STALL_KEYS, STREAM_KEYS, TUNE_KEYS,
+                            WRITE_KEYS, unwrap)
 
 # The gated metric set: (metric, direction) over the single-sourced
 # comparison tuples, where direction is "up" (bigger is better) or "down"
@@ -130,6 +130,15 @@ SENTINEL_FIELDS = (
     # tier stopped serving, not weather
     ("dist_ok", "up"),
     ("dist_peer_hit_ratio", "up"),
+    # kernel bypass + autotuner (ISSUE 16): tuned_vs_hand is a same-run
+    # interleaved A/B ratio (weather-independent — the tuner's contract
+    # is never shipping knobs that measured worse, so a drop below ~1.0
+    # is a controller bug, not noise) and the SQPOLL arm's submit
+    # syscalls/GB is a same-run count per byte (the kernel poller either
+    # absorbs submissions or it doesn't — a rise means the probe fell
+    # back or the poller stopped keeping up)
+    ("tuned_vs_hand", "up"),
+    ("sqpoll_submit_syscalls_per_gb", "down"),
 )
 
 # absolute slack for count-like "down" metrics around small values: going
@@ -146,7 +155,7 @@ RATIO_DOWN = frozenset({"chaos_slowdown", "ckpt_async_stall_frac"})
 TABLE_KEYS = list(dict.fromkeys(
     BINDING_ORDER + DECODE_KEYS + DECODE2_KEYS + STALL_KEYS + CACHE_KEYS
     + STREAM_KEYS + SLO_KEYS + RESIL_KEYS + WRITE_KEYS + RESUME_KEYS
-    + DIST_KEYS))
+    + DIST_KEYS + TUNE_KEYS))
 
 
 def load_round(path: str) -> dict:

@@ -300,6 +300,26 @@ DIST_KEYS = [
     "dist_assembly_wait_p99_us",
     "dist_peer_rtt_p99_us",
 ]
+# kernel bypass & autotune (ISSUE 16): the tune arm's hand-vs-tuned A/B
+# (tuned_vs_hand >= 1.0 is the controller contract — guarded revert plus
+# a final interleaved validation means the tuner never ships knobs that
+# measured worse) plus the nvme arm's SQPOLL submit-syscall A/B and the
+# fixed-buffer registration coverage. Suffixes single-sourced in
+# strom.tune.TUNE_BENCH_FIELDS (parity-tested in
+# tests/test_compare_rounds.py, same contract as the other sections).
+TUNE_KEYS = [
+    "hand_items_per_s",
+    "tuned_items_per_s",
+    "tuned_vs_hand",
+    "tune_moves",
+    "tune_reverts",
+    "tune_holds",
+    "engine_fixed_buf_ratio",
+    "engine_unregistered_reads",
+    "plain_submit_syscalls_per_gb",
+    "sqpoll_submit_syscalls_per_gb",
+    "sqpoll_active",
+]
 # per-attempt / per-pass audit arrays (VERDICT.md r4 next #3): printed so
 # the best-of selection's discards are visible in the comparison too
 AUDIT_SUFFIXES = ("_attempts", "_passes")
@@ -446,10 +466,12 @@ def main(argv: list[str]) -> int:
                       for k in RESUME_KEYS)
     have_dist = any(cell(d, k) != "-" for _, d in rounds
                     for k in DIST_KEYS)
+    have_tune = any(cell(d, k) != "-" for _, d in rounds
+                    for k in TUNE_KEYS)
     name_w = max(len(k) for k in binding_keys + CONTEXT_KEYS + DECODE_KEYS
                  + DECODE2_KEYS + STALL_KEYS + CACHE_KEYS + STREAM_KEYS
                  + SCHED_KEYS + SLO_KEYS + RESIL_KEYS + WRITE_KEYS
-                 + RESUME_KEYS + DIST_KEYS + audit_keys) + 2
+                 + RESUME_KEYS + DIST_KEYS + TUNE_KEYS + audit_keys) + 2
     # every rendered cell folds into ONE column width, or rows misalign
     col_w = max(max(len(n) for n, _ in rounds) + 2, 12,
                 *(len(c) + 2 for cs in audit_cells.values() for c in cs),
@@ -536,6 +558,13 @@ def main(argv: list[str]) -> int:
               "to single-process; peer_hit_ratio = batch bytes served "
               "peer-to-peer, not re-read from SSD):")
         for k in DIST_KEYS:
+            print(k.ljust(name_w)
+                  + "".join(cell(d, k).rjust(col_w) for _, d in rounds))
+    if have_tune:
+        print("kernel bypass & autotune (tuned_vs_hand >= 1.0 = closed-loop "
+              "tuner never ships worse than the hand knobs; SQPOLL A/B = "
+              "submit syscalls/GB with and without the kernel poller):")
+        for k in TUNE_KEYS:
             print(k.ljust(name_w)
                   + "".join(cell(d, k).rjust(col_w) for _, d in rounds))
     if audit_keys:

@@ -13,8 +13,8 @@ Usage:
 
 Data sources (all server-side-filtered so a poll never pays for the
 expensive stall-attribution section):
-- ``/stats?sections=sched,cache`` — scheduler/cache sections + the scoped
-  (per-tenant labeled) registry snapshots;
+- ``/stats?sections=sched,cache,tune`` — scheduler/cache/autotuner
+  sections + the scoped (per-tenant labeled) registry snapshots;
 - ``/tenants`` — per-tenant queue/budget rows + the slo_burning flag;
 - ``/slo``     — burn rates per tenant.
 
@@ -72,7 +72,7 @@ def _scope_tenants(scopes: dict) -> dict[str, dict]:
 
 def sample(base: str) -> dict:
     """One poll: everything the table needs, already tenant-keyed."""
-    stats = fetch_json(base, "/stats?sections=sched,cache") or {}
+    stats = fetch_json(base, "/stats?sections=sched,cache,tune") or {}
     tenants = fetch_json(base, "/tenants") or {}
     slo = fetch_json(base, "/slo") or {}
     return {
@@ -130,6 +130,22 @@ def _fmt(v, nd: int = 1) -> str:
     return str(v)
 
 
+def _tune_line(tune: dict) -> "str | None":
+    """One status row for the closed-loop autotuner (absent when the
+    context runs without ``tune=True`` — the section simply isn't
+    served)."""
+    if not tune:
+        return None
+    state = "RUNNING" if tune.get("tune_active") else "stopped"
+    return (f"tune: {state}"
+            f"  profile={tune.get('tune_profile', '-') or '-'}"
+            f"  x{_fmt(tune.get('tuned_vs_baseline'), 3)} vs baseline"
+            f"  moves={tune.get('tune_moves', 0)}"
+            f" reverts={tune.get('tune_reverts', 0)}"
+            f" holds={tune.get('tune_holds', 0)}"
+            f"  last: {tune.get('tune_last_move', '-') or '-'}")
+
+
 def render(cur: dict, prev: "dict | None") -> str:
     """The whole screen as text (shared by --once, plain loop and curses)."""
     g = cur["global"]
@@ -140,11 +156,17 @@ def render(cur: dict, prev: "dict | None") -> str:
         f"  inflight={sched.get('sched_active_grants', '-')}"
         f"  queued={sched.get('sched_queued_ops', '-')}"
         f"  admission_waits={sched.get('slab_pool_admission_waits', '-')}",
+    ]
+    tline = _tune_line(cur["sections"].get("tune", {}))
+    if tline:
+        lines.append(tline)
+    lines += [
         "",
         (f"{'tenant':<14}{'prio':<13}{'queued':>7}{'active':>7}"
          f"{'wait_p99_ms':>13}{'MB/s':>9}{'hit%':>7}"
          f"{'burn_f':>8}{'burn_s':>8}  slo"),
     ]
+    n_header = len(lines)
     for r in rows(cur, prev):
         lines.append(
             f"{r['tenant']:<14}{r['prio']:<13}{r['queued']:>7}"
@@ -152,7 +174,7 @@ def render(cur: dict, prev: "dict | None") -> str:
             f"{_fmt(r['granted_mb_s']):>9}{_fmt(r['hit_pct']):>7}"
             f"{_fmt(r['burn_fast'], 2):>8}{_fmt(r['burn_slow'], 2):>8}"
             f"  {r['slo']}")
-    if len(lines) == 3:
+    if len(lines) == n_header:
         lines.append("(no tenants registered — single-tenant context?)")
     return "\n".join(lines)
 

@@ -49,6 +49,10 @@ LOCK_RANKS = {
     "app.ckpt_async": 14,      # AsyncCheckpointer writer bookkeeping
                                # (ISSUE 14; holds only for latch/future
                                # swaps — commits run outside it)
+    "app.tune": 15,            # Autotuner counters/state (ISSUE 16): a
+                               # leaf in practice — metrics_fn and
+                               # knob.set both run OUTSIDE it (metrics
+                               # walks the context's stats locks)
     # -- band: scheduler -----------------------------------------------------
     "sched.arbiter": 20,       # IoScheduler._cond (the fair-drain core)
     "sched.admission": 21,     # AdmissionGate._cond
