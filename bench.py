@@ -1041,8 +1041,14 @@ def main() -> int:
         # invariant. Keys copy via the single-sourced DIST_BENCH_FIELDS
         # tuple (parity-tested like the other sections); bench_sentinel
         # gates dist_ok up and dist_peer_hit_ratio up.
+        # ISSUE 18 rides the same arm: rank 0 federates every worker's
+        # /stats into a ClusterView; the FED_FIELDS gauges (hosts,
+        # unhealthy count, trace-linked ratio, scrape-lag p99) copy via
+        # the single-sourced tuple and bench_sentinel gates
+        # cluster_hosts_unhealthy exactly zero.
         from strom.cli import bench_dist
         from strom.dist.peers import DIST_BENCH_FIELDS
+        from strom.obs.federation import FED_FIELDS
 
         dsargs = argparse.Namespace(
             file=None, size=size, block=cfg.block_size, depth=32, iters=1,
@@ -1053,7 +1059,7 @@ def main() -> int:
         dsres = attempt("dist", lambda: bench_dist(dsargs)) \
             if phase_ok("dist", 120) else None
         if dsres is not None:
-            for k in DIST_BENCH_FIELDS:
+            for k in DIST_BENCH_FIELDS + FED_FIELDS:
                 if k in dsres:
                     loader_res[k] = dsres[k]
             print(f"dist: {dsres.get('dist_procs')} procs ok="

@@ -530,6 +530,10 @@ class StromContext:
         # behavior unchanged.
         self._peer_tier = None
         self._peer_server = None
+        # cluster observability plane (ISSUE 18, strom/obs/federation.py):
+        # attach_cluster() on the coordinator polls every worker's /stats,
+        # merges them and watches fleet health; None = no /cluster route
+        self._cluster = None
         # closed-loop knob autotuner (ISSUE 16 tentpole, strom/tune):
         # armed below after every knob surface exists; None until
         # attach_tuner() (config.tune=False = no controller, no thread,
@@ -558,6 +562,12 @@ class StromContext:
         # steal from decode workers) more than once per TTL
         self._steps_cache: tuple[float, dict] | None = None
         self._steps_cache_lock = make_lock("app.steps_cache")
+        # per-bucket stall totals already published as global counters
+        # (ISSUE 18 / ROADMAP 5 residual): each steps recompute pushes the
+        # window's GROWTH into stall_<bucket>_us counters, so /history's
+        # rate() turns the attribution into per-second burn the autotuner
+        # can steer on. Guarded by _steps_cache_lock.
+        self._stall_published: dict[str, float] = {}
         # flight recorder (ISSUE 6 tentpole, strom/obs/flight.py): with a
         # flight_dir configured, a watchdog samples progress/pressure for
         # the context's lifetime and dumps an atomic crash bundle on
@@ -667,6 +677,21 @@ class StromContext:
         except Exception:
             return None
 
+    def _stall_deltas_locked(self, summary: dict) -> "dict[str, int]":
+        """Growth of each stall-attribution bucket's total since the last
+        publication, as ``stall_<bucket>_us`` counter increments (caller
+        holds ``_steps_cache_lock``; the window is the whole retained ring,
+        so a drop-oldest wrap can SHRINK a total — clamp to zero growth and
+        re-anchor rather than publish a negative counter delta)."""
+        out: dict[str, int] = {}
+        for b, v in summary.get("buckets", {}).items():
+            total = float(v.get("total_us", 0.0))
+            last = self._stall_published.get(b, 0.0)
+            if total > last:
+                out[f"stall_{b}_us"] = int(total - last)
+            self._stall_published[b] = total
+        return out
+
     @property
     def scheduler(self):
         """The multi-tenant I/O scheduler when ``sched_enabled``, else
@@ -768,6 +793,34 @@ class StromContext:
             plan=getattr(self.engine, "plan", None))
 
     @property
+    def cluster_view(self):
+        """The metrics-federation view when :meth:`attach_cluster` wired
+        one (the coordinator's /cluster route), else None
+        (strom/obs/federation.py)."""
+        return self._cluster
+
+    def attach_cluster(self, hosts, *, interval_s: float = 1.0,
+                       stall_s: float = 10.0, **kwargs):
+        """Start the cluster observability plane (ISSUE 18): a background
+        loop polling each worker's ``/stats`` endpoint (*hosts* maps host
+        id → ``ip:port`` metrics address), merging the fleet into one
+        aggregate (served on this context's ``/cluster`` route) and
+        flagging hosts whose scrape fails or whose progress stalls —
+        an unhealthy transition best-effort-triggers the remote host's
+        ``/flight?dump=1`` and dumps this context's own flight recorder.
+        Replaces any previous view; returns it."""
+        if self._closed:
+            raise RuntimeError("StromContext is closed")
+        from strom.obs.federation import ClusterView
+
+        if self._cluster is not None:
+            self._cluster.close()
+        self._cluster = ClusterView(
+            hosts, recorder=self._flight, interval_s=interval_s,
+            stall_s=stall_s, **kwargs)
+        return self._cluster
+
+    @property
     def tuner(self):
         """The closed-loop knob autotuner when ``tune=True`` (or
         :meth:`attach_tuner` was called), else None (strom/tune)."""
@@ -814,8 +867,20 @@ class StromContext:
         to judge the trial by."""
         goodput = self._current_goodput()
         burning = bool(self._slo.stats().get("slo_tenants_burning", 0))
-        return {"objective": float(goodput or 0.0),
-                "slo_burning": burning or goodput is None}
+        metrics = {"objective": float(goodput or 0.0),
+                   "slo_burning": burning or goodput is None}
+        # windowed stall-attribution burn (ISSUE 18 satellite / ROADMAP 5
+        # residual): the per-bucket counters the steps recompute publishes,
+        # turned into per-second rates by the history ring — the controller
+        # sees WHERE the stall time goes, not just the goodput scalar
+        if self._history is not None:
+            from strom.obs.stall import BUCKETS
+
+            for b in BUCKETS:
+                r = self._history.rate(f"stall_{b}_us", window_s=30.0)
+                if r is not None:
+                    metrics[f"stall_{b}_us_per_s"] = r
+        return metrics
 
     @contextlib.contextmanager
     def engine_exclusive(self, nbytes: int = 0, tenant: str | None = None):
@@ -2217,14 +2282,23 @@ class StromContext:
 
             _STEPS_TTL_S = 2.0
             now = time.monotonic()
+            deltas: "dict[str, int] | None" = None
             with self._steps_cache_lock:
                 cached = self._steps_cache
                 if cached is not None and now - cached[0] < _STEPS_TTL_S:
                     steps = dict(cached[1])
                 else:
-                    steps = stall.flatten_summary(stall.steps_summary(
-                        _events_ring.snapshot(), lo_us=self._obs_t0_us))
+                    summary = stall.steps_summary(
+                        _events_ring.snapshot(), lo_us=self._obs_t0_us)
+                    steps = stall.flatten_summary(summary)
                     self._steps_cache = (now, dict(steps))
+                    deltas = self._stall_deltas_locked(summary)
+            if deltas:
+                # counter writes OUTSIDE the cache lock; the delta state
+                # above was settled under it, so two racing recomputes
+                # can't publish the same growth twice
+                for k, d in deltas.items():
+                    global_stats.add(k, d)
             steps["events_dropped"] = _events_ring.events_dropped
             out["steps"] = steps
         # hot-set cache observability (ISSUE 4): hit/miss/admission/
@@ -2308,6 +2382,11 @@ class StromContext:
         # them — close is not a revert)
         if self._tuner is not None:
             self._tuner.close()
+        # cluster view before the servers it scrapes through: its poll
+        # thread must stop before the flight recorder it dumps to and the
+        # metrics server serving /cluster go away
+        if self._cluster is not None:
+            self._cluster.close()
         # peer service down first: no new serve can start a cache/spill
         # read (or a scheduler grant) against a closing context, and the
         # consult stops probing peers before the engine goes away

@@ -183,8 +183,13 @@ def run_worker(args: argparse.Namespace) -> dict:
         hot_cache_bytes=args.hot_cache_bytes, hot_cache_admit="always",
         # the sandbox fixtures live on tmpfs-ish paths; spill off keeps
         # the worker lean (the peer tier serves from RAM here)
-        fault_plan=args.fault_plan)
-    ctx = StromContext(cfg)
+        fault_plan=args.fault_plan,
+        # a per-rank flight dir: the coordinator's fleet watchdog dumps a
+        # host-stamped bundle here when a peer goes dark
+        flight_dir=os.path.join(args.workdir, f"flight_{rank}"))
+    # metrics_port=0 (explicit) = ephemeral port: every worker is
+    # scrapeable so rank 0's ClusterView can federate the fleet
+    ctx = StromContext(cfg, metrics_port=0)
     result: dict = {"rank": rank, "ok": 0}
     try:
         # peer service up, addresses exchanged, ownership → owner_fn
@@ -197,6 +202,20 @@ def run_worker(args: argparse.Namespace) -> dict:
                          owner_fn=lambda p: (
                              path_owner.get(p)
                              if path_owner.get(p) != rank else None))
+
+        # observability rendezvous: every rank publishes its metrics
+        # address; rank 0 federates them all (itself included) into the
+        # /cluster view for the run's lifetime
+        obs = rendezvous(
+            args.workdir, "obs", rank, nproc,
+            json.dumps({"metrics":
+                        f"127.0.0.1:{ctx.metrics_server.port}",
+                        "peer": addr}),
+            timeout_s=args.timeout_s)
+        if rank == 0:
+            hosts = {f"rank{r}": json.loads(o)["metrics"]
+                     for r, o in enumerate(obs)}
+            ctx.attach_cluster(hosts, interval_s=0.25, stall_s=5.0)
 
         if mesh_mode:
             import jax
@@ -286,6 +305,19 @@ def run_worker(args: argparse.Namespace) -> dict:
         rendezvous(args.workdir, "done", rank, nproc,
                    timeout_s=args.timeout_s)
         dist = ctx.stats(sections=["dist"]).get("dist", {})
+        if rank == 0 and ctx.cluster_view is not None:
+            # one last scrape with every worker still alive, then fold
+            # the federation gauges into the result the bench arm reads
+            ctx.cluster_view.poll_now()
+            result.update(ctx.cluster_view.stats())
+        # per-host trace file: tools/trace_report.py merges these into one
+        # Perfetto timeline with cross-host flow arrows
+        from strom.obs import chrome_trace
+
+        with contextlib.suppress(Exception):
+            chrome_trace.dump(
+                os.path.join(args.workdir, f"trace_{rank}.json"),
+                meta={"host": f"rank{rank}", "peer_addr": addr})
         asm = sorted(asm_us)
         items = args.steps * per_host
         result.update({
@@ -334,7 +366,7 @@ def launch_local(nproc: int, data_dir: str, workdir: str, *,
         # stale rendezvous/result files from a previous run in the same
         # workdir would satisfy (or corrupt) this run's barriers
         if f.startswith(("peers_", "coord_", "warm_", "epoch", "done_",
-                         "result_")):
+                         "result_", "obs_", "trace_")):
             with contextlib.suppress(OSError):
                 os.unlink(os.path.join(workdir, f))
     repo = os.path.dirname(os.path.dirname(os.path.dirname(
@@ -422,9 +454,15 @@ def measure_ingest(procs: int, workdir: str, *, data_dir: "str | None" = None,
     served = sum(r.get("peer_served_bytes", 0) for r in results)
     ingest = sum(r.get("ingest_bytes", 0) for r in results)
     engine_bytes = sum(r.get("engine_ingest_bytes", 0) for r in results)
+    from strom.obs.federation import FED_FIELDS
+
+    rank0 = results[0] if results else {}
     return {
         "dist_ok": int(ok),
         "dist_procs": procs,
+        # federation gauges from rank 0's ClusterView (present when the
+        # obs rendezvous completed; 0 on degraded/partial runs)
+        **{k: rank0.get(k, 0) for k in FED_FIELDS},
         "dist_steps": steps,
         "dist_items_per_s":
             round(items / max(walls), 2) if walls and max(walls) else 0.0,

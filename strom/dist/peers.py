@@ -27,11 +27,26 @@ followed by the payload, so a truncated frame (mid-stream hangup, the
 data. Requests: ``op u8 | path_len u16 | path | lo u64 | hi u64``.
 Responses: ``status u8 | bytes`` (status 0 = hit, 1 = miss).
 
+Cross-host trace propagation (ISSUE 18): an ``OP_GET_TRACED`` request
+appends a trace context — req id, a process-unique flow id, the client's
+send timestamp and the parent span name — and the server answers with two
+of its own timestamps (recv, send) prepended to the payload. The server
+mints ``peer.queue``/``peer.grant``/``peer.copy``/``peer.send`` spans
+billed under the inbound req id, each carrying a flow step of the client's
+flow id, so the merged fleet trace draws one arrow chain from the asking
+host's ``peer.fetch`` span through the serving host's spans and back. The
+four timestamps double as an NTP-style clock-offset estimate per peer
+(``obs/chrome_trace.merge_host_traces`` aligns the per-host timebases with
+it). An old server sees an unknown op and drops the conn — the client
+notices once, downgrades that peer, and keeps fetching untraced.
+
 Counters (``DIST_FIELDS``, the ``stats()["dist"]`` section → /metrics):
 client ``peer_hit_bytes``/``peer_hits``/``peer_misses``/``peer_errors``/
-``peer_skips`` + the ``peer_rtt`` histogram, server ``peer_served_bytes``/
-``peer_serves``/``peer_serve_misses``, breaker ``peer_breaker_trips`` and
-the ``peer_breaker_open`` gauge.
+``peer_skips``/``peer_fetch_traced`` + the ``peer_rtt`` histogram (written
+through a per-peer-address scope, so one slow peer is distinguishable from
+fleet-wide slowness on /metrics), server ``peer_served_bytes``/
+``peer_serves``/``peer_serves_traced``/``peer_serve_misses``, breaker
+``peer_breaker_trips`` and the ``peer_breaker_open`` gauge.
 
 Lock discipline (tools/stromlint ``dist.peer``/``dist.server`` ranks):
 neither lock is ever held across socket I/O — the client lock checks a
@@ -41,6 +56,8 @@ connection out and back in, the server lock guards only counters.
 from __future__ import annotations
 
 import contextlib
+import itertools
+import os
 import socket
 import struct
 import threading
@@ -50,6 +67,8 @@ from typing import Callable, Mapping, Sequence
 import numpy as np
 
 from strom.engine.resilience import CircuitBreaker
+from strom.obs import request as _request
+from strom.obs.events import ring as _ring
 from strom.utils.locks import make_lock
 
 # The dist section of ``StromContext.stats()`` (→ /stats, /metrics),
@@ -62,10 +81,12 @@ DIST_FIELDS = (
     "peer_misses",
     "peer_errors",
     "peer_skips",
+    "peer_fetch_traced",
     "peer_rtt_p50_us",
     "peer_rtt_p99_us",
     "peer_served_bytes",
     "peer_serves",
+    "peer_serves_traced",
     "peer_serve_misses",
     "peer_breaker_trips",
     "peer_breaker_open",
@@ -100,10 +121,18 @@ DIST_BENCH_FIELDS = (
 
 # wire protocol ------------------------------------------------------------
 OP_GET = 1
+OP_GET_TRACED = 2
 ST_HIT, ST_MISS = 0, 1
 _LEN = struct.Struct("!I")
 _REQ_HEAD = struct.Struct("!BH")
 _REQ_RANGE = struct.Struct("!QQ")
+# trace context appended to an OP_GET_TRACED request: req_id u64 | flow_id
+# u64 | client send ts f64 (its ring timebase) | parent_len u16 | parent
+# bytes. A traced response echoes (server recv ts, server send ts) — the
+# server ring's timebase — right after the status byte, for both hits and
+# misses, so every traced exchange carries the four NTP timestamps.
+_TRACE_CTX = struct.Struct("!QQdH")
+_TRACED_RESP = struct.Struct("!dd")
 # sanity bound on any single frame: an extent-sized response, never a
 # whole-file stream (the consult asks per miss run, which is bounded by
 # the gather's chunking) — a corrupt length prefix fails fast instead of
@@ -173,11 +202,23 @@ def recv_frame(sock: socket.socket, max_len: int = MAX_FRAME) -> bytearray:
     return recv_exact(sock, n)
 
 
-def encode_request(path: str, lo: int, hi: int) -> bytes:
+def encode_request(path: str, lo: int, hi: int,
+                   trace: "tuple[int, int, float, str] | None" = None
+                   ) -> bytes:
+    """One request frame. *trace* = (req_id, flow_id, send_us, parent)
+    upgrades the op to OP_GET_TRACED; None is byte-identical to the
+    pre-ISSUE-18 wire."""
     p = path.encode("utf-8")
     if len(p) > 0xFFFF:
         raise ValueError(f"path too long for the wire ({len(p)} bytes)")
-    return _REQ_HEAD.pack(OP_GET, len(p)) + p + _REQ_RANGE.pack(lo, hi)
+    if trace is None:
+        return _REQ_HEAD.pack(OP_GET, len(p)) + p + _REQ_RANGE.pack(lo, hi)
+    req_id, flow_id, send_us, parent = trace
+    pb = parent.encode("utf-8")[:0xFFFF]
+    return (_REQ_HEAD.pack(OP_GET_TRACED, len(p)) + p
+            + _REQ_RANGE.pack(lo, hi)
+            + _TRACE_CTX.pack(int(req_id), int(flow_id), float(send_us),
+                              len(pb)) + pb)
 
 
 def decode_request(payload) -> tuple[str, int, int]:
@@ -194,6 +235,46 @@ def decode_request(payload) -> tuple[str, int, int]:
     if hi < lo:
         raise PeerProtocolError(f"bad range [{lo}, {hi})")
     return path, lo, hi
+
+
+def decode_request_ex(payload) -> "tuple[str, int, int, dict | None]":
+    """:func:`decode_request` that also understands OP_GET_TRACED — the
+    server's decoder. Returns ``(path, lo, hi, trace)`` with *trace* None
+    for a plain OP_GET or ``{"req", "flow", "send_us", "parent"}`` for a
+    traced one; the same exact-length strictness per op (trailing bytes
+    are a protocol error, never silently ignored)."""
+    if len(payload) < _REQ_HEAD.size + _REQ_RANGE.size:
+        raise PeerProtocolError(f"request frame too short ({len(payload)})")
+    op, plen = _REQ_HEAD.unpack_from(payload, 0)
+    if op not in (OP_GET, OP_GET_TRACED):
+        raise PeerProtocolError(f"unknown peer op {op}")
+    end = _REQ_HEAD.size + plen
+    rng_end = end + _REQ_RANGE.size
+    trace = None
+    if op == OP_GET:
+        if len(payload) != rng_end:
+            raise PeerProtocolError("request frame length mismatch")
+    else:
+        if len(payload) < rng_end + _TRACE_CTX.size:
+            raise PeerProtocolError("traced request frame too short")
+        req_id, flow_id, send_us, par_len = _TRACE_CTX.unpack_from(
+            payload, rng_end)
+        if len(payload) != rng_end + _TRACE_CTX.size + par_len:
+            raise PeerProtocolError("request frame length mismatch")
+        parent = bytes(payload[rng_end + _TRACE_CTX.size:]).decode("utf-8")
+        trace = {"req": req_id, "flow": flow_id, "send_us": send_us,
+                 "parent": parent}
+    path = bytes(payload[_REQ_HEAD.size: end]).decode("utf-8")
+    lo, hi = _REQ_RANGE.unpack_from(payload, end)
+    if hi < lo:
+        raise PeerProtocolError(f"bad range [{lo}, {hi})")
+    return path, lo, hi, trace
+
+
+# cross-host flow ids: a request's per-process int id collides across
+# hosts, so the arrow chain binds on a separate id seeded from urandom —
+# unique across the fleet w.h.p., monotonic within a process
+_flow_ids = itertools.count(int.from_bytes(os.urandom(6), "big") << 16)
 
 
 def split_addr(addr: str) -> tuple[str, int]:
@@ -221,6 +302,7 @@ class PeerServer:
         self._sem = threading.Semaphore(max(int(max_conns), 1))
         self.served_bytes = 0
         self.serves = 0
+        self.serves_traced = 0
         self.serve_misses = 0
         # zero-copy exporter (ISSUE 16, opt-in via dist_send_zc): serve hits
         # straight from the pinned tier views / the spill file instead of
@@ -240,6 +322,9 @@ class PeerServer:
                                         name="strom-peer-accept",
                                         daemon=True)
         self._accept.start()
+        # self-identity marker: the trace merger pairs each host's trace
+        # file with the clock offsets OTHER hosts estimated for this addr
+        _ring.instant("peer.self", cat="dist", args={"addr": self.addr})
 
     @property
     def addr(self) -> str:
@@ -274,9 +359,10 @@ class PeerServer:
                     zstate = None
             while not self._closed:
                 try:
-                    path, lo, hi = decode_request(recv_frame(conn))
+                    path, lo, hi, trace = decode_request_ex(recv_frame(conn))
                 except (PeerProtocolError, OSError, ValueError):
                     return  # peer went away / spoke garbage: drop the conn
+                recv_us = _ring.now_us() if trace is not None else 0.0
                 # bounded concurrency PER REQUEST, not per connection:
                 # every remote host keeps one pooled conn open for its
                 # lifetime, so a connection-scoped slot would wedge the
@@ -290,43 +376,78 @@ class PeerServer:
                 # per-call-site lock-order suppressions below
                 served: "tuple[int, int, int] | None" = None
                 data = None
+                q0 = _ring.now_us() if trace is not None else 0.0
                 with self._sem:
+                    if trace is not None:
+                        # stromlint: ignore[lock-order] -- slot semaphore, see above
+                        self._span(trace, "peer.queue", q0,
+                                   _ring.now_us() - q0)
                     if self._zc:
                         try:
                             # stromlint: ignore[lock-order] -- slot semaphore, see above
                             served = self._serve_range_zc(conn, path, lo,
-                                                          hi, zstate)
+                                                          hi, zstate,
+                                                          trace=trace,
+                                                          recv_us=recv_us)
                         except OSError:
                             return  # conn already destroyed by the zc path
                     else:
                         # stromlint: ignore[lock-order] -- slot semaphore, see above
-                        data = self._serve_range(path, lo, hi)
+                        data = self._serve_range(path, lo, hi, trace=trace)
                 # tally BEFORE the reply frame leaves: the moment the
                 # client sees the frame it may read our stats (tests and
                 # strom_top sample right after a pread returns), and a
                 # post-send tally loses that race
                 if self._zc:
-                    self._tally(None if served is None else served[0])
+                    self._tally(None if served is None else served[0],
+                                traced=trace is not None)
                     if served is None:
                         try:
-                            send_frame(conn, bytes([ST_MISS]))
+                            send_frame(conn, self._miss_frame(trace,
+                                                              recv_us))
                         except OSError:
                             return
                     continue
                 self._tally(None if data is None else data.nbytes,
-                            copied=True)
+                            copied=True, traced=trace is not None)
+                s0 = _ring.now_us() if trace is not None else 0.0
                 try:
                     if data is None:
-                        send_frame(conn, bytes([ST_MISS]))
+                        send_frame(conn, self._miss_frame(trace, recv_us))
+                    elif trace is not None:
+                        send_frame(conn, (bytes([ST_HIT]),
+                                          _TRACED_RESP.pack(recv_us, s0),
+                                          data.data))
                     else:
                         send_frame(conn, (bytes([ST_HIT]), data.data))
                 except OSError:
                     return
+                if trace is not None:
+                    self._span(trace, "peer.send", s0, _ring.now_us() - s0)
         finally:
             with contextlib.suppress(OSError):
                 conn.close()
 
-    def _tally(self, n: "int | None", *, copied: bool = False) -> None:
+    def _span(self, trace: dict, name: str, ts_us: float,
+              dur_us: float) -> None:
+        """One server-side span billed under the inbound req id, carrying a
+        step of the client's flow chain. The flow event lands at now(),
+        inside the [ts_us, ts_us+dur_us) slice being emitted — the same
+        binds-to-the-enclosing-slice trick Request._flow uses."""
+        _ring.flow("t", trace["flow"], "peer.req", "reqx")
+        args = {"req": trace["req"]}
+        if trace.get("parent"):
+            args["parent"] = trace["parent"]
+        _ring.complete(ts_us, dur_us, "dist", name, args)
+
+    @staticmethod
+    def _miss_frame(trace: "dict | None", recv_us: float) -> bytes:
+        if trace is None:
+            return bytes([ST_MISS])
+        return bytes([ST_MISS]) + _TRACED_RESP.pack(recv_us, _ring.now_us())
+
+    def _tally(self, n: "int | None", *, copied: bool = False,
+               traced: bool = False) -> None:
         with self._lock:
             if n is None:
                 self.serve_misses += 1
@@ -335,14 +456,18 @@ class PeerServer:
                 self.served_bytes += n
                 if copied:
                     self.copy_bytes += n
+                if traced:
+                    self.serves_traced += 1
         if n is None:
             self._scope.add("peer_serve_misses")
         else:
             self._scope.add("peer_serves")
             self._scope.add("peer_served_bytes", n)
+            if traced:
+                self._scope.add("peer_serves_traced")
 
-    def _serve_range(self, path: str, lo: int, hi: int
-                     ) -> "np.ndarray | None":
+    def _serve_range(self, path: str, lo: int, hi: int, *,
+                     trace: "dict | None" = None) -> "np.ndarray | None":
         """The billed local read: full-range coverage from RAM + spill, or
         None (a partial range is a miss — the asker's engine read is
         cheaper than a split conversation)."""
@@ -358,9 +483,13 @@ class PeerServer:
                 # machinery sees peer traffic like any other tenant's.
                 # Held across the tier memcpy/pread only, NEVER across
                 # socket I/O (the caller sends after we return).
+                g0 = _ring.now_us() if trace is not None else 0.0
                 with sched.grant("peer", n, priority="background"):
-                    return self._read_local(path, lo, hi)
-            return self._read_local(path, lo, hi)
+                    if trace is not None:
+                        self._span(trace, "peer.grant", g0,
+                                   _ring.now_us() - g0)
+                    return self._read_traced(path, lo, hi, trace)
+            return self._read_traced(path, lo, hi, trace)
         # stromlint: ignore[swallowed-exceptions] -- advisory service: any
         # local failure (closing context, deadline on the grant) answers
         # miss and is visible as peer_serve_misses; the asker falls back
@@ -409,7 +538,8 @@ class PeerServer:
         return ([seg for _, seg in segs], cache, pinned, spill, sp_pinned)
 
     def _serve_range_zc(self, conn: socket.socket, path: str, lo: int,
-                        hi: int, zstate: "_ZcState | None"
+                        hi: int, zstate: "_ZcState | None", *,
+                        trace: "dict | None" = None, recv_us: float = 0.0
                         ) -> "tuple[int, int, int] | None":
         """Serve a hit straight out of the tiers: pinned cache views go to
         the socket with no userspace assembly (MSG_ZEROCOPY when the conn
@@ -429,20 +559,31 @@ class PeerServer:
             # never the sends; what the socket does afterwards is paced by
             # TCP, not by the engine arbiter
             if sched is not None:
+                g0 = _ring.now_us() if trace is not None else 0.0
                 with sched.grant("peer", n, priority="background"):
+                    if trace is not None:
+                        self._span(trace, "peer.grant", g0,
+                                   _ring.now_us() - g0)
+                    c0 = _ring.now_us() if trace is not None else 0.0
                     plan = self._plan_local(path, lo, hi)
             else:
+                c0 = _ring.now_us() if trace is not None else 0.0
                 plan = self._plan_local(path, lo, hi)
         except Exception:  # stromlint: ignore[swallowed-exceptions] -- same advisory-service contract as _serve_range: any local failure answers miss (counted peer_serve_misses) and the asker reads from its own engine
             return None
         if plan is None:
             return None
+        if trace is not None:
+            self._span(trace, "peer.copy", c0, _ring.now_us() - c0)
         segs, cache, pinned, spill, sp_pinned = plan
         zc0 = zstate.seq if zstate is not None else 0
         zc_b = sf_b = 0
+        s0 = _ring.now_us() if trace is not None else 0.0
+        tr = (_TRACED_RESP.pack(recv_us, s0) if trace is not None else b"")
         try:
             try:
-                conn.sendall(_LEN.pack(1 + n) + bytes([ST_HIT]))
+                conn.sendall(_LEN.pack(1 + len(tr) + n)
+                             + bytes([ST_HIT]) + tr)
                 for kind, a, off, ln in segs:
                     if kind == "mem":
                         mv = memoryview(a)
@@ -478,6 +619,8 @@ class PeerServer:
         with self._lock:
             self.zc_bytes += zc_b
             self.sendfile_bytes += sf_b
+        if trace is not None:
+            self._span(trace, "peer.send", s0, _ring.now_us() - s0)
         return (n, zc_b, sf_b)
 
     def _send_view_zc(self, conn: socket.socket, mv: memoryview,
@@ -525,6 +668,16 @@ class PeerServer:
                         zstate.acked = max(zstate.acked, dat + 1)
         return True
 
+    def _read_traced(self, path: str, lo: int, hi: int,
+                     trace: "dict | None") -> "np.ndarray | None":
+        if trace is None:
+            return self._read_local(path, lo, hi)
+        c0 = _ring.now_us()
+        try:
+            return self._read_local(path, lo, hi)
+        finally:
+            self._span(trace, "peer.copy", c0, _ring.now_us() - c0)
+
     def _read_local(self, path: str, lo: int, hi: int
                     ) -> "np.ndarray | None":
         cache = getattr(self._ctx, "hot_cache", None)
@@ -560,6 +713,7 @@ class PeerServer:
         with self._lock:
             return {"peer_served_bytes": self.served_bytes,
                     "peer_serves": self.serves,
+                    "peer_serves_traced": self.serves_traced,
                     "peer_serve_misses": self.serve_misses,
                     "peer_zc_bytes": self.zc_bytes,
                     "peer_sendfile_bytes": self.sendfile_bytes,
@@ -576,15 +730,27 @@ class PeerServer:
 
 class _PeerState:
     """Client-side per-peer state: one pooled connection (checked out
-    under the tier lock, used outside it) and a circuit breaker."""
+    under the tier lock, used outside it), a circuit breaker, the traced-
+    protocol verdict and the running clock-offset estimate."""
 
-    __slots__ = ("addr", "sock", "busy", "breaker")
+    __slots__ = ("addr", "sock", "busy", "breaker", "trace_ok",
+                 "offset_us", "rtt_scope")
 
-    def __init__(self, addr: str, breaker: CircuitBreaker):
+    def __init__(self, addr: str, breaker: CircuitBreaker, rtt_scope):
         self.addr = addr
         self.sock: "socket.socket | None" = None
         self.busy = False
         self.breaker = breaker
+        # None = untried, True = peer answered a traced request, False =
+        # peer dropped one (old wire) — downgraded to plain OP_GET forever
+        self.trace_ok: "bool | None" = None
+        # EWMA of (peer ring clock - our ring clock), microseconds, from
+        # the NTP-style four-timestamp estimate each traced exchange carries
+        self.offset_us: "float | None" = None
+        # per-peer-address scoped series: peer_rtt writes fan to this
+        # scope AND the registry aggregate, so /metrics shows one labeled
+        # latency series per peer under the unchanged aggregate sum
+        self.rtt_scope = rtt_scope
 
 
 class PeerTier:
@@ -629,13 +795,15 @@ class PeerTier:
         for name, addr in peers.items():
             br = CircuitBreaker(name=f"peer:{addr}", clock=clock,
                                 on_trip=self._on_trip, **bk)
-            self._peers[name] = _PeerState(str(addr), br)
+            self._peers[name] = _PeerState(
+                str(addr), br, self._scope.scoped(peer=str(addr)))
         # tallies (authoritative for stats(); mirrored into the scope)
         self.hit_bytes = 0
         self.hits = 0
         self.misses = 0
         self.errors = 0
         self.skips = 0
+        self.fetch_traced = 0
 
     def _on_trip(self, note: str) -> None:
         with self._lock:
@@ -692,7 +860,14 @@ class PeerTier:
             else:
                 st.busy = True
                 sock, st.sock = st.sock, None
+        # trace propagation (ISSUE 18): carry the live request's identity
+        # plus a fleet-unique flow id over the wire unless this peer has
+        # already proven it speaks the old protocol
+        req = _request.current() if st.trace_ok is not False else None
+        traced = st.trace_ok is not False
+        flow_id = next(_flow_ids) if traced else 0
         t0 = time.perf_counter()
+        t_send = 0.0
         try:
             if sock is None:
                 host, port = split_addr(st.addr)
@@ -700,16 +875,33 @@ class PeerTier:
                                                 timeout=self._timeout)
                 sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
             sock.settimeout(self._timeout)
-            send_frame(sock, encode_request(path, lo, hi))
+            if traced:
+                t_send = _ring.now_us()
+                send_frame(sock, encode_request(
+                    path, lo, hi,
+                    trace=(req.id if req is not None else 0, flow_id,
+                           t_send, req.kind if req is not None else "")))
+                # flow start lands just after t_send — inside the
+                # peer.fetch slice emitted below, which is what binds it
+                _ring.flow("s", flow_id, "peer.req", "reqx")
+            else:
+                send_frame(sock, encode_request(path, lo, hi))
             payload = recv_frame(sock)
         except (OSError, PeerProtocolError, ValueError):
+            if traced and st.trace_ok is None:
+                # first traced attempt died: assume an old peer dropped
+                # the unknown op and downgrade — one counted error, every
+                # later fetch goes plain OP_GET
+                st.trace_ok = False
             self._fail(st, sock, ephemeral=ephemeral)
             return None
+        t_recv = _ring.now_us()
         rtt_us = (time.perf_counter() - t0) * 1e6
+        hdr = 1 + (_TRACED_RESP.size if traced else 0)
         status = payload[0] if payload else -1
-        if status == ST_HIT and len(payload) == 1 + n:
-            data = np.frombuffer(payload, np.uint8, count=n, offset=1)
-        elif status == ST_MISS and len(payload) == 1:
+        if status == ST_HIT and len(payload) == hdr + n:
+            data = np.frombuffer(payload, np.uint8, count=n, offset=hdr)
+        elif status == ST_MISS and len(payload) == hdr:
             data = None
         else:
             # wrong-length hit = a truncated/corrupt frame that happened
@@ -727,14 +919,47 @@ class PeerTier:
             else:
                 self.hits += 1
                 self.hit_bytes += n
+            if traced:
+                self.fetch_traced += 1
         st.breaker.record_success()
-        self._scope.observe_us("peer_rtt", rtt_us)
+        if traced:
+            st.trace_ok = True
+            self._finish_traced(st, payload, flow_id, t_send, t_recv,
+                                rtt_us, n, req)
+        st.rtt_scope.observe_us("peer_rtt", rtt_us)
         if data is None:
             self._scope.add("peer_misses")
         else:
             self._scope.add("peer_hits")
             self._scope.add("peer_hit_bytes", n)
+        if traced:
+            self._scope.add("peer_fetch_traced")
         return data
+
+    def _finish_traced(self, st: _PeerState, payload, flow_id: int,
+                       t_send: float, t_recv: float, rtt_us: float,
+                       n: int, req) -> None:
+        """Trace epilogue of one traced exchange: fold the server's two
+        echoed timestamps into the peer's clock-offset EWMA (NTP-style:
+        offset = ((t2-t1)+(t3-t4))/2, each side on its own ring timebase),
+        close the flow arrow, and emit the client-side ``peer.fetch`` span
+        — billed under the live request when one is active."""
+        t2, t3 = _TRACED_RESP.unpack_from(payload, 1)
+        off = ((t2 - t_send) + (t3 - t_recv)) / 2.0
+        st.offset_us = off if st.offset_us is None \
+            else 0.7 * st.offset_us + 0.3 * off
+        _ring.instant("peer.clock_offset", cat="dist",
+                      args={"peer": st.addr,
+                            "offset_us": round(st.offset_us, 1),
+                            "rtt_us": round(rtt_us, 1)})
+        _ring.flow("f", flow_id, "peer.req", "reqx")
+        args = {"peer": st.addr, "bytes": n, "flow": flow_id}
+        if req is not None:
+            req.record("peer.fetch", "dist", t_send, t_recv - t_send,
+                       args, parent=req.parent_of())
+        else:
+            _ring.complete(t_send, t_recv - t_send, "dist", "peer.fetch",
+                           args)
 
     def _fail(self, st: _PeerState, sock: "socket.socket | None", *,
               ephemeral: bool = False) -> None:
@@ -755,14 +980,25 @@ class PeerTier:
     def peers_info(self) -> dict:
         out = {}
         for name, st in self._peers.items():
-            out[str(name)] = {"addr": st.addr, **st.breaker.info()}
+            out[str(name)] = {"addr": st.addr, "trace_ok": st.trace_ok,
+                              "clock_offset_us":
+                                  None if st.offset_us is None
+                                  else round(st.offset_us, 1),
+                              **st.breaker.info()}
         return out
 
     def stats(self) -> dict:
-        # the SCOPED series, not the process-global aggregate: two peered
-        # contexts in one process (daemon mode) must not read each
-        # other's latencies into their dist sections
-        h = self._scope.histogram("peer_rtt")
+        # rtt writes land in per-peer-ADDRESS scopes (one labeled series
+        # per peer), so this tier's own latency view is the bucket-merge
+        # of exactly its peers' scopes — never the process-global
+        # aggregate: two peered contexts in one process (daemon mode)
+        # must not read each other's latencies into their dist sections
+        from strom.utils.stats import _Histogram
+
+        h = _Histogram()
+        for st in self._peers.values():
+            sh = st.rtt_scope.histogram("peer_rtt")
+            h.add_buckets(sh.buckets, sh.total_us)
         open_peers = sum(1 for st in self._peers.values()
                          if st.breaker.state == CircuitBreaker.OPEN)
         with self._lock:
@@ -772,6 +1008,7 @@ class PeerTier:
                 "peer_misses": self.misses,
                 "peer_errors": self.errors,
                 "peer_skips": self.skips,
+                "peer_fetch_traced": self.fetch_traced,
                 "peer_breaker_trips": self.breaker_trips,
             }
         out["peer_breaker_open"] = open_peers

@@ -39,6 +39,7 @@ import itertools
 import json
 import os
 import signal
+import socket
 import sys
 import threading
 import time
@@ -124,10 +125,28 @@ def capture_doc(*, ctx=None, ring: EventRing | None = None,
     if ctx is not None:
         with contextlib.suppress(Exception):
             stats["sections"] = ctx.stats()
+    # fleet correlation (ISSUE 18): every bundle names the host that wrote
+    # it and the peer fabric it was talking to, so bundles from one
+    # incident — the stalled worker's own dump plus the coordinator
+    # watchdog's cluster_unhealthy dump — can be matched after the fact
+    peer_addrs: list = []
+    if ctx is not None:
+        with contextlib.suppress(Exception):
+            srv = getattr(ctx, "peer_server", None)
+            if srv is not None:
+                peer_addrs.append({"self": srv.addr})
+        with contextlib.suppress(Exception):
+            tier = getattr(ctx, "peer_tier", None)
+            if tier is not None:
+                peer_addrs.extend(
+                    {str(name): info.get("addr")}
+                    for name, info in tier.peers_info().items())
     return {
         "reason": reason,
         "note": note,
         "pid": os.getpid(),
+        "host": f"{socket.gethostname()}:{os.getpid()}",
+        "peer_addrs": peer_addrs,
         "fields": list(FLIGHT_FIELDS),
         "samples": [],
         "stall_s": 0.0,
@@ -153,9 +172,11 @@ def _write_bundle(flight_dir: str, cap: dict, reason: str,
     final = os.path.join(flight_dir, name)
     tmp = os.path.join(flight_dir, f".tmp-{name}")
     os.makedirs(tmp, exist_ok=True)
-    manifest = {k: cap[k] for k in
-                ("reason", "note", "pid", "fields", "samples",
-                 "stall_s", "interval_s")}
+    # .get: captures from before the host/peer stamps (or a recorder's
+    # layered doc built without them) still dump — stable-format contract
+    manifest = {k: cap.get(k) for k in
+                ("reason", "note", "pid", "host", "peer_addrs", "fields",
+                 "samples", "stall_s", "interval_s")}
     with open(os.path.join(tmp, BUNDLE_MANIFEST), "w") as f:
         json.dump(manifest, f)
     with open(os.path.join(tmp, BUNDLE_TRACE), "w") as f:

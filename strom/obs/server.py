@@ -49,6 +49,10 @@ same way — four routes, no dependencies beyond ``http.server``:
   FlightRecorder is attached — its watchdog sample history.
   ``?dump=1`` additionally writes an atomic bundle to the recorder's
   ``flight_dir`` and reports the path.
+- ``GET /cluster`` — the metrics-federation view (ISSUE 18,
+  strom/obs/federation.py): per-host health rows, the summed cluster
+  aggregate of every fresh worker snapshot, and the FED_FIELDS. 404 when
+  the owning context has no ClusterView (``attach_cluster``).
 
 Wired as ``StromContext(metrics_port=...)`` / ``StromConfig.metrics_port``
 (``STROM_METRICS_PORT``) / ``--metrics-port`` on the benches; port 0 asks
@@ -208,6 +212,17 @@ class MetricsServer:
                                        json.dumps(tuner.stats(),
                                                   default=str).encode(),
                                        "application/json")
+                    elif path == "/cluster":
+                        view = getattr(server._ctx, "cluster_view", None)
+                        if view is None:
+                            self._send(404, b"no cluster view on this "
+                                            b"context (attach_cluster)\n",
+                                       "text/plain")
+                        else:
+                            self._send(200,
+                                       json.dumps(view.snapshot(),
+                                                  default=str).encode(),
+                                       "application/json")
                     elif path == "/flight":
                         dump = q.get("dump", ["0"])[0] not in ("0", "", "no")
                         self._send(200,
@@ -217,7 +232,7 @@ class MetricsServer:
                     else:
                         self._send(404, b"not found: try /metrics /stats "
                                         b"/trace /flight /tenants /slo "
-                                        b"/tune /history\n",
+                                        b"/tune /history /cluster\n",
                                    "text/plain")
                 except _BadQuery as e:
                     with contextlib.suppress(Exception):

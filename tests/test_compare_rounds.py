@@ -426,6 +426,44 @@ def test_dist_keys_match_producers():
             f"produces no such key (renamed column?)"
 
 
+def test_cluster_keys_match_producers():
+    """Producer↔report key parity for the cluster-obs section (ISSUE 18,
+    same contract as the other sections): every compare_rounds cluster
+    column must be a key the federation emits (single-sourced in
+    strom.obs.federation.FED_FIELDS) — a rename on either side is a
+    silently dead column."""
+    from strom.obs.federation import FED_FIELDS
+
+    produced = set(FED_FIELDS)
+    for key in compare_rounds.CLUSTER_KEYS:
+        assert key in produced, \
+            f"compare_rounds consumes {key!r} but the federation " \
+            f"produces no such key (renamed column?)"
+    # and the other direction: every FED gauge the bench copies renders
+    assert produced == set(compare_rounds.CLUSTER_KEYS)
+
+
+def test_cluster_section_renders(tmp_path, capsys):
+    """A round carrying cluster_* keys gets the cluster obs section."""
+    d = dict(NEW_ROUND)
+    d.update({"cluster_hosts": 2, "cluster_hosts_unhealthy": 0,
+              "cluster_trace_linked_ratio": 1.0,
+              "cluster_scrape_lag_p99_us": 2048.0})
+    p = tmp_path / "BENCH_r18.json"
+    p.write_text(json.dumps(d))
+    assert compare_rounds.main([str(p)]) == 0
+    out = capsys.readouterr().out
+    assert "cluster obs (rank-0 federation" in out
+    assert "cluster_hosts_unhealthy" in out
+
+
+def test_cluster_section_hidden_without_cluster_keys(tmp_path, capsys):
+    p = tmp_path / "BENCH_r01.json"
+    p.write_text(json.dumps(dict(NEW_ROUND)))
+    assert compare_rounds.main([str(p)]) == 0
+    assert "cluster obs (rank-0" not in capsys.readouterr().out
+
+
 def test_dist_section_renders(tmp_path, capsys):
     """A round carrying dist_* keys gets the distributed section."""
     d = dict(NEW_ROUND)

@@ -46,10 +46,10 @@ import sys
 _HERE = os.path.dirname(os.path.abspath(__file__))
 sys.path.insert(0, _HERE)  # runnable as a script from anywhere
 
-from compare_rounds import (BINDING_ORDER, CACHE_KEYS, DECODE2_KEYS,  # noqa: E402
-                            DECODE_KEYS, DIST_KEYS, RESIL_KEYS, RESUME_KEYS,
-                            SLO_KEYS, STALL_KEYS, STREAM_KEYS, TUNE_KEYS,
-                            WRITE_KEYS, unwrap)
+from compare_rounds import (BINDING_ORDER, CACHE_KEYS, CLUSTER_KEYS,  # noqa: E402
+                            DECODE2_KEYS, DECODE_KEYS, DIST_KEYS, RESIL_KEYS,
+                            RESUME_KEYS, SLO_KEYS, STALL_KEYS, STREAM_KEYS,
+                            TUNE_KEYS, WRITE_KEYS, unwrap)
 
 # The gated metric set: (metric, direction) over the single-sourced
 # comparison tuples, where direction is "up" (bigger is better) or "down"
@@ -139,7 +139,19 @@ SENTINEL_FIELDS = (
     # back or the poller stopped keeping up)
     ("tuned_vs_hand", "up"),
     ("sqpoll_submit_syscalls_per_gb", "down"),
+    # cluster observability (ISSUE 18): the federation's trace-linked
+    # ratio is a same-run ratio of a deterministic peer-fetch stream (a
+    # shrink means peers stopped carrying trace context, not weather).
+    # cluster_hosts_unhealthy is NOT here: the count-sized ABS_SLACK
+    # would wave a 0 -> 1 flip through, and one dark host is exactly the
+    # page — it gates exactly-zero via EXACT_ZERO_FIELDS below.
+    ("cluster_trace_linked_ratio", "up"),
 )
+
+# metrics where ANY nonzero value in the newest valid round fails the
+# gate outright — no band, no slack, no history vote. A fleet with one
+# unhealthy host is a red run even if the previous round also had one.
+EXACT_ZERO_FIELDS = ("cluster_hosts_unhealthy",)
 
 # absolute slack for count-like "down" metrics around small values: going
 # 0 -> 1 stall is jitter, not a regression (the llama stall phase is
@@ -155,7 +167,7 @@ RATIO_DOWN = frozenset({"chaos_slowdown", "ckpt_async_stall_frac"})
 TABLE_KEYS = list(dict.fromkeys(
     BINDING_ORDER + DECODE_KEYS + DECODE2_KEYS + STALL_KEYS + CACHE_KEYS
     + STREAM_KEYS + SLO_KEYS + RESIL_KEYS + WRITE_KEYS + RESUME_KEYS
-    + DIST_KEYS + TUNE_KEYS))
+    + DIST_KEYS + CLUSTER_KEYS + TUNE_KEYS))
 
 
 def load_round(path: str) -> dict:
@@ -327,6 +339,23 @@ def run_sentinel(paths: list[str], *, band: float,
         if hit is not None:
             hit["grandfathered"] = grandfathered(hit["latest_round"])
             regressions.append(hit)
+    # exact-zero gate: the newest valid round carrying the metric must
+    # report exactly 0 — banded check_metric can't catch a 0 -> 1 flip
+    # (ABS_SLACK exists for count jitter; an unhealthy host isn't jitter)
+    for key in EXACT_ZERO_FIELDS:
+        series = [(r["name"], metric_value(r["data"], key))
+                  for r in valid_bench]
+        series = [(n, v) for n, v in series if v is not None]
+        if series and series[-1][1] != 0:
+            last_name, last = series[-1]
+            prev_name, prev = series[-2] if len(series) > 1 \
+                else (None, None)
+            regressions.append({
+                "metric": key, "direction": "zero",
+                "latest_round": last_name, "latest": last,
+                "previous_round": prev_name, "previous": prev,
+                "best": 0, "band": 0.0,
+                "grandfathered": grandfathered(last_name)})
     # multichip gate: ok-count may not shrink round-over-round (a config
     # that stopped lowering is a regression even at rc=0)
     valid_mc = [(r["name"], r["data"].get("multichip_ok"))

@@ -300,6 +300,20 @@ DIST_KEYS = [
     "dist_assembly_wait_p99_us",
     "dist_peer_rtt_p99_us",
 ]
+# cluster observability (ISSUE 18): the dist arm's federation gauges —
+# rank 0's ClusterView scrapes every worker's /stats during the run;
+# cluster_hosts_unhealthy must be 0 on a clean run (bench_sentinel gates
+# it exactly-zero), cluster_trace_linked_ratio is the share of peer
+# serves that carried trace context (1.0 = every peer span flow-linked
+# across hosts), and the scrape-lag p99 bounds how stale the fleet view
+# can be. Suffixes single-sourced in strom.obs.federation.FED_FIELDS
+# (parity-tested in tests/test_compare_rounds.py).
+CLUSTER_KEYS = [
+    "cluster_hosts",
+    "cluster_hosts_unhealthy",
+    "cluster_trace_linked_ratio",
+    "cluster_scrape_lag_p99_us",
+]
 # kernel bypass & autotune (ISSUE 16): the tune arm's hand-vs-tuned A/B
 # (tuned_vs_hand >= 1.0 is the controller contract — guarded revert plus
 # a final interleaved validation means the tuner never ships knobs that
@@ -466,12 +480,15 @@ def main(argv: list[str]) -> int:
                       for k in RESUME_KEYS)
     have_dist = any(cell(d, k) != "-" for _, d in rounds
                     for k in DIST_KEYS)
+    have_cluster = any(cell(d, k) != "-" for _, d in rounds
+                       for k in CLUSTER_KEYS)
     have_tune = any(cell(d, k) != "-" for _, d in rounds
                     for k in TUNE_KEYS)
     name_w = max(len(k) for k in binding_keys + CONTEXT_KEYS + DECODE_KEYS
                  + DECODE2_KEYS + STALL_KEYS + CACHE_KEYS + STREAM_KEYS
                  + SCHED_KEYS + SLO_KEYS + RESIL_KEYS + WRITE_KEYS
-                 + RESUME_KEYS + DIST_KEYS + TUNE_KEYS + audit_keys) + 2
+                 + RESUME_KEYS + DIST_KEYS + CLUSTER_KEYS + TUNE_KEYS
+                 + audit_keys) + 2
     # every rendered cell folds into ONE column width, or rows misalign
     col_w = max(max(len(n) for n, _ in rounds) + 2, 12,
                 *(len(c) + 2 for cs in audit_cells.values() for c in cs),
@@ -558,6 +575,13 @@ def main(argv: list[str]) -> int:
               "to single-process; peer_hit_ratio = batch bytes served "
               "peer-to-peer, not re-read from SSD):")
         for k in DIST_KEYS:
+            print(k.ljust(name_w)
+                  + "".join(cell(d, k).rjust(col_w) for _, d in rounds))
+    if have_cluster:
+        print("cluster obs (rank-0 federation over every worker's /stats: "
+              "hosts_unhealthy=0 = clean fleet; trace_linked_ratio = peer "
+              "serves carrying cross-host trace context):")
+        for k in CLUSTER_KEYS:
             print(k.ljust(name_w)
                   + "".join(cell(d, k).rjust(col_w) for _, d in rounds))
     if have_tune:

@@ -64,7 +64,8 @@ def _is_metric_receiver(recv: "str | None") -> bool:
 
 # single-sourced metric-name tuples (STALL_FIELDS, CACHE_BENCH_FIELDS,
 # STREAM_FIELDS, FLIGHT_FIELDS, SENTINEL_FIELDS, SCHED_FIELDS,
-# DIST_FIELDS/DIST_BENCH_FIELDS (strom/dist/peers.py, ISSUE 15), the
+# DIST_FIELDS/DIST_BENCH_FIELDS (strom/dist/peers.py, ISSUE 15),
+# FED_FIELDS (strom/obs/federation.py, ISSUE 18), the
 # compare_rounds *_KEYS column lists, cli _DECODE_COUNTERS, ...): their
 # literals name the SAME series the producers feed, so a restyled
 # spelling here forks a dashboard column exactly like a restyled call

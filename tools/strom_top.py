@@ -10,6 +10,13 @@ Usage:
     python tools/strom_top.py --port 9000               # curses live view
     python tools/strom_top.py --port 9000 --once        # one plain table
     python tools/strom_top.py --url http://host:9000 --interval 1
+    python tools/strom_top.py --port 9000 --cluster     # fleet view
+
+``--cluster`` points at a coordinator serving ``/cluster`` (a context
+with ``attach_cluster``, ISSUE 18) and renders one row per HOST instead
+of per tenant: health, heartbeat age, goodput, peer hit ratio, queue
+p99 and burn state, under a header of the federation gauges
+(hosts/unhealthy/trace-linked ratio/scrape lag).
 
 Data sources (all server-side-filtered so a poll never pays for the
 expensive stall-attribution section):
@@ -179,21 +186,63 @@ def render(cur: dict, prev: "dict | None") -> str:
     return "\n".join(lines)
 
 
-def run_once(base: str, settle_s: float = 0.5) -> int:
+def sample_cluster(base: str) -> dict:
+    """One /cluster poll — the coordinator's federated fleet snapshot."""
+    doc = fetch_json(base, "/cluster")
+    if doc is None:
+        raise RuntimeError(
+            "no /cluster route (coordinator needs attach_cluster)")
+    doc["t"] = time.monotonic()
+    return doc
+
+
+def render_cluster(cur: dict, prev: "dict | None" = None) -> str:
+    """The fleet screen: federation gauges up top, one row per host."""
+    lines = [
+        f"strom_top --cluster  hosts={cur.get('cluster_hosts', 0)}"
+        f"  unhealthy={cur.get('cluster_hosts_unhealthy', 0)}"
+        f"  trace_linked={_fmt(cur.get('cluster_trace_linked_ratio'), 2)}"
+        f"  scrape_lag_p99_ms="
+        f"{_fmt((cur.get('cluster_scrape_lag_p99_us') or 0) / 1e3)}",
+        "",
+        (f"{'host':<12}{'addr':<22}{'health':<11}{'hb_age_s':>9}"
+         f"{'goodput%':>10}{'peer_hit%':>11}{'queue_p99_ms':>14}"
+         f"  burn"),
+    ]
+    n_header = len(lines)
+    for name in sorted(cur.get("hosts", {})):
+        h = cur["hosts"][name]
+        hit = h.get("peer_hit_ratio")
+        lines.append(
+            f"{name:<12}{h.get('addr', '-'):<22}"
+            f"{'ok' if h.get('healthy') else 'UNHEALTHY':<11}"
+            f"{_fmt(h.get('age_s')):>9}"
+            f"{_fmt(h.get('goodput_pct')):>10}"
+            f"{_fmt(100.0 * hit if hit is not None else None):>11}"
+            f"{_fmt((h.get('sched_queue_wait_p99_us') or 0) / 1e3):>14}"
+            f"  {'BURNING' if h.get('slo_burning') else 'ok'}")
+    if len(lines) == n_header:
+        lines.append("(no hosts in the cluster view)")
+    return "\n".join(lines)
+
+
+def run_once(base: str, settle_s: float = 0.5, *,
+             sample_fn=sample, render_fn=render) -> int:
     """Two quick polls (rates need a delta), one printed table."""
-    prev = sample(base)
+    prev = sample_fn(base)
     time.sleep(settle_s)
-    cur = sample(base)
-    print(render(cur, prev))
+    cur = sample_fn(base)
+    print(render_fn(cur, prev))
     return 0
 
 
-def run_plain(base: str, interval: float) -> int:
+def run_plain(base: str, interval: float, *,
+              sample_fn=sample, render_fn=render) -> int:
     prev = None
     try:
         while True:
-            cur = sample(base)
-            sys.stdout.write("\x1b[2J\x1b[H" + render(cur, prev) + "\n")
+            cur = sample_fn(base)
+            sys.stdout.write("\x1b[2J\x1b[H" + render_fn(cur, prev) + "\n")
             sys.stdout.flush()
             prev = cur
             time.sleep(interval)
@@ -201,7 +250,8 @@ def run_plain(base: str, interval: float) -> int:
         return 0
 
 
-def run_curses(base: str, interval: float) -> int:
+def run_curses(base: str, interval: float, *,
+               sample_fn=sample, render_fn=render) -> int:
     import curses
 
     def loop(scr):
@@ -209,9 +259,9 @@ def run_curses(base: str, interval: float) -> int:
         scr.nodelay(True)
         prev = None
         while True:
-            cur = sample(base)
+            cur = sample_fn(base)
             scr.erase()
-            for i, line in enumerate(render(cur, prev).split("\n")):
+            for i, line in enumerate(render_fn(cur, prev).split("\n")):
                 try:
                     scr.addnstr(i, 0, line, max(scr.getmaxyx()[1] - 1, 1))
                 except curses.error:
@@ -237,20 +287,26 @@ def main(argv: "list[str] | None" = None) -> int:
     ap.add_argument("--interval", type=float, default=2.0)
     ap.add_argument("--once", action="store_true",
                     help="print one table and exit (no curses)")
+    ap.add_argument("--cluster", action="store_true",
+                    help="fleet view: poll the coordinator's /cluster "
+                         "route, one row per host")
     args = ap.parse_args(argv)
     base = args.url or f"http://{args.host}:{args.port}"
     base = base.rstrip("/")
+    fns = dict(sample_fn=sample_cluster, render_fn=render_cluster) \
+        if args.cluster else {}
     try:
         if args.once:
-            return run_once(base)
+            return run_once(base, **fns)
         try:
             import curses  # noqa: F401
         except ImportError:
-            return run_plain(base, args.interval)
+            return run_plain(base, args.interval, **fns)
         if not sys.stdout.isatty():
-            return run_plain(base, args.interval)
-        return run_curses(base, args.interval)
-    except (urllib.error.URLError, ConnectionError, OSError) as e:
+            return run_plain(base, args.interval, **fns)
+        return run_curses(base, args.interval, **fns)
+    except (RuntimeError, urllib.error.URLError, ConnectionError,
+            OSError) as e:
         print(f"strom_top: cannot reach {base}: {e}", file=sys.stderr)
         return 1
 

@@ -104,6 +104,9 @@ LOCK_RANKS = {
     "obs.exemplars": 73,
     "obs.request_observers": 74,
     "obs.request": 75,
+    "obs.federation": 76,      # ClusterView state; NEVER held across a
+                               # scrape socket (poll_now fetches first,
+                               # locks after), writes stats under itself
     # -- band: stats/ring (the terminal leaves) ------------------------------
     "stats.registries": 80,    # module-level registry set
     "stats.registry": 81,      # per-registry name tables

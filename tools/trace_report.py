@@ -1,9 +1,20 @@
 #!/usr/bin/env python
-"""Summarize a strom Trace Event JSON (from ``--trace-out`` or the live
+"""Summarize strom Trace Event JSON (from ``--trace-out`` or the live
 ``/trace`` endpoint): per-span rollups, per-step stall attribution, and
 per-request / per-tenant causal rollups (ISSUE 8).
 
 Usage: python tools/trace_report.py trace.json [--no-steps] [--requests N]
+       python tools/trace_report.py trace_0.json trace_1.json ...
+           [--merged-out merged.json]
+
+Given MULTIPLE trace files (one per host — the dist launcher writes
+``trace_<rank>.json`` per worker), the tool merges them into one timeline
+(ISSUE 18): per-host clock offsets recovered from the traced peer
+exchanges align every file onto host 0's timebase, the cross-host
+``reqx`` flow chains (client 's' on the asking host, server 't' spans on
+the serving host) are counted and reported as linked/unlinked, and
+``--merged-out`` writes ONE Perfetto document — each host a process row,
+peer fetches rendered as arrows crossing them.
 
 Sections:
 - span rollup: one row per span name (count, total/mean/p50/p99 wall) —
@@ -33,7 +44,8 @@ import sys
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 from strom.obs import stall  # noqa: E402
-from strom.obs.chrome_trace import load_events  # noqa: E402
+from strom.obs.chrome_trace import (_clock_shifts, load_events,  # noqa: E402
+                                    merge_host_traces)
 
 # the ONE nearest-rank percentile convention, shared with the bench-JSON
 # bucket percentiles computed from the same events (strom/obs/stall.py)
@@ -174,25 +186,99 @@ def tenant_table(events: list[dict]) -> list[tuple]:
     return rows
 
 
+def flow_links(host_events: "dict[str, list[dict]]") -> dict:
+    """The cross-host ``reqx`` flow chains: one chain per peer fetch,
+    flow id minted on the asking host ('s' phase at send), echoed by the
+    serving host's span binders ('t') and closed by the client's 'f'.
+    Returns ``{"linked": n, "unlinked": n, "pairs": {(client, server): n}}``
+    — *linked* = the id appears on >= 2 hosts (the arrow has both ends;
+    an unlinked chain means the peer answered without trace context, an
+    old peer or a downgraded one)."""
+    by_id: dict[int, dict[str, set]] = {}
+    for host, evs in host_events.items():
+        for e in evs:
+            if e.get("cat") == "reqx" and e.get("ph") in ("s", "t", "f"):
+                by_id.setdefault(e.get("id", 0), {}) \
+                    .setdefault(host, set()).add(e["ph"])
+    linked = unlinked = 0
+    pairs: dict[tuple, int] = {}
+    for phases_by_host in by_id.values():
+        if len(phases_by_host) >= 2:
+            linked += 1
+            clients = [h for h, ps in phases_by_host.items() if "s" in ps]
+            servers = [h for h, ps in phases_by_host.items() if "t" in ps]
+            for c in clients:
+                for s in servers:
+                    if s != c:
+                        pairs[(c, s)] = pairs.get((c, s), 0) + 1
+        else:
+            unlinked += 1
+    return {"linked": linked, "unlinked": unlinked, "pairs": pairs}
+
+
+def _cluster_report(host_events: "dict[str, list[dict]]") -> None:
+    shifts = _clock_shifts(host_events)
+    print(f"hosts: {len(host_events)}")
+    for host, evs in host_events.items():
+        spans = sum(1 for e in evs if e.get("ph") == "X")
+        print(f"  {host}: {len(evs)} events ({spans} spans), "
+              f"clock shift {shifts.get(host, 0.0):+.1f}us")
+    links = flow_links(host_events)
+    total = links["linked"] + links["unlinked"]
+    ratio = links["linked"] / total if total else 0.0
+    print(f"peer-fetch flows: {total} ({links['linked']} cross-host "
+          f"linked, {links['unlinked']} unlinked; "
+          f"linked ratio {ratio:.2f})")
+    for (c, s), n in sorted(links["pairs"].items()):
+        print(f"  {c} -> {s}: {n} fetches")
+
+
 def main(argv: list[str] | None = None) -> int:
     ap = argparse.ArgumentParser(prog="trace_report")
-    ap.add_argument("trace", help="Trace Event JSON (--trace-out / GET /trace)")
+    ap.add_argument("traces", nargs="+", metavar="trace",
+                    help="Trace Event JSON (--trace-out / GET /trace); "
+                         "several = per-host files to merge (ISSUE 18)")
     ap.add_argument("--no-steps", action="store_true",
                     help="skip the per-step stall attribution section")
     ap.add_argument("--requests", type=int, default=10, metavar="N",
                     help="show the N slowest requests' critical paths "
                          "(0 = skip; default 10)")
+    ap.add_argument("--merged-out", default=None, metavar="PATH",
+                    dest="merged_out",
+                    help="write the merged multi-host Perfetto document "
+                         "here (multi-trace mode)")
     args = ap.parse_args(argv)
-    try:
-        events = load_events(args.trace)
-    except (OSError, ValueError, KeyError) as e:
-        print(f"trace_report: cannot read {args.trace}: {e}", file=sys.stderr)
-        return 1
-    if not events:
+    host_events: dict[str, list[dict]] = {}
+    for path in args.traces:
+        host = os.path.splitext(os.path.basename(path))[0]
+        try:
+            host_events[host] = load_events(path)
+        except (OSError, ValueError, KeyError) as e:
+            print(f"trace_report: cannot read {path}: {e}", file=sys.stderr)
+            return 1
+    if not any(host_events.values()):
         print("trace_report: no events in trace", file=sys.stderr)
         return 1
+    multi = len(host_events) > 1
     try:
-        _report(events, steps=not args.no_steps, requests=args.requests)
+        if multi:
+            _cluster_report(host_events)
+            if args.merged_out:
+                import json
+
+                with open(args.merged_out, "w") as f:
+                    json.dump(merge_host_traces(host_events), f)
+                print(f"merged trace -> {args.merged_out}")
+            print()
+        # single-timeline sections over the (shifted) union: cross-host
+        # stall attribution is meaningless, so steps stay single-mode only
+        shifts = _clock_shifts(host_events) if multi else {}
+        events = sorted(
+            ({**e, "ts_us": e["ts_us"] + shifts.get(h, 0.0)}
+             for h, evs in host_events.items() for e in evs),
+            key=lambda e: e["ts_us"])
+        _report(events, steps=not args.no_steps and not multi,
+                requests=args.requests)
     except BrokenPipeError:  # `| head` is a normal way to use this tool
         return 0
     return 0
