@@ -884,6 +884,44 @@ def main() -> int:
                   file=sys.stderr)
         flush_partial(**loader_res)
 
+        # ISSUE 19: plan-time predicate pushdown A/B — the same logical
+        # scan pushed (stats-refuted row groups never submitted) vs
+        # post-hoc filtered over the full read, on a monotone-keyed
+        # fixture so selectivity is controlled. pushdown_ok folds the
+        # acceptance: identical aggregates AND skipped_bytes > 0 AND
+        # submitted strictly below the unpushed byte set. Keys copy via
+        # the single-sourced PUSHDOWN_BENCH_FIELDS tuple (parity-tested
+        # like the other sections); bench_sentinel gates pushdown_ok and
+        # parquet_pushdown_skipped_bytes.
+        from strom.ops.pushdown import PUSHDOWN_BENCH_FIELDS
+
+        pdargs = argparse.Namespace(**{**vars(pargs), "rows": 1_000_000,
+                                       "columns": 1, "raid": 0,
+                                       "unit_batch": 1, "cpu_device": True,
+                                       "pushdown": True,
+                                       "pushdown_selectivity": 0.25})
+        pdres = attempt("parquet PUSHDOWN",
+                        lambda: bench_parquet(pdargs)) \
+            if phase_ok("parquet PUSHDOWN", 90) else None
+        if pdres is not None:
+            for k in PUSHDOWN_BENCH_FIELDS:
+                if k in pdres:
+                    loader_res[k] = pdres[k]
+            print(f"parquet PUSHDOWN (sel "
+                  f"{pdres.get('pushdown_selectivity')}): ok="
+                  f"{pdres.get('pushdown_ok')} "
+                  f"{pdres.get('parquet_pushdown_groups_skipped')}/"
+                  f"{pdres.get('parquet_pushdown_groups_total')} groups "
+                  f"refuted at plan, "
+                  f"{pdres.get('parquet_pushdown_skipped_bytes', 0) / 1e6:.1f}"
+                  f"MB never submitted; pushed "
+                  f"{pdres.get('parquet_pushdown_rows_per_s'):.0f} rows/s "
+                  f"vs unpushed "
+                  f"{pdres.get('parquet_unpushed_rows_per_s'):.0f} "
+                  f"(x{pdres.get('parquet_pushdown_vs_unpushed')})",
+                  file=sys.stderr)
+            flush_partial(**loader_res)
+
         # ISSUE 7: multi-tenant fairness arm — 2 vision + 1 parquet tenant
         # run CONCURRENTLY on one StromContext through the shared I/O
         # scheduler. Per-tenant columns (items/s, vs_solo, queue-wait
@@ -1049,17 +1087,23 @@ def main() -> int:
         from strom.cli import bench_dist
         from strom.dist.peers import DIST_BENCH_FIELDS
         from strom.obs.federation import FED_FIELDS
+        from strom.ops.pushdown import PUSHDOWN_BENCH_FIELDS
 
+        # ISSUE 19 rides the same arm too: --peer-compress reruns the
+        # multi-process pass with the compressed peer wire (same seed,
+        # bit-identity required on both passes) and the compressed-vs-raw
+        # wire-byte columns copy via PUSHDOWN_BENCH_FIELDS; bench_sentinel
+        # gates peer_comp_ratio up.
         dsargs = argparse.Namespace(
             file=None, size=size, block=cfg.block_size, depth=32, iters=1,
             engine="auto", tmpdir=args.tmpdir, json=True, procs=2,
             steps=6, batch=16, seq_len=64, files=4, records=128, seed=0,
             mode="host", devices_per_proc=1, fault_plan="",
-            metrics_port=args.metrics_port)
+            peer_compress=True, metrics_port=args.metrics_port)
         dsres = attempt("dist", lambda: bench_dist(dsargs)) \
-            if phase_ok("dist", 120) else None
+            if phase_ok("dist", 180) else None
         if dsres is not None:
-            for k in DIST_BENCH_FIELDS + FED_FIELDS:
+            for k in DIST_BENCH_FIELDS + FED_FIELDS + PUSHDOWN_BENCH_FIELDS:
                 if k in dsres:
                     loader_res[k] = dsres[k]
             print(f"dist: {dsres.get('dist_procs')} procs ok="
@@ -1070,7 +1114,12 @@ def main() -> int:
                   f"({dsres.get('dist_peer_hit_bytes')}B peer-served, "
                   f"{dsres.get('dist_engine_ingest_bytes')}B duplicate "
                   f"engine reads, {dsres.get('dist_worker_errors')} peer "
-                  f"errors)", file=sys.stderr)
+                  f"errors); comp wire "
+                  f"{dsres.get('dist_peer_comp_wire_bytes')}B vs raw "
+                  f"{dsres.get('dist_peer_raw_wire_bytes')}B "
+                  f"(x{dsres.get('dist_peer_comp_vs_raw')}, codec ratio "
+                  f"{dsres.get('peer_comp_ratio')}, comp_ok="
+                  f"{dsres.get('dist_comp_ok')})", file=sys.stderr)
             flush_partial(**loader_res)
 
         # ISSUE 16: kernel-bypass speed pass + closed-loop autotuner —

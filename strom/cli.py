@@ -1379,6 +1379,111 @@ def bench_vit(args: argparse.Namespace) -> dict:
     return out
 
 
+def _pushdown_ab(ctx, args: argparse.Namespace) -> dict:
+    """ISSUE 19 tentpole proof: the SAME logical scan twice — once with the
+    predicate pushed to extent-plan time (stats-refuted row groups never
+    enter an ExtentList), once as a post-hoc row filter over the full read.
+    Both arms must produce the identical aggregate; the pushed arm must
+    submit strictly fewer bytes. Selectivity is an INPUT, not an accident
+    of the data: the fixture's ``seq`` column is monotone, so per-group
+    min/max stats are disjoint and ``seq < cutoff`` refutes exactly the
+    groups past the cutoff."""
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+
+    from strom.ops.pushdown import PUSHDOWN_FIELDS, col
+    from strom.pipelines.parquet_scan import parquet_scan_aggregate
+    from strom.utils.stats import global_stats
+
+    rows = min(int(args.rows), 1 << 20)
+    groups = max(int(args.row_groups), 8)
+    sel = float(getattr(args, "pushdown_selectivity", 0.25) or 0.25)
+    sel = min(max(sel, 0.05), 1.0)
+    path = os.path.join(args.tmpdir,
+                        f"strom_bench_pushdown_{rows}_{groups}.parquet")
+    if not os.path.exists(path):
+        rng = np.random.default_rng(1)
+        pq.write_table(pa.table({
+            "seq": np.arange(rows, dtype=np.int64),
+            "value": rng.standard_normal(rows),
+            # dead weight neither arm selects: projection pruning must
+            # leave it on disk in both, so the A/B isolates the predicate
+            "payload": rng.integers(0, 256, rows, dtype=np.int64),
+        }), path, row_group_size=max(rows // groups, 1))
+        os.sync()
+    cutoff = int(rows * sel)
+    pred = col("seq") < cutoff
+    devs = None
+    if getattr(args, "cpu_device", False):
+        import jax
+
+        devs = jax.devices("cpu")
+
+    def map_pushed(d: dict):
+        import jax.numpy as jnp
+
+        return {"hits": jnp.sum((d["value"] > 0).astype(jnp.int32))}
+
+    def map_post(d: dict):
+        import jax.numpy as jnp
+
+        keep = d["seq"] < cutoff
+        return {"hits": jnp.sum(((d["value"] > 0) & keep).astype(jnp.int32))}
+
+    def pushed() -> int:
+        r = parquet_scan_aggregate(ctx, [path], ["value"], map_pushed,
+                                   predicate=pred, prefetch_depth=args.prefetch,
+                                   unit_batch=1, devices=devs)
+        return int(r["hits"])
+
+    def post() -> int:
+        r = parquet_scan_aggregate(ctx, [path], ["value", "seq"], map_post,
+                                   prefetch_depth=args.prefetch,
+                                   unit_batch=1, devices=devs)
+        return int(r["hits"])
+
+    # warmup: XLA compiles both bodies (full groups + the masked cutoff
+    # group's shape) outside the timed region — house pattern
+    pushed()
+    post()
+    snap0 = global_stats.snapshot()
+    _drop_cache_hint(path)
+    t0 = time.perf_counter()
+    h_push = pushed()
+    dt_push = time.perf_counter() - t0
+    snap1 = global_stats.snapshot()
+    _drop_cache_hint(path)
+    t0 = time.perf_counter()
+    h_post = post()
+    dt_post = time.perf_counter() - t0
+    d = {k: int(snap1.get(k, 0)) - int(snap0.get(k, 0))
+         for k in PUSHDOWN_FIELDS}
+    # skipped + submitted = what the unpushed plan would have submitted for
+    # the same read set — the strictly-fewer-bytes check needs no second
+    # metadata walk
+    unpushed_bytes = d["parquet_pushdown_skipped_bytes"] \
+        + d["parquet_pushdown_submitted_bytes"]
+    ok = int(h_push == h_post and d["parquet_pushdown_skipped_bytes"] > 0
+             and d["parquet_pushdown_submitted_bytes"] < unpushed_bytes)
+    return {
+        "pushdown_ok": ok,
+        "pushdown_hits": h_push, "unpushed_hits": h_post,
+        "pushdown_rows": rows, "pushdown_selectivity": sel,
+        "parquet_pushdown_rows_per_s": round(rows / dt_push, 1),
+        "parquet_unpushed_rows_per_s": round(rows / dt_post, 1),
+        # same-run ratio: the plan-time refutation's rows/s over the
+        # post-hoc filter's on identical logical work
+        "parquet_pushdown_vs_unpushed": round(dt_post / dt_push, 4),
+        "parquet_pushdown_skipped_bytes":
+            d["parquet_pushdown_skipped_bytes"],
+        "parquet_pushdown_submitted_bytes":
+            d["parquet_pushdown_submitted_bytes"],
+        "parquet_pushdown_groups_skipped":
+            d["parquet_pushdown_groups_skipped"],
+        "parquet_pushdown_groups_total": d["parquet_pushdown_groups_total"],
+    }
+
+
 def bench_parquet(args: argparse.Namespace) -> dict:
     """Config #5 shape (PG-Strom-style SSD2TPU columnar scan): only the
     selected columns' compressed chunks are engine-read, filter/aggregate
@@ -1636,10 +1741,13 @@ def bench_parquet(args: argparse.Namespace) -> dict:
         plain_bytes //= len(scan_dts)
         pyarrow_bytes //= len(scan_dts)
         disk_gbps = round(max(raw_gbps_list), 4) if raw_gbps_list else None
+        pd_res = _pushdown_ab(ctx, args) \
+            if getattr(args, "pushdown", False) else {}
         sched = {k: _gs.counter(k).value - v0 for k, v0 in _sched0.items()}
     finally:
         ctx.close()
     return {
+        **pd_res,
         "bench": "parquet_scan",
         "rows_per_s": round(n_rows / dt, 1),
         "selected_gbps": round(sel_bytes / dt / 1e9, 4),
@@ -2479,6 +2587,28 @@ def bench_dist(args: argparse.Namespace) -> dict:
         "dist_worker_errors": sum(w.get("peer_errors", 0)
                                   for w in workers),
     }
+    if getattr(args, "peer_compress", False):
+        # ISSUE 19: compressed-wire A/B — the SAME fleet/seed/steps rerun
+        # with peer_compress on. Bit-identity (dist_ok) must hold on both
+        # passes; the comparison is wire bytes for the identical served
+        # payloads (the raw pass's wire bytes == its served bytes)
+        comp = measure_ingest(
+            args.procs, os.path.join(wd, "multi_comp"), data_dir=data_dir,
+            steps=args.steps, batch=args.batch, seq_len=args.seq_len,
+            seed=args.seed, engine=worker_engine, mode=args.mode,
+            devices_per_proc=args.devices_per_proc, peer_compress=True)
+        comp.pop("workers", None)
+        raw_wire = multi["dist_peer_wire_bytes"]
+        comp_wire = comp.get("dist_peer_wire_bytes", 0)
+        out.update({
+            "dist_comp_ok": comp.get("dist_ok"),
+            "dist_peer_raw_wire_bytes": raw_wire,
+            "dist_peer_comp_wire_bytes": comp_wire,
+            # >1 = the compressed pass moved fewer bytes for the same rows
+            "dist_peer_comp_vs_raw":
+                round(raw_wire / comp_wire, 4) if comp_wire else None,
+            "peer_comp_ratio": comp.get("peer_comp_ratio", 0.0),
+        })
     shutil.rmtree(wd, ignore_errors=True)
     return out
 
@@ -2872,6 +3002,17 @@ def main(argv: list[str] | None = None) -> int:
                       help="generated fixture's value/feature column dtype "
                            "(float32: device dispatch aliases instead of "
                            "downcasting under jax's x64-off default)")
+    p_pq.add_argument("--pushdown", action="store_true",
+                      help="also run the plan-time predicate pushdown A/B "
+                           "(ISSUE 19): the same scan pushed vs post-hoc "
+                           "over a monotone-keyed fixture — identical "
+                           "aggregates, strictly fewer submitted bytes "
+                           "(pushdown_ok gates both)")
+    p_pq.add_argument("--pushdown-selectivity", type=float, default=0.25,
+                      dest="pushdown_selectivity",
+                      help="fraction of rows the pushed predicate keeps "
+                           "(the monotone fixture makes this the fraction "
+                           "of row groups that survive refutation)")
     p_pq.set_defaults(fn=bench_parquet)
 
     p_all = sub.add_parser("all", help="every BASELINE config, quick shapes, "
@@ -3002,6 +3143,12 @@ def main(argv: list[str] | None = None) -> int:
     p_dist.add_argument("--devices-per-proc", type=int,
                         dest="devices_per_proc", default=1,
                         help="virtual CPU devices per worker (mesh mode)")
+    p_dist.add_argument("--peer-compress", action="store_true",
+                        dest="peer_compress",
+                        help="also rerun the multi-process pass with the "
+                             "compressed peer wire (ISSUE 19): same fleet, "
+                             "same seed, bit-identical batches, "
+                             "compressed-vs-raw wire bytes reported")
     p_dist.set_defaults(fn=bench_dist)
 
     p_tune = sub.add_parser(

@@ -229,6 +229,15 @@ class StromConfig:
     # (spill_engine_ops / spill_fallback_ops). False = the pre-ISSUE-14
     # page-cache pread/pwrite path everywhere (the A/B flag).
     spill_engine_io: bool = True
+    # transparent spill compression (ISSUE 19 front 3): demoted ranges are
+    # compressed with the probed LZ4-class codec (strom/utils/codec.py)
+    # when that pays — already-compressed bytes (JPEG members, snappy
+    # parquet chunks) store raw — and decompress on serve. Spilled bytes
+    # shrink at unchanged served-data bit-identity; compressed entries
+    # can't ride the sendfile(2) zero-copy peer export (they fall back to
+    # the decompress-and-send path). Off = the pre-compression tier,
+    # byte for byte (the --spill-compress A/B flag).
+    spill_compress: bool = False
 
     # multi-tenant I/O scheduler (strom/sched — ISSUE 7 tentpole): the
     # shared arbiter that replaces the per-transfer engine lock. Tenants
@@ -270,6 +279,14 @@ class StromConfig:
                                        # — MSG_ZEROCOPY sends with errqueue
                                        # completion waits. Off = byte-
                                        # identical pre-PR copy path
+    # transparent peer-response compression (ISSUE 19 front 3): fetches
+    # advertise the probed codec in the request framing and a willing
+    # server answers with a compressed hit frame when that pays (raw
+    # otherwise). Old peers see an unknown op and drop the conn — the
+    # client notices once and latches that peer back to the plain ops
+    # (the same downgrade contract as trace_ok). Off = the pre-PR wire,
+    # byte for byte (the --peer-compress A/B flag).
+    peer_compress: bool = False
 
     # closed-loop knob autotuner (ISSUE 16, strom/tune/): coordinate descent
     # over the live knob surfaces (prefetch depth, sched slice, cache

@@ -508,7 +508,8 @@ class StromContext:
             self._spill = SpillTier(
                 os.path.join(sdir,
                              f"strom-spill-{os.getpid()}-{id(self):x}.bin"),
-                self.config.spill_bytes, scope=self.scope)
+                self.config.spill_bytes, scope=self.scope,
+                compress=self.config.spill_compress)
             if self.config.spill_engine_io and self._scheduler is not None:
                 # spill I/O rides the engines (ISSUE 14 satellite):
                 # O_DIRECT + background-class grants; attached after the
@@ -539,6 +540,12 @@ class StromContext:
         # attach_tuner() (config.tune=False = no controller, no thread,
         # every knob byte-identical to the hand configuration)
         self._tuner = None
+        # live pipeline surfaces the tuner can steer (ISSUE 19 satellite):
+        # pipelines register their decode pool / readahead here at build;
+        # standard_knobs() turns whatever is present into knobs. Last
+        # registration of a kind wins (one live pipeline per context is
+        # the common shape; a rebuilt pipeline re-registers).
+        self._tunables: dict = {}
         # in-flight DEMAND gathers (not readahead): the readahead thread
         # checks this between engine-budget-sized slices and yields, so a
         # consumer's read never queues behind more than one warming slice
@@ -775,6 +782,12 @@ class StromContext:
             max_conns=self.config.dist_server_max_conns)
         return self._peer_server.addr
 
+    def register_tunable(self, kind: str, obj) -> None:
+        """Expose a live pipeline surface (``"decode_pool"``,
+        ``"readahead"``) so :func:`strom.tune.standard_knobs` can build a
+        knob over it; last registration of a *kind* wins."""
+        self._tunables[str(kind)] = obj
+
     def attach_peers(self, peers, owner_fn=None) -> None:
         """Wire the peer tier of the delivery consult: *peers* maps a
         peer name to its ``host:port`` (or is a plain address list);
@@ -790,7 +803,8 @@ class StromContext:
         self._peer_tier = PeerTier(
             peers, owner_fn=owner_fn, scope=self.scope,
             timeout_s=self.config.dist_peer_timeout_s,
-            plan=getattr(self.engine, "plan", None))
+            plan=getattr(self.engine, "plan", None),
+            compress=self.config.peer_compress)
 
     @property
     def cluster_view(self):

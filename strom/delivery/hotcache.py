@@ -632,11 +632,24 @@ class Readahead:
     tests/test_hotcache.py).
     """
 
-    def __init__(self, ctx, window_fn: Callable[[], Iterable[tuple]], *,
-                 interval_s: float = 0.02, tenant: "str | None" = None):
+    def __init__(self, ctx, window_fn: Callable[..., Iterable[tuple]], *,
+                 interval_s: float = 0.02, tenant: "str | None" = None,
+                 window_batches: int = 0):
+        import inspect
+
         self._ctx = ctx
         self._window_fn = window_fn
         self._interval = interval_s
+        # live window size (ISSUE 19 satellite): the autotuner's
+        # readahead_window_batches knob writes here and the next tick
+        # builds that many batches — window fns taking an argument receive
+        # it, zero-arg fns (fixed windows) keep their own count
+        self.window_batches = int(window_batches)
+        try:
+            self._fn_takes_n = bool(
+                inspect.signature(window_fn).parameters)
+        except (TypeError, ValueError):  # builtins/partials w/o signature
+            self._fn_takes_n = False
         # the pipeline this thread warms FOR: admitted entries charge that
         # tenant's cache partition (the ENGINE reads still ride the shared
         # background "readahead" tenant — ownership and scheduling differ)
@@ -657,7 +670,9 @@ class Readahead:
                 continue
             warmed = 0
             try:
-                for source, segments, base_offset in self._window_fn():
+                window = (self._window_fn(self.window_batches)
+                          if self._fn_takes_n else self._window_fn())
+                for source, segments, base_offset in window:
                     if self._stop.is_set():
                         break
                     warmed += self._ctx.warm(source, segments, base_offset,

@@ -72,16 +72,20 @@ SPILL_FIELDS = (
 
 
 class _SpillEntry:
-    """One spilled range: spill_file[off : off + (hi-lo)] holds bytes
-    [lo, hi) of *skey*. ``cls`` is the size-class-rounded file allocation
-    the occupancy budget is billed; ``refs`` pins against eviction (the
-    caller is mid-pread); ``dead`` marks evicted-while-pinned (slot
-    recycles on last unpin)."""
+    """One spilled range: spill_file[off : off + stored] holds bytes
+    [lo, hi) of *skey* — raw (``codec`` None, ``stored`` == hi-lo) or
+    compressed (``codec`` names the wire codec, ``stored`` is the on-disk
+    payload length; ISSUE 19). ``cls`` is the size-class-rounded file
+    allocation the occupancy budget is billed; ``refs`` pins against
+    eviction (the caller is mid-pread); ``dead`` marks
+    evicted-while-pinned (slot recycles on last unpin)."""
 
-    __slots__ = ("skey", "lo", "hi", "off", "cls", "refs", "dead", "tenant")
+    __slots__ = ("skey", "lo", "hi", "off", "cls", "refs", "dead", "tenant",
+                 "codec", "stored")
 
     def __init__(self, skey: Any, lo: int, hi: int, off: int, cls: int,
-                 tenant: "str | None"):
+                 tenant: "str | None", *, codec: "str | None" = None,
+                 stored: "int | None" = None):
         self.skey = skey
         self.lo = lo
         self.hi = hi
@@ -90,6 +94,8 @@ class _SpillEntry:
         self.refs = 0
         self.dead = False
         self.tenant = tenant
+        self.codec = codec
+        self.stored = (hi - lo) if stored is None else stored
 
     @property
     def nbytes(self) -> int:
@@ -101,13 +107,23 @@ class SpillTier:
     entries and per-tenant accounting. Thread-safe; all file I/O runs
     outside the tier lock (see module docstring)."""
 
-    def __init__(self, path: str, max_bytes: int, *, scope=None, io=None):
+    def __init__(self, path: str, max_bytes: int, *, scope=None, io=None,
+                 compress: bool = False):
         if max_bytes <= 0:
             raise ValueError("max_bytes must be positive")
         from strom.utils.stats import global_stats
 
         self.path = path
         self.max_bytes = max_bytes
+        # transparent demote compression (ISSUE 19 front 3): the probed
+        # LZ4-class codec, engaged per entry only when it PAYS (raw
+        # otherwise — strom/utils/codec.py); None = the pre-compression
+        # tier byte for byte
+        self._codec = None
+        if compress:
+            from strom.utils.codec import default_codec
+
+            self._codec = default_codec()
         self._scope = scope if scope is not None else global_stats
         self._fd = os.open(path, os.O_RDWR | os.O_CREAT, 0o600)
         # engine I/O router (ISSUE 14 satellite): an object with
@@ -141,6 +157,11 @@ class SpillTier:
         # which route spill bytes took (engine vs buffered-fd fallback)
         self.engine_ops = 0
         self.fallback_ops = 0
+        # compression accounting (COMP_FIELDS contract): raw bytes entering
+        # the codec vs stored bytes leaving it, and served decompressions
+        self.comp_bytes_in = 0
+        self.comp_bytes_out = 0
+        self.decomp_bytes = 0
 
     # -- allocator (lock held) ----------------------------------------------
     def _alloc_locked(self, n: int, tenant: "str | None") -> "int | None":
@@ -215,10 +236,10 @@ class SpillTier:
             return 0
         d8 = np.ascontiguousarray(data).reshape(-1).view(np.uint8)
         written = 0
-        # gap scan + allocation under the lock, pwrite outside it, publish
-        # under it again: the allocated slot is private until published,
-        # so nothing can read half-written bytes
-        staged: list[tuple[int, int, int, int]] = []  # (g_lo, g_hi, off, cls)
+        # gap scan under the lock; codec pass OUTSIDE it (CPU never runs
+        # under the tier lock); allocation under it; pwrite outside;
+        # publish under it again — the allocated slot is private until
+        # published, so nothing can read half-written bytes
         with self._lock:
             if self._closed:
                 return 0
@@ -240,21 +261,41 @@ class SpillTier:
                 i += 1
             if pos < hi:
                 gaps.append((pos, hi))
-            for g_lo, g_hi in gaps:
-                off = self._alloc_locked(g_hi - g_lo, tenant)
+        codec = self._codec
+        # (g_lo, g_hi, payload_u8, codec_name): payload is the raw slice
+        # view when compression is off or didn't pay — no copy either way
+        prepped: list = []
+        for g_lo, g_hi in gaps:
+            seg = d8[g_lo - lo: g_hi - lo]
+            payload, cname = seg, None
+            if codec is not None:
+                comp = codec.compress(seg.tobytes())
+                if len(comp) < len(seg):
+                    payload = np.frombuffer(comp, np.uint8)
+                    cname = codec.name
+            prepped.append((g_lo, g_hi, payload, cname))
+        staged: list = []   # + (off, cls)
+        with self._lock:
+            if self._closed:
+                return 0
+            for g_lo, g_hi, payload, cname in prepped:
+                off = self._alloc_locked(len(payload), tenant)
                 if off is None:
                     continue
-                staged.append((g_lo, g_hi, off, size_class(g_hi - g_lo)))
-        for g_lo, g_hi, off, _cls in staged:
-            self._pwrite(d8[g_lo - lo: g_hi - lo], off)
+                staged.append((g_lo, g_hi, payload, cname, off,
+                               size_class(len(payload))))
+        for _g_lo, _g_hi, payload, _cname, off, _cls in staged:
+            self._pwrite(payload, off)
         if not staged:
             return 0
+        comp_in = comp_out = 0
         with self._lock:
             if self._closed:
                 return 0
             entries = self._index.setdefault(skey, [])
-            for g_lo, g_hi, off, cls in staged:
-                e = _SpillEntry(skey, g_lo, g_hi, off, cls, tenant)
+            for g_lo, g_hi, payload, cname, off, cls in staged:
+                e = _SpillEntry(skey, g_lo, g_hi, off, cls, tenant,
+                                codec=cname, stored=len(payload))
                 i = bisect.bisect_right(entries, g_lo, key=lambda x: x.lo)
                 # a concurrent offer may have covered the gap meanwhile;
                 # keep entries disjoint (release the orphaned slot)
@@ -266,10 +307,21 @@ class SpillTier:
                 entries.insert(i, e)
                 self._lru[id(e)] = e
                 written += g_hi - g_lo
+                if cname is not None:
+                    comp_in += g_hi - g_lo
+                    comp_out += len(payload)
             self.spilled_bytes += written
             self.spills += 1 if written else 0
+            self.comp_bytes_in += comp_in
+            self.comp_bytes_out += comp_out
+            ratio = (round(self.comp_bytes_in / self.comp_bytes_out, 4)
+                     if self.comp_bytes_out else 0.0)
         if written:
             self._scope.add("spill_spilled_bytes", written)
+        if comp_in:
+            self._scope.add("spill_comp_bytes_in", comp_in)
+            self._scope.add("spill_comp_bytes_out", comp_out)
+            self._scope.set_gauge("spill_comp_ratio", ratio)
         return written
 
     # -- serve ---------------------------------------------------------------
@@ -318,12 +370,30 @@ class SpillTier:
     def read_into(self, e: _SpillEntry, s: int, t: int,
                   dest: np.ndarray) -> int:
         """Read spill bytes [s, t) of *e*'s range straight into *dest*
-        (writable uint8 view, len >= t-s) — engine-routed when a router is
-        attached and can enqueue safely, else preadv on the buffered fd
-        (no intermediate bytes copy either way). The entry must be pinned
-        (a :meth:`lookup` hit)."""
+        (writable uint8 view, len >= t-s). Raw entries pread with no
+        intermediate copy (engine-routed when a router is attached and can
+        enqueue safely, else the buffered fd); compressed entries read
+        their stored payload and decompress through it (counted
+        ``spill_decomp_bytes``). The entry must be pinned (a
+        :meth:`lookup` hit)."""
         n = t - s
-        off = e.off + (s - e.lo)
+        if e.codec is None:
+            return self._read_raw(dest, e.off + (s - e.lo), n)
+        from strom.utils.codec import get_codec
+
+        comp = np.empty(e.stored, np.uint8)
+        self._read_raw(comp, e.off, e.stored)
+        codec = get_codec(e.codec)
+        if codec is None:  # pragma: no cover - entry codec is process-local
+            raise RuntimeError(f"spill entry codec {e.codec!r} unavailable")
+        raw = codec.decompress(comp)
+        dest[:n] = np.frombuffer(raw, np.uint8, count=n, offset=s - e.lo)
+        with self._lock:
+            self.decomp_bytes += n
+        self._scope.add("spill_decomp_bytes", n)
+        return n
+
+    def _read_raw(self, dest: np.ndarray, off: int, n: int) -> int:
         io = self._io
         if io is not None and io.read(dest[:n], off, n):
             with self._lock:
@@ -334,12 +404,17 @@ class SpillTier:
         return os.preadv(self._fd, [memoryview(dest)[:n]], off)
 
     def file_range(self, e: _SpillEntry, s: int, t: int
-                   ) -> tuple[int, int, int]:
+                   ) -> "tuple[int, int, int] | None":
         """``(fd, file_offset, length)`` for bytes [s, t) of *e*'s range —
         the sendfile(2) coordinates the zero-copy peer exporter uses to
-        ship spill-resident bytes without a userspace read. The entry must
-        be pinned (a :meth:`lookup` hit) and stay pinned until the send
-        completes; the fd is owned by this tier, do not close it."""
+        ship spill-resident bytes without a userspace read, or None for a
+        COMPRESSED entry (its file bytes aren't the logical bytes; the
+        caller falls back to :meth:`read_into`, which decompresses). The
+        entry must be pinned (a :meth:`lookup` hit) and stay pinned until
+        the send completes; the fd is owned by this tier, do not close
+        it."""
+        if e.codec is not None:
+            return None
         return self._fd, e.off + (s - e.lo), t - s
 
     def _pwrite(self, data: np.ndarray, off: int) -> None:
@@ -463,6 +538,12 @@ class SpillTier:
                 "spill_promote_bytes": self.promote_bytes,
                 "spill_engine_ops": self.engine_ops,
                 "spill_fallback_ops": self.fallback_ops,
+                "spill_comp_bytes_in": self.comp_bytes_in,
+                "spill_comp_bytes_out": self.comp_bytes_out,
+                "spill_decomp_bytes": self.decomp_bytes,
+                "spill_comp_ratio":
+                    round(self.comp_bytes_in / self.comp_bytes_out, 4)
+                    if self.comp_bytes_out else 0.0,
                 "spill_hit_ratio":
                     round(self.hit_bytes / served, 4) if served else 0.0,
             }

@@ -184,6 +184,10 @@ def run_worker(args: argparse.Namespace) -> dict:
         # the sandbox fixtures live on tmpfs-ish paths; spill off keeps
         # the worker lean (the peer tier serves from RAM here)
         fault_plan=args.fault_plan,
+        # ISSUE 19: compressed peer wire — both halves flip together so
+        # every server compresses and every client asks (mixed fleets
+        # degrade per-peer via the comp_ok latch, exercised in tests)
+        peer_compress=args.peer_compress,
         # a per-rank flight dir: the coordinator's fleet watchdog dumps a
         # host-stamped bundle here when a peer goes dark
         flight_dir=os.path.join(args.workdir, f"flight_{rank}"))
@@ -357,7 +361,8 @@ def launch_local(nproc: int, data_dir: str, workdir: str, *,
                  seed: int = 0, engine: str = "python",
                  mode: str = "host", devices_per_proc: int = 1,
                  hot_cache_bytes: int = 64 * 1024 * 1024,
-                 fault_plan: str = "", timeout_s: float = 120.0) -> list[dict]:
+                 fault_plan: str = "", peer_compress: bool = False,
+                 timeout_s: float = 120.0) -> list[dict]:
     """Spawn *nproc* workers over *data_dir*, join them, return their
     result dicts in rank order. Raises on a worker that died without a
     result (its tail is included)."""
@@ -382,7 +387,8 @@ def launch_local(nproc: int, data_dir: str, workdir: str, *,
          "--devices-per-proc", str(devices_per_proc),
          "--hot-cache-bytes", str(hot_cache_bytes),
          "--timeout-s", str(timeout_s)]
-        + (["--fault-plan", fault_plan] if fault_plan else []),
+        + (["--fault-plan", fault_plan] if fault_plan else [])
+        + (["--peer-compress"] if peer_compress else []),
         stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
         cwd=repo, env=env) for r in range(nproc)]
     outs = []
@@ -429,7 +435,7 @@ def measure_ingest(procs: int, workdir: str, *, data_dir: "str | None" = None,
                    steps: int = 4, batch: int = 8, seq_len: int = 16,
                    seed: int = 0, engine: str = "python",
                    mode: str = "host", devices_per_proc: int = 1,
-                   fault_plan: str = "",
+                   fault_plan: str = "", peer_compress: bool = False,
                    timeout_s: float = 120.0) -> dict:
     """The whole acceptance in one call: launch *procs* workers, verify
     bit-identity against the single-process reference, fold the measured
@@ -445,7 +451,7 @@ def measure_ingest(procs: int, workdir: str, *, data_dir: "str | None" = None,
         procs, data_dir, os.path.join(workdir, f"run{procs}"),
         steps=steps, batch=batch, seq_len=seq_len, seed=seed, engine=engine,
         mode=mode, devices_per_proc=devices_per_proc, fault_plan=fault_plan,
-        timeout_s=timeout_s)
+        peer_compress=peer_compress, timeout_s=timeout_s)
     ok = all(r.get("rc") == 0 and r.get("ok") for r in results) and \
         all(r.get("sha256") == ref[i] for i, r in enumerate(results))
     walls = [r.get("wall_s", 0.0) for r in results if r.get("ok")]
@@ -454,6 +460,11 @@ def measure_ingest(procs: int, workdir: str, *, data_dir: "str | None" = None,
     served = sum(r.get("peer_served_bytes", 0) for r in results)
     ingest = sum(r.get("ingest_bytes", 0) for r in results)
     engine_bytes = sum(r.get("engine_ingest_bytes", 0) for r in results)
+    # ISSUE 19: server-side compression tallies (raw bytes in, wire bytes
+    # out); the wire total replaces compressed spans' logical bytes with
+    # what actually crossed the socket
+    comp_in = sum(r.get("peer_comp_bytes_in", 0) for r in results)
+    comp_out = sum(r.get("peer_comp_bytes_out", 0) for r in results)
     from strom.obs.federation import FED_FIELDS
 
     rank0 = results[0] if results else {}
@@ -471,6 +482,10 @@ def measure_ingest(procs: int, workdir: str, *, data_dir: "str | None" = None,
         "dist_peer_hit_bytes": hit,
         "dist_peer_served_bytes": served,
         "dist_engine_ingest_bytes": engine_bytes,
+        "dist_peer_comp_bytes_in": comp_in,
+        "dist_peer_comp_bytes_out": comp_out,
+        "dist_peer_wire_bytes": served - comp_in + comp_out,
+        "peer_comp_ratio": round(comp_in / comp_out, 4) if comp_out else 0.0,
         "dist_assembly_wait_p99_us": round(max(
             (r.get("assembly_wait_p99_us", 0.0) for r in results),
             default=0.0), 1),
@@ -498,6 +513,8 @@ def main(argv: "list[str] | None" = None) -> int:
     ap.add_argument("--hot-cache-bytes", type=int, dest="hot_cache_bytes",
                     default=64 * 1024 * 1024)
     ap.add_argument("--fault-plan", dest="fault_plan", default="")
+    ap.add_argument("--peer-compress", dest="peer_compress",
+                    action="store_true")
     ap.add_argument("--timeout-s", type=float, dest="timeout_s",
                     default=120.0)
     args = ap.parse_args(argv)

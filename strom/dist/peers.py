@@ -69,6 +69,8 @@ import numpy as np
 from strom.engine.resilience import CircuitBreaker
 from strom.obs import request as _request
 from strom.obs.events import ring as _ring
+from strom.utils.codec import COMP_FIELDS as _COMP_FIELDS
+from strom.utils.codec import default_codec, get_codec
 from strom.utils.locks import make_lock
 
 # The dist section of ``StromContext.stats()`` (→ /stats, /metrics),
@@ -97,7 +99,11 @@ DIST_FIELDS = (
     "peer_zc_bytes",
     "peer_sendfile_bytes",
     "peer_copy_bytes",
-)
+    # + the peer half of the compression counters (ISSUE 19), single-
+    # sourced in strom/utils/codec.py COMP_FIELDS: raw vs wire bytes of
+    # compressed serves, the in/out ratio gauge, and raw-served fallbacks
+    # (codec didn't pay / name unknown)
+) + tuple(k for k in _COMP_FIELDS if k.startswith("peer_"))
 
 # bench-JSON columns the dist arm emits (cli.py bench_dist → bench.py copy
 # loop → compare_rounds "distributed" section; parity-tested like
@@ -122,8 +128,20 @@ DIST_BENCH_FIELDS = (
 # wire protocol ------------------------------------------------------------
 OP_GET = 1
 OP_GET_TRACED = 2
+# compressed-capable requests (ISSUE 19 front 3): byte-identical to the
+# corresponding plain op plus a trailing ``codec_len u16 | codec name``
+# advertising the codec the CLIENT can decompress. A willing server may
+# answer ST_HIT_COMP (``raw_len u64 | compressed bytes`` after the status/
+# trace header) when compression pays, or a plain raw ST_HIT otherwise —
+# an old server sees an unknown op and drops the conn, and the client's
+# per-peer ``comp_ok`` latch downgrades exactly like ``trace_ok``.
+OP_GET_COMP = 3
+OP_GET_TRACED_COMP = 4
 ST_HIT, ST_MISS = 0, 1
+ST_HIT_COMP = 2
 _LEN = struct.Struct("!I")
+_CODEC_LEN = struct.Struct("!H")
+_RAW_LEN = struct.Struct("!Q")
 _REQ_HEAD = struct.Struct("!BH")
 _REQ_RANGE = struct.Struct("!QQ")
 # trace context appended to an OP_GET_TRACED request: req_id u64 | flow_id
@@ -203,22 +221,30 @@ def recv_frame(sock: socket.socket, max_len: int = MAX_FRAME) -> bytearray:
 
 
 def encode_request(path: str, lo: int, hi: int,
-                   trace: "tuple[int, int, float, str] | None" = None
-                   ) -> bytes:
+                   trace: "tuple[int, int, float, str] | None" = None,
+                   codec: "str | None" = None) -> bytes:
     """One request frame. *trace* = (req_id, flow_id, send_us, parent)
-    upgrades the op to OP_GET_TRACED; None is byte-identical to the
-    pre-ISSUE-18 wire."""
+    upgrades the op to OP_GET_TRACED; *codec* upgrades it to the _COMP
+    variant carrying the advertised codec name (ISSUE 19). Both None is
+    byte-identical to the pre-ISSUE-18 wire."""
     p = path.encode("utf-8")
     if len(p) > 0xFFFF:
         raise ValueError(f"path too long for the wire ({len(p)} bytes)")
+    suffix = b""
+    if codec is not None:
+        cb = codec.encode("utf-8")[:0xFFFF]
+        suffix = _CODEC_LEN.pack(len(cb)) + cb
     if trace is None:
-        return _REQ_HEAD.pack(OP_GET, len(p)) + p + _REQ_RANGE.pack(lo, hi)
+        op = OP_GET if codec is None else OP_GET_COMP
+        return (_REQ_HEAD.pack(op, len(p)) + p + _REQ_RANGE.pack(lo, hi)
+                + suffix)
     req_id, flow_id, send_us, parent = trace
     pb = parent.encode("utf-8")[:0xFFFF]
-    return (_REQ_HEAD.pack(OP_GET_TRACED, len(p)) + p
+    op = OP_GET_TRACED if codec is None else OP_GET_TRACED_COMP
+    return (_REQ_HEAD.pack(op, len(p)) + p
             + _REQ_RANGE.pack(lo, hi)
             + _TRACE_CTX.pack(int(req_id), int(flow_id), float(send_us),
-                              len(pb)) + pb)
+                              len(pb)) + pb + suffix)
 
 
 def decode_request(payload) -> tuple[str, int, int]:
@@ -237,38 +263,53 @@ def decode_request(payload) -> tuple[str, int, int]:
     return path, lo, hi
 
 
-def decode_request_ex(payload) -> "tuple[str, int, int, dict | None]":
-    """:func:`decode_request` that also understands OP_GET_TRACED — the
-    server's decoder. Returns ``(path, lo, hi, trace)`` with *trace* None
-    for a plain OP_GET or ``{"req", "flow", "send_us", "parent"}`` for a
-    traced one; the same exact-length strictness per op (trailing bytes
-    are a protocol error, never silently ignored)."""
-    if len(payload) < _REQ_HEAD.size + _REQ_RANGE.size:
-        raise PeerProtocolError(f"request frame too short ({len(payload)})")
+def decode_request_ex(payload
+                      ) -> "tuple[str, int, int, dict | None, str | None]":
+    """:func:`decode_request` that also understands the traced and
+    compressed-capable ops — the server's decoder. Returns
+    ``(path, lo, hi, trace, codec)`` with *trace* None for an untraced op
+    or ``{"req", "flow", "send_us", "parent"}``, and *codec* the
+    advertised codec name of a _COMP op (None otherwise); the same
+    exact-length strictness per op (trailing bytes are a protocol error,
+    never silently ignored)."""
+    total = len(payload)
+    if total < _REQ_HEAD.size + _REQ_RANGE.size:
+        raise PeerProtocolError(f"request frame too short ({total})")
     op, plen = _REQ_HEAD.unpack_from(payload, 0)
-    if op not in (OP_GET, OP_GET_TRACED):
+    if op not in (OP_GET, OP_GET_TRACED, OP_GET_COMP, OP_GET_TRACED_COMP):
         raise PeerProtocolError(f"unknown peer op {op}")
     end = _REQ_HEAD.size + plen
-    rng_end = end + _REQ_RANGE.size
+    pos = end + _REQ_RANGE.size
     trace = None
-    if op == OP_GET:
-        if len(payload) != rng_end:
-            raise PeerProtocolError("request frame length mismatch")
-    else:
-        if len(payload) < rng_end + _TRACE_CTX.size:
+    if op in (OP_GET_TRACED, OP_GET_TRACED_COMP):
+        if total < pos + _TRACE_CTX.size:
             raise PeerProtocolError("traced request frame too short")
         req_id, flow_id, send_us, par_len = _TRACE_CTX.unpack_from(
-            payload, rng_end)
-        if len(payload) != rng_end + _TRACE_CTX.size + par_len:
+            payload, pos)
+        pos += _TRACE_CTX.size
+        if total < pos + par_len:
             raise PeerProtocolError("request frame length mismatch")
-        parent = bytes(payload[rng_end + _TRACE_CTX.size:]).decode("utf-8")
+        parent = bytes(payload[pos: pos + par_len]).decode("utf-8")
+        pos += par_len
         trace = {"req": req_id, "flow": flow_id, "send_us": send_us,
                  "parent": parent}
+    codec = None
+    if op in (OP_GET_COMP, OP_GET_TRACED_COMP):
+        if total < pos + _CODEC_LEN.size:
+            raise PeerProtocolError("comp request frame too short")
+        (clen,) = _CODEC_LEN.unpack_from(payload, pos)
+        pos += _CODEC_LEN.size
+        if total < pos + clen:
+            raise PeerProtocolError("request frame length mismatch")
+        codec = bytes(payload[pos: pos + clen]).decode("utf-8")
+        pos += clen
+    if total != pos:
+        raise PeerProtocolError("request frame length mismatch")
     path = bytes(payload[_REQ_HEAD.size: end]).decode("utf-8")
     lo, hi = _REQ_RANGE.unpack_from(payload, end)
     if hi < lo:
         raise PeerProtocolError(f"bad range [{lo}, {hi})")
-    return path, lo, hi, trace
+    return path, lo, hi, trace, codec
 
 
 # cross-host flow ids: a request's per-process int id collides across
@@ -313,6 +354,14 @@ class PeerServer:
         self.zc_bytes = 0
         self.sendfile_bytes = 0
         self.copy_bytes = 0
+        # response compression (ISSUE 19, opt-in via peer_compress):
+        # honoured only for codec-advertising requests on the copy path —
+        # the zc path keeps serving raw (a comp request accepts ST_HIT).
+        self._comp = bool(getattr(getattr(ctx, "config", None),
+                                  "peer_compress", False))
+        self.comp_bytes_in = 0
+        self.comp_bytes_out = 0
+        self.comp_fallbacks = 0
         self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         self._sock.bind((host, port))
@@ -359,7 +408,8 @@ class PeerServer:
                     zstate = None
             while not self._closed:
                 try:
-                    path, lo, hi, trace = decode_request_ex(recv_frame(conn))
+                    path, lo, hi, trace, req_codec = decode_request_ex(
+                        recv_frame(conn))
                 except (PeerProtocolError, OSError, ValueError):
                     return  # peer went away / spoke garbage: drop the conn
                 recv_us = _ring.now_us() if trace is not None else 0.0
@@ -410,16 +460,20 @@ class PeerServer:
                     continue
                 self._tally(None if data is None else data.nbytes,
                             copied=True, traced=trace is not None)
+                comp = None
+                if data is not None and req_codec is not None and self._comp:
+                    comp = self._try_compress(data, req_codec)
                 s0 = _ring.now_us() if trace is not None else 0.0
+                tr = (_TRACED_RESP.pack(recv_us, s0)
+                      if trace is not None else b"")
                 try:
                     if data is None:
                         send_frame(conn, self._miss_frame(trace, recv_us))
-                    elif trace is not None:
-                        send_frame(conn, (bytes([ST_HIT]),
-                                          _TRACED_RESP.pack(recv_us, s0),
-                                          data.data))
+                    elif comp is not None:
+                        send_frame(conn, (bytes([ST_HIT_COMP]), tr,
+                                          _RAW_LEN.pack(data.nbytes), comp))
                     else:
-                        send_frame(conn, (bytes([ST_HIT]), data.data))
+                        send_frame(conn, (bytes([ST_HIT]), tr, data.data))
                 except OSError:
                     return
                 if trace is not None:
@@ -465,6 +519,27 @@ class PeerServer:
             self._scope.add("peer_served_bytes", n)
             if traced:
                 self._scope.add("peer_serves_traced")
+
+    def _try_compress(self, data, codec_name: str) -> "bytes | None":
+        """Compress a hit for a codec-advertising peer, or None to serve
+        raw: unknown codec and doesn't-pay payloads both fall back (each
+        counted peer_comp_fallbacks — the wire stays correct either way,
+        a comp request always accepts a plain ST_HIT)."""
+        codec = get_codec(codec_name)
+        comp = codec.compress(data.tobytes()) if codec is not None else None
+        if comp is None or len(comp) >= data.nbytes:
+            with self._lock:
+                self.comp_fallbacks += 1
+            self._scope.add("peer_comp_fallbacks")
+            return None
+        with self._lock:
+            self.comp_bytes_in += data.nbytes
+            self.comp_bytes_out += len(comp)
+            ratio = round(self.comp_bytes_in / self.comp_bytes_out, 4)
+        self._scope.add("peer_comp_bytes_in", data.nbytes)
+        self._scope.add("peer_comp_bytes_out", len(comp))
+        self._scope.set_gauge("peer_comp_ratio", ratio)
+        return comp
 
     def _serve_range(self, path: str, lo: int, hi: int, *,
                      trace: "dict | None" = None) -> "np.ndarray | None":
@@ -527,8 +602,18 @@ class PeerServer:
                         ok = False
                         break
                     for ss, tt, ent in sp_hits:
-                        fd, off, ln = spill.file_range(ent, ss, tt)
-                        segs.append((ss, ("file", fd, off, ln)))
+                        fr = spill.file_range(ent, ss, tt)
+                        if fr is None:
+                            # compressed spill entry: no sendfile identity
+                            # between file bytes and logical bytes — fall
+                            # back to a decompressed bounce segment (the
+                            # entry stays pinned like any other)
+                            tmp = np.empty(tt - ss, np.uint8)
+                            spill.read_into(ent, ss, tt, tmp)
+                            segs.append((ss, ("mem", tmp, 0, tt - ss)))
+                        else:
+                            fd, off, ln = fr
+                            segs.append((ss, ("file", fd, off, ln)))
         if not ok:
             if spill is not None:
                 spill.unpin(sp_pinned)
@@ -717,7 +802,13 @@ class PeerServer:
                     "peer_serve_misses": self.serve_misses,
                     "peer_zc_bytes": self.zc_bytes,
                     "peer_sendfile_bytes": self.sendfile_bytes,
-                    "peer_copy_bytes": self.copy_bytes}
+                    "peer_copy_bytes": self.copy_bytes,
+                    "peer_comp_bytes_in": self.comp_bytes_in,
+                    "peer_comp_bytes_out": self.comp_bytes_out,
+                    "peer_comp_fallbacks": self.comp_fallbacks,
+                    "peer_comp_ratio":
+                        round(self.comp_bytes_in / self.comp_bytes_out, 4)
+                        if self.comp_bytes_out else 0.0}
 
     def close(self) -> None:
         if self._closed:
@@ -733,7 +824,7 @@ class _PeerState:
     under the tier lock, used outside it), a circuit breaker, the traced-
     protocol verdict and the running clock-offset estimate."""
 
-    __slots__ = ("addr", "sock", "busy", "breaker", "trace_ok",
+    __slots__ = ("addr", "sock", "busy", "breaker", "trace_ok", "comp_ok",
                  "offset_us", "rtt_scope")
 
     def __init__(self, addr: str, breaker: CircuitBreaker, rtt_scope):
@@ -744,6 +835,11 @@ class _PeerState:
         # None = untried, True = peer answered a traced request, False =
         # peer dropped one (old wire) — downgraded to plain OP_GET forever
         self.trace_ok: "bool | None" = None
+        # same latch for the compressed-capable ops (ISSUE 19): the first
+        # dropped comp request downgrades this peer to uncompressed ops
+        # forever, trace verdict untouched (comp downgrades BEFORE trace
+        # on a shared failure — comp ops are the newer wire)
+        self.comp_ok: "bool | None" = None
         # EWMA of (peer ring clock - our ring clock), microseconds, from
         # the NTP-style four-timestamp estimate each traced exchange carries
         self.offset_us: "float | None" = None
@@ -776,7 +872,8 @@ class PeerTier:
                  owner_fn: "Callable[[str], object] | None" = None,
                  scope=None, timeout_s: float = 0.5, plan=None,
                  clock: Callable[[], float] = time.monotonic,
-                 breaker_kwargs: "dict | None" = None):
+                 breaker_kwargs: "dict | None" = None,
+                 compress: bool = False):
         from strom.utils.stats import global_stats
 
         if not isinstance(peers, Mapping):
@@ -785,6 +882,10 @@ class PeerTier:
         self._owner_fn = owner_fn
         self._timeout = float(timeout_s)
         self._plan = plan
+        # fetch-side compression ask (ISSUE 19): advertise our codec on
+        # the wire; the server still decides per response (raw when it
+        # doesn't pay). Off = the pre-PR wire, byte for byte.
+        self._codec = default_codec() if compress else None
         self._lock = make_lock("dist.peer")
         self._closed = False
         self.breaker_trips = 0
@@ -865,6 +966,11 @@ class PeerTier:
         # already proven it speaks the old protocol
         req = _request.current() if st.trace_ok is not False else None
         traced = st.trace_ok is not False
+        # compression ask (ISSUE 19): same first-failure downgrade latch
+        # as trace_ok, tried independently — a comp-refusing old peer can
+        # still speak the traced wire
+        use_comp = self._codec is not None and st.comp_ok is not False
+        wire_codec = self._codec.name if use_comp else None
         flow_id = next(_flow_ids) if traced else 0
         t0 = time.perf_counter()
         t_send = 0.0
@@ -880,15 +986,23 @@ class PeerTier:
                 send_frame(sock, encode_request(
                     path, lo, hi,
                     trace=(req.id if req is not None else 0, flow_id,
-                           t_send, req.kind if req is not None else "")))
+                           t_send, req.kind if req is not None else ""),
+                    codec=wire_codec))
                 # flow start lands just after t_send — inside the
                 # peer.fetch slice emitted below, which is what binds it
                 _ring.flow("s", flow_id, "peer.req", "reqx")
             else:
-                send_frame(sock, encode_request(path, lo, hi))
+                send_frame(sock, encode_request(path, lo, hi,
+                                                codec=wire_codec))
             payload = recv_frame(sock)
         except (OSError, PeerProtocolError, ValueError):
-            if traced and st.trace_ok is None:
+            # first-attempt downgrade ladder, newest wire first: a comp
+            # op that died latches comp_ok (trace verdict untouched —
+            # retry traced-uncompressed next); only a comp-free traced
+            # failure blames the traced op itself
+            if use_comp and st.comp_ok is None:
+                st.comp_ok = False
+            elif traced and st.trace_ok is None:
                 # first traced attempt died: assume an old peer dropped
                 # the unknown op and downgrade — one counted error, every
                 # later fetch goes plain OP_GET
@@ -901,6 +1015,25 @@ class PeerTier:
         status = payload[0] if payload else -1
         if status == ST_HIT and len(payload) == hdr + n:
             data = np.frombuffer(payload, np.uint8, count=n, offset=hdr)
+        elif (status == ST_HIT_COMP and use_comp
+              and len(payload) > hdr + _RAW_LEN.size):
+            # compressed hit: raw_len u64 + codec payload after the
+            # normal header; decompressed length must equal the asked
+            # range exactly or the frame is untrusted like any other
+            # wrong-length hit
+            (raw_n,) = _RAW_LEN.unpack_from(payload, hdr)
+            try:
+                raw = self._codec.decompress(
+                    bytes(payload[hdr + _RAW_LEN.size:]))
+            except Exception:
+                # undecodable payload = corrupt frame: fail the peer
+                # exactly like a wrong-length hit
+                self._fail(st, sock, ephemeral=ephemeral)
+                return None
+            if raw_n != n or len(raw) != n:
+                self._fail(st, sock, ephemeral=ephemeral)
+                return None
+            data = np.frombuffer(raw, np.uint8, count=n)
         elif status == ST_MISS and len(payload) == hdr:
             data = None
         else:
@@ -922,6 +1055,8 @@ class PeerTier:
             if traced:
                 self.fetch_traced += 1
         st.breaker.record_success()
+        if use_comp:
+            st.comp_ok = True
         if traced:
             st.trace_ok = True
             self._finish_traced(st, payload, flow_id, t_send, t_recv,
@@ -981,6 +1116,7 @@ class PeerTier:
         out = {}
         for name, st in self._peers.items():
             out[str(name)] = {"addr": st.addr, "trace_ok": st.trace_ok,
+                              "comp_ok": st.comp_ok,
                               "clock_offset_us":
                                   None if st.offset_us is None
                                   else round(st.offset_us, 1),

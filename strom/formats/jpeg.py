@@ -643,6 +643,11 @@ class DecodePool:
         self.decode_errors = 0
         self._err_lock = make_lock("app.jpeg_errs")
         self.fuse_runs = fuse_runs
+        # live per-task work target (ISSUE 19 satellite): instance-level
+        # so the autotuner's decode_run_target_us knob steers run
+        # granularity on a running pool; class default = the measured
+        # sweet spot
+        self.run_target_us = float(self._RUN_TARGET_US)
         # EWMA of per-image decode+transform micros, seeded at 1ms (the
         # measured pre-v2 cost on the bench host); updated by fused runs
         self._img_us = 1000.0
@@ -670,7 +675,7 @@ class DecodePool:
             return 1
         with self._err_lock:
             per_img = self._img_us
-        want = int(self._RUN_TARGET_US / max(per_img, 1.0))
+        want = int(self.run_target_us / max(per_img, 1.0))
         cap = -(-n // (self.workers * 2))
         return max(1, min(want, cap))
 

@@ -2,3 +2,6 @@
 the I/O side's hot loops live in strom/_core)."""
 
 from strom.ops.flash_attention import flash_attention, make_flash_attention  # noqa: F401
+from strom.ops.pushdown import (  # noqa: F401
+    OPS_FIELDS, PUSHDOWN_BENCH_FIELDS, PUSHDOWN_FIELDS, And, Cmp,
+    CompiledOpGraph, OpGraph, Or, Predicate, col, row_group_stats)

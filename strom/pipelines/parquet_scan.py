@@ -90,6 +90,7 @@ def _mesh_reducer(mesh: Any):
 
 def parquet_scan_aggregate(ctx: StromContext, paths: Sequence[str],
                            columns: Sequence[str], map_fn: MapFn, *,
+                           predicate: Any = None,
                            prefetch_depth: int = 2,
                            auto_prefetch: bool | None = None,
                            unit_batch: int = 1,
@@ -131,6 +132,17 @@ def parquet_scan_aggregate(ctx: StromContext, paths: Sequence[str],
     order-identical to serial decode — concatenation keeps the chunk's
     unit order. Engages only when unit_batch > 1.
 
+    *predicate* (a :class:`strom.ops.pushdown.Predicate`, ISSUE 19) pushes
+    filtering into the plan: row groups whose column statistics refute it
+    are never submitted (their chunks never enter an ExtentList — the
+    ``parquet_pushdown_*`` counters record the skipped/submitted bytes),
+    and surviving groups are row-masked after decode, so map_fn sees
+    exactly the rows a post-hoc filter of the unpushed read would — bit-
+    identical results, fewer bytes moved. Predicate-only columns are
+    gathered alongside *columns* for mask evaluation but never reach
+    map_fn. Missing/partial stats conservatively pass. Note masked chunk
+    lengths vary, so jit compiles per distinct length — predicate scans
+    prefer small unit_batch.
     """
     import jax
     import jax.numpy as jnp
@@ -145,11 +157,47 @@ def parquet_scan_aggregate(ctx: StromContext, paths: Sequence[str],
     units = scan_units(shards)
     if not units:
         raise ValueError("no row groups to scan")
+    # telemetry scope (ISSUE 6): parquet scans surface their prefetch
+    # depth/stall series under their own label, distinguishable from any
+    # concurrent vision/llama pipeline on the same context
+    pscope = ctx.scope.scoped(**(scope if scope is not None
+                                 else {"pipeline": "parquet"}))
+    # predicate pushdown (ISSUE 19): refute row groups against their
+    # column statistics DURING planning — a refuted group's chunks are
+    # never submitted. Deterministic on every process (the stats walk is
+    # pure metadata), so the LPT assignment below stays coordination-free.
+    read_cols = list(columns)
+    if predicate is not None:
+        from strom.ops.pushdown import row_group_stats
+
+        read_cols += sorted(predicate.columns() - set(columns))
+        pred_cols = sorted(predicate.columns())
+        kept: list = []
+        skipped_bytes = submitted_bytes = 0
+        for (s, g) in units:
+            nbytes = s.column_chunk_extents(g, read_cols).size
+            if predicate.refutes(row_group_stats(s, g, pred_cols)):
+                skipped_bytes += nbytes
+            else:
+                kept.append((s, g))
+                submitted_bytes += nbytes
+        pscope.add("parquet_pushdown_groups_total", len(units))
+        pscope.add("parquet_pushdown_groups_skipped",
+                   len(units) - len(kept))
+        pscope.add("parquet_pushdown_skipped_bytes", skipped_bytes)
+        pscope.add("parquet_pushdown_submitted_bytes", submitted_bytes)
+        units = kept
     n_proc = process_count if process_count is not None else jax.process_count()
     idx = process_index if process_index is not None else jax.process_index()
-    sizes = [s.column_chunk_extents(g, columns).size for (s, g) in units]
-    bins = assign_balanced(sizes, n_proc)
-    local_units = [units[i] for i in bins[idx]]
+    if units:
+        sizes = [s.column_chunk_extents(g, read_cols).size
+                 for (s, g) in units]
+        bins = assign_balanced(sizes, n_proc)
+        local_units = [units[i] for i in bins[idx]]
+    else:
+        # every group refuted: each process still runs the zero-aggregate
+        # contribution path below (the reduce is a collective)
+        local_units = []
     devs = list(devices) if devices is not None else jax.local_devices()
 
     # scheduler tenant (ISSUE 7): a tenant-labeled scope queues this
@@ -162,7 +210,17 @@ def parquet_scan_aggregate(ctx: StromContext, paths: Sequence[str],
         # zero-copy variant was measured 25x SLOWER here: ~80KB pages make
         # the per-operand device dispatch cost dwarf the saved memcpy),
         # pyarrow decode otherwise
-        return shard.read_row_group_arrays(ctx, rg, columns, tenant=tname)
+        d = shard.read_row_group_arrays(ctx, rg, read_cols, tenant=tname)
+        if predicate is None:
+            return d
+        # row mask over the decoded group: together with the refutation
+        # pass this reproduces a post-hoc filter of the unpushed read
+        # bit-identically (refuted groups contribute zero rows by proof)
+        m = predicate.mask(d)
+        masked = int(m.size - np.count_nonzero(m))
+        if masked:
+            pscope.add("parquet_pushdown_rows_masked", masked)
+        return {c: d[c][m] for c in columns}
 
     if unit_batch < 1:
         raise ValueError(f"unit_batch must be >= 1, got {unit_batch}")
@@ -205,16 +263,11 @@ def parquet_scan_aggregate(ctx: StromContext, paths: Sequence[str],
     if auto:
         from strom.delivery.prefetch import bound_depth
 
-        unit_bytes = max((sum(s.column_chunk_extents(g, columns).size
+        unit_bytes = max((sum(s.column_chunk_extents(g, read_cols).size
                               for (s, g) in ch) for ch in unit_chunks),
                          default=0)
         max_depth = bound_depth(ctx.config.slab_pool_bytes, unit_bytes,
                                 cap=ctx.config.prefetch_max_depth)
-    # telemetry scope (ISSUE 6): parquet scans surface their prefetch
-    # depth/stall series under their own label, distinguishable from any
-    # concurrent vision/llama pipeline on the same context
-    pscope = ctx.scope.scoped(**(scope if scope is not None
-                                 else {"pipeline": "parquet"}))
     pf = Prefetcher(thunks, depth=prefetch_depth, auto_depth=auto,
                     max_depth=max_depth, scope=pscope)
     try:
@@ -256,15 +309,18 @@ def parquet_scan_aggregate(ctx: StromContext, paths: Sequence[str],
 
 
 def parquet_count_where(ctx: StromContext, paths: Sequence[str],
-                        column: str, predicate: Callable[[Any], Any],
+                        column: str, where_fn: Callable[[Any], Any],
                         **kw: Any) -> int:
-    """Convenience: SELECT count(*) WHERE predicate(column) — the canonical
-    PG-Strom scan shape."""
+    """Convenience: SELECT count(*) WHERE where_fn(column) — the canonical
+    PG-Strom scan shape. A declarative ``predicate=`` kwarg (ISSUE 19)
+    additionally pushes the filter into the plan; *where_fn* still runs on
+    whatever rows survive, so passing both the IR form and its callable
+    twin yields the identical count with refuted groups never read."""
     import jax.numpy as jnp
 
     def map_fn(cols: dict) -> Any:
         # int32 partials: jax defaults to x64-disabled; per-row-group counts
         # fit easily and the final sum is a python int anyway
-        return jnp.sum(predicate(cols[column]).astype(jnp.int32))
+        return jnp.sum(where_fn(cols[column]).astype(jnp.int32))
 
     return int(parquet_scan_aggregate(ctx, paths, [column], map_fn, **kw))

@@ -63,15 +63,20 @@ def _make_readahead(ctx: StromContext, sampler: EpochShuffleSampler,
 
     window_batches = ctx.config.readahead_window_batches
 
-    def window():
+    def window(n: int):
+        # n is the Readahead's LIVE window_batches — the autotuner's knob
+        # moves it between ticks (ISSUE 19 satellite)
         out = []
-        for indices in sampler.peek(window_batches):
+        for indices in sampler.peek(max(int(n), 0)):
             el = extents_for_batch(indices)
             if el.size:
                 out.append((el, [Segment(0, 0, el.size)], 0))
         return out
 
-    return Readahead(ctx, window, tenant=tenant)
+    ra = Readahead(ctx, window, tenant=tenant,
+                   window_batches=window_batches)
+    ctx.register_tunable("readahead", ra)
+    return ra
 
 
 def _chain_close(*closers) -> Callable[[], None] | None:
@@ -133,21 +138,25 @@ def _local_batch_rows(sharding: Any, batch: int) -> dict:
 
 
 def _init_group_state(ctx: StromContext, images: np.ndarray,
-                      dev_items: Sequence, row_pos: dict
+                      dev_items: Sequence, row_pos: dict,
+                      prep: "Callable | None" = None
                       ) -> tuple[list[list[int]], list[int], list]:
     """Per-device completion bookkeeping shared by the overlapped and
     streamed batch paths: which device groups each row feeds, how many
     rows each group still waits on, and pre-put shards for empty row
-    ranges (nothing to wait for)."""
+    ranges (nothing to wait for). *prep* (the compiled OpGraph kernel,
+    ISSUE 19) must shape the empty pre-puts too, or their dtype/shape
+    diverges from the transformed groups."""
     pos_devs: list[list[int]] = [[] for _ in range(images.shape[0])]
     pending: list[int] = []
     shards: list = [None] * len(dev_items)
+    empty = images[0:0] if prep is None else prep(images[0:0])
     for di, (device, (lo, hi)) in enumerate(dev_items):
         for r in range(lo, hi):
             pos_devs[row_pos[r]].append(di)
         pending.append(hi - lo)
         if hi <= lo:  # empty row range: nothing to wait for
-            shards[di] = ctx.device_put(images[0:0], device)
+            shards[di] = ctx.device_put(empty, device)
     return pos_devs, pending, shards
 
 
@@ -177,7 +186,8 @@ def _decode_put_overlapped(ctx: StromContext, pool: DecodePool, tf: Transform,
                            blobs: Sequence, rngs: Sequence,
                            images: np.ndarray, dev_items: Sequence,
                            row_pos: dict, scope=None,
-                           ckeys: "Sequence | None" = None) -> list:
+                           ckeys: "Sequence | None" = None,
+                           prep: "Callable | None" = None) -> list:
     """Decode every row into its slot and `device_put` each device's row
     group the moment its rows finish (completion-ordered — the per-group
     analogue of `_deliver_streamed`'s read/transfer overlap: early groups
@@ -191,7 +201,7 @@ def _decode_put_overlapped(ctx: StromContext, pool: DecodePool, tf: Transform,
     (the window during which puts overlapped in-flight decode)."""
     n = images.shape[0]
     pos_devs, pending, shards = _init_group_state(ctx, images, dev_items,
-                                                  row_pos)
+                                                  row_pos, prep)
     run = pool.run_size(n)
     futs: dict = {}
     if run <= 1:
@@ -220,8 +230,13 @@ def _decode_put_overlapped(ctx: StromContext, pool: DecodePool, tf: Transform,
                     base = row_pos[lo]
                     if t_first_put is None:
                         t_first_put = time.perf_counter()
-                    shards[di] = ctx.device_put(images[base: base + hi - lo],
-                                                device)
+                    rows = images[base: base + hi - lo]
+                    if prep is not None:
+                        # fused OpGraph (ISSUE 19): the chain runs per
+                        # completed device group, overlapping the remaining
+                        # in-flight decode exactly like the put it feeds
+                        rows = prep(rows)
+                    shards[di] = ctx.device_put(rows, device)
     _note_decode_overlap(scope or global_stats, t0, t_first_put,
                          t_last_decode)
     return shards
@@ -232,7 +247,8 @@ def _decode_put_streamed(ctx: StromContext, pool: DecodePool, tf: Transform,
                          rngs: Sequence, images: np.ndarray,
                          dev_items: Sequence, row_pos: dict, scope=None,
                          ckeys: "Sequence | None" = None,
-                         served: "Sequence | None" = None
+                         served: "Sequence | None" = None,
+                         prep: "Callable | None" = None
                          ) -> tuple[list, list[int]]:
     """Completion-driven batch assembly (ISSUE 5 tentpole): the member
     gather is submitted through ``ctx.stream_segments`` and each sample is
@@ -269,7 +285,7 @@ def _decode_put_streamed(ctx: StromContext, pool: DecodePool, tf: Transform,
     buf = ctx.alloc_read_buffer(el, max(el.size, 1))
 
     pos_devs, pending, shards = _init_group_state(ctx, images, dev_items,
-                                                  row_pos)
+                                                  row_pos, prep)
 
     events: "_queue.SimpleQueue" = _queue.SimpleQueue()
     stop = threading.Event()
@@ -395,8 +411,10 @@ def _decode_put_streamed(ctx: StromContext, pool: DecodePool, tf: Transform,
                             base = row_pos[lo]
                             if t_first_put is None:
                                 t_first_put = time.perf_counter()
-                            shards[di] = ctx.device_put(
-                                images[base: base + hi - lo], device)
+                            rows = images[base: base + hi - lo]
+                            if prep is not None:
+                                rows = prep(rows)
+                            shards[di] = ctx.device_put(rows, device)
             elif kind == "done":
                 gather_done = True
             elif kind == "error":
@@ -440,6 +458,8 @@ def make_wds_vision_pipeline(ctx: StromContext, paths: Sequence[str], *,
                              decode_fuse_runs: bool | None = None,
                              decode_roi: bool | None = None,
                              decode_cache: bool | None = None,
+                             opgraph: Any = None,
+                             opgraph_fuse: bool | None = None,
                              stream_intra_batch: bool | None = None,
                              resume_from: "str | SamplerState | object | None" = None,
                              scope: dict | None = None
@@ -457,6 +477,15 @@ def make_wds_vision_pipeline(ctx: StromContext, paths: Sequence[str], *,
     context's scope — defaults to ``{"pipeline": "vision"}`` so two
     pipelines on one context surface distinguishable per-scope series on
     /metrics while the unlabeled aggregates stay their sum.
+
+    *opgraph* (ISSUE 19): a :class:`strom.ops.OpGraph` compiled once
+    against the decoded sample geometry and run between decode completion
+    and ``device_put``. With *opgraph_fuse* (default on) the chain runs per
+    completed device group inside the completion-ordered dispatch,
+    overlapping remaining decode; ``opgraph_fuse=False`` is the parity
+    reference — barrier decode, one batch-wise apply — and produces
+    bit-identical batches (the kernel is per-sample deterministic). The
+    delivered arrays take the graph's output shape/dtype.
     """
     import jax
     from jax.sharding import NamedSharding, PartitionSpec as P
@@ -522,11 +551,32 @@ def make_wds_vision_pipeline(ctx: StromContext, paths: Sequence[str], *,
     stream = cfg.stream_intra_batch if stream_intra_batch is None \
         else stream_intra_batch
     stream = stream and overlap_put
+    # fused per-sample operator graph (ISSUE 19 front 2): compiled once per
+    # pipeline; opgraph_fuse=False forces the barrier path so the ONE
+    # batch-wise apply below is the only fusion-free reference
+    cgraph = None
+    if opgraph is not None:
+        cgraph = opgraph.compile((image_size, image_size, 3), np.uint8)
+        if not (True if opgraph_fuse is None else opgraph_fuse):
+            stream = False
+            overlap_put = False
+    if cgraph is not None:
+        from strom.obs.events import ring as _ring
+
+        def prep(rows: np.ndarray) -> np.ndarray:
+            with _ring.span("ops.apply", cat="ops",
+                            args={"rows": int(rows.shape[0])}):
+                return cgraph.apply_batch(rows)
+    else:
+        prep = None
     pool = DecodePool(decode_workers, fuse_runs=fuse)
+    ctx.register_tunable("decode_pool", pool)
     label_sharding = NamedSharding(
         sharding.mesh,
         P(sharding.spec[0] if len(sharding.spec) else None))
-    global_shape = (batch, image_size, image_size, 3)
+    out_sample_shape = (cgraph.out_shape if cgraph is not None
+                        else (image_size, image_size, 3))
+    global_shape = (batch,) + out_sample_shape
     rows_by_device = _local_batch_rows(sharding, batch)
     # the union of rows this host decodes, and each device's slice into it
     local_rows = sorted({r for lo, hi in rows_by_device.values()
@@ -583,7 +633,12 @@ def make_wds_vision_pipeline(ctx: StromContext, paths: Sequence[str], *,
             sizes = [(s.members[image_ext].size, s.members[label_ext].size)
                      for s in samples]
         try:
-            return _assemble_batch(el, sizes, rngs, ckeys, served)
+            out = _assemble_batch(el, sizes, rngs, ckeys, served)
+            if cgraph is not None:
+                # per-op engagement counters, flushed per batch so /metrics
+                # tracks the stream (tallies accumulate under ops.graph)
+                cgraph.flush_stats(pscope)
+            return out
         except BaseException:
             # transforms release their own frames; anything that died
             # before (or instead of) a transform still holds pins —
@@ -603,7 +658,7 @@ def make_wds_vision_pipeline(ctx: StromContext, paths: Sequence[str], *,
                               dtype=np.uint8)
             img_shards, labels = _decode_put_streamed(
                 ctx, pool, tf, el, sizes, rngs, images, dev_items, row_pos,
-                scope=pscope, ckeys=ckeys, served=served)
+                scope=pscope, ckeys=ckeys, served=served, prep=prep)
             labels_np = np.asarray(labels, dtype=np.int32)
             pscope.add("decode_slot_bytes", images.nbytes)
             lbl_shards = [ctx.device_put(shard_view(labels_np, lo, hi), d)
@@ -637,11 +692,15 @@ def make_wds_vision_pipeline(ctx: StromContext, paths: Sequence[str], *,
             if overlap_put:
                 img_shards = _decode_put_overlapped(
                     ctx, pool, tf, blobs, rngs, images, dev_items, row_pos,
-                    scope=pscope, ckeys=ckeys)
+                    scope=pscope, ckeys=ckeys, prep=prep)
             else:
                 with pscope.timer_us("decode_batch"):
                     pool.map_into(tf, blobs, rngs, images, ckeys=ckeys)
-                img_shards = [ctx.device_put(shard_view(images, lo, hi), d)
+                # unfused OpGraph reference (ISSUE 19): one batch-wise
+                # apply after the decode barrier — same per-sample kernel
+                # as the fused dispatch, so outputs are bit-identical
+                out = images if prep is None else prep(images)
+                img_shards = [ctx.device_put(shard_view(out, lo, hi), d)
                               for d, (lo, hi) in dev_items]
             # billed after the decode completes: an aborted batch never
             # claims slot bytes it didn't deliver (zero-substituted rows DO
@@ -650,6 +709,8 @@ def make_wds_vision_pipeline(ctx: StromContext, paths: Sequence[str], *,
         else:
             with pscope.timer_us("decode_batch"):
                 images = np.stack(pool.map(tf, blobs, rngs))
+            if prep is not None:
+                images = prep(images)
             img_shards = [ctx.device_put(shard_view(images, lo, hi), d)
                           for d, (lo, hi) in dev_items]
         lbl_shards = [ctx.device_put(shard_view(labels_np, lo, hi), d)

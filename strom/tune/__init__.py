@@ -18,8 +18,9 @@ ended instead of re-searching from the hand defaults.
 """
 
 from strom.tune.autotuner import (TUNE_BENCH_FIELDS, TUNE_FIELDS, Autotuner,
-                                  Profile)
+                                  Profile, stall_weighted_metrics)
 from strom.tune.knobs import Knob, prefetcher_knob, standard_knobs
 
 __all__ = ["Autotuner", "Knob", "Profile", "TUNE_BENCH_FIELDS",
-           "TUNE_FIELDS", "prefetcher_knob", "standard_knobs"]
+           "TUNE_FIELDS", "prefetcher_knob", "stall_weighted_metrics",
+           "standard_knobs"]

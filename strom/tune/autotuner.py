@@ -73,6 +73,37 @@ TUNE_BENCH_FIELDS = (
 )
 
 
+def stall_weighted_metrics(base_fn: Callable[[], dict], *,
+                           wait_weight: float = 0.5) -> Callable[[], dict]:
+    """Wrap a ``metrics_fn`` so the objective also PENALIZES ingest-wait
+    share, not just rewards goodput (ISSUE 19 satellite).
+
+    The base fn's stall-attribution rates (``stall_<bucket>_us_per_s``,
+    published by ``StromContext._tune_metrics``) give the split of step
+    wall time between waiting on ingest and computing. The wrapped
+    objective is ``objective * (1 - wait_weight * share)`` with
+    ``share = ingest_wait / (ingest_wait + compute)`` — two knob settings
+    with equal goodput now rank by how much accelerator time each one
+    leaves stalled, steering the search toward settings with headroom
+    instead of ones barely keeping up. Without the rates (no step windows
+    yet, history off) the metrics pass through untouched, so the wrapper
+    is safe as a default."""
+    w = min(max(float(wait_weight), 0.0), 1.0)
+
+    def metrics() -> dict:
+        m = dict(base_fn())
+        wait = m.get("stall_ingest_wait_us_per_s")
+        comp = m.get("stall_compute_us_per_s")
+        if wait is not None and comp is not None and (wait + comp) > 0:
+            share = min(max(wait / (wait + comp), 0.0), 1.0)
+            m["ingest_wait_share"] = round(share, 4)
+            m["objective"] = float(m.get("objective", 0.0)) \
+                * (1.0 - w * share)
+        return m
+
+    return metrics
+
+
 @dataclasses.dataclass
 class Profile:
     """A persisted knob assignment: what the tuner converged to for one

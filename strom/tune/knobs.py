@@ -104,4 +104,36 @@ def standard_knobs(ctx) -> list[Knob]:
             # most 2x (host memory is someone else's budget too)
             lo=base / 2, hi=base * 2,
             step=base / 8, quantize=_quant_4k, min_step=4096.0))
+    # pipeline surfaces registered via ctx.register_tunable (ISSUE 19
+    # satellite): present only after a pipeline is built on this context
+    tunables = getattr(ctx, "_tunables", {})
+    pool = tunables.get("decode_pool")
+    if pool is not None and hasattr(pool, "run_target_us"):
+        def _set_target(v: float, _p=pool) -> None:
+            _p.run_target_us = float(v)
+
+        knobs.append(Knob(
+            name="decode_run_target_us",
+            get=lambda _p=pool: float(_p.run_target_us),
+            set=_set_target,
+            # half a task-overhead-bound run up to 5x the measured sweet
+            # spot: enough room to trade tail granularity vs dispatch
+            # overhead, never so low that fusing degenerates to per-sample
+            lo=500.0, hi=20000.0, step=1000.0, min_step=100.0))
+    ra = tunables.get("readahead")
+    if ra is not None and getattr(ra, "window_batches", 0) > 0:
+        base = float(ra.window_batches)
+
+        def _set_window(v: float, _r=ra) -> None:
+            _r.window_batches = int(v)
+
+        knobs.append(Knob(
+            name="readahead_window_batches",
+            get=lambda _r=ra: float(_r.window_batches),
+            set=_set_window,
+            # 1 keeps the warmer alive (0 = off is the operator's call,
+            # not the tuner's); 4x the configured window bounds the cache
+            # churn one runaway arm can cause
+            lo=1.0, hi=max(base * 4, 16.0),
+            step=1.0, quantize=_quant_int, min_step=1.0))
     return knobs

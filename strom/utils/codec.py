@@ -1,0 +1,95 @@
+"""Transparent LZ4-class compression for the spill and peer tiers (ISSUE 19
+front 3): probe for a fast codec, fall back to raw.
+
+The probe ladder is ``lz4.frame`` (the reference-class codec, if the box
+has it) then stdlib ``zlib`` at level 1 (always present — the "LZ4-class"
+role here is a cheap, fast byte codec, not maximum ratio). Nothing is ever
+a hard dependency: :func:`default_codec` returning ``None`` means both
+tiers serve raw, bit-identically to the pre-compression path.
+
+Compression only engages when it PAYS: :func:`maybe_compress` returns the
+raw bytes (codec ``None``) whenever the compressed form isn't smaller —
+already-compressed payloads (JPEG, snappy parquet chunks) ride through
+untouched, so the tiers never pay decompress cost to recover padding.
+
+Both wire peers must agree on the codec by NAME (the peer protocol
+negotiates it per request; the spill tier records it per entry), so
+:func:`get_codec` is the one lookup both sides resolve through.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, NamedTuple
+
+# single-sourced numeric leaves of the compression counters: the spill tier
+# and peer tier/server feed them; compare_rounds' "pushdown" section and the
+# bench_sentinel peer_comp_ratio gate read them (tools/lint_stats_names.py
+# walks this tuple). *_in = raw bytes entering the codec, *_out = stored/
+# wire bytes leaving it; ratio = in/out (>= 1.0 when compression engaged).
+COMP_FIELDS = (
+    "spill_comp_bytes_in",
+    "spill_comp_bytes_out",
+    "spill_comp_ratio",
+    "spill_decomp_bytes",
+    "peer_comp_bytes_in",
+    "peer_comp_bytes_out",
+    "peer_comp_ratio",
+    "peer_comp_fallbacks",
+)
+
+
+class Codec(NamedTuple):
+    name: str
+    compress: Callable[[bytes], bytes]
+    decompress: Callable[[bytes], bytes]
+
+
+def _probe() -> "Codec | None":
+    try:  # the reference-class codec, when the box has it
+        import lz4.frame as _lz4  # type: ignore[import-not-found]
+
+        return Codec("lz4", _lz4.compress, _lz4.decompress)
+    except ImportError:
+        pass
+    try:
+        import zlib
+
+        # level 1: the fast end — this codec's job is cheap bytes-on-the-
+        # wire reduction, not archival ratio
+        return Codec("zlib", lambda b: zlib.compress(b, 1), zlib.decompress)
+    except ImportError:  # pragma: no cover - zlib is stdlib
+        return None
+
+
+_DEFAULT = _probe()
+
+
+def default_codec() -> "Codec | None":
+    """The probed codec for this process (``None`` = raw only)."""
+    return _DEFAULT
+
+
+def get_codec(name: str) -> "Codec | None":
+    """Resolve a negotiated codec NAME; None when this side can't speak it
+    (the caller then downgrades to raw, exactly like an old peer)."""
+    if _DEFAULT is not None and name == _DEFAULT.name:
+        return _DEFAULT
+    if name == "zlib":
+        import zlib
+
+        return Codec("zlib", lambda b: zlib.compress(b, 1), zlib.decompress)
+    return None
+
+
+def maybe_compress(data, codec: "Codec | None"
+                   ) -> "tuple[bytes, str | None]":
+    """Compress *data* iff it pays: returns ``(payload, codec_name)`` where
+    ``codec_name`` is ``None`` when the payload is the raw bytes (codec
+    absent, or the compressed form wasn't smaller)."""
+    raw = bytes(data)
+    if codec is None or len(raw) == 0:
+        return raw, None
+    comp = codec.compress(raw)
+    if len(comp) >= len(raw):
+        return raw, None
+    return comp, codec.name

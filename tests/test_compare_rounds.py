@@ -555,3 +555,45 @@ def test_tune_section_hidden_without_tune_keys(tmp_path, capsys):
     p.write_text(json.dumps(OLD_ROUND))
     assert compare_rounds.main([str(p)]) == 0
     assert "kernel bypass" not in capsys.readouterr().out
+
+
+def test_pushdown_keys_match_producers():
+    """Producer↔report key parity for the near-data pushdown section
+    (ISSUE 19, the decode/stall/.../tune pattern): the compare_rounds
+    pushdown columns must be EXACTLY the keys the parquet pushdown A/B
+    and the dist arm's compressed-wire pass emit (single-sourced in
+    strom.ops.pushdown.PUSHDOWN_BENCH_FIELDS) — a rename on either side
+    is a silently dead column."""
+    from strom.ops.pushdown import PUSHDOWN_BENCH_FIELDS
+
+    assert list(compare_rounds.PUSHDOWN_KEYS) == list(PUSHDOWN_BENCH_FIELDS)
+
+
+def test_pushdown_section_renders(tmp_path, capsys):
+    """A round carrying pushdown/comp-wire keys gets the pushdown
+    section."""
+    d = dict(NEW_ROUND)
+    d.update({"pushdown_ok": 1, "parquet_pushdown_rows_per_s": 5023174.2,
+              "parquet_unpushed_rows_per_s": 3881202.9,
+              "parquet_pushdown_vs_unpushed": 1.2943,
+              "parquet_pushdown_skipped_bytes": 6291456,
+              "parquet_pushdown_groups_skipped": 24,
+              "parquet_pushdown_groups_total": 32,
+              "dist_peer_raw_wire_bytes": 1048576,
+              "dist_peer_comp_wire_bytes": 81920,
+              "dist_peer_comp_vs_raw": 12.8, "peer_comp_ratio": 13.0})
+    p = tmp_path / "BENCH_r19.json"
+    p.write_text(json.dumps(d))
+    assert compare_rounds.main([str(p)]) == 0
+    out = capsys.readouterr().out
+    assert "near-data pushdown" in out
+    assert "parquet_pushdown_vs_unpushed" in out
+    assert "dist_peer_comp_vs_raw" in out
+    assert "12.8" in out
+
+
+def test_pushdown_section_hidden_without_keys(tmp_path, capsys):
+    p = tmp_path / "BENCH_r02.json"
+    p.write_text(json.dumps(OLD_ROUND))
+    assert compare_rounds.main([str(p)]) == 0
+    assert "near-data pushdown" not in capsys.readouterr().out

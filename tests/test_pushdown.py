@@ -1,0 +1,540 @@
+"""ISSUE 19 near-data pushdown: the predicate IR's refutation rules,
+plan-time pushdown vs post-hoc filtering bit-identity (including
+missing-stats conservatism), OpGraph fused-vs-unfused parity, compressed
+spill/peer tiers (off-path = pre-PR wire/file layout, mixed fleets
+downgrade per peer), and the new autotuner surfaces."""
+
+import os
+import socket
+import threading
+
+import numpy as np
+import pytest
+
+from strom.config import StromConfig
+from strom.delivery.core import StromContext
+from strom.ops.pushdown import ColStats, col
+
+
+def _cfg(**kw):
+    base = dict(engine="python", queue_depth=8, num_buffers=8,
+                hot_cache_bytes=64 * 1024 * 1024, hot_cache_admit="always")
+    base.update(kw)
+    return StromConfig(**base)
+
+
+# ---------------------------------------------------------------- predicate
+class TestPredicate:
+    def test_cmp_refutation(self):
+        st = {"x": ColStats(10, 20, 0)}
+        assert (col("x") < 10).refutes(st)
+        assert not (col("x") < 11).refutes(st)
+        assert (col("x") <= 9).refutes(st)
+        assert (col("x") > 20).refutes(st)
+        assert not (col("x") >= 20).refutes(st)
+        assert (col("x") == 9).refutes(st)
+        assert not (col("x") == 15).refutes(st)
+
+    def test_missing_stats_conservative(self):
+        # no stats / partial stats / incomparable stats: never refute
+        assert not (col("x") < 0).refutes({})
+        assert not (col("x") < 0).refutes({"x": ColStats(None, None, 0)})
+        assert not (col("x") < 0).refutes({"x": ColStats(b"a", b"z", 0)})
+
+    def test_ne_needs_constant_group_without_nulls(self):
+        assert (col("x") != 5).refutes({"x": ColStats(5, 5, 0)})
+        # unknown null count: a null would decode to NaN and NaN != 5
+        assert not (col("x") != 5).refutes({"x": ColStats(5, 5, None)})
+        assert not (col("x") != 5).refutes({"x": ColStats(5, 6, 0)})
+
+    def test_and_or_composition(self):
+        st = {"x": ColStats(10, 20, 0), "y": ColStats(0, 1, 0)}
+        # one refuted conjunct refutes the conjunction
+        assert ((col("x") < 5) & (col("y") >= 0)).refutes(st)
+        # one live disjunct saves the disjunction
+        assert not ((col("x") < 5) | (col("y") >= 0)).refutes(st)
+        assert ((col("x") < 5) | (col("y") > 1)).refutes(st)
+        p = (col("x") < 5) | (col("y") > 1)
+        assert p.columns() == frozenset({"x", "y"})
+
+    def test_mask_matches_numpy(self):
+        cols_ = {"x": np.arange(10), "y": np.arange(10) % 3}
+        m = ((col("x") >= 4) & (col("y") == 0)).mask(cols_)
+        np.testing.assert_array_equal(
+            m, (np.arange(10) >= 4) & (np.arange(10) % 3 == 0))
+
+
+# ------------------------------------------------------- plan-time pushdown
+class TestParquetPushdown:
+    ROWS, GROUPS = 4000, 8
+
+    def _write(self, tmp_path, name, **kw):
+        pa = pytest.importorskip("pyarrow")
+        import pyarrow.parquet as pq
+
+        rng = np.random.default_rng(3)
+        path = str(tmp_path / name)
+        # monotone seq: disjoint per-group min/max, so a cutoff predicate
+        # refutes a controlled set of groups
+        pq.write_table(pa.table({
+            "seq": np.arange(self.ROWS, dtype=np.int64),
+            "value": rng.integers(0, 1000, self.ROWS, dtype=np.int64),
+        }), path, row_group_size=self.ROWS // self.GROUPS, **kw)
+        return path
+
+    def _scan_pair(self, ctx, path, cutoff):
+        """(pushed, post-hoc) integer aggregates — int sums are
+        order-independent, so equality here is bit-identity."""
+        import jax.numpy as jnp
+
+        from strom.pipelines.parquet_scan import parquet_scan_aggregate
+
+        def m_push(d):
+            return {"hits": jnp.sum((d["value"] > 500).astype(jnp.int32)),
+                    "ssum": jnp.sum(d["seq"].astype(jnp.int32))}
+
+        def m_post(d):
+            keep = d["seq"] < cutoff
+            return {"hits": jnp.sum(((d["value"] > 500) & keep)
+                                    .astype(jnp.int32)),
+                    "ssum": jnp.sum(jnp.where(keep, d["seq"], 0)
+                                    .astype(jnp.int32))}
+
+        pushed = parquet_scan_aggregate(ctx, [path], ["value", "seq"],
+                                        m_push, predicate=col("seq") < cutoff)
+        post = parquet_scan_aggregate(ctx, [path], ["value", "seq"], m_post)
+        return ({k: int(v) for k, v in pushed.items()},
+                {k: int(v) for k, v in post.items()})
+
+    def test_pushdown_bit_identical_and_skips(self, tmp_path):
+        from strom.ops.pushdown import PUSHDOWN_FIELDS
+        from strom.utils.stats import global_stats
+
+        path = self._write(tmp_path, "push.parquet")
+        # 750 straddles group 1 (rows 500..999): exercises the row-mask
+        # half as well as whole-group refutation of groups 2..7
+        cutoff = 750
+        ctx = StromContext(_cfg())
+        try:
+            snap0 = global_stats.snapshot()
+            pushed, post = self._scan_pair(ctx, path, cutoff)
+            snap1 = global_stats.snapshot()
+        finally:
+            ctx.close()
+        assert pushed == post
+        d = {k: snap1.get(k, 0) - snap0.get(k, 0) for k in PUSHDOWN_FIELDS}
+        assert d["parquet_pushdown_groups_total"] == self.GROUPS
+        assert d["parquet_pushdown_groups_skipped"] == 6
+        assert d["parquet_pushdown_skipped_bytes"] > 0
+        assert d["parquet_pushdown_submitted_bytes"] < \
+            d["parquet_pushdown_skipped_bytes"] \
+            + d["parquet_pushdown_submitted_bytes"]
+        # group 1 survives the stats pass but rows 750..999 mask out
+        assert d["parquet_pushdown_rows_masked"] == 250
+
+    def test_missing_stats_groups_conservatively_pass(self, tmp_path):
+        """A file written without column statistics refutes nothing at
+        plan time — every group submits — and the row-mask half alone
+        still reproduces the post-hoc result bit-identically."""
+        from strom.ops.pushdown import PUSHDOWN_FIELDS
+        from strom.utils.stats import global_stats
+
+        path = self._write(tmp_path, "nostats.parquet",
+                           write_statistics=False)
+        ctx = StromContext(_cfg())
+        try:
+            snap0 = global_stats.snapshot()
+            pushed, post = self._scan_pair(ctx, path, 750)
+            snap1 = global_stats.snapshot()
+        finally:
+            ctx.close()
+        assert pushed == post
+        d = {k: snap1.get(k, 0) - snap0.get(k, 0) for k in PUSHDOWN_FIELDS}
+        assert d["parquet_pushdown_groups_total"] == self.GROUPS
+        assert d["parquet_pushdown_groups_skipped"] == 0
+        assert d["parquet_pushdown_skipped_bytes"] == 0
+
+    def test_all_groups_refuted_yields_zero(self, tmp_path):
+        path = self._write(tmp_path, "allout.parquet")
+        ctx = StromContext(_cfg())
+        try:
+            pushed, post = self._scan_pair(ctx, path, -1)
+        finally:
+            ctx.close()
+        assert pushed == post == {"hits": 0, "ssum": 0}
+
+
+# ----------------------------------------------------------- OpGraph parity
+class TestOpGraphParity:
+    def test_fused_matches_unfused_and_streamed(self, tmp_path):
+        """The fused per-sample kernel on the decode pool must be
+        bit-identical to per-op application, with and without intra-batch
+        streaming, and the per-op engagement counters must move."""
+        cv2 = pytest.importorskip("cv2")
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from strom.ops import OpGraph
+        from strom.parallel.mesh import make_mesh
+        from strom.pipelines.vision import make_wds_vision_pipeline
+        from strom.utils.stats import global_stats
+        from tests.test_formats import make_wds_shard
+
+        rng = np.random.default_rng(5)
+        samples = []
+        for i in range(24):
+            img = rng.integers(0, 256, (48 + (i % 5), 56, 3), dtype=np.uint8)
+            ok, buf = cv2.imencode(".jpg", img)
+            assert ok
+            samples.append((f"s{i:04d}", {"jpg": buf.tobytes(),
+                                          "cls": str(i % 10).encode()}))
+        path = str(tmp_path / "og.tar")
+        make_wds_shard(path, samples)
+        mesh = make_mesh({"dp": 2}, devices=jax.devices()[:2])
+        sharding = NamedSharding(mesh, P("dp", None, None, None))
+
+        def graph():
+            return (OpGraph()
+                    .filter(lambda x: x[0, 0, 0] < 250)
+                    .project(slice(0, 24), slice(0, 24))
+                    .normalize([127.5] * 3, [63.0] * 3)
+                    .cast(np.float32))
+
+        def run(fuse, stream):
+            ctx = StromContext(_cfg(num_buffers=16))
+            out = []
+            try:
+                with make_wds_vision_pipeline(
+                        ctx, [path], batch=8, image_size=32,
+                        sharding=sharding, seed=11, decode_workers=2,
+                        stream_intra_batch=stream, opgraph=graph(),
+                        opgraph_fuse=fuse) as pipe:
+                    for _ in range(pipe.sampler.batches_per_epoch * 2):
+                        imgs, lbls = next(pipe)
+                        out.append((np.asarray(imgs), np.asarray(lbls)))
+            finally:
+                ctx.close()
+            return out
+
+        fused = run(True, True)
+        unfused = run(False, False)
+        fused_nostream = run(True, False)
+        assert fused[0][0].shape == (8, 24, 24, 3)
+        assert fused[0][0].dtype == np.float32
+        for (ia, la), (ib, lb), (ic, _lc) in zip(fused, unfused,
+                                                 fused_nostream):
+            np.testing.assert_array_equal(ia, ib)
+            np.testing.assert_array_equal(ia, ic)
+            np.testing.assert_array_equal(la, lb)
+        snap = global_stats.snapshot()
+        for k in ("ops_graph_samples", "ops_graph_runs",
+                  "ops_normalize_samples"):
+            assert snap.get(k, 0) > 0, k
+
+
+# ------------------------------------------------------- compressed spill
+class TestSpillCompression:
+    def test_compressed_round_trip(self, tmp_path):
+        from strom.delivery.spill import SpillTier
+
+        sp = SpillTier(str(tmp_path / "spill.bin"), 16 << 20, compress=True)
+        try:
+            data = np.tile(np.arange(64, dtype=np.uint8), 4096)  # 256 KiB
+            n = data.nbytes
+            assert sp.offer("k1", 0, n, data) == n
+            hits, misses = sp.lookup("k1", 0, n)
+            assert not misses and len(hits) == 1
+            s, t, ent = hits[0]
+            assert ent.codec is not None and ent.stored < n
+            # compressed entries cannot serve via sendfile/file ranges
+            assert sp.file_range(ent, s, t) is None
+            dest = np.empty(n, np.uint8)
+            sp.read_into(ent, 0, n, dest)
+            np.testing.assert_array_equal(dest, data)
+            # partial-range read decompresses and slices
+            part = np.empty(1000, np.uint8)
+            sp.read_into(ent, 500, 1500, part)
+            np.testing.assert_array_equal(part, data[500:1500])
+            sp.unpin([ent])
+            st = sp.stats()
+            assert st["spill_comp_bytes_in"] == n
+            assert 0 < st["spill_comp_bytes_out"] < n
+            assert st["spill_comp_ratio"] > 1.0
+            assert st["spill_decomp_bytes"] == n + 1000
+        finally:
+            sp.close()
+
+    def test_incompressible_rides_raw(self, tmp_path):
+        from strom.delivery.spill import SpillTier
+
+        sp = SpillTier(str(tmp_path / "spill.bin"), 16 << 20, compress=True)
+        try:
+            rnd = np.random.default_rng(0).integers(
+                0, 255, 64 << 10, dtype=np.uint8)
+            sp.offer("k", 0, rnd.nbytes, rnd)
+            hits, misses = sp.lookup("k", 0, rnd.nbytes)
+            assert not misses
+            _, _, ent = hits[0]
+            # the codec didn't pay: stored raw, file ranges still served
+            assert ent.codec is None
+            assert sp.file_range(ent, 0, rnd.nbytes) is not None
+            dest = np.empty(rnd.nbytes, np.uint8)
+            sp.read_into(ent, 0, rnd.nbytes, dest)
+            np.testing.assert_array_equal(dest, rnd)
+            sp.unpin([ent])
+        finally:
+            sp.close()
+
+    def test_compress_off_is_pre_pr_path(self, tmp_path):
+        from strom.delivery.spill import SpillTier
+
+        sp = SpillTier(str(tmp_path / "spill.bin"), 16 << 20)
+        try:
+            data = np.tile(np.arange(64, dtype=np.uint8), 4096)
+            n = data.nbytes
+            sp.offer("k", 0, n, data)
+            hits, _ = sp.lookup("k", 0, n)
+            _, _, ent = hits[0]
+            assert ent.codec is None and ent.stored == n
+            assert sp.file_range(ent, 0, n) is not None
+            sp.unpin([ent])
+        finally:
+            sp.close()
+
+
+# --------------------------------------------------------- compressed peers
+def _peer_pair(tmp_path, payload, server_cfg, client_cfg):
+    p = os.path.join(str(tmp_path), "data.bin")
+    payload.tofile(p)
+    a = StromContext(server_cfg)
+    b = StromContext(client_cfg)
+    addr = a.serve_peers()
+    a.pread(p, 0, payload.nbytes)  # warm the owner
+    b.attach_peers({0: addr}, owner_fn=lambda path: 0)
+    return a, b, p
+
+
+class TestPeerCompression:
+    PAYLOAD = np.tile(np.arange(251, dtype=np.uint8), 1024)
+
+    def test_comp_both_sides(self, tmp_path):
+        a, b, p = _peer_pair(tmp_path, self.PAYLOAD,
+                             _cfg(peer_compress=True),
+                             _cfg(peer_compress=True))
+        try:
+            got = b.pread(p, 0, 4096)
+            assert bytes(got) == self.PAYLOAD[:4096].tobytes()
+            st = a._peer_server.stats()
+            assert st["peer_comp_bytes_in"] == 4096
+            assert 0 < st["peer_comp_bytes_out"] < 4096
+            assert st["peer_comp_ratio"] > 1.0
+            info = next(iter(b.peer_tier.peers_info().values()))
+            assert info["comp_ok"] is True
+        finally:
+            a.close()
+            b.close()
+
+    def test_comp_client_raw_server(self, tmp_path):
+        """Server without compression answers a comp request with a raw
+        hit — the client keeps asking (the op WAS understood)."""
+        a, b, p = _peer_pair(tmp_path, self.PAYLOAD, _cfg(),
+                             _cfg(peer_compress=True))
+        try:
+            got = b.pread(p, 0, 4096)
+            assert bytes(got) == self.PAYLOAD[:4096].tobytes()
+            assert a._peer_server.stats()["peer_comp_bytes_in"] == 0
+            info = next(iter(b.peer_tier.peers_info().values()))
+            assert info["comp_ok"] is True
+        finally:
+            a.close()
+            b.close()
+
+    def test_raw_client_comp_server(self, tmp_path):
+        """Nothing compresses without the ask on the wire — the off-path
+        client sees the pre-PR protocol byte for byte."""
+        a, b, p = _peer_pair(tmp_path, self.PAYLOAD,
+                             _cfg(peer_compress=True), _cfg())
+        try:
+            got = b.pread(p, 0, 4096)
+            assert bytes(got) == self.PAYLOAD[:4096].tobytes()
+            assert a._peer_server.stats()["peer_comp_bytes_in"] == 0
+        finally:
+            a.close()
+            b.close()
+
+    def test_doesnt_pay_fallback_counted(self, tmp_path):
+        rnd = np.random.default_rng(1).integers(
+            0, 255, 256 * 1024, dtype=np.uint8)
+        a, b, p = _peer_pair(tmp_path, rnd, _cfg(peer_compress=True),
+                             _cfg(peer_compress=True))
+        try:
+            got = b.pread(p, 0, 4096)
+            assert bytes(got) == rnd[:4096].tobytes()
+            assert a._peer_server.stats()["peer_comp_fallbacks"] >= 1
+        finally:
+            a.close()
+            b.close()
+
+    def test_old_peer_downgrade_ladder(self, tmp_path):
+        """A pre-compression peer that kills the connection on any op it
+        doesn't know: the client must latch comp_ok=False first, then
+        trace_ok=False, and finally be served over plain OP_GET."""
+        from strom.dist.peers import (OP_GET, ST_HIT, _REQ_HEAD, recv_frame,
+                                      send_frame)
+
+        blob = self.PAYLOAD.tobytes()
+        p = os.path.join(str(tmp_path), "data.bin")
+        self.PAYLOAD.tofile(p)
+        lsock = socket.socket()
+        lsock.bind(("127.0.0.1", 0))
+        lsock.listen(4)
+        stop = threading.Event()
+
+        def old_peer():
+            while not stop.is_set():
+                try:
+                    conn, _ = lsock.accept()
+                except OSError:
+                    return
+                try:
+                    while True:
+                        fr = recv_frame(conn)
+                        op, _plen = _REQ_HEAD.unpack_from(fr, 0)
+                        if op != OP_GET:
+                            conn.close()  # old wire: unknown op = dead conn
+                            break
+                        send_frame(conn, (bytes([ST_HIT]), blob[:4096]))
+                except Exception:
+                    pass
+                finally:
+                    try:
+                        conn.close()
+                    except OSError:
+                        pass
+
+        t = threading.Thread(target=old_peer, daemon=True)
+        t.start()
+        b = StromContext(_cfg(peer_compress=True))
+        try:
+            b.attach_peers({0: f"127.0.0.1:{lsock.getsockname()[1]}"},
+                           owner_fn=lambda path: 0)
+            tier = b.peer_tier
+            # 1st fetch: comp+traced op, conn dropped, comp_ok latches
+            assert tier.fetch(p, 0, 4096) is None
+            info = next(iter(tier.peers_info().values()))
+            assert info["comp_ok"] is False
+            # 2nd: traced-uncompressed, still dropped, trace_ok latches
+            assert tier.fetch(p, 0, 4096) is None
+            info = next(iter(tier.peers_info().values()))
+            assert info["trace_ok"] is False
+            # 3rd: plain OP_GET — served
+            got = tier.fetch(p, 0, 4096)
+            assert got is not None and bytes(got) == blob[:4096]
+        finally:
+            stop.set()
+            lsock.close()
+            b.close()
+
+
+# ----------------------------------------------------------- tuner surfaces
+class _Pool:
+    run_target_us = 4000.0
+
+
+class _RA:
+    def __init__(self, n):
+        self.window_batches = n
+
+
+class TestTunables:
+    def test_registered_surfaces_become_knobs(self):
+        from strom.tune.knobs import standard_knobs
+
+        ctx = StromContext(_cfg())
+        try:
+            pool, ra = _Pool(), _RA(4)
+            ctx.register_tunable("decode_pool", pool)
+            ctx.register_tunable("readahead", ra)
+            knobs = {k.name: k for k in standard_knobs(ctx)}
+            assert "decode_run_target_us" in knobs
+            assert "readahead_window_batches" in knobs
+            knobs["decode_run_target_us"].set(9000.0)
+            assert pool.run_target_us == 9000.0
+            knobs["readahead_window_batches"].set(2.0)
+            assert ra.window_batches == 2
+        finally:
+            ctx.close()
+
+    def test_disabled_readahead_has_no_knob(self):
+        from strom.tune.knobs import standard_knobs
+
+        ctx = StromContext(_cfg())
+        try:
+            ctx.register_tunable("readahead", _RA(0))
+            names = {k.name for k in standard_knobs(ctx)}
+            assert "readahead_window_batches" not in names
+        finally:
+            ctx.close()
+
+    def test_profile_round_trip_clamps_and_ignores_unknown(self, tmp_path):
+        from strom.tune import Autotuner, Profile
+        from strom.tune.knobs import standard_knobs
+
+        ctx = StromContext(_cfg())
+        try:
+            pool, ra = _Pool(), _RA(4)
+            ctx.register_tunable("decode_pool", pool)
+            ctx.register_tunable("readahead", ra)
+            knobs = [k for k in standard_knobs(ctx)
+                     if k.name in ("decode_run_target_us",
+                                   "readahead_window_batches")]
+            tuner = Autotuner(knobs, lambda: {"objective": 1.0})
+            path = str(tmp_path / "profile.json")
+            Profile("arm", {"decode_run_target_us": 250.0,  # below lo
+                            "readahead_window_batches": 3.0,
+                            "gone_knob": 7.0}).save(path)
+            applied = tuner.apply_profile(Profile.load(path))
+            assert applied == 2  # the unknown name is skipped, not fatal
+            assert pool.run_target_us == 500.0  # clamped to the live lo
+            assert ra.window_batches == 3
+        finally:
+            ctx.close()
+
+
+def test_stall_weighted_metrics():
+    from strom.tune import stall_weighted_metrics
+
+    def base():
+        return {"objective": 100.0,
+                "stall_ingest_wait_us_per_s": 250_000.0,
+                "stall_compute_us_per_s": 750_000.0}
+
+    m = stall_weighted_metrics(base, wait_weight=0.5)()
+    assert m["ingest_wait_share"] == 0.25
+    assert m["objective"] == pytest.approx(100.0 * (1 - 0.5 * 0.25))
+    # without the rates the wrapper is a pass-through
+    m2 = stall_weighted_metrics(lambda: {"objective": 7.0})()
+    assert m2["objective"] == 7.0 and "ingest_wait_share" not in m2
+
+
+def test_readahead_window_fn_arity():
+    """Zero-arg window fns (every pre-ISSUE-19 caller) keep working; fns
+    taking a count receive the live window_batches value."""
+    from strom.delivery.hotcache import Readahead
+
+    ctx = StromContext(_cfg())
+    ras = []
+    try:
+        ra0 = Readahead(ctx, lambda: [])
+        ras.append(ra0)
+        assert ra0._fn_takes_n is False
+        got = []
+        ra1 = Readahead(ctx, lambda n: got.append(n) or [],
+                        window_batches=4)
+        ras.append(ra1)
+        assert ra1._fn_takes_n is True
+        assert ra1.window_batches == 4
+    finally:
+        for ra in ras:
+            ra.close()
+        ctx.close()

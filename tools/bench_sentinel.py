@@ -146,6 +146,16 @@ SENTINEL_FIELDS = (
     # would wave a 0 -> 1 flip through, and one dark host is exactly the
     # page — it gates exactly-zero via EXACT_ZERO_FIELDS below.
     ("cluster_trace_linked_ratio", "up"),
+    # near-data pushdown (ISSUE 19): pushdown_ok is 0/1 — identical
+    # aggregates pushed-vs-unpushed with refuted groups never submitted,
+    # any drop fails outright; skipped_bytes counts a SEEDED monotone
+    # fixture's refuted row groups (a shrink means the planner stopped
+    # refuting, not weather); peer_comp_ratio is the codec's raw/wire
+    # ratio over a seeded peer stream (a shrink means serves stopped
+    # compressing or fell back)
+    ("pushdown_ok", "up"),
+    ("parquet_pushdown_skipped_bytes", "up"),
+    ("peer_comp_ratio", "up"),
 )
 
 # metrics where ANY nonzero value in the newest valid round fails the

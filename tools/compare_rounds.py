@@ -334,6 +334,27 @@ TUNE_KEYS = [
     "sqpoll_submit_syscalls_per_gb",
     "sqpoll_active",
 ]
+# near-data pushdown (ISSUE 19): the parquet arm's pushed-vs-unpushed A/B
+# (pushdown_ok=1 = identical aggregates with stats-refuted row groups
+# never submitted and strictly fewer bytes moved) plus the dist arm's
+# compressed-vs-raw peer wire pair (comp_vs_raw > 1 = fewer bytes crossed
+# the socket for the same bit-identical batches). Suffixes single-sourced
+# in strom.ops.pushdown.PUSHDOWN_BENCH_FIELDS (parity-tested in
+# tests/test_compare_rounds.py, same contract as the other sections).
+PUSHDOWN_KEYS = [
+    "pushdown_ok",
+    "parquet_pushdown_rows_per_s",
+    "parquet_unpushed_rows_per_s",
+    "parquet_pushdown_vs_unpushed",
+    "parquet_pushdown_skipped_bytes",
+    "parquet_pushdown_submitted_bytes",
+    "parquet_pushdown_groups_skipped",
+    "parquet_pushdown_groups_total",
+    "dist_peer_raw_wire_bytes",
+    "dist_peer_comp_wire_bytes",
+    "dist_peer_comp_vs_raw",
+    "peer_comp_ratio",
+]
 # per-attempt / per-pass audit arrays (VERDICT.md r4 next #3): printed so
 # the best-of selection's discards are visible in the comparison too
 AUDIT_SUFFIXES = ("_attempts", "_passes")
@@ -484,11 +505,13 @@ def main(argv: list[str]) -> int:
                        for k in CLUSTER_KEYS)
     have_tune = any(cell(d, k) != "-" for _, d in rounds
                     for k in TUNE_KEYS)
+    have_pushdown = any(cell(d, k) != "-" for _, d in rounds
+                        for k in PUSHDOWN_KEYS)
     name_w = max(len(k) for k in binding_keys + CONTEXT_KEYS + DECODE_KEYS
                  + DECODE2_KEYS + STALL_KEYS + CACHE_KEYS + STREAM_KEYS
                  + SCHED_KEYS + SLO_KEYS + RESIL_KEYS + WRITE_KEYS
                  + RESUME_KEYS + DIST_KEYS + CLUSTER_KEYS + TUNE_KEYS
-                 + audit_keys) + 2
+                 + PUSHDOWN_KEYS + audit_keys) + 2
     # every rendered cell folds into ONE column width, or rows misalign
     col_w = max(max(len(n) for n, _ in rounds) + 2, 12,
                 *(len(c) + 2 for cs in audit_cells.values() for c in cs),
@@ -589,6 +612,13 @@ def main(argv: list[str]) -> int:
               "tuner never ships worse than the hand knobs; SQPOLL A/B = "
               "submit syscalls/GB with and without the kernel poller):")
         for k in TUNE_KEYS:
+            print(k.ljust(name_w)
+                  + "".join(cell(d, k).rjust(col_w) for _, d in rounds))
+    if have_pushdown:
+        print("near-data pushdown (pushed-vs-unpushed parquet scan + "
+              "compressed-vs-raw peer wire: pushdown_ok=1 = identical "
+              "aggregates, refuted groups never submitted):")
+        for k in PUSHDOWN_KEYS:
             print(k.ljust(name_w)
                   + "".join(cell(d, k).rjust(col_w) for _, d in rounds))
     if audit_keys:

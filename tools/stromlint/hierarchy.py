@@ -107,6 +107,10 @@ LOCK_RANKS = {
     "obs.federation": 76,      # ClusterView state; NEVER held across a
                                # scrape socket (poll_now fetches first,
                                # locks after), writes stats under itself
+    "ops.graph": 78,           # CompiledOpGraph tally lock (ISSUE 19): a
+                               # leaf on the decode pool workers guarding
+                               # per-op counters only, flushed into the
+                               # stats band (rank 80+) under itself
     # -- band: stats/ring (the terminal leaves) ------------------------------
     "stats.registries": 80,    # module-level registry set
     "stats.registry": 81,      # per-registry name tables
