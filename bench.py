@@ -1099,7 +1099,8 @@ def main() -> int:
             engine="auto", tmpdir=args.tmpdir, json=True, procs=2,
             steps=6, batch=16, seq_len=64, files=4, records=128, seed=0,
             mode="host", devices_per_proc=1, fault_plan="",
-            peer_compress=True, metrics_port=args.metrics_port)
+            peer_compress=True, batch_ab=True,
+            metrics_port=args.metrics_port)
         dsres = attempt("dist", lambda: bench_dist(dsargs)) \
             if phase_ok("dist", 180) else None
         if dsres is not None:
@@ -1119,7 +1120,13 @@ def main() -> int:
                   f"{dsres.get('dist_peer_raw_wire_bytes')}B "
                   f"(x{dsres.get('dist_peer_comp_vs_raw')}, codec ratio "
                   f"{dsres.get('peer_comp_ratio')}, comp_ok="
-                  f"{dsres.get('dist_comp_ok')})", file=sys.stderr)
+                  f"{dsres.get('dist_comp_ok')}); fabric v2 "
+                  f"batch_vs_single=x{dsres.get('dist_batch_vs_single')} "
+                  f"(unbatched {dsres.get('dist_unbatched_items_per_s')} "
+                  f"items/s, unbatched_ok={dsres.get('dist_unbatched_ok')}, "
+                  f"rtt/extent {dsres.get('peer_rtt_per_extent_us')}us, "
+                  f"conn_reuse={dsres.get('peer_conn_reuse_ratio')})",
+                  file=sys.stderr)
             flush_partial(**loader_res)
 
         # ISSUE 16: kernel-bypass speed pass + closed-loop autotuner —

@@ -2587,6 +2587,27 @@ def bench_dist(args: argparse.Namespace) -> dict:
         "dist_worker_errors": sum(w.get("peer_errors", 0)
                                   for w in workers),
     }
+    if getattr(args, "batch_ab", False):
+        # ISSUE 20: batched-transport A/B — the SAME fleet/seed/steps
+        # rerun with the batch wire OFF (batch_extents=0 → every peer
+        # miss pays its own v1 round trip). Bit-identity must hold on
+        # both passes; dist_batch_vs_single > 1 means batching the
+        # gather's misses into one RTT bought real rate.
+        unb = measure_ingest(
+            args.procs, os.path.join(wd, "multi_unbatched"),
+            data_dir=data_dir, steps=args.steps, batch=args.batch,
+            seq_len=args.seq_len, seed=args.seed, engine=worker_engine,
+            mode=args.mode, devices_per_proc=args.devices_per_proc,
+            batch_extents=0)
+        unb.pop("workers", None)
+        unb_rate = unb.get("dist_items_per_s") or 0.0
+        out.update({
+            "dist_unbatched_ok": unb.get("dist_ok"),
+            "dist_unbatched_items_per_s": unb_rate,
+            "dist_batch_vs_single":
+                round(multi["dist_items_per_s"] / unb_rate, 3)
+                if unb_rate else None,
+        })
     if getattr(args, "peer_compress", False):
         # ISSUE 19: compressed-wire A/B — the SAME fleet/seed/steps rerun
         # with peer_compress on. Bit-identity (dist_ok) must hold on both
@@ -3149,6 +3170,12 @@ def main(argv: list[str] | None = None) -> int:
                              "compressed peer wire (ISSUE 19): same fleet, "
                              "same seed, bit-identical batches, "
                              "compressed-vs-raw wire bytes reported")
+    p_dist.add_argument("--batch-ab", action="store_true", dest="batch_ab",
+                        help="also rerun the multi-process pass with the "
+                             "batched transport OFF (ISSUE 20): same "
+                             "fleet, same seed, bit-identical batches, "
+                             "dist_batch_vs_single = batched rate over "
+                             "per-extent-RTT rate")
     p_dist.set_defaults(fn=bench_dist)
 
     p_tune = sub.add_parser(

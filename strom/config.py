@@ -287,6 +287,20 @@ class StromConfig:
     # (the same downgrade contract as trace_ok). Off = the pre-PR wire,
     # byte for byte (the --peer-compress A/B flag).
     peer_compress: bool = False
+    # peer fabric v2 (ISSUE 20): batched pipelined transport + connection
+    # pool + shared-key auth. Batching packs up to this many extents into
+    # one OP_GET_BATCH round trip (0 = off: the v1 one-extent-per-RTT
+    # wire, the bench's unbatched A/B arm); old peers latch back per the
+    # usual downgrade ladder. The pool keeps this many persistent conns
+    # per peer (overflow rides ephemeral conns); a failed conn is
+    # discarded so a restarted peer gets fresh re-probed ones.
+    dist_batch_max_extents: int = 64
+    dist_conn_pool_size: int = 2
+    # shared-key auth: when non-empty every new peer conn must pass an
+    # HMAC-SHA256 challenge/response before its first request; wrong or
+    # missing key is refused cleanly (peer_auth_rejects). Empty = the
+    # open loopback wire, byte for byte.
+    dist_auth_key: str = ""
 
     # closed-loop knob autotuner (ISSUE 16, strom/tune/): coordinate descent
     # over the live knob surfaces (prefetch depth, sched slice, cache
@@ -482,6 +496,11 @@ class StromConfig:
             raise ValueError("dist_peer_timeout_s must be > 0")
         if self.dist_server_max_conns < 1:
             raise ValueError("dist_server_max_conns must be >= 1")
+        if self.dist_batch_max_extents < 0:
+            raise ValueError("dist_batch_max_extents must be >= 0 (0 = "
+                             "unbatched transport)")
+        if self.dist_conn_pool_size < 1:
+            raise ValueError("dist_conn_pool_size must be >= 1")
         if self.uring_sqpoll and not self.sqpoll:
             object.__setattr__(self, "sqpoll", True)
         if self.ring_recovery_s < 0:

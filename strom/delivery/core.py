@@ -531,6 +531,7 @@ class StromContext:
         # behavior unchanged.
         self._peer_tier = None
         self._peer_server = None
+        self._decoded_cache = None
         # cluster observability plane (ISSUE 18, strom/obs/federation.py):
         # attach_cluster() on the coordinator polls every worker's /stats,
         # merges them and watches fleet health; None = no /cluster route
@@ -788,23 +789,62 @@ class StromContext:
         knob over it; last registration of a *kind* wins."""
         self._tunables[str(kind)] = obj
 
-    def attach_peers(self, peers, owner_fn=None) -> None:
+    def attach_peers(self, peers, owner_fn=None, directory=None) -> None:
         """Wire the peer tier of the delivery consult: *peers* maps a
         peer name to its ``host:port`` (or is a plain address list);
         *owner_fn* maps a dataset path to the peer name expected to have
-        it hot (None/unknown = straight to the engine). Fetch failures
-        and timeouts fall back to the local engine read — never fatal —
-        and a dead peer trips a per-peer circuit breaker. Replaces any
-        previously attached tier."""
+        it hot (None/unknown = straight to the engine), or *directory* is
+        a live :class:`~strom.dist.directory.ExtentDirectory` — the
+        consistent-hash owner map that re-owns a dead host's keys across
+        membership epochs (ISSUE 20; it outranks *owner_fn*). Fetch
+        failures and timeouts fall back to the local engine read — never
+        fatal — and a dead peer trips a per-peer circuit breaker (which
+        publishes the death to the directory when one is attached).
+        Replaces any previously attached tier; the tier registers itself
+        as the ``"peer_tier"`` tunable so the autotuner can steer the
+        batch size and pool depth."""
         from strom.dist.peers import PeerTier
 
         if self._peer_tier is not None:
             self._peer_tier.close()
         self._peer_tier = PeerTier(
-            peers, owner_fn=owner_fn, scope=self.scope,
+            peers, owner_fn=owner_fn, directory=directory,
+            scope=self.scope,
             timeout_s=self.config.dist_peer_timeout_s,
             plan=getattr(self.engine, "plan", None),
-            compress=self.config.peer_compress)
+            compress=self.config.peer_compress,
+            batch_max_extents=self.config.dist_batch_max_extents,
+            conn_pool_size=self.config.dist_conn_pool_size,
+            auth_key=self.config.dist_auth_key)
+        self.register_tunable("peer_tier", self._peer_tier)
+
+    @property
+    def decoded_cache(self):
+        """The DecodedCache registered via :meth:`attach_decoded_cache`
+        (the vision pipeline's decode-once tier), else None — the peer
+        server exports decoded frames from it (ISSUE 20)."""
+        return self._decoded_cache
+
+    def attach_decoded_cache(self, dcache) -> None:
+        """Register the pipeline's DecodedCache so the peer server can
+        serve decoded frames cluster-wide (kind-1 batch keys carrying the
+        decode fingerprint); last registration wins."""
+        self._decoded_cache = dcache
+
+    def peer_decoded_fetch(self, ckey) -> "np.ndarray | None":
+        """Probe the owning peer for a decoded frame by its DecodedCache
+        key ``("jpegdec", path, lo, hi, fingerprint)`` → ``(h, w, 3)``
+        uint8 RGB or None. The vision pipeline consults this on a local
+        decoded-cache miss BEFORE planning the JPEG extent read — a frame
+        decoded once is decoded once per cluster."""
+        if self._peer_tier is None:
+            return None
+        try:
+            _, path, lo, hi, fp = ckey
+        except (TypeError, ValueError):
+            return None
+        return self._peer_tier.fetch_frame(str(path), int(lo), int(hi),
+                                           str(fp))
 
     @property
     def cluster_view(self):
@@ -1243,27 +1283,21 @@ class StromContext:
         peers = self._peer_tier if (not warm and dflat is not None) else None
         spill_served = 0
 
+        # peer fabric v2 (ISSUE 20): true misses are COLLECTED during the
+        # tier walk and resolved in one fetch_many after it — a gather's
+        # worth of peer candidates rides the batched wire (one round trip
+        # per owner chunk) instead of one synchronous exchange per range
+        peer_pending: list = []
+
         def true_miss(fi: int, path, fo: int, do: int, s: int, t: int, *,
                       deferred: bool) -> None:
-            """Neither RAM nor spill holds [s, t): probe the peer tier,
-            engine on miss. *deferred* = the cache lookup left miss
-            counting to us (a peer hit must not read as a cache miss)."""
-            nonlocal cache_hit, peer_hit
+            """Neither RAM nor spill holds [s, t): queue it for the peer
+            tier (resolved in a batch below), engine on miss. *deferred* =
+            the cache lookup left miss counting to us (a peer hit must not
+            read as a cache miss)."""
             if peers is not None and path is not None:
-                data = peers.fetch(path, s, t)
-                if data is not None:
-                    d_lo = do + (s - fo)
-                    dflat[d_lo: d_lo + (t - s)] = data
-                    hit_ranges.append((d_lo, d_lo + (t - s)))
-                    cache_hit += t - s
-                    peer_hit += t - s
-                    if cache is not None:
-                        # promote like a spill hit: the NEXT request is a
-                        # RAM hit, and this host can serve it onward
-                        cache.admit(path, s, t,
-                                    dflat[d_lo: d_lo + (t - s)],
-                                    tenant=tenant)
-                    return
+                peer_pending.append((fi, path, fo, do, s, t, deferred))
+                return
             miss_chunks.append((fi, s, do + (s - fo), t - s))
             if deferred and cache is not None and not warm:
                 cache.note_miss(t - s)
@@ -1335,6 +1369,27 @@ class StromContext:
                     spill.unpin([e for _, _, e in sp_hits])
                 for ss, tt in sp_misses:
                     true_miss(fi, path, fo, do, ss, tt, deferred=True)
+        if peer_pending:
+            results = peers.fetch_many(
+                [(path, s, t) for _, path, _, _, s, t, _ in peer_pending])
+            for (fi, path, fo, do, s, t, deferred), data in zip(
+                    peer_pending, results):
+                if data is not None:
+                    d_lo = do + (s - fo)
+                    dflat[d_lo: d_lo + (t - s)] = data
+                    hit_ranges.append((d_lo, d_lo + (t - s)))
+                    cache_hit += t - s
+                    peer_hit += t - s
+                    if cache is not None:
+                        # promote like a spill hit: the NEXT request is a
+                        # RAM hit, and this host can serve it onward
+                        cache.admit(path, s, t,
+                                    dflat[d_lo: d_lo + (t - s)],
+                                    tenant=tenant)
+                    continue
+                miss_chunks.append((fi, s, do + (s - fo), t - s))
+                if deferred and cache is not None and not warm:
+                    cache.note_miss(t - s)
         if cache is not None:
             cache.unpin(pinned)
         if spill_served:

@@ -17,7 +17,9 @@
   ``strom/parallel/multihost.py``.
 """
 
+from strom.dist.directory import ExtentDirectory, HashRing
 from strom.dist.peers import (DIST_BENCH_FIELDS, DIST_FIELDS, PeerServer,
                               PeerTier)
 
-__all__ = ["DIST_FIELDS", "DIST_BENCH_FIELDS", "PeerServer", "PeerTier"]
+__all__ = ["DIST_FIELDS", "DIST_BENCH_FIELDS", "ExtentDirectory",
+           "HashRing", "PeerServer", "PeerTier"]

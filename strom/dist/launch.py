@@ -77,6 +77,13 @@ def rendezvous(workdir: str, phase: str, rank: int, nproc: int,
                 with open(p) as f:
                     out.append(f.read())
             except OSError:
+                # a rank that died mid-run (kill-a-host injection, ISSUE
+                # 20) published a ``dead_<rank>`` marker on its way out:
+                # it satisfies every later barrier with an empty payload
+                # so the survivors complete instead of timing out
+                if os.path.exists(os.path.join(workdir, f"dead_{r}")):
+                    out.append("")
+                    continue
                 break
         if len(out) == nproc:
             return out
@@ -164,7 +171,6 @@ def run_worker(args: argparse.Namespace) -> dict:
     if not paths:
         raise RuntimeError(f"no .bin shards under {args.data}")
     counts, starts, rec_bytes = dataset_layout(paths, args.seq_len)
-    owners = owner_of(paths, nproc)
     per_host = args.batch // nproc
     if per_host * nproc != args.batch:
         raise ValueError(f"batch {args.batch} not divisible by {nproc}")
@@ -188,6 +194,11 @@ def run_worker(args: argparse.Namespace) -> dict:
         # every server compresses and every client asks (mixed fleets
         # degrade per-peer via the comp_ok latch, exercised in tests)
         peer_compress=args.peer_compress,
+        # ISSUE 20: --batch-extents overrides the batched-transport chunk
+        # size (0 = the unbatched v1 wire, the bench's A/B arm); -1 keeps
+        # the config default
+        **({"dist_batch_max_extents": args.batch_extents}
+           if args.batch_extents >= 0 else {}),
         # a per-rank flight dir: the coordinator's fleet watchdog dumps a
         # host-stamped bundle here when a peer goes dark
         flight_dir=os.path.join(args.workdir, f"flight_{rank}"))
@@ -201,11 +212,19 @@ def run_worker(args: argparse.Namespace) -> dict:
         addrs = rendezvous(args.workdir, "peers", rank, nproc, addr,
                            timeout_s=args.timeout_s)
         peer_map = {r: a for r, a in enumerate(addrs) if r != rank}
-        path_owner = {p: owners[p] for p in paths}
-        ctx.attach_peers(peer_map,
-                         owner_fn=lambda p: (
-                             path_owner.get(p)
-                             if path_owner.get(p) != rank else None))
+        # consistent-hash extent directory (ISSUE 20): every rank builds
+        # the identical ring from the shared membership set — the same
+        # coordination-free determinism assign_balanced gave the static
+        # owner map, plus live re-ownership: a tripped peer's death is
+        # published through this workdir and every survivor's throttled
+        # poll recomputes the ring (epoch++), so its keys re-route to
+        # live owners mid-run
+        from strom.dist.directory import ExtentDirectory
+
+        directory = ExtentDirectory(range(nproc), rank,
+                                    rendezvous_dir=args.workdir,
+                                    poll_interval_s=0.05)
+        ctx.attach_peers(peer_map, directory=directory)
 
         # observability rendezvous: every rank publishes its metrics
         # address; rank 0 federates them all (itself included) into the
@@ -236,7 +255,7 @@ def run_worker(args: argparse.Namespace) -> dict:
         # admission is "always" so every byte lands hot. The barrier
         # after it guarantees ingest-phase peer probes find owners warm.
         for p in paths:
-            if owners[p] == rank:
+            if directory.ring_owner(p) == rank:
                 ctx.pread(p, 0, counts[paths.index(p)] * rec_bytes)
         rendezvous(args.workdir, "warm", rank, nproc,
                    timeout_s=args.timeout_s)
@@ -289,6 +308,15 @@ def run_worker(args: argparse.Namespace) -> dict:
                     dtype=RECORD_DTYPE)
             asm_us.append((time.perf_counter() - ta) * 1e6)
             sha.update(np.ascontiguousarray(local).tobytes())
+            if (args.die_after_step >= 0 and args.die_rank == rank
+                    and step == args.die_after_step):
+                # kill-a-host injection (ISSUE 20): publish the death
+                # marker (later barriers tolerate us, survivors' ring
+                # polls re-own our keys) and vanish with NO cleanup —
+                # exactly how a crashed host looks to the fleet
+                _atomic_write(os.path.join(args.workdir, f"dead_{rank}"),
+                              str(step))
+                os._exit(17)
             prev_epoch, consumed = consumed // rows_per_epoch, \
                 consumed + args.batch
             if consumed // rows_per_epoch != prev_epoch:
@@ -362,16 +390,23 @@ def launch_local(nproc: int, data_dir: str, workdir: str, *,
                  mode: str = "host", devices_per_proc: int = 1,
                  hot_cache_bytes: int = 64 * 1024 * 1024,
                  fault_plan: str = "", peer_compress: bool = False,
+                 batch_extents: int = -1, die_rank: int = -1,
+                 die_after_step: int = -1,
                  timeout_s: float = 120.0) -> list[dict]:
     """Spawn *nproc* workers over *data_dir*, join them, return their
     result dicts in rank order. Raises on a worker that died without a
-    result (its tail is included)."""
+    result (its tail is included). *batch_extents* overrides the batched
+    transport's chunk size (0 = unbatched, -1 = config default);
+    *die_rank*/*die_after_step* arm the kill-a-host injection (that
+    worker exits uncleanly after the given step — its result row reads
+    ``ok 0, rc 17``)."""
     os.makedirs(workdir, exist_ok=True)
     for f in os.listdir(workdir):
         # stale rendezvous/result files from a previous run in the same
         # workdir would satisfy (or corrupt) this run's barriers
         if f.startswith(("peers_", "coord_", "warm_", "epoch", "done_",
-                         "result_", "obs_", "trace_")):
+                         "result_", "obs_", "trace_", "dead_",
+                         "ring_dead_")):
             with contextlib.suppress(OSError):
                 os.unlink(os.path.join(workdir, f))
     repo = os.path.dirname(os.path.dirname(os.path.dirname(
@@ -386,7 +421,10 @@ def launch_local(nproc: int, data_dir: str, workdir: str, *,
          "--seed", str(seed), "--engine", engine, "--mode", mode,
          "--devices-per-proc", str(devices_per_proc),
          "--hot-cache-bytes", str(hot_cache_bytes),
-         "--timeout-s", str(timeout_s)]
+         "--timeout-s", str(timeout_s),
+         "--batch-extents", str(batch_extents),
+         "--die-rank", str(die_rank),
+         "--die-after-step", str(die_after_step)]
         + (["--fault-plan", fault_plan] if fault_plan else [])
         + (["--peer-compress"] if peer_compress else []),
         stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
@@ -436,11 +474,16 @@ def measure_ingest(procs: int, workdir: str, *, data_dir: "str | None" = None,
                    seed: int = 0, engine: str = "python",
                    mode: str = "host", devices_per_proc: int = 1,
                    fault_plan: str = "", peer_compress: bool = False,
+                   batch_extents: int = -1, die_rank: int = -1,
+                   die_after_step: int = -1,
                    timeout_s: float = 120.0) -> dict:
     """The whole acceptance in one call: launch *procs* workers, verify
     bit-identity against the single-process reference, fold the measured
     rates + peer traffic into the ``DIST_BENCH_FIELDS`` columns (the
-    ``strom-bench dist`` arm and the dryrun tail both ride this)."""
+    ``strom-bench dist`` arm and the dryrun tail both ride this). With a
+    kill injection armed (*die_rank* >= 0) the acceptance covers the
+    SURVIVORS: each must exit clean and bit-identical — the dead rank is
+    expected to vanish."""
     if data_dir is None:
         data_dir = os.path.join(workdir, "data")
         make_fixture(data_dir, seq_len=seq_len)
@@ -451,9 +494,12 @@ def measure_ingest(procs: int, workdir: str, *, data_dir: "str | None" = None,
         procs, data_dir, os.path.join(workdir, f"run{procs}"),
         steps=steps, batch=batch, seq_len=seq_len, seed=seed, engine=engine,
         mode=mode, devices_per_proc=devices_per_proc, fault_plan=fault_plan,
-        peer_compress=peer_compress, timeout_s=timeout_s)
-    ok = all(r.get("rc") == 0 and r.get("ok") for r in results) and \
-        all(r.get("sha256") == ref[i] for i, r in enumerate(results))
+        peer_compress=peer_compress, batch_extents=batch_extents,
+        die_rank=die_rank, die_after_step=die_after_step,
+        timeout_s=timeout_s)
+    judged = [(i, r) for i, r in enumerate(results) if i != die_rank]
+    ok = all(r.get("rc") == 0 and r.get("ok") for _, r in judged) and \
+        all(r.get("sha256") == ref[i] for i, r in judged)
     walls = [r.get("wall_s", 0.0) for r in results if r.get("ok")]
     items = sum(r.get("items", 0) for r in results)
     hit = sum(r.get("peer_hit_bytes", 0) for r in results)
@@ -492,6 +538,19 @@ def measure_ingest(procs: int, workdir: str, *, data_dir: "str | None" = None,
         "dist_peer_rtt_p99_us": round(max(
             (r.get("peer_rtt_p99_us", 0.0) for r in results),
             default=0.0), 1),
+        # ISSUE 20 fabric v2 columns: per-extent round-trip cost (worst
+        # worker), decoded-frame traffic, and how well the conn pool
+        # amortised dials across the whole fleet
+        "peer_rtt_per_extent_us": round(max(
+            (r.get("peer_rtt_per_extent_us", 0.0) for r in results),
+            default=0.0), 1),
+        "peer_frame_hit_bytes":
+            sum(r.get("peer_frame_hit_bytes", 0) for r in results),
+        "peer_conn_reuse_ratio": round(
+            sum(r.get("peer_conn_reuses", 0) for r in results)
+            / max(sum(r.get("peer_conn_opens", 0)
+                      + r.get("peer_conn_reuses", 0) for r in results), 1),
+            4),
         "workers": results,
     }
 
@@ -517,6 +576,11 @@ def main(argv: "list[str] | None" = None) -> int:
                     action="store_true")
     ap.add_argument("--timeout-s", type=float, dest="timeout_s",
                     default=120.0)
+    ap.add_argument("--batch-extents", type=int, dest="batch_extents",
+                    default=-1)
+    ap.add_argument("--die-rank", type=int, dest="die_rank", default=-1)
+    ap.add_argument("--die-after-step", type=int, dest="die_after_step",
+                    default=-1)
     args = ap.parse_args(argv)
     res = run_worker(args)
     print(json.dumps(res))

@@ -16,10 +16,23 @@ that into a cooperative cache tier:
   peer traffic can never starve local demand.
 - :class:`PeerTier` — the client side, probed by the delivery consult
   (``StromContext._consult_cache``) after local RAM/spill and before the
-  engine. One persistent connection per peer; fetch failures/timeouts
-  are NEVER fatal (the range falls back to the local engine read), and a
-  dead peer trips a per-peer :class:`~strom.engine.resilience.CircuitBreaker`
+  engine. A pool of persistent connections per peer (``dist_conn_pool_size``,
+  ISSUE 20); fetch failures/timeouts are NEVER fatal (the range falls
+  back to the local engine read), and a dead peer trips a per-peer
+  :class:`~strom.engine.resilience.CircuitBreaker`
   so a down host costs one cooldown, not a timeout per request.
+
+Peer fabric v2 (ISSUE 20) stacks three mechanisms on that wire: a
+batched multi-extent op (``OP_GET_BATCH``) so a gather's worth of peer
+misses rides one pipelined round trip per chunk instead of one per
+extent; an optional shared-key HMAC handshake (``OP_AUTH`` /
+``dist_auth_key``) gating every new connection; and decoded-frame keys
+(kind-1 batch items carrying a decode fingerprint) so one host's
+DecodedCache serves crop-ready RGB cluster-wide. Ownership resolution
+moves from the static ``owner_fn`` to ``strom/dist/directory.py``'s
+consistent-hash :class:`~strom.dist.directory.ExtentDirectory` when the
+launcher attaches one — breaker trips publish deaths, membership epochs
+re-own a dead host's keys fleet-wide.
 
 Framing is length-prefixed binary: every frame is ``u32 payload length``
 followed by the payload, so a truncated frame (mid-stream hangup, the
@@ -56,6 +69,8 @@ connection out and back in, the server lock guards only counters.
 from __future__ import annotations
 
 import contextlib
+import hashlib
+import hmac
 import itertools
 import os
 import socket
@@ -92,6 +107,30 @@ DIST_FIELDS = (
     "peer_serve_misses",
     "peer_breaker_trips",
     "peer_breaker_open",
+    # peer fabric v2 (ISSUE 20): batched transport, connection pool, auth
+    # and decoded-frame serving. Client half: batches/extent counts + the
+    # per-extent RTT gauge (the headline the batching exists to lower),
+    # conn pool open/reuse counters + ratio gauge, decoded-frame fetch
+    # tallies (kept SEPARATE from peer_hit_bytes — frame bytes are not
+    # extent bytes, and the hit==served symmetry tests pin the extent
+    # pair), and the consistent-hash directory's membership epoch.
+    "peer_batches",
+    "peer_batch_extents",
+    "peer_rtt_per_extent_us",
+    "peer_conn_opens",
+    "peer_conn_reuses",
+    "peer_conn_reuse_ratio",
+    "peer_frame_hits",
+    "peer_frame_misses",
+    "peer_frame_hit_bytes",
+    "peer_ring_epoch",
+    # server half of fabric v2: batch request serves, auth refusals and
+    # the decoded-frame exports (again separate from peer_served_bytes)
+    "peer_batch_serves",
+    "peer_auth_rejects",
+    "peer_frame_serves",
+    "peer_frame_served_bytes",
+    "peer_frame_serve_misses",
     # zero-copy exporter accounting (ISSUE 16, dist_send_zc): payload bytes
     # sent straight from pinned cache views (zc), via sendfile(2) from the
     # spill file (sendfile), or through the legacy assemble-then-send bounce
@@ -123,6 +162,14 @@ DIST_BENCH_FIELDS = (
     "dist_engine_ingest_bytes",
     "dist_assembly_wait_p99_us",
     "dist_peer_rtt_p99_us",
+    # peer fabric v2 A/B (ISSUE 20): the batched arm vs an unbatched rerun
+    # (dist_batch_max_extents=0 — PR 15's one-extent-per-RTT transport),
+    # plus the fabric gauges the compare_rounds FABRIC_KEYS section reads
+    "dist_batch_vs_single",
+    "dist_unbatched_items_per_s",
+    "peer_rtt_per_extent_us",
+    "peer_frame_hit_bytes",
+    "peer_conn_reuse_ratio",
 )
 
 # wire protocol ------------------------------------------------------------
@@ -137,8 +184,25 @@ OP_GET_TRACED = 2
 # per-peer ``comp_ok`` latch downgrades exactly like ``trace_ok``.
 OP_GET_COMP = 3
 OP_GET_TRACED_COMP = 4
+# fabric v2 ops (ISSUE 20): OP_GET_BATCH carries a whole gather's worth of
+# keys in one frame (``op u8 | count u16 | flags u8 | [trace ctx] |
+# [codec] | count × key``, each key ``kind u8 | path_len u16 | path |
+# lo u64 | hi u64 | [fp_len u16 | fingerprint]``) and the server streams
+# back count individual response frames in key order — one round trip per
+# batch instead of per extent. Key kinds: 0 = source extent (payload =
+# bytes, ST_HIT_COMP legal like the plain wire), 1 = decoded frame
+# (payload = ``h u32 | w u32 | rgb bytes`` out of the owner's
+# DecodedCache). OP_AUTH opens the optional shared-key handshake: client
+# sends the bare op, server answers a 16-byte nonce frame, client answers
+# HMAC-SHA256(key, nonce), server answers ST_AUTH_OK / ST_AUTH_REJECT.
+# Both ops are unknown to a v1 server, which drops the conn — the
+# client's per-peer ``batch_ok`` latch downgrades to single-extent ops
+# exactly like ``comp_ok``/``trace_ok`` (newest wire downgrades first).
+OP_GET_BATCH = 5
+OP_AUTH = 6
 ST_HIT, ST_MISS = 0, 1
 ST_HIT_COMP = 2
+ST_AUTH_OK, ST_AUTH_REJECT = 3, 4
 _LEN = struct.Struct("!I")
 _CODEC_LEN = struct.Struct("!H")
 _RAW_LEN = struct.Struct("!Q")
@@ -151,6 +215,14 @@ _REQ_RANGE = struct.Struct("!QQ")
 # misses, so every traced exchange carries the four NTP timestamps.
 _TRACE_CTX = struct.Struct("!QQdH")
 _TRACED_RESP = struct.Struct("!dd")
+# batch framing (ISSUE 20): header + per-key layout, see OP_GET_BATCH
+_BATCH_HEAD = struct.Struct("!BHB")
+_KEY_HEAD = struct.Struct("!BH")
+_FP_LEN = struct.Struct("!H")
+_DIMS = struct.Struct("!II")
+KIND_EXTENT, KIND_FRAME = 0, 1
+_BF_TRACED, _BF_COMP = 0x1, 0x2
+AUTH_NONCE_LEN = 16
 # sanity bound on any single frame: an extent-sized response, never a
 # whole-file stream (the consult asks per miss run, which is bounded by
 # the gather's chunking) — a corrupt length prefix fails fast instead of
@@ -312,6 +384,110 @@ def decode_request_ex(payload
     return path, lo, hi, trace, codec
 
 
+def encode_batch_request(keys: Sequence, *,
+                         trace: "tuple[int, int, float, str] | None" = None,
+                         codec: "str | None" = None) -> bytes:
+    """One OP_GET_BATCH frame for *keys*: each key is ``(path, lo, hi)``
+    (a source extent) or ``(path, lo, hi, fingerprint)`` (a decoded
+    frame). *trace*/*codec* raise the corresponding header flags — one
+    trace context and one codec ask cover the whole batch, the server
+    echoes/honours them per item."""
+    if not 0 < len(keys) <= 0xFFFF:
+        raise ValueError(f"bad batch size {len(keys)}")
+    flags = ((_BF_TRACED if trace is not None else 0)
+             | (_BF_COMP if codec is not None else 0))
+    parts = [_BATCH_HEAD.pack(OP_GET_BATCH, len(keys), flags)]
+    if trace is not None:
+        req_id, flow_id, send_us, parent = trace
+        pb = parent.encode("utf-8")[:0xFFFF]
+        parts.append(_TRACE_CTX.pack(int(req_id), int(flow_id),
+                                     float(send_us), len(pb)) + pb)
+    if codec is not None:
+        cb = codec.encode("utf-8")[:0xFFFF]
+        parts.append(_CODEC_LEN.pack(len(cb)) + cb)
+    for key in keys:
+        path, lo, hi = key[0], int(key[1]), int(key[2])
+        fp = key[3] if len(key) > 3 else None
+        p = path.encode("utf-8")
+        if len(p) > 0xFFFF:
+            raise ValueError(f"path too long for the wire ({len(p)} bytes)")
+        kind = KIND_EXTENT if fp is None else KIND_FRAME
+        parts.append(_KEY_HEAD.pack(kind, len(p)) + p
+                     + _REQ_RANGE.pack(lo, hi))
+        if fp is not None:
+            fb = str(fp).encode("utf-8")[:0xFFFF]
+            parts.append(_FP_LEN.pack(len(fb)) + fb)
+    return b"".join(parts)
+
+
+def decode_batch_request(payload) -> "tuple[list, dict | None, str | None]":
+    """The server's OP_GET_BATCH decoder → ``(keys, trace, codec)`` with
+    each key ``(kind, path, lo, hi, fp)`` (*fp* None for extents). Same
+    exact-length strictness as the single-op decoders."""
+    total = len(payload)
+    if total < _BATCH_HEAD.size:
+        raise PeerProtocolError(f"batch frame too short ({total})")
+    op, count, flags = _BATCH_HEAD.unpack_from(payload, 0)
+    if op != OP_GET_BATCH:
+        raise PeerProtocolError(f"not a batch op ({op})")
+    if count == 0:
+        raise PeerProtocolError("empty batch")
+    pos = _BATCH_HEAD.size
+    trace = None
+    if flags & _BF_TRACED:
+        if total < pos + _TRACE_CTX.size:
+            raise PeerProtocolError("batch frame too short for trace ctx")
+        req_id, flow_id, send_us, par_len = _TRACE_CTX.unpack_from(
+            payload, pos)
+        pos += _TRACE_CTX.size
+        if total < pos + par_len:
+            raise PeerProtocolError("batch frame length mismatch")
+        parent = bytes(payload[pos: pos + par_len]).decode("utf-8")
+        pos += par_len
+        trace = {"req": req_id, "flow": flow_id, "send_us": send_us,
+                 "parent": parent}
+    codec = None
+    if flags & _BF_COMP:
+        if total < pos + _CODEC_LEN.size:
+            raise PeerProtocolError("batch frame too short for codec")
+        (clen,) = _CODEC_LEN.unpack_from(payload, pos)
+        pos += _CODEC_LEN.size
+        if total < pos + clen:
+            raise PeerProtocolError("batch frame length mismatch")
+        codec = bytes(payload[pos: pos + clen]).decode("utf-8")
+        pos += clen
+    keys = []
+    for _ in range(count):
+        if total < pos + _KEY_HEAD.size:
+            raise PeerProtocolError("batch key truncated")
+        kind, plen = _KEY_HEAD.unpack_from(payload, pos)
+        if kind not in (KIND_EXTENT, KIND_FRAME):
+            raise PeerProtocolError(f"unknown batch key kind {kind}")
+        pos += _KEY_HEAD.size
+        if total < pos + plen + _REQ_RANGE.size:
+            raise PeerProtocolError("batch key truncated")
+        path = bytes(payload[pos: pos + plen]).decode("utf-8")
+        pos += plen
+        lo, hi = _REQ_RANGE.unpack_from(payload, pos)
+        pos += _REQ_RANGE.size
+        if hi < lo:
+            raise PeerProtocolError(f"bad range [{lo}, {hi})")
+        fp = None
+        if kind == KIND_FRAME:
+            if total < pos + _FP_LEN.size:
+                raise PeerProtocolError("batch key truncated")
+            (flen,) = _FP_LEN.unpack_from(payload, pos)
+            pos += _FP_LEN.size
+            if total < pos + flen:
+                raise PeerProtocolError("batch key truncated")
+            fp = bytes(payload[pos: pos + flen]).decode("utf-8")
+            pos += flen
+        keys.append((kind, path, lo, hi, fp))
+    if total != pos:
+        raise PeerProtocolError("batch frame length mismatch")
+    return keys, trace, codec
+
+
 # cross-host flow ids: a request's per-process int id collides across
 # hosts, so the arrow chain binds on a separate id seeded from urandom —
 # unique across the fleet w.h.p., monotonic within a process
@@ -362,6 +538,16 @@ class PeerServer:
         self.comp_bytes_in = 0
         self.comp_bytes_out = 0
         self.comp_fallbacks = 0
+        # fabric v2 (ISSUE 20): shared-key auth (dist_auth_key, off by
+        # default = the v1 open wire), batch serves and decoded-frame
+        # exports out of the context's DecodedCache
+        self._auth_key = str(getattr(getattr(ctx, "config", None),
+                                     "dist_auth_key", "") or "")
+        self.batch_serves = 0
+        self.auth_rejects = 0
+        self.frame_serves = 0
+        self.frame_served_bytes = 0
+        self.frame_serve_misses = 0
         self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         self._sock.bind((host, port))
@@ -406,12 +592,36 @@ class PeerServer:
                     zstate = _ZcState()
                 except OSError:
                     zstate = None
+            # auth gate (ISSUE 20): with a key configured the FIRST frame
+            # must be OP_AUTH and the handshake must verify — anything
+            # else is refused with one clean ST_AUTH_REJECT frame (counted
+            # peer_auth_rejects) and the conn dropped. A keyless server
+            # still answers an authing client's handshake (nonce → OK
+            # without verifying) so mixed configs degrade to open, not to
+            # a wedged fleet.
+            authed = not self._auth_key
             while not self._closed:
                 try:
-                    path, lo, hi, trace, req_codec = decode_request_ex(
-                        recv_frame(conn))
+                    frame = recv_frame(conn)
                 except (PeerProtocolError, OSError, ValueError):
                     return  # peer went away / spoke garbage: drop the conn
+                op = frame[0] if frame else -1
+                if op == OP_AUTH:
+                    if not self._auth_conn(conn, frame):
+                        return
+                    authed = True
+                    continue
+                if not authed:
+                    self._reject_auth(conn)
+                    return
+                if op == OP_GET_BATCH:
+                    if not self._serve_batch(conn, frame):
+                        return
+                    continue
+                try:
+                    path, lo, hi, trace, req_codec = decode_request_ex(frame)
+                except (PeerProtocolError, ValueError):
+                    return  # unknown op / malformed frame: drop the conn
                 recv_us = _ring.now_us() if trace is not None else 0.0
                 # bounded concurrency PER REQUEST, not per connection:
                 # every remote host keeps one pooled conn open for its
@@ -540,6 +750,129 @@ class PeerServer:
         self._scope.add("peer_comp_bytes_out", len(comp))
         self._scope.set_gauge("peer_comp_ratio", ratio)
         return comp
+
+    # -- fabric v2: auth handshake / batch serving (ISSUE 20) ----------------
+    def _auth_conn(self, conn: socket.socket, frame) -> bool:
+        """One OP_AUTH challenge/response exchange. Returns True when the
+        conn may proceed (HMAC verified, or no key configured here — a
+        keyless server humours an authing client)."""
+        if len(frame) != 1:
+            return False
+        nonce = os.urandom(AUTH_NONCE_LEN)
+        try:
+            send_frame(conn, nonce)
+            mac = bytes(recv_frame(conn, max_len=1024))
+        except (PeerProtocolError, OSError):
+            return False
+        if self._auth_key:
+            want = hmac.new(self._auth_key.encode("utf-8"), nonce,
+                            hashlib.sha256).digest()
+            if not hmac.compare_digest(mac, want):
+                self._reject_auth(conn)
+                return False
+        with contextlib.suppress(OSError):
+            send_frame(conn, bytes([ST_AUTH_OK]))
+        return True
+
+    def _reject_auth(self, conn: socket.socket) -> None:
+        with self._lock:
+            self.auth_rejects += 1
+        self._scope.add("peer_auth_rejects")
+        with contextlib.suppress(OSError):
+            send_frame(conn, bytes([ST_AUTH_REJECT]))
+
+    def _serve_batch(self, conn: socket.socket, frame) -> bool:
+        """One OP_GET_BATCH frame: serve every key in order, streaming one
+        response frame per key (the client drains them sequentially —
+        that stream IS the single round trip). Each item takes its own
+        bounded-concurrency slot and its own tally, so batched serving is
+        accounting-identical to N single serves plus one peer_batch_serves
+        tick. Returns False when the conn must close (send failure or a
+        malformed frame)."""
+        try:
+            keys, trace, req_codec = decode_batch_request(frame)
+        except (PeerProtocolError, ValueError):
+            return False
+        recv_us = _ring.now_us() if trace is not None else 0.0
+        with self._lock:
+            self.batch_serves += 1
+        self._scope.add("peer_batch_serves")
+        for kind, path, lo, hi, fp in keys:
+            if kind == KIND_FRAME:
+                with self._sem:
+                    # stromlint: ignore[lock-order] -- slot semaphore, see above
+                    res = self._serve_frame(path, lo, hi, fp)
+                s0 = _ring.now_us() if trace is not None else 0.0
+                tr = (_TRACED_RESP.pack(recv_us, s0)
+                      if trace is not None else b"")
+                try:
+                    if res is None:
+                        send_frame(conn, bytes([ST_MISS]) + tr)
+                    else:
+                        h, w, rgb = res
+                        send_frame(conn, (bytes([ST_HIT]), tr,
+                                          _DIMS.pack(h, w), rgb))
+                except OSError:
+                    return False
+                continue
+            q0 = _ring.now_us() if trace is not None else 0.0
+            with self._sem:
+                if trace is not None:
+                    # stromlint: ignore[lock-order] -- slot semaphore, see above
+                    self._span(trace, "peer.queue", q0, _ring.now_us() - q0)
+                # stromlint: ignore[lock-order] -- slot semaphore, see above
+                data = self._serve_range(path, lo, hi, trace=trace)
+            # same tally-before-send contract as the single-op path
+            self._tally(None if data is None else data.nbytes,
+                        copied=data is not None, traced=trace is not None)
+            comp = None
+            if data is not None and req_codec is not None and self._comp:
+                comp = self._try_compress(data, req_codec)
+            s0 = _ring.now_us() if trace is not None else 0.0
+            tr = (_TRACED_RESP.pack(recv_us, s0)
+                  if trace is not None else b"")
+            try:
+                if data is None:
+                    send_frame(conn, bytes([ST_MISS]) + tr)
+                elif comp is not None:
+                    send_frame(conn, (bytes([ST_HIT_COMP]), tr,
+                                      _RAW_LEN.pack(data.nbytes), comp))
+                else:
+                    send_frame(conn, (bytes([ST_HIT]), tr, data.data))
+            except OSError:
+                return False
+            if trace is not None:
+                self._span(trace, "peer.send", s0, _ring.now_us() - s0)
+        return True
+
+    def _serve_frame(self, path: str, lo: int, hi: int, fp: "str | None"
+                     ) -> "tuple[int, int, bytes] | None":
+        """One decoded-frame export (kind-1 batch key) out of this
+        context's DecodedCache: ``(h, w, rgb bytes)`` when the frame is
+        resident under a matching decode fingerprint, else None — never a
+        local decode (the whole point is the owner already paid it)."""
+        dc = getattr(self._ctx, "decoded_cache", None)
+        res = None
+        if dc is not None and not self._closed:
+            try:
+                res = dc.export(path, lo, hi, fingerprint=fp)
+            # stromlint: ignore[swallowed-exceptions] -- advisory service:
+            # any local failure answers miss (peer_frame_serve_misses) and
+            # the asker decodes locally
+            except Exception:
+                res = None
+        with self._lock:
+            if res is None:
+                self.frame_serve_misses += 1
+            else:
+                self.frame_serves += 1
+                self.frame_served_bytes += len(res[2])
+        if res is None:
+            self._scope.add("peer_frame_serve_misses")
+        else:
+            self._scope.add("peer_frame_serves")
+            self._scope.add("peer_frame_served_bytes", len(res[2]))
+        return res
 
     def _serve_range(self, path: str, lo: int, hi: int, *,
                      trace: "dict | None" = None) -> "np.ndarray | None":
@@ -800,6 +1133,11 @@ class PeerServer:
                     "peer_serves": self.serves,
                     "peer_serves_traced": self.serves_traced,
                     "peer_serve_misses": self.serve_misses,
+                    "peer_batch_serves": self.batch_serves,
+                    "peer_auth_rejects": self.auth_rejects,
+                    "peer_frame_serves": self.frame_serves,
+                    "peer_frame_served_bytes": self.frame_served_bytes,
+                    "peer_frame_serve_misses": self.frame_serve_misses,
                     "peer_zc_bytes": self.zc_bytes,
                     "peer_sendfile_bytes": self.sendfile_bytes,
                     "peer_copy_bytes": self.copy_bytes,
@@ -814,24 +1152,38 @@ class PeerServer:
         if self._closed:
             return
         self._closed = True
+        # shutdown() before close(): close() alone does NOT wake a thread
+        # blocked in accept(), which would keep the kernel listener alive
+        # (the port stays bound, a same-addr restart gets EADDRINUSE) and
+        # stall this join until its timeout
+        with contextlib.suppress(OSError):
+            self._sock.shutdown(socket.SHUT_RDWR)
         with contextlib.suppress(OSError):
             self._sock.close()
         self._accept.join(timeout=5)
 
 
 class _PeerState:
-    """Client-side per-peer state: one pooled connection (checked out
-    under the tier lock, used outside it), a circuit breaker, the traced-
-    protocol verdict and the running clock-offset estimate."""
+    """Client-side per-peer state: a pool of persistent connections
+    (checked out under the tier lock, used outside it), a circuit
+    breaker, the per-wire-generation verdicts and the running
+    clock-offset estimate."""
 
-    __slots__ = ("addr", "sock", "busy", "breaker", "trace_ok", "comp_ok",
-                 "offset_us", "rtt_scope")
+    __slots__ = ("addr", "pool", "live", "breaker", "trace_ok", "comp_ok",
+                 "batch_ok", "offset_us", "rtt_scope")
 
     def __init__(self, addr: str, breaker: CircuitBreaker, rtt_scope):
         self.addr = addr
-        self.sock: "socket.socket | None" = None
-        self.busy = False
+        # idle pooled connections + the count of pooled conns in existence
+        # (idle or checked out); a failed conn is discarded and live
+        # decremented, so a restarted peer gets fresh re-probed conns
+        self.pool: "list[socket.socket]" = []
+        self.live = 0
         self.breaker = breaker
+        # None = untried, True = peer answered a batch op, False = peer
+        # dropped one (v1 wire) — downgraded to single-extent ops forever
+        # (the newest-wire-downgrades-first ladder: batch → comp → traced)
+        self.batch_ok: "bool | None" = None
         # None = untried, True = peer answered a traced request, False =
         # peer dropped one (old wire) — downgraded to plain OP_GET forever
         self.trace_ok: "bool | None" = None
@@ -870,18 +1222,27 @@ class PeerTier:
 
     def __init__(self, peers: "Mapping[object, str] | Sequence[str]", *,
                  owner_fn: "Callable[[str], object] | None" = None,
-                 scope=None, timeout_s: float = 0.5, plan=None,
-                 clock: Callable[[], float] = time.monotonic,
+                 directory=None, scope=None, timeout_s: float = 0.5,
+                 plan=None, clock: Callable[[], float] = time.monotonic,
                  breaker_kwargs: "dict | None" = None,
-                 compress: bool = False):
+                 compress: bool = False, batch_max_extents: int = 64,
+                 conn_pool_size: int = 2, auth_key: str = ""):
         from strom.utils.stats import global_stats
 
         if not isinstance(peers, Mapping):
             peers = {a: a for a in peers}
         self._scope = scope if scope is not None else global_stats
         self._owner_fn = owner_fn
+        # fabric v2 (ISSUE 20): a live ExtentDirectory outranks the static
+        # owner_fn — ownership then tracks membership epochs, and a peer
+        # whose breaker trips is published dead so the whole fleet re-owns
+        # its keys within one directory poll
+        self._directory = directory
         self._timeout = float(timeout_s)
         self._plan = plan
+        self._batch_max = max(int(batch_max_extents), 0)
+        self._pool_size = max(int(conn_pool_size), 1)
+        self._auth_key = str(auth_key or "")
         # fetch-side compression ask (ISSUE 19): advertise our codec on
         # the wire; the server still decides per response (raw when it
         # doesn't pay). Off = the pre-PR wire, byte for byte.
@@ -895,7 +1256,8 @@ class PeerTier:
         self._peers: dict = {}
         for name, addr in peers.items():
             br = CircuitBreaker(name=f"peer:{addr}", clock=clock,
-                                on_trip=self._on_trip, **bk)
+                                on_trip=(lambda note, _n=name:
+                                         self._on_trip(_n, note)), **bk)
             self._peers[name] = _PeerState(
                 str(addr), br, self._scope.scoped(peer=str(addr)))
         # tallies (authoritative for stats(); mirrored into the scope)
@@ -905,23 +1267,67 @@ class PeerTier:
         self.errors = 0
         self.skips = 0
         self.fetch_traced = 0
+        # fabric v2 tallies: batch/RTT accounting, conn pool churn and
+        # decoded-frame fetches
+        self.batches = 0
+        self.batch_extents = 0
+        self.rtt_us_accum = 0.0
+        self.rtt_extents = 0
+        self.conn_opens = 0
+        self.conn_reuses = 0
+        self.frame_hits = 0
+        self.frame_misses = 0
+        self.frame_hit_bytes = 0
 
-    def _on_trip(self, note: str) -> None:
+    def _on_trip(self, name, note: str) -> None:
         with self._lock:
             self.breaker_trips += 1
         self._scope.add("peer_breaker_trips")
+        # a tripped peer is presumed dead: publish it to the directory so
+        # every host re-owns its keys at the next membership poll (the
+        # window in between shows up as peer_skips — the breaker keeps
+        # those probes cheap, the engine fallback keeps them correct)
+        if self._directory is not None:
+            self._directory.mark_dead(name)
+
+    # -- live-tunable knobs (ISSUE 20 satellite: Autotuner integration) ------
+    @property
+    def batch_max_extents(self) -> int:
+        return self._batch_max
+
+    @batch_max_extents.setter
+    def batch_max_extents(self, v: int) -> None:
+        self._batch_max = max(int(v), 0)
+
+    @property
+    def conn_pool_size(self) -> int:
+        return self._pool_size
+
+    @conn_pool_size.setter
+    def conn_pool_size(self, v: int) -> None:
+        # growing takes effect at the next checkout; shrinking only stops
+        # NEW pooled conns — existing ones drain through reuse untouched
+        self._pool_size = max(int(v), 1)
 
     # -- the consult's probe -------------------------------------------------
-    def fetch(self, path: str, lo: int, hi: int) -> "np.ndarray | None":
-        """Bytes [lo, hi) of *path* from the owning peer, or None (miss /
-        error / breaker open / no owner). The returned array is read-only;
-        callers copy it into their dest."""
+    def _owner(self, path: str):
+        """The owning peer's name for *path*: the live directory when one
+        is attached (membership-epoch aware), else the static owner_fn."""
+        if self._directory is not None:
+            return self._directory.owner(path)
+        return self._owner_fn(path) if self._owner_fn is not None else None
+
+    def _precheck(self, path: str, lo: int, hi: int) -> "_PeerState | None":
+        """Owner lookup + breaker gate + fault injection for one range —
+        the shared front half of fetch()/fetch_many(). Returns the peer
+        to ask, or None (no owner / breaker open / injected fault, each
+        already counted)."""
         n = hi - lo
         # +1: a hit response is status byte + payload in ONE frame, so the
         # largest servable range is one byte under the frame cap
         if n <= 0 or n + 1 > MAX_FRAME or self._closed:
             return None
-        name = self._owner_fn(path) if self._owner_fn is not None else None
+        name = self._owner(path)
         st = self._peers.get(name) if name is not None else None
         if st is None:
             return None
@@ -944,23 +1350,122 @@ class PeerTier:
             time.sleep(fault.latency_s)
             fault = None
         if fault is not None:
-            # ephemeral: the injected failure happens BEFORE any checkout,
-            # so it must not reset a pooled slot another in-flight request
-            # owns (or discard a healthy idle connection)
-            self._fail(st, None, ephemeral=True)
+            # the injected failure happens BEFORE any checkout, so no
+            # pooled slot is held — fail with no conn to discard
+            self._fail(st, None)
             return None
-        ephemeral = False
+        return st
+
+    def fetch(self, path: str, lo: int, hi: int) -> "np.ndarray | None":
+        """Bytes [lo, hi) of *path* from the owning peer, or None (miss /
+        error / breaker open / no owner). The returned array is read-only;
+        callers copy it into their dest."""
+        st = self._precheck(path, lo, hi)
+        if st is None:
+            return None
+        return self._fetch_one(st, path, lo, hi)
+
+    def fetch_many(self, ranges: "Sequence[tuple[str, int, int]]"
+                   ) -> "list[np.ndarray | None]":
+        """Batched probe for a gather's worth of ranges: resolve owners,
+        group per peer, and ride each group over the batch wire in
+        pipelined chunks of ``dist_batch_max_extents`` — ONE round trip
+        per chunk instead of per extent. Results align with *ranges*
+        (None = miss / error / no owner, exactly fetch()'s contract).
+        Groups of one range — and every range when batching is off or the
+        peer is a v1 server — take the single-extent path, so the
+        zc/traced/comp wire and the fault-plan op order are unchanged
+        wherever batching cannot help."""
+        out: "list[np.ndarray | None]" = [None] * len(ranges)
+        groups: dict = {}
+        for i, (path, lo, hi) in enumerate(ranges):
+            st = self._precheck(path, lo, hi)
+            if st is not None:
+                groups.setdefault(id(st), (st, []))[1].append(
+                    (i, path, lo, hi))
+        for st, items in groups.values():
+            if (len(items) == 1 or self._batch_max <= 0
+                    or st.batch_ok is False):
+                for i, path, lo, hi in items:
+                    out[i] = self._fetch_one(st, path, lo, hi)
+                continue
+            served = self._batch_group(st, items)
+            for i, path, lo, hi in items:
+                if i in served:
+                    out[i] = served[i]
+                else:
+                    # the batch died before this item's response came
+                    # back: the single-extent fallback keeps the gather
+                    # correct (batch_ok latched, so no retry loop)
+                    out[i] = self._fetch_one(st, path, lo, hi)
+        return out
+
+    # -- connection pool (ISSUE 20) ------------------------------------------
+    def _checkout(self, st: _PeerState
+                  ) -> "tuple[socket.socket | None, bool]":
+        """A connection to *st*, preferring the pool: ``(sock, pooled)``.
+        *sock* None = the caller opens one; pooled = it owns a pool slot
+        and is checked back in after use, else overflow beyond
+        ``dist_conn_pool_size`` rides an ephemeral conn (closed after
+        use) so concurrent gathers never queue on a socket."""
         with self._lock:
-            if st.busy:
-                # the pooled conn is mid-request (concurrent per-device
-                # gathers): ride a fresh ephemeral connection instead of
-                # queueing on the socket — the server's bounded accept
-                # backpressures if this host asks too wide
-                ephemeral = True
-                sock = None
+            if st.pool:
+                sock = st.pool.pop()
+                self.conn_reuses += 1
             else:
-                st.busy = True
-                sock, st.sock = st.sock, None
+                sock = None
+                if st.live >= self._pool_size:
+                    return None, False
+                st.live += 1
+        if sock is not None:
+            self._scope.add("peer_conn_reuses")
+        return sock, True
+
+    def _open_conn(self, st: _PeerState) -> socket.socket:
+        """Fresh connection to *st* — TCP_NODELAY, counted peer_conn_opens,
+        and the shared-key handshake when ``dist_auth_key`` is set (a
+        refusal raises and is counted like any other peer error)."""
+        host, port = split_addr(st.addr)
+        sock = socket.create_connection((host, port),
+                                        timeout=self._timeout)
+        try:
+            sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            with self._lock:
+                self.conn_opens += 1
+            self._scope.add("peer_conn_opens")
+            if self._auth_key:
+                sock.settimeout(self._timeout)
+                send_frame(sock, bytes([OP_AUTH]))
+                nonce = recv_frame(sock, max_len=1024)
+                mac = hmac.new(self._auth_key.encode("utf-8"),
+                               bytes(nonce), hashlib.sha256).digest()
+                send_frame(sock, mac)
+                verdict = recv_frame(sock, max_len=16)
+                if not verdict or verdict[0] != ST_AUTH_OK:
+                    raise PeerProtocolError("peer refused auth")
+        except BaseException:
+            with contextlib.suppress(OSError):
+                sock.close()
+            raise
+        return sock
+
+    def _checkin(self, st: _PeerState, sock: socket.socket,
+                 pooled: bool) -> None:
+        with self._lock:
+            if pooled and not self._closed:
+                st.pool.append(sock)
+                return
+            if pooled:
+                st.live -= 1
+        with contextlib.suppress(OSError):
+            sock.close()
+
+    def _fetch_one(self, st: _PeerState, path: str, lo: int, hi: int
+                   ) -> "np.ndarray | None":
+        """One single-extent exchange on a pooled (or overflow ephemeral)
+        connection — the transport half of :meth:`fetch`."""
+        n = hi - lo
+        sock, pooled = self._checkout(st)
         # trace propagation (ISSUE 18): carry the live request's identity
         # plus a fleet-unique flow id over the wire unless this peer has
         # already proven it speaks the old protocol
@@ -976,10 +1481,7 @@ class PeerTier:
         t_send = 0.0
         try:
             if sock is None:
-                host, port = split_addr(st.addr)
-                sock = socket.create_connection((host, port),
-                                                timeout=self._timeout)
-                sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+                sock = self._open_conn(st)
             sock.settimeout(self._timeout)
             if traced:
                 t_send = _ring.now_us()
@@ -1007,7 +1509,7 @@ class PeerTier:
                 # the unknown op and downgrade — one counted error, every
                 # later fetch goes plain OP_GET
                 st.trace_ok = False
-            self._fail(st, sock, ephemeral=ephemeral)
+            self._fail(st, sock, pooled=pooled)
             return None
         t_recv = _ring.now_us()
         rtt_us = (time.perf_counter() - t0) * 1e6
@@ -1028,10 +1530,10 @@ class PeerTier:
             except Exception:
                 # undecodable payload = corrupt frame: fail the peer
                 # exactly like a wrong-length hit
-                self._fail(st, sock, ephemeral=ephemeral)
+                self._fail(st, sock, pooled=pooled)
                 return None
             if raw_n != n or len(raw) != n:
-                self._fail(st, sock, ephemeral=ephemeral)
+                self._fail(st, sock, pooled=pooled)
                 return None
             data = np.frombuffer(raw, np.uint8, count=n)
         elif status == ST_MISS and len(payload) == hdr:
@@ -1039,14 +1541,10 @@ class PeerTier:
         else:
             # wrong-length hit = a truncated/corrupt frame that happened
             # to parse: never trust it
-            self._fail(st, sock, ephemeral=ephemeral)
+            self._fail(st, sock, pooled=pooled)
             return None
-        if ephemeral:
-            with contextlib.suppress(OSError):
-                sock.close()
+        self._checkin(st, sock, pooled)
         with self._lock:
-            if not ephemeral:
-                st.sock, st.busy = sock, False
             if data is None:
                 self.misses += 1
             else:
@@ -1054,6 +1552,8 @@ class PeerTier:
                 self.hit_bytes += n
             if traced:
                 self.fetch_traced += 1
+            self.rtt_us_accum += rtt_us
+            self.rtt_extents += 1
         st.breaker.record_success()
         if use_comp:
             st.comp_ok = True
@@ -1070,6 +1570,215 @@ class PeerTier:
         if traced:
             self._scope.add("peer_fetch_traced")
         return data
+
+    # -- batched transport (ISSUE 20) ----------------------------------------
+    def _batch_group(self, st: _PeerState, items: list) -> dict:
+        """One owner's items over the batch wire, chunked to
+        ``dist_batch_max_extents`` and PIPELINED: chunk k+1's request
+        frame is on the wire before chunk k's responses drain, so the
+        server never idles between chunks and the stream surfaces as
+        instant completions. Returns ``{index: data}`` for every item
+        whose response arrived (data None = a served miss); an absent
+        index means the transport died first — the caller falls back per
+        extent. A first-batch failure latches ``batch_ok`` (trace/comp
+        verdicts untouched: the v1 single-extent wire may still be
+        fine)."""
+        served: dict = {}
+        sock, pooled = self._checkout(st)
+        traced = st.trace_ok is not False
+        use_comp = self._codec is not None and st.comp_ok is not False
+        wire_codec = self._codec.name if use_comp else None
+        chunks = [items[k: k + self._batch_max]
+                  for k in range(0, len(items), self._batch_max)]
+        sent: list = []
+
+        def _send(chunk):
+            req = _request.current() if traced else None
+            flow_id = next(_flow_ids) if traced else 0
+            t0 = time.perf_counter()
+            t_send = 0.0
+            tr = None
+            if traced:
+                t_send = _ring.now_us()
+                tr = (req.id if req is not None else 0, flow_id, t_send,
+                      req.kind if req is not None else "")
+            send_frame(sock, encode_batch_request(
+                [(path, lo, hi) for _, path, lo, hi in chunk],
+                trace=tr, codec=wire_codec))
+            if traced:
+                _ring.flow("s", flow_id, "peer.req", "reqx")
+            sent.append((chunk, t0, t_send, flow_id, req))
+
+        hdr = 1 + (_TRACED_RESP.size if traced else 0)
+        try:
+            if sock is None:
+                sock = self._open_conn(st)
+            sock.settimeout(self._timeout)
+            _send(chunks[0])
+            for k in range(len(chunks)):
+                if k + 1 < len(chunks):
+                    _send(chunks[k + 1])
+                chunk, t0, t_send, flow_id, req = sent[k]
+                chunk_bytes = 0
+                first_tr = None
+                for i, path, lo, hi in chunk:
+                    n = hi - lo
+                    payload = recv_frame(sock)
+                    status = payload[0] if payload else -1
+                    if status == ST_HIT and len(payload) == hdr + n:
+                        data = np.frombuffer(payload, np.uint8, count=n,
+                                             offset=hdr)
+                    elif (status == ST_HIT_COMP and use_comp
+                          and len(payload) > hdr + _RAW_LEN.size):
+                        (raw_n,) = _RAW_LEN.unpack_from(payload, hdr)
+                        try:
+                            raw = self._codec.decompress(
+                                bytes(payload[hdr + _RAW_LEN.size:]))
+                        except Exception as e:
+                            raise PeerProtocolError(
+                                f"undecodable batch item: {e}") from e
+                        if raw_n != n or len(raw) != n:
+                            raise PeerProtocolError(
+                                "batch item length mismatch")
+                        data = np.frombuffer(raw, np.uint8, count=n)
+                    elif status == ST_MISS and len(payload) == hdr:
+                        data = None
+                    else:
+                        raise PeerProtocolError("bad batch item frame")
+                    if traced and first_tr is None:
+                        first_tr = _TRACED_RESP.unpack_from(payload, 1)
+                    served[i] = data
+                    if data is not None:
+                        chunk_bytes += n
+                self._account_chunk(st, chunk, served, chunk_bytes,
+                                    (time.perf_counter() - t0) * 1e6,
+                                    traced, first_tr, t_send,
+                                    _ring.now_us(), flow_id, req)
+        except (OSError, PeerProtocolError, ValueError):
+            if st.batch_ok is None:
+                st.batch_ok = False
+            self._fail(st, sock, pooled=pooled)
+            return served
+        st.batch_ok = True
+        if traced:
+            st.trace_ok = True
+        if use_comp:
+            st.comp_ok = True
+        self._checkin(st, sock, pooled)
+        st.breaker.record_success()
+        return served
+
+    def _account_chunk(self, st: _PeerState, chunk, served: dict,
+                       chunk_bytes: int, rtt_us: float, traced: bool,
+                       first_tr, t_send: float, t_recv: float,
+                       flow_id: int, req) -> None:
+        """Tallies + trace epilogue for one drained batch chunk: the
+        accounting is item-for-item identical to N single fetches, plus
+        one peer_batches tick and ONE rtt observation (the chunk IS one
+        round trip — which is the whole claim peer_rtt_per_extent_us
+        quantifies)."""
+        nhit = sum(1 for i, _, _, _ in chunk if served[i] is not None)
+        nmiss = len(chunk) - nhit
+        with self._lock:
+            self.hits += nhit
+            self.misses += nmiss
+            self.hit_bytes += chunk_bytes
+            self.batches += 1
+            self.batch_extents += len(chunk)
+            self.rtt_us_accum += rtt_us
+            self.rtt_extents += len(chunk)
+            if traced:
+                self.fetch_traced += len(chunk)
+        st.rtt_scope.observe_us("peer_rtt", rtt_us)
+        if nhit:
+            self._scope.add("peer_hits", nhit)
+            self._scope.add("peer_hit_bytes", chunk_bytes)
+        if nmiss:
+            self._scope.add("peer_misses", nmiss)
+        self._scope.add("peer_batches")
+        self._scope.add("peer_batch_extents", len(chunk))
+        if not traced:
+            return
+        self._scope.add("peer_fetch_traced", len(chunk))
+        if first_tr is None:
+            return
+        t2, t3 = first_tr
+        off = ((t2 - t_send) + (t3 - t_recv)) / 2.0
+        st.offset_us = off if st.offset_us is None \
+            else 0.7 * st.offset_us + 0.3 * off
+        _ring.flow("f", flow_id, "peer.req", "reqx")
+        args = {"peer": st.addr, "extents": len(chunk),
+                "bytes": chunk_bytes, "flow": flow_id}
+        if req is not None:
+            req.record("peer.fetch", "dist", t_send, t_recv - t_send,
+                       args, parent=req.parent_of())
+        else:
+            _ring.complete(t_send, t_recv - t_send, "dist", "peer.fetch",
+                           args)
+
+    # -- decoded-frame fetch (ISSUE 20) --------------------------------------
+    def fetch_frame(self, path: str, lo: int, hi: int,
+                    fingerprint: "str | None" = None
+                    ) -> "np.ndarray | None":
+        """One decoded frame (``(h, w, 3)`` uint8 RGB) out of the owning
+        peer's DecodedCache, or None. Rides a one-key kind-1 batch frame
+        on the pooled conn — a v1 peer (batch_ok False) is never asked,
+        and frame bytes are tallied apart from extent bytes."""
+        if self._closed or self._batch_max <= 0:
+            return None
+        name = self._owner(path)
+        st = self._peers.get(name) if name is not None else None
+        if st is None or st.batch_ok is False:
+            return None
+        if not st.breaker.allow():
+            with self._lock:
+                self.skips += 1
+            self._scope.add("peer_skips")
+            return None
+        sock, pooled = self._checkout(st)
+        t0 = time.perf_counter()
+        try:
+            if sock is None:
+                sock = self._open_conn(st)
+            sock.settimeout(self._timeout)
+            send_frame(sock, encode_batch_request(
+                [(path, lo, hi, fingerprint or "")]))
+            payload = recv_frame(sock)
+        except (OSError, PeerProtocolError, ValueError):
+            if st.batch_ok is None:
+                st.batch_ok = False
+            self._fail(st, sock, pooled=pooled)
+            return None
+        rtt_us = (time.perf_counter() - t0) * 1e6
+        status = payload[0] if payload else -1
+        img = None
+        if status == ST_HIT and len(payload) >= 1 + _DIMS.size:
+            h, w = _DIMS.unpack_from(payload, 1)
+            nb = len(payload) - 1 - _DIMS.size
+            if nb != h * w * 3:
+                self._fail(st, sock, pooled=pooled)
+                return None
+            img = np.frombuffer(payload, np.uint8, count=nb,
+                                offset=1 + _DIMS.size).reshape(h, w, 3)
+        elif not (status == ST_MISS and len(payload) == 1):
+            self._fail(st, sock, pooled=pooled)
+            return None
+        st.batch_ok = True
+        self._checkin(st, sock, pooled)
+        st.breaker.record_success()
+        st.rtt_scope.observe_us("peer_rtt", rtt_us)
+        with self._lock:
+            if img is None:
+                self.frame_misses += 1
+            else:
+                self.frame_hits += 1
+                self.frame_hit_bytes += img.nbytes
+        if img is None:
+            self._scope.add("peer_frame_misses")
+        else:
+            self._scope.add("peer_frame_hits")
+            self._scope.add("peer_frame_hit_bytes", img.nbytes)
+        return img
 
     def _finish_traced(self, st: _PeerState, payload, flow_id: int,
                        t_send: float, t_recv: float, rtt_us: float,
@@ -1097,16 +1806,17 @@ class PeerTier:
                            args)
 
     def _fail(self, st: _PeerState, sock: "socket.socket | None", *,
-              ephemeral: bool = False) -> None:
+              pooled: bool = False) -> None:
         if sock is not None:
             with contextlib.suppress(OSError):
                 sock.close()
         with self._lock:
-            if not ephemeral:
-                # the pooled slot is ours to reset; an ephemeral failure
-                # must not clear another in-flight request's busy mark
-                st.busy = False
-                st.sock = None
+            if pooled:
+                # a failed pooled conn is DISCARDED, never re-pooled: the
+                # freed slot makes the next fetch open (and re-auth) a
+                # fresh connection, so a restarted peer is re-probed
+                # immediately and stale sockets never linger
+                st.live -= 1
             self.errors += 1
         st.breaker.record_failure()
         self._scope.add("peer_errors")
@@ -1117,6 +1827,8 @@ class PeerTier:
         for name, st in self._peers.items():
             out[str(name)] = {"addr": st.addr, "trace_ok": st.trace_ok,
                               "comp_ok": st.comp_ok,
+                              "batch_ok": st.batch_ok,
+                              "pooled_conns": st.live,
                               "clock_offset_us":
                                   None if st.offset_us is None
                                   else round(st.offset_us, 1),
@@ -1138,6 +1850,7 @@ class PeerTier:
         open_peers = sum(1 for st in self._peers.values()
                          if st.breaker.state == CircuitBreaker.OPEN)
         with self._lock:
+            reuse_denom = self.conn_opens + self.conn_reuses
             out = {
                 "peer_hit_bytes": self.hit_bytes,
                 "peer_hits": self.hits,
@@ -1146,11 +1859,31 @@ class PeerTier:
                 "peer_skips": self.skips,
                 "peer_fetch_traced": self.fetch_traced,
                 "peer_breaker_trips": self.breaker_trips,
+                "peer_batches": self.batches,
+                "peer_batch_extents": self.batch_extents,
+                "peer_rtt_per_extent_us":
+                    round(self.rtt_us_accum / self.rtt_extents, 1)
+                    if self.rtt_extents else 0.0,
+                "peer_conn_opens": self.conn_opens,
+                "peer_conn_reuses": self.conn_reuses,
+                "peer_conn_reuse_ratio":
+                    round(self.conn_reuses / reuse_denom, 4)
+                    if reuse_denom else 0.0,
+                "peer_frame_hits": self.frame_hits,
+                "peer_frame_misses": self.frame_misses,
+                "peer_frame_hit_bytes": self.frame_hit_bytes,
             }
         out["peer_breaker_open"] = open_peers
         out["peer_rtt_p50_us"] = h.percentile(0.50)
         out["peer_rtt_p99_us"] = h.percentile(0.99)
+        out["peer_ring_epoch"] = (self._directory.epoch
+                                  if self._directory is not None else 0)
         self._scope.set_gauge("peer_breaker_open", open_peers)
+        self._scope.set_gauge("peer_rtt_per_extent_us",
+                              out["peer_rtt_per_extent_us"])
+        self._scope.set_gauge("peer_conn_reuse_ratio",
+                              out["peer_conn_reuse_ratio"])
+        self._scope.set_gauge("peer_ring_epoch", out["peer_ring_epoch"])
         return out
 
     def close(self) -> None:
@@ -1158,10 +1891,10 @@ class PeerTier:
             if self._closed:
                 return
             self._closed = True
-            socks = [st.sock for st in self._peers.values()
-                     if st.sock is not None]
+            socks = []
             for st in self._peers.values():
-                st.sock = None
+                socks.extend(st.pool)
+                st.pool.clear()
         for s in socks:
             with contextlib.suppress(OSError):
                 s.close()

@@ -169,6 +169,41 @@ class DecodedCache:
                             skipped_bytes)
         return ServedFrame(buf.reshape(h, w, 3), entry, self)
 
+    def export(self, path: str, lo: int, hi: int,
+               fingerprint: "str | None" = None
+               ) -> "tuple[int, int, bytes] | None":
+        """Peer-serving lookup (ISSUE 20): ``(h, w, rgb bytes)`` for the
+        member at [*lo*, *hi*) of *path* when the full decoded frame is
+        resident, else None. Unlike :meth:`probe` the pixels are COPIED
+        out (the peer server writes them to a socket after the call
+        returns, far outside any pin window) and the requester's decode
+        *fingerprint* must match ours — pixels decoded under different
+        semantics never cross the wire either."""
+        if not self.enabled:
+            return None
+        if fingerprint and fingerprint != self._fp:
+            return None
+        ckey = ("jpegdec", path, lo, hi, self._fp)
+        with self._lock:
+            dims = self._dims.get(ckey)
+        if dims is None:
+            return None
+        h, w = dims
+        got = self._hot_cache.view(ckey, 0, h * w * 3, record=False)
+        if got is None:
+            return None
+        buf, entry = got
+        try:
+            out = bytes(buf)
+        finally:
+            self._hot_cache.unpin((entry,))
+        with self._lock:
+            self.hits += 1
+            self.hit_bytes += h * w * 3
+        self._scope.add("decode_cache_hits")
+        self._scope.add("decode_cache_hit_bytes", h * w * 3)
+        return h, w, out
+
     def release(self, pin) -> None:
         self._hot_cache.unpin((pin,))
 

@@ -535,6 +535,10 @@ def make_wds_vision_pipeline(ctx: StromContext, paths: Sequence[str], *,
         eng = "turbo" if (native and _jpeg.native_available()) else "cv2"
         dcache = DecodedCache(ctx.hot_cache, tenant=tname,
                               fingerprint=f"rgb8/{eng}", scope=pscope)
+        # peer fabric v2 (ISSUE 20): register the cache so this host's
+        # peer server exports decoded frames cluster-wide, and the probe
+        # below can pull frames a PEER already decoded
+        ctx.attach_decoded_cache(dcache)
     tf = transform or make_train_transform(image_size, reduced_scale=reduced,
                                            native=native, roi=use_roi,
                                            dcache=dcache)
@@ -617,6 +621,20 @@ def make_wds_vision_pipeline(ctx: StromContext, paths: Sequence[str], *,
                 # the pixels make redundant.
                 served = [dcache.probe(ck, s.members[image_ext].size)
                           for ck, s in zip(ckeys, samples)]
+                # decoded-frame peer serving (ISSUE 20): a local miss may
+                # be hot on the owning peer's DecodedCache — pull the
+                # crop-ready RGB over the batch wire, offer it locally,
+                # and re-probe (a refused admission just falls back to
+                # the gather; never wrong pixels)
+                for j, sv in enumerate(served):
+                    if sv is not None:
+                        continue
+                    img = ctx.peer_decoded_fetch(ckeys[j])
+                    if img is None:
+                        continue
+                    dcache.offer(ckeys[j], img)
+                    served[j] = dcache.probe(
+                        ckeys[j], samples[j].members[image_ext].size)
                 if not any(sv is not None for sv in served):
                     served = None
         if served is not None:

@@ -120,6 +120,31 @@ def standard_knobs(ctx) -> list[Knob]:
             # spot: enough room to trade tail granularity vs dispatch
             # overhead, never so low that fusing degenerates to per-sample
             lo=500.0, hi=20000.0, step=1000.0, min_step=100.0))
+    tier = tunables.get("peer_tier")
+    if tier is not None and hasattr(tier, "batch_max_extents"):
+        def _set_batch(v: float, _t=tier) -> None:
+            _t.batch_max_extents = int(v)
+
+        knobs.append(Knob(
+            name="dist_batch_max_extents",
+            get=lambda _t=tier: float(_t.batch_max_extents),
+            set=_set_batch,
+            # 1 keeps the batched wire on (0 = unbatched is the A/B arm's
+            # call, not the tuner's); 512 bounds the per-chunk frame the
+            # server must buffer before its first response byte
+            lo=1.0, hi=512.0, step=16.0, quantize=_quant_int,
+            min_step=1.0))
+
+        def _set_pool(v: float, _t=tier) -> None:
+            _t.conn_pool_size = int(v)
+
+        knobs.append(Knob(
+            name="dist_conn_pool_size",
+            get=lambda _t=tier: float(_t.conn_pool_size),
+            set=_set_pool,
+            # at least one pooled conn per peer; 16 bounds idle-socket FD
+            # cost across a wide fleet
+            lo=1.0, hi=16.0, step=1.0, quantize=_quant_int, min_step=1.0))
     ra = tunables.get("readahead")
     if ra is not None and getattr(ra, "window_batches", 0) > 0:
         base = float(ra.window_batches)

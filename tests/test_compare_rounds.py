@@ -597,3 +597,44 @@ def test_pushdown_section_hidden_without_keys(tmp_path, capsys):
     p.write_text(json.dumps(OLD_ROUND))
     assert compare_rounds.main([str(p)]) == 0
     assert "near-data pushdown" not in capsys.readouterr().out
+
+
+def test_fabric_keys_match_producers():
+    """Producer↔report key parity for the peer fabric v2 section (ISSUE
+    20, the decode/stall/.../pushdown pattern): every compare_rounds
+    fabric column must be a key the dist arm emits (single-sourced in
+    strom.dist.peers.DIST_BENCH_FIELDS) — a rename on either side is a
+    silently dead column."""
+    from strom.dist.peers import DIST_BENCH_FIELDS
+
+    produced = set(DIST_BENCH_FIELDS)
+    for key in compare_rounds.FABRIC_KEYS:
+        assert key in produced, \
+            f"compare_rounds consumes {key!r} but the dist arm " \
+            f"produces no such key (renamed column?)"
+
+
+def test_fabric_section_renders(tmp_path, capsys):
+    """A round carrying the batched-transport A/B keys gets the peer
+    fabric v2 section."""
+    d = dict(NEW_ROUND)
+    d.update({"dist_batch_vs_single": 1.42,
+              "dist_unbatched_items_per_s": 911.5,
+              "peer_rtt_per_extent_us": 183.2,
+              "peer_frame_hit_bytes": 602112,
+              "peer_conn_reuse_ratio": 0.9167})
+    p = tmp_path / "BENCH_r20.json"
+    p.write_text(json.dumps(d))
+    assert compare_rounds.main([str(p)]) == 0
+    out = capsys.readouterr().out
+    assert "peer fabric v2" in out
+    assert "dist_batch_vs_single" in out
+    assert "peer_conn_reuse_ratio" in out
+    assert "1.42" in out
+
+
+def test_fabric_section_hidden_without_keys(tmp_path, capsys):
+    p = tmp_path / "BENCH_r03.json"
+    p.write_text(json.dumps(OLD_ROUND))
+    assert compare_rounds.main([str(p)]) == 0
+    assert "peer fabric v2" not in capsys.readouterr().out

@@ -533,8 +533,10 @@ def test_measure_ingest_fields(tmp_path):
     assert res["dist_peer_hit_ratio"] > 0
     assert res["dist_engine_ingest_bytes"] == 0
     # every DIST_BENCH_FIELDS column the arm copies is either produced
-    # here or derived by the arm itself (single-pass comparison keys)
-    arm_derived = {"dist_single_items_per_s", "dist_vs_single"}
+    # here or derived by the arm itself (single-pass comparison keys +
+    # the fabric v2 batched-vs-unbatched A/B, ISSUE 20)
+    arm_derived = {"dist_single_items_per_s", "dist_vs_single",
+                   "dist_batch_vs_single", "dist_unbatched_items_per_s"}
     for k in DIST_BENCH_FIELDS:
         assert k in res or k in arm_derived, k
 

@@ -1,0 +1,587 @@
+"""Peer fabric v2 (ISSUE 20): batched pipelined transport, consistent-hash
+directory with re-ownership, decoded-frame serving, conn pool + auth.
+
+Covers the acceptance invariants directly:
+
+- a gather's worth of peer misses rides ONE round trip (client
+  ``peer_batches``/server ``peer_batch_serves`` accounting) with bytes
+  bit-identical to the unbatched v1 wire, including the pipelined
+  multi-chunk path,
+- the per-peer downgrade latch against an old-protocol peer: the batch
+  attempt fails once, the traced attempt fails once, then every fetch
+  rides the raw v1 op — correct bytes throughout, never fatal,
+- persistent conn pool: dials amortised across fetches
+  (``peer_conn_reuse_ratio``), stale pooled conns re-probed after a peer
+  restart,
+- shared-key auth: wrong/missing key is a clean counted refusal
+  (``peer_auth_rejects``) with engine fallback; matching keys serve;
+  a keyless server tolerates a keyed client (mixed-config rollout),
+- HashRing determinism (membership-order independent) + minimal movement
+  (only the dead member's keys move), ExtentDirectory death publish/poll
+  epochs through a shared rendezvous dir,
+- the kill-a-host story end to end: breaker trip publishes the death,
+  the skip window keeps probes cheap (``peer_skips``), the poll re-owns
+  the keys (epoch bump) and the fetch recovers off the survivor —
+  then the full subprocess fleet: survivors bit-identical to the
+  single-process oracle with the victim gone mid-run,
+- decoded-frame serving: one host's DecodedCache answers a peer's
+  ``fetch_frame`` with crop-ready RGB (zero decodes on the asker),
+  fingerprint-split, miss-counted,
+- the Autotuner knobs over the live tier (batch size + pool depth)
+  profile round-trip.
+"""
+
+import os
+import socket
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from strom.config import StromConfig
+from strom.delivery.core import StromContext
+from strom.dist.directory import ExtentDirectory, HashRing
+from strom.dist.launch import measure_ingest
+from strom.dist.peers import (PeerProtocolError, PeerTier,
+                              decode_batch_request, decode_request,
+                              encode_batch_request, recv_frame, send_frame,
+                              ST_HIT)
+
+
+def _cfg(**kw):
+    base = dict(engine="python", queue_depth=8, num_buffers=8,
+                hot_cache_bytes=64 << 20, hot_cache_admit="always")
+    base.update(kw)
+    return StromConfig(**base)
+
+
+def _fixture(tmp_path, name="data.bin", n=256 * 1024, seed=0):
+    p = str(tmp_path / name)
+    payload = np.random.default_rng(seed).integers(0, 255, n, dtype=np.uint8)
+    payload.tofile(p)
+    return p, payload
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+# -- batch frame codec units -------------------------------------------------
+
+def test_batch_request_roundtrip():
+    keys = [("/a.bin", 0, 4096), ("/b.bin", 4096, 8192, "rgb8/turbo")]
+    raw = encode_batch_request(keys, trace=(7, 9, 1.5, "read"),
+                               codec="lz4")
+    got, trace, codec = decode_batch_request(raw)
+    assert [(k[1], k[2], k[3], k[4]) for k in got] == \
+        [("/a.bin", 0, 4096, None), ("/b.bin", 4096, 8192, "rgb8/turbo")]
+    assert got[0][0] == 0 and got[1][0] == 1  # extent vs frame kind
+    assert trace["req"] == 7 and trace["flow"] == 9
+    assert codec == "lz4"
+
+
+def test_batch_request_rejects_garbage():
+    with pytest.raises(PeerProtocolError):
+        decode_batch_request(b"\x05\x00")
+    with pytest.raises(PeerProtocolError):
+        decode_batch_request(encode_batch_request([("p", 0, 8)]) + b"x")
+    with pytest.raises(ValueError):
+        encode_batch_request([])
+
+
+# -- batched transport: one RTT per gather, bit-identical --------------------
+
+def test_batched_fetch_many_single_rtt_bit_identical(tmp_path):
+    """Six peer misses ride ONE batch round trip (client counts 1 batch /
+    6 extents, server counts 1 batch serve / 6 item serves) and the bytes
+    match an unbatched tier's fetch of the same ranges byte for byte."""
+    p, payload = _fixture(tmp_path)
+    A = StromContext(_cfg())
+    B = StromContext(_cfg())
+    U = StromContext(_cfg(dist_batch_max_extents=0))  # v1 wire
+    try:
+        addr = A.serve_peers()
+        A.pread(p, 0, payload.nbytes)
+        B.attach_peers({0: addr}, owner_fn=lambda path: 0)
+        U.attach_peers({0: addr}, owner_fn=lambda path: 0)
+        ranges = [(p, i * 4096, (i + 1) * 4096) for i in range(6)]
+
+        batched = B.peer_tier.fetch_many(ranges)
+        unbatched = U.peer_tier.fetch_many(ranges)
+        for (path, lo, hi), bv, uv in zip(ranges, batched, unbatched):
+            assert bytes(bv) == payload[lo:hi].tobytes()
+            assert bytes(bv) == bytes(uv)
+
+        bst = B.peer_tier.stats()
+        assert bst["peer_batches"] == 1
+        assert bst["peer_batch_extents"] == 6
+        assert bst["peer_hits"] == 6
+        assert bst["peer_hit_bytes"] == 6 * 4096
+        assert bst["peer_rtt_per_extent_us"] > 0
+        ust = U.peer_tier.stats()
+        assert ust["peer_batches"] == 0
+        assert ust["peer_hits"] == 6
+        sst = A.peer_server.stats()
+        assert sst["peer_batch_serves"] == 1
+    finally:
+        A.close()
+        B.close()
+        U.close()
+
+
+def test_pipelined_chunks_bit_identical(tmp_path):
+    """batch_max_extents=2 over 8 ranges = 4 pipelined chunks on one
+    conn (chunk k+1's request is in flight while chunk k drains) — same
+    bytes, batch accounting reflects the chunking."""
+    p, payload = _fixture(tmp_path)
+    A, B = StromContext(_cfg(dist_batch_max_extents=2)), None
+    B = StromContext(_cfg(dist_batch_max_extents=2))
+    try:
+        addr = A.serve_peers()
+        A.pread(p, 0, payload.nbytes)
+        B.attach_peers({0: addr}, owner_fn=lambda path: 0)
+        ranges = [(p, i * 8192, i * 8192 + 4096) for i in range(8)]
+        got = B.peer_tier.fetch_many(ranges)
+        for (path, lo, hi), d in zip(ranges, got):
+            assert bytes(d) == payload[lo:hi].tobytes()
+        st = B.peer_tier.stats()
+        assert st["peer_batches"] == 4
+        assert st["peer_batch_extents"] == 8
+    finally:
+        A.close()
+        B.close()
+
+
+def test_batch_mixes_hits_and_misses(tmp_path):
+    """A batch whose tail ranges the owner never warmed answers per-item
+    hit/miss — misses fall to the asker's engine via the consult, hits
+    skip it."""
+    p, payload = _fixture(tmp_path)
+    A, B = StromContext(_cfg()), StromContext(_cfg())
+    try:
+        addr = A.serve_peers()
+        A.pread(p, 0, 16 * 1024)  # only the head is hot on the owner
+        B.attach_peers({0: addr}, owner_fn=lambda path: 0)
+        ranges = [(p, 0, 4096), (p, 4096, 8192),
+                  (p, 128 * 1024, 132 * 1024)]
+        got = B.peer_tier.fetch_many(ranges)
+        assert bytes(got[0]) == payload[:4096].tobytes()
+        assert bytes(got[1]) == payload[4096:8192].tobytes()
+        assert got[2] is None
+        st = B.peer_tier.stats()
+        assert st["peer_hits"] == 2 and st["peer_misses"] == 1
+    finally:
+        A.close()
+        B.close()
+
+
+# -- downgrade ladder vs an old-protocol peer --------------------------------
+
+def test_old_proto_peer_downgrades_batch_then_trace_then_raw(tmp_path):
+    """A stub peer speaking ONLY the raw v1 ``OP_GET`` (closes the conn on
+    any op it can't parse — exactly what the pre-batch server did): the
+    first gather burns one error latching batch_ok=False, the first
+    single fetch burns one latching trace_ok=False, and everything after
+    rides plain OP_GET with correct bytes."""
+    p, payload = _fixture(tmp_path)
+
+    lsock = socket.socket()
+    lsock.bind(("127.0.0.1", 0))
+    lsock.listen(8)
+    addr = f"127.0.0.1:{lsock.getsockname()[1]}"
+    stop = threading.Event()
+
+    def v1_only():
+        while not stop.is_set():
+            try:
+                conn, _ = lsock.accept()
+            except OSError:
+                return
+            with conn:
+                while True:
+                    try:
+                        frame = recv_frame(conn)
+                        path, lo, hi = decode_request(frame)
+                        send_frame(conn, bytes([ST_HIT])
+                                   + payload[lo:hi].tobytes())
+                    except (OSError, PeerProtocolError, ValueError):
+                        break  # unknown op/hangup: slam the conn, v1-style
+
+    t = threading.Thread(target=v1_only, name="test-v1-peer", daemon=True)
+    t.start()
+    tier = PeerTier({0: addr}, owner_fn=lambda path: 0, timeout_s=2.0,
+                    breaker_kwargs=dict(min_events=100))
+    try:
+        ranges = [(p, i * 4096, (i + 1) * 4096) for i in range(4)]
+        first = tier.fetch_many(ranges)
+        # the batch attempt died (error 1, batch latch), item 0's traced
+        # fallback died (error 2, trace latch), items 1..3 landed raw
+        info = next(iter(tier.peers_info().values()))
+        assert info["batch_ok"] is False
+        assert info["trace_ok"] is False
+        assert first[0] is None
+        for (path, lo, hi), d in zip(ranges[1:], first[1:]):
+            assert bytes(d) == payload[lo:hi].tobytes()
+        assert tier.stats()["peer_errors"] == 2
+
+        # fully downgraded: every later gather is raw per-extent, no new
+        # errors, no batch attempted
+        second = tier.fetch_many(ranges)
+        for (path, lo, hi), d in zip(ranges, second):
+            assert bytes(d) == payload[lo:hi].tobytes()
+        st = tier.stats()
+        assert st["peer_errors"] == 2
+        assert st["peer_batches"] == 0
+    finally:
+        stop.set()
+        lsock.close()
+        tier.close()
+        t.join(timeout=5)
+
+
+# -- conn pool ---------------------------------------------------------------
+
+def test_conn_pool_reuse_and_restart_reprobe(tmp_path):
+    """Sequential fetches ride ONE pooled conn (reuse ratio climbs); a
+    peer restart leaves a stale pooled sock that costs one counted error,
+    is discarded, and the next fetch re-dials clean."""
+    p, payload = _fixture(tmp_path)
+    port = _free_port()
+    A = StromContext(_cfg())
+    B = StromContext(_cfg())
+    try:
+        A.serve_peers(port=port)
+        A.pread(p, 0, payload.nbytes)
+        B.attach_peers({0: f"127.0.0.1:{port}"}, owner_fn=lambda path: 0)
+        for i in range(4):
+            got = B.peer_tier.fetch(p, i * 4096, (i + 1) * 4096)
+            assert bytes(got) == payload[i * 4096:(i + 1) * 4096].tobytes()
+        st = B.peer_tier.stats()
+        assert st["peer_conn_opens"] == 1
+        assert st["peer_conn_reuses"] == 3
+        assert st["peer_conn_reuse_ratio"] == 0.75
+        info = next(iter(B.peer_tier.peers_info().values()))
+        assert info["pooled_conns"] == 1
+
+        # restart the peer on the same address (the old listener may take
+        # a beat to release the port after close — retry the bind)
+        A.close()
+        A2 = None
+        for _ in range(40):
+            try:
+                A2 = StromContext(_cfg())
+                A2.serve_peers(port=port)
+                break
+            except OSError:
+                A2.close()
+                A2 = None
+                time.sleep(0.05)
+        assert A2 is not None, "peer restart could not rebind its port"
+        try:
+            A2.pread(p, 0, payload.nbytes)
+            # the pooled conn is dead: at most a couple of probe fetches
+            # burn it off, then service resumes on a fresh dial
+            got = None
+            for _ in range(3):
+                got = B.peer_tier.fetch(p, 0, 4096)
+                if got is not None:
+                    break
+            assert bytes(got) == payload[:4096].tobytes()
+            assert B.peer_tier.stats()["peer_conn_opens"] >= 2
+        finally:
+            A2.close()
+    finally:
+        B.close()
+        A.close()
+
+
+# -- shared-key auth ---------------------------------------------------------
+
+def test_auth_missing_or_wrong_key_cleanly_refused(tmp_path):
+    p, payload = _fixture(tmp_path)
+    A = StromContext(_cfg(dist_auth_key="sekrit"))
+    Bnone = StromContext(_cfg())
+    Bwrong = StromContext(_cfg(dist_auth_key="wrong"))
+    try:
+        addr = A.serve_peers()
+        A.pread(p, 0, payload.nbytes)
+        for B in (Bnone, Bwrong):
+            B.attach_peers({0: addr}, owner_fn=lambda path: 0)
+            # the consult degrades to the engine: bytes stay correct
+            got = B.pread(p, 0, 4096)
+            assert bytes(got) == payload[:4096].tobytes()
+            assert B.peer_tier.stats()["peer_hits"] == 0
+            assert B.peer_tier.stats()["peer_errors"] >= 1
+        assert A.peer_server.stats()["peer_auth_rejects"] >= 2
+    finally:
+        A.close()
+        Bnone.close()
+        Bwrong.close()
+
+
+def test_auth_matching_key_serves(tmp_path):
+    p, payload = _fixture(tmp_path)
+    A = StromContext(_cfg(dist_auth_key="sekrit"))
+    B = StromContext(_cfg(dist_auth_key="sekrit"))
+    try:
+        addr = A.serve_peers()
+        A.pread(p, 0, payload.nbytes)
+        B.attach_peers({0: addr}, owner_fn=lambda path: 0)
+        got = B.peer_tier.fetch_many([(p, 0, 4096), (p, 4096, 8192)])
+        assert bytes(got[0]) == payload[:4096].tobytes()
+        assert bytes(got[1]) == payload[4096:8192].tobytes()
+        assert A.peer_server.stats()["peer_auth_rejects"] == 0
+        # the handshake rode the same pooled conn the batch then used
+        assert B.peer_tier.stats()["peer_conn_opens"] == 1
+    finally:
+        A.close()
+        B.close()
+
+
+def test_keyless_server_tolerates_keyed_client(tmp_path):
+    """Mixed-config rollout: a server without a key answers the auth
+    handshake permissively so a keyed client keeps fetching."""
+    p, payload = _fixture(tmp_path)
+    A = StromContext(_cfg())
+    B = StromContext(_cfg(dist_auth_key="sekrit"))
+    try:
+        addr = A.serve_peers()
+        A.pread(p, 0, payload.nbytes)
+        B.attach_peers({0: addr}, owner_fn=lambda path: 0)
+        got = B.peer_tier.fetch(p, 0, 4096)
+        assert bytes(got) == payload[:4096].tobytes()
+    finally:
+        A.close()
+        B.close()
+
+
+# -- hash ring + extent directory --------------------------------------------
+
+def test_hash_ring_deterministic_and_minimal_movement():
+    members = list(range(4))
+    r1 = HashRing(members)
+    r2 = HashRing(list(reversed(members)))  # membership ORDER is identity
+    keys = [f"shard{i}.bin" for i in range(500)]
+    assert [r1.owner(k) for k in keys] == [r2.owner(k) for k in keys]
+    # killing one member moves EXACTLY its keys, nobody else's
+    survivors = HashRing([m for m in members if m != 2])
+    moved = owned = 0
+    for k in keys:
+        if r1.owner(k) == 2:
+            owned += 1
+        if r1.owner(k) != survivors.owner(k):
+            moved += 1
+            assert r1.owner(k) == 2, f"{k} moved off a LIVE owner"
+    assert owned > 0 and moved == owned
+
+
+def test_directory_death_publish_poll_epochs(tmp_path):
+    """Two directories sharing a rendezvous dir: one publishes a death,
+    the other's poll applies it (epoch bump, owner excluded); mark_alive
+    restores the member and bumps again."""
+    d1 = ExtentDirectory(["a", "b", "c"], "a", rendezvous_dir=str(tmp_path))
+    d2 = ExtentDirectory(["a", "b", "c"], "b", rendezvous_dir=str(tmp_path))
+    assert d1.epoch == 0 and sorted(d1.live) == ["a", "b", "c"]
+    d1.mark_dead("c")
+    assert os.path.exists(str(tmp_path / "ring_dead_c"))
+    assert d2.poll() is True
+    assert d2.epoch == 1
+    assert "c" not in d2.live
+    # both sides converge to the identical post-death ring
+    assert d1.poll() is True
+    for k in ("x.bin", "y.bin", "z.bin"):
+        assert d1.ring_owner(k) == d2.ring_owner(k)
+        assert d2.ring_owner(k) != "c"
+    d2.mark_alive("c")
+    assert d1.poll() is True
+    assert d1.epoch == 2 and "c" in d1.live
+
+
+def test_reownership_skip_window_then_recovery(tmp_path):
+    """The kill-a-host mechanics, deterministically: errors trip the
+    breaker, the trip publishes the death (NOT yet applied), the skip
+    window keeps probes cheap, the poll re-owns the key, and the fetch
+    recovers off the survivor — bit-identical bytes."""
+    # f4.bin: owned by "D" in the full ring, re-owned to "A" (not "me")
+    # once D dies — computed from the deterministic ring, pinned here
+    p, payload = _fixture(tmp_path, name="f4.bin")
+    directory = ExtentDirectory(["me", "A", "D"], "me",
+                                rendezvous_dir=str(tmp_path),
+                                poll_interval_s=3600.0)
+    assert directory.ring_owner(p) == "D"
+    A = StromContext(_cfg())
+    tier = None
+    try:
+        addr = A.serve_peers()
+        A.pread(p, 0, payload.nbytes)
+        tier = PeerTier({"A": addr, "D": f"127.0.0.1:{_free_port()}"},
+                        directory=directory, timeout_s=0.5,
+                        breaker_kwargs=dict(min_events=2, cooldown_s=3600))
+        for _ in range(2):  # dead owner: counted errors, trip on the 2nd
+            assert tier.fetch(p, 0, 4096) is None
+        st = tier.stats()
+        assert st["peer_errors"] == 2 and st["peer_breaker_trips"] == 1
+        assert os.path.exists(str(tmp_path / "ring_dead_D"))
+        assert directory.epoch == 0  # published, not yet applied
+
+        # skip window: the breaker short-circuits, no new dials
+        assert tier.fetch(p, 0, 4096) is None
+        assert tier.stats()["peer_skips"] == 1
+
+        # the poll applies the death: epoch bump, keys re-owned
+        assert directory.poll() is True
+        assert directory.owner(p) == "A"
+        got = tier.fetch(p, 0, 4096)
+        assert bytes(got) == payload[:4096].tobytes()
+        st = tier.stats()
+        assert st["peer_hits"] == 1
+        assert st["peer_ring_epoch"] == 1
+    finally:
+        if tier is not None:
+            tier.close()
+        A.close()
+
+
+def test_kill_one_host_mid_run_survivors_bit_identical(tmp_path):
+    """The full fleet acceptance: rank 1 (owner of most fixture bytes at
+    nproc=3) dies uncleanly after step 1; the survivors complete every
+    step bit-identical to the single-process oracle, counting errors on
+    the dead peer and re-owning its keys (ring epoch bump)."""
+    res = measure_ingest(3, str(tmp_path), steps=8, batch=6, seq_len=16,
+                         die_rank=1, die_after_step=1)
+    workers = res["workers"]
+    assert res["dist_ok"] == 1, workers
+    assert workers[1]["rc"] == 17  # the victim vanished, as armed
+    survivors = [workers[0], workers[2]]
+    assert all(w["ok"] == 1 for w in survivors)
+    # the death was felt: failed dials and/or breaker-skip probes
+    assert sum(w.get("peer_errors", 0) + w.get("peer_skips", 0)
+               for w in survivors) > 0
+    # ...tripped a survivor's breaker, which PUBLISHED the death marker
+    # to the rendezvous dir for fleet-wide re-ownership (whether a given
+    # survivor's throttled poll APPLIES it before its last fetch is
+    # timing-dependent — the marker is the deterministic evidence)
+    assert max(w.get("peer_breaker_trips", 0) for w in survivors) >= 1
+    assert os.path.exists(str(tmp_path / "run3" / "ring_dead_1"))
+    # recovery is real work, not a stall: every survivor rated > 0
+    assert all(w["items_per_s"] > 0 for w in survivors)
+
+
+# -- decoded-frame serving ---------------------------------------------------
+
+def test_decoded_frame_served_cluster_wide(tmp_path):
+    """A frame decoded ONCE on the owner answers a peer's fetch_frame as
+    crop-ready RGB — the asker runs zero decode machinery; fingerprint
+    mismatches and absent frames answer miss."""
+    from strom.formats.decoded_cache import DecodedCache
+
+    p, _ = _fixture(tmp_path, name="shots.jpgpack")
+    A, B = StromContext(_cfg()), StromContext(_cfg())
+    try:
+        addr = A.serve_peers()
+        dcache = DecodedCache(A.hot_cache, fingerprint="rgb8/turbo")
+        img = np.random.default_rng(3).integers(
+            0, 255, (8, 6, 3), dtype=np.uint8)
+        ckey = dcache.key(p, 100, 900)
+        assert dcache.offer(ckey, img) > 0
+        A.attach_decoded_cache(dcache)
+
+        B.attach_peers({0: addr}, owner_fn=lambda path: 0)
+        got = B.peer_tier.fetch_frame(p, 100, 900, "rgb8/turbo")
+        assert got is not None and got.shape == (8, 6, 3)
+        assert np.array_equal(got, img)
+        # the consult-facing wrapper rides the same wire by ckey
+        got2 = B.peer_decoded_fetch(("jpegdec", p, 100, 900, "rgb8/turbo"))
+        assert np.array_equal(got2, img)
+
+        # wrong fingerprint / unknown member: clean misses
+        assert B.peer_tier.fetch_frame(p, 100, 900, "rgb8/cv2") is None
+        assert B.peer_tier.fetch_frame(p, 0, 50, "rgb8/turbo") is None
+
+        bst = B.peer_tier.stats()
+        assert bst["peer_frame_hits"] == 2
+        assert bst["peer_frame_hit_bytes"] == 2 * img.nbytes
+        assert bst["peer_frame_misses"] == 2
+        sst = A.peer_server.stats()
+        assert sst["peer_frame_serves"] == 2
+        assert sst["peer_frame_served_bytes"] == 2 * img.nbytes
+        assert sst["peer_frame_serve_misses"] == 2
+        # frame traffic never pollutes the extent byte ledgers
+        assert bst["peer_hit_bytes"] == 0
+        assert sst["peer_served_bytes"] == 0
+    finally:
+        A.close()
+        B.close()
+
+
+def test_decoded_export_copies_out(tmp_path):
+    """export() hands back an owned bytes copy (the server writes it to a
+    socket long after any pin window) and refuses fingerprint drift."""
+    from strom.formats.decoded_cache import DecodedCache
+
+    ctx = StromContext(_cfg())
+    try:
+        dc = DecodedCache(ctx.hot_cache, fingerprint="rgb8/x")
+        img = np.arange(4 * 5 * 3, dtype=np.uint8).reshape(4, 5, 3)
+        dc.offer(dc.key("/s", 0, 64), img)
+        got = dc.export("/s", 0, 64)
+        assert got is not None
+        h, w, raw = got
+        assert (h, w) == (4, 5) and isinstance(raw, bytes)
+        assert raw == img.tobytes()
+        assert dc.export("/s", 0, 64, fingerprint="rgb8/other") is None
+        assert dc.export("/nope", 0, 64) is None
+    finally:
+        ctx.close()
+
+
+# -- autotuner knobs ---------------------------------------------------------
+
+def test_peer_tier_knobs_profile_round_trip(tmp_path):
+    from strom.tune import Autotuner, Profile
+    from strom.tune.knobs import standard_knobs
+
+    p, payload = _fixture(tmp_path)
+    A, B = StromContext(_cfg()), StromContext(_cfg())
+    try:
+        addr = A.serve_peers()
+        A.pread(p, 0, payload.nbytes)
+        B.attach_peers({0: addr}, owner_fn=lambda path: 0)
+        knobs = {k.name: k for k in standard_knobs(B)}
+        assert "dist_batch_max_extents" in knobs
+        assert "dist_conn_pool_size" in knobs
+        knobs["dist_batch_max_extents"].set(32.0)
+        knobs["dist_conn_pool_size"].set(4.0)
+        assert B.peer_tier.batch_max_extents == 32
+        assert B.peer_tier.conn_pool_size == 4
+
+        # profile round trip: persisted knobs restart the tier where the
+        # search converged, clamped onto the live bounds
+        tuner = Autotuner([knobs["dist_batch_max_extents"],
+                           knobs["dist_conn_pool_size"]],
+                          lambda: {"objective": 1.0})
+        path = str(tmp_path / "profile.json")
+        tuner.profile().save(path)
+        knobs["dist_batch_max_extents"].set(64.0)
+        knobs["dist_conn_pool_size"].set(1.0)
+        applied = tuner.apply_profile(Profile.load(path))
+        assert applied == 2
+        assert B.peer_tier.batch_max_extents == 32
+        assert B.peer_tier.conn_pool_size == 4
+        # clamp floor: 0 would turn the wire off — the tuner can't
+        Profile("arm", {"dist_batch_max_extents": 0.0,
+                        "dist_conn_pool_size": 0.0}).save(path)
+        tuner.apply_profile(Profile.load(path))
+        assert B.peer_tier.batch_max_extents == 1
+        assert B.peer_tier.conn_pool_size == 1
+        # the knobs steer live transport, not a snapshot: fetches still
+        # serve bit-identical after the moves
+        got = B.peer_tier.fetch_many([(p, 0, 4096), (p, 4096, 8192)])
+        assert bytes(got[0]) == payload[:4096].tobytes()
+        assert bytes(got[1]) == payload[4096:8192].tobytes()
+    finally:
+        A.close()
+        B.close()

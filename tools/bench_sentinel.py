@@ -47,9 +47,10 @@ _HERE = os.path.dirname(os.path.abspath(__file__))
 sys.path.insert(0, _HERE)  # runnable as a script from anywhere
 
 from compare_rounds import (BINDING_ORDER, CACHE_KEYS, CLUSTER_KEYS,  # noqa: E402
-                            DECODE2_KEYS, DECODE_KEYS, DIST_KEYS, RESIL_KEYS,
-                            RESUME_KEYS, SLO_KEYS, STALL_KEYS, STREAM_KEYS,
-                            TUNE_KEYS, WRITE_KEYS, unwrap)
+                            DECODE2_KEYS, DECODE_KEYS, DIST_KEYS,
+                            FABRIC_KEYS, RESIL_KEYS, RESUME_KEYS, SLO_KEYS,
+                            STALL_KEYS, STREAM_KEYS, TUNE_KEYS, WRITE_KEYS,
+                            unwrap)
 
 # The gated metric set: (metric, direction) over the single-sourced
 # comparison tuples, where direction is "up" (bigger is better) or "down"
@@ -156,6 +157,12 @@ SENTINEL_FIELDS = (
     ("pushdown_ok", "up"),
     ("parquet_pushdown_skipped_bytes", "up"),
     ("peer_comp_ratio", "up"),
+    # peer fabric v2 (ISSUE 20): batched-vs-unbatched transport rate over
+    # the same seeded fleet — a same-run interleaved A/B ratio
+    # (weather-independent; a drop toward 1.0 means the batch wire
+    # stopped amortising round trips, not noise). dist_ok above keeps
+    # gating bit-identity for the batched pass itself.
+    ("dist_batch_vs_single", "up"),
 )
 
 # metrics where ANY nonzero value in the newest valid round fails the
@@ -177,7 +184,7 @@ RATIO_DOWN = frozenset({"chaos_slowdown", "ckpt_async_stall_frac"})
 TABLE_KEYS = list(dict.fromkeys(
     BINDING_ORDER + DECODE_KEYS + DECODE2_KEYS + STALL_KEYS + CACHE_KEYS
     + STREAM_KEYS + SLO_KEYS + RESIL_KEYS + WRITE_KEYS + RESUME_KEYS
-    + DIST_KEYS + CLUSTER_KEYS + TUNE_KEYS))
+    + DIST_KEYS + CLUSTER_KEYS + TUNE_KEYS + FABRIC_KEYS))
 
 
 def load_round(path: str) -> dict:

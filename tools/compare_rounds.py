@@ -355,6 +355,21 @@ PUSHDOWN_KEYS = [
     "dist_peer_comp_vs_raw",
     "peer_comp_ratio",
 ]
+# peer fabric v2 (ISSUE 20): the dist arm's batched-vs-unbatched transport
+# A/B (dist_batch_vs_single > 1 = riding a gather's worth of peer misses
+# on one round trip bought real rate at bit-identical batches), the
+# per-extent round-trip cost it amortises, decoded-frame bytes served
+# cluster-wide, and how well the persistent conn pool replaced per-fetch
+# dials. Suffixes single-sourced in strom.dist.peers.DIST_BENCH_FIELDS
+# (parity-tested in tests/test_compare_rounds.py, same contract as the
+# other sections).
+FABRIC_KEYS = [
+    "dist_batch_vs_single",
+    "dist_unbatched_items_per_s",
+    "peer_rtt_per_extent_us",
+    "peer_frame_hit_bytes",
+    "peer_conn_reuse_ratio",
+]
 # per-attempt / per-pass audit arrays (VERDICT.md r4 next #3): printed so
 # the best-of selection's discards are visible in the comparison too
 AUDIT_SUFFIXES = ("_attempts", "_passes")
@@ -507,11 +522,13 @@ def main(argv: list[str]) -> int:
                     for k in TUNE_KEYS)
     have_pushdown = any(cell(d, k) != "-" for _, d in rounds
                         for k in PUSHDOWN_KEYS)
+    have_fabric = any(cell(d, k) != "-" for _, d in rounds
+                      for k in FABRIC_KEYS)
     name_w = max(len(k) for k in binding_keys + CONTEXT_KEYS + DECODE_KEYS
                  + DECODE2_KEYS + STALL_KEYS + CACHE_KEYS + STREAM_KEYS
                  + SCHED_KEYS + SLO_KEYS + RESIL_KEYS + WRITE_KEYS
                  + RESUME_KEYS + DIST_KEYS + CLUSTER_KEYS + TUNE_KEYS
-                 + PUSHDOWN_KEYS + audit_keys) + 2
+                 + PUSHDOWN_KEYS + FABRIC_KEYS + audit_keys) + 2
     # every rendered cell folds into ONE column width, or rows misalign
     col_w = max(max(len(n) for n, _ in rounds) + 2, 12,
                 *(len(c) + 2 for cs in audit_cells.values() for c in cs),
@@ -619,6 +636,13 @@ def main(argv: list[str]) -> int:
               "compressed-vs-raw peer wire: pushdown_ok=1 = identical "
               "aggregates, refuted groups never submitted):")
         for k in PUSHDOWN_KEYS:
+            print(k.ljust(name_w)
+                  + "".join(cell(d, k).rjust(col_w) for _, d in rounds))
+    if have_fabric:
+        print("peer fabric v2 (batched-vs-unbatched transport A/B at "
+              "bit-identical batches; rtt/extent = amortised round-trip "
+              "cost; conn_reuse = pooled dials avoided):")
+        for k in FABRIC_KEYS:
             print(k.ljust(name_w)
                   + "".join(cell(d, k).rjust(col_w) for _, d in rounds))
     if audit_keys:

@@ -76,6 +76,11 @@ LOCK_RANKS = {
     # -- band: slab pool -----------------------------------------------------
     "slab.pool": 50,
     # -- band: hot cache -----------------------------------------------------
+    "dist.directory": 55,      # ExtentDirectory dead-set/ring/epoch swap
+                               # (ISSUE 20): a leaf — listdir and marker
+                               # writes happen outside it, and the tier
+                               # releases dist.peer before mark_dead so
+                               # the two never nest
     "dist.peer": 56,           # PeerTier conn-pool checkout (ISSUE 15):
                                # NEVER held across socket I/O — the fetch
                                # checks a connection out, releases, does
